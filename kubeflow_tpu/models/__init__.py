@@ -1,0 +1,14 @@
+"""Model families shipped in the platform's notebook images.
+
+The reference platform ships model-less CUDA images and leaves all modelling
+to user notebooks (see SURVEY.md §2.10/§2.13; reference
+``components/example-notebook-servers/``).  The TPU rebuild instead bundles a
+small, idiomatic JAX model zoo covering the baseline configs in
+BASELINE.json: ResNet50 (images/sec/chip headline), ViT-B/16, BERT-base, and
+a Llama-style decoder for the multi-host pjit config.
+"""
+
+from kubeflow_tpu.models import registry
+from kubeflow_tpu.models.registry import create_model, list_models, register_model
+
+__all__ = ["create_model", "list_models", "register_model", "registry"]

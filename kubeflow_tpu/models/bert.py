@@ -1,0 +1,109 @@
+"""BERT encoder in Flax — BASELINE.json config 3
+("jupyter-pytorch-full -> PyTorch/XLA notebook, BERT-base fine-tune").
+
+The TPU rebuild's notebook images carry the JAX stack as the first-class
+path, so the BERT fine-tune config is served natively by this module (a
+PyTorch/XLA image recipe still exists for parity — see
+kubeflow_tpu/platform/images/).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.layers import Attention, Mlp
+from kubeflow_tpu.models.registry import register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    num_classes: int = 2  # sequence-classification head (fine-tune config)
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+
+
+CONFIGS = {
+    "bert_debug": BertConfig(vocab_size=128, dim=32, n_layers=2, n_heads=2,
+                             mlp_dim=64, max_seq_len=64, dtype=jnp.float32),
+    "bert_base": BertConfig(),
+    "bert_large": BertConfig(dim=1024, n_layers=24, n_heads=16, mlp_dim=4096),
+}
+
+
+class BertEncoderBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, *, mask_bias, train: bool):
+        cfg = self.cfg
+        h = Attention(num_heads=cfg.n_heads, dtype=cfg.dtype, name="attn")(
+            x, mask_bias=mask_bias
+        )
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="norm1")(x + h)
+        h = Mlp(hidden_dim=cfg.mlp_dim, dtype=cfg.dtype, name="mlp")(x)
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        return nn.LayerNorm(dtype=cfg.dtype, name="norm2")(x + h)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens,
+        *,
+        attention_mask: Optional[jnp.ndarray] = None,
+        token_type_ids: Optional[jnp.ndarray] = None,
+        train: bool = True,
+    ):
+        cfg = self.cfg
+        b, s = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, name="pos_embed")(
+            jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        )
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(tokens)
+        typ = nn.Embed(
+            cfg.type_vocab_size, cfg.dim, dtype=cfg.dtype, name="type_embed"
+        )(token_type_ids)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="embed_norm")(x + pos + typ)
+
+        mask_bias = None
+        if attention_mask is not None:
+            # [b, s] {0,1} -> additive [b, 1, 1, s] bias over key positions.
+            mask_bias = (1.0 - attention_mask[:, None, None, :]) * -1e30
+        for i in range(cfg.n_layers):
+            x = BertEncoderBlock(cfg, name=f"layer_{i}")(
+                x, mask_bias=mask_bias, train=train
+            )
+        pooled = nn.tanh(
+            nn.Dense(cfg.dim, dtype=jnp.float32, name="pooler")(x[:, 0])
+        )
+        logits = nn.Dense(cfg.num_classes, dtype=jnp.float32, name="classifier")(pooled)
+        return logits
+
+
+def _factory(name):
+    @register_model(name)
+    def make(**overrides):
+        return Bert(dataclasses.replace(CONFIGS[name], **overrides))
+
+    make.__name__ = name
+    return make
+
+
+for _n in CONFIGS:
+    _factory(_n)

@@ -1,0 +1,105 @@
+"""Shared transformer building blocks (Flax linen), routed through
+``kubeflow_tpu.ops`` so every model picks up the Pallas kernels."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu import ops
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(), (x.shape[-1],))
+        return ops.rms_norm(x, scale, eps=self.eps)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, *, theta: float = 10000.0):
+    """Rotary embeddings, BSHD input, pairing (x[..., :d/2], x[..., d/2:])."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.log(theta) * jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [b, s, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    """Multi-head / grouped-query attention over ops.dot_product_attention."""
+
+    num_heads: int
+    num_kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    rope: bool = False
+    rope_theta: float = 10000.0
+    causal: bool = False
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, *, positions=None, segment_ids=None, mask_bias=None):
+        b, s, dim = x.shape
+        kv_heads = self.num_kv_heads or self.num_heads
+        head_dim = self.head_dim or dim // self.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=self.dtype, name=name
+        )
+        q = dense((self.num_heads, head_dim), "q_proj")(x)
+        k = dense((kv_heads, head_dim), "k_proj")(x)
+        v = dense((kv_heads, head_dim), "v_proj")(x)
+        if self.rope:
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+            q = apply_rope(q, positions, theta=self.rope_theta)
+            k = apply_rope(k, positions, theta=self.rope_theta)
+        out = ops.dot_product_attention(
+            q,
+            k,
+            v,
+            causal=self.causal,
+            segment_ids=segment_ids,
+            bias=mask_bias,
+            impl=self.attn_impl,
+        )
+        out = nn.DenseGeneral(
+            dim, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="o_proj"
+        )(out)
+        return out
+
+
+class SwiGLU(nn.Module):
+    hidden_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        gate = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype, name="gate_proj")(x)
+        up = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype, name="up_proj")(x)
+        y = nn.silu(gate) * up
+        return nn.Dense(dim, use_bias=False, dtype=self.dtype, name="down_proj")(y)
+
+
+class Mlp(nn.Module):
+    """Classic GELU MLP (ViT/BERT)."""
+
+    hidden_dim: int
+    dtype: Any = jnp.bfloat16
+    act: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        y = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc1")(x)
+        y = self.act(y)
+        return nn.Dense(dim, dtype=self.dtype, name="fc2")(y)

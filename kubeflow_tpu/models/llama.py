@@ -1,0 +1,127 @@
+"""Llama-family decoder in Flax — the multi-host pjit flagship.
+
+BASELINE.json config 5 is "multi-host TPU slice notebook (v5e-16, JAX pjit
+Llama-2-7B)"; the reference platform only *schedules* such a notebook and
+ships no model (SURVEY.md §2.13).  Here the model itself is part of the
+stack, designed for SPMD from the start:
+
+* Pure functional forward; all sharding is applied externally by
+  ``kubeflow_tpu.parallel.sharding`` rules over the param pytree paths — the
+  model stays mesh-agnostic.
+* GQA + RoPE + RMSNorm + SwiGLU (Llama-2/3 shape); attention runs through
+  the Pallas flash kernel at long sequence.
+* Optional ``remat`` per layer (jax.checkpoint) to trade FLOPs for HBM.
+* Static shapes everywhere; the layer stack is a Python loop over identical
+  blocks, which XLA deduplicates into one compiled body per unique shape.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.layers import Attention, RMSNorm, SwiGLU
+from kubeflow_tpu.models.registry import register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Published Llama-2/3 shapes plus tiny/test scales.
+CONFIGS = {
+    "llama_debug": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=256, dtype=jnp.float32,
+    ),
+    "llama_125m": LlamaConfig(
+        vocab_size=32000, dim=768, n_layers=12, n_heads=12, n_kv_heads=12,
+        ffn_dim=2048,
+    ),
+    "llama2_7b": LlamaConfig(),
+    "llama2_13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                              ffn_dim=13824),
+    "llama3_8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
+                             n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                             rope_theta=500000.0, max_seq_len=8192),
+}
+
+
+class LlamaBlock(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids):
+        cfg = self.cfg
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(x)
+        h = Attention(
+            num_heads=cfg.n_heads,
+            num_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim,
+            rope=True,
+            rope_theta=cfg.rope_theta,
+            causal=True,
+            dtype=cfg.dtype,
+            attn_impl=cfg.attn_impl,
+            name="attn",
+        )(h, positions=positions, segment_ids=segment_ids)
+        x = x + h
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
+        h = SwiGLU(hidden_dim=cfg.ffn_dim, dtype=cfg.dtype, name="mlp")(h)
+        return x + h
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, positions=None, segment_ids=None):
+        cfg = self.cfg
+        b, s = tokens.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        x = nn.Embed(
+            cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="embed"
+        )(tokens)
+        block = LlamaBlock
+        if cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+        x = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
+        )(x)
+        return logits
+
+
+def _factory(name):
+    @register_model(name)
+    def make(**overrides):
+        cfg = dataclasses.replace(CONFIGS[name], **overrides)
+        return Llama(cfg)
+
+    make.__name__ = name
+    return make
+
+
+for _n in CONFIGS:
+    _factory(_n)

@@ -1,0 +1,34 @@
+"""Tiny model registry so notebooks / bench harnesses can spawn models by name."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+_REGISTRY: Dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    """Decorator: register a model factory under ``name``."""
+
+    def deco(fn: Callable[..., Any]):
+        if name in _REGISTRY:
+            raise ValueError(f"model {name!r} already registered")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def create_model(name: str, **kwargs) -> Any:
+    """Instantiate a registered model (a ``flax.linen.Module``)."""
+    # Import for registration side effects on first use.
+    from kubeflow_tpu.models import bert, llama, resnet, vit  # noqa: F401
+
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def list_models() -> list[str]:
+    from kubeflow_tpu.models import bert, llama, resnet, vit  # noqa: F401
+
+    return sorted(_REGISTRY)

@@ -1,0 +1,169 @@
+"""ResNet v1.5 in Flax, TPU-first.
+
+This is the headline-benchmark model (BASELINE.json: "in-notebook ResNet50
+images/sec/chip"; reference config 2 "jupyter-tensorflow-full single-device
+notebook (ResNet50 CIFAR)").  The reference platform has no model code at all
+(SURVEY.md §2.13) — it ships ResNet inside TF/CUDA notebook images
+(reference ``components/example-notebook-servers/jupyter-tensorflow/``).
+
+TPU-first choices:
+* bfloat16 compute / float32 params and batch stats — keeps the convolutions
+  on the MXU at full rate without loss-scale bookkeeping.
+* NHWC layout (XLA:TPU's native conv layout).
+* v1.5 downsampling (stride on the 3x3, not the 1x1) — better accuracy at
+  equal FLOPs, and identical MXU utilisation.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import register_model
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3(stride) -> 1x1 bottleneck with projection shortcut."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last norm's scale so each block starts as identity:
+        # standard large-batch trick; costs nothing on TPU.
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5.  ``stem='cifar'`` swaps the 7x7/maxpool stem for a 3x3."""
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    stem: str = "imagenet"  # or "cifar"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                     name="conv_init")(x)
+            x = norm(name="norm_init")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        else:  # cifar
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+            x = norm(name="norm_init")(x)
+            x = act(x)
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=act,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+_CONFIGS = {
+    "resnet18": dict(stage_sizes=[2, 2, 2, 2], block_cls=BasicBlock),
+    "resnet34": dict(stage_sizes=[3, 4, 6, 3], block_cls=BasicBlock),
+    "resnet50": dict(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock),
+    "resnet101": dict(stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock),
+    "resnet152": dict(stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock),
+}
+
+
+def _make(name):
+    cfg = _CONFIGS[name]
+
+    @register_model(name)
+    def factory(**kwargs):
+        return ResNet(**{**cfg, **kwargs})
+
+    factory.__name__ = name
+    return factory
+
+
+for _name in _CONFIGS:
+    _make(_name)
+
+
+# Small net for unit tests: 2 stages, runs in milliseconds on CPU.
+@register_model("resnet_tiny")
+def resnet_tiny(**kwargs):
+    defaults = dict(
+        stage_sizes=[1, 1],
+        block_cls=BasicBlock,
+        num_filters=8,
+        num_classes=10,
+        stem="cifar",
+        dtype=jnp.float32,
+    )
+    return ResNet(**{**defaults, **kwargs})

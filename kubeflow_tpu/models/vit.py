@@ -1,0 +1,96 @@
+"""Vision Transformer (ViT) in Flax — BASELINE.json config 4
+("codeserver-python image with JAX + Flax, ViT-B/16 training")."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.layers import Attention, Mlp
+from kubeflow_tpu.models.registry import register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    mlp_dim: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+
+
+CONFIGS = {
+    "vit_debug": ViTConfig(image_size=32, patch_size=8, dim=32, n_layers=2,
+                           n_heads=2, mlp_dim=64, num_classes=10,
+                           dtype=jnp.float32),
+    "vit_s16": ViTConfig(dim=384, n_layers=12, n_heads=6, mlp_dim=1536),
+    "vit_b16": ViTConfig(),
+    "vit_l16": ViTConfig(dim=1024, n_layers=24, n_heads=16, mlp_dim=4096),
+}
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=cfg.dtype, name="norm1")(x)
+        h = Attention(num_heads=cfg.n_heads, dtype=cfg.dtype, name="attn")(h)
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        x = x + h
+        h = nn.LayerNorm(dtype=cfg.dtype, name="norm2")(x)
+        h = Mlp(hidden_dim=cfg.mlp_dim, dtype=cfg.dtype, name="mlp")(h)
+        h = nn.Dropout(cfg.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, *, train: bool = True):
+        cfg = self.cfg
+        b = images.shape[0]
+        x = nn.Conv(
+            cfg.dim,
+            (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dtype=cfg.dtype,
+            name="patch_embed",
+        )(images.astype(cfg.dtype))
+        x = x.reshape(b, -1, cfg.dim)
+        cls = self.param(
+            "cls_token", nn.initializers.zeros_init(), (1, 1, cfg.dim)
+        )
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, cfg.dim)).astype(cfg.dtype), x], axis=1)
+        pos = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (1, x.shape[1], cfg.dim),
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_norm")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+def _factory(name):
+    @register_model(name)
+    def make(**overrides):
+        return ViT(dataclasses.replace(CONFIGS[name], **overrides))
+
+    make.__name__ = name
+    return make
+
+
+for _n in CONFIGS:
+    _factory(_n)

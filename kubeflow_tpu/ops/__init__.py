@@ -1,0 +1,11 @@
+"""TPU op library: Pallas kernels with XLA reference fallbacks.
+
+Public API is stable regardless of backend: ``impl="auto"`` uses the Pallas
+TPU kernel when it applies and falls back to the pure-XLA reference
+otherwise (CPU tests, odd shapes).
+"""
+
+from kubeflow_tpu.ops.attention import dot_product_attention
+from kubeflow_tpu.ops.norms import rms_norm
+
+__all__ = ["dot_product_attention", "rms_norm"]

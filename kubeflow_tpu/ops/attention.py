@@ -1,0 +1,126 @@
+"""Multi-head attention with a Pallas TPU flash kernel and an XLA fallback.
+
+All shapes are ``[batch, seq, heads, head_dim]`` (BSHD — the layout XLA:TPU
+prefers for fusing the surrounding projections).  GQA is supported by passing
+k/v with fewer heads; they are logically repeated.
+
+The reference platform contains no attention code at all (SURVEY.md §2.13) —
+long-context support there is "whatever the user runs inside the notebook".
+Here it is a first-class op: ``impl="pallas"`` selects the flash kernel
+(ops/pallas/flash_attention.py), and ring-attention context parallelism
+builds on this op in ``kubeflow_tpu.parallel.ring``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d
+    )
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference implementation; XLA fuses this well enough for short seqs."""
+    orig_dtype = q.dtype
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+
+    # [b, h, sq, sk] logits in f32 for a stable softmax.
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    mask = None
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        # Offset supports cross-ring blocks where q starts later than k.
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)[None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.astype(orig_dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Scaled dot-product attention, BSHD layout.
+
+    impl: "auto" | "pallas" | "xla" | "ring".  "auto" prefers the Pallas
+    flash kernel on TPU for bias-free shapes it supports, else falls back to
+    XLA.  "ring" runs sequence-parallel ring attention over the active
+    mesh's ``sp`` axis (kubeflow_tpu.parallel.ring).
+    """
+    if impl not in ("auto", "pallas", "xla", "ring"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl == "ring":
+        from kubeflow_tpu.parallel.context import get_global_mesh
+        from kubeflow_tpu.parallel.ring import ring_attention
+
+        mesh = get_global_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "impl='ring' needs an active mesh; wrap the call in "
+                "kubeflow_tpu.parallel.context.global_mesh(mesh)"
+            )
+        if bias is not None or segment_ids is not None:
+            raise NotImplementedError("ring attention: bias/segment_ids TODO")
+        return ring_attention(
+            q, k, v, mesh=mesh, causal=causal, softmax_scale=softmax_scale
+        )
+
+    use_pallas = False
+    if impl in ("auto", "pallas"):
+        from kubeflow_tpu.ops.pallas import flash_attention as fa
+
+        ok = fa.supported(q, k, v, bias=bias, segment_ids=segment_ids)
+        if impl == "pallas" and not ok:
+            raise ValueError("pallas flash attention does not support this shape")
+        use_pallas = ok and (impl == "pallas" or fa.should_use(q))
+    if use_pallas:
+        from kubeflow_tpu.ops.pallas import flash_attention as fa
+
+        return fa.flash_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale
+        )
+    return xla_attention(
+        q,
+        k,
+        v,
+        causal=causal,
+        segment_ids=segment_ids,
+        bias=bias,
+        softmax_scale=softmax_scale,
+    )

@@ -1,0 +1,18 @@
+"""Normalization ops.  RMSNorm is the hot one (every Llama layer, twice)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm with f32 accumulation, output in x.dtype.
+
+    XLA fuses this into neighbouring ops on TPU; a Pallas version exists in
+    ops/pallas for the cases where it doesn't (measured, not assumed).
+    """
+    orig_dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(orig_dtype)

@@ -1,0 +1,1 @@
+"""Pallas TPU kernels.  Import lazily; everything has an XLA fallback."""

@@ -1,0 +1,35 @@
+"""SPMD parallelism over TPU meshes.
+
+The reference platform's "distributed backend" is nothing but pod scheduling
+(SURVEY.md §5.8): NCCL/MPI never appear; multi-device is the user's problem.
+In the TPU rebuild the compute-side story is explicit and first-class:
+
+* ``mesh``     — build ``jax.sharding.Mesh``es over (dp, fsdp, tp, sp) axes;
+  ICI-friendly axis ordering.
+* ``sharding`` — param-pytree partition rules (Megatron-style TP + FSDP) that
+  keep models mesh-agnostic.
+* ``train``    — wrap a pure train step in ``jax.jit`` with NamedShardings.
+* ``ring``     — ring attention (sequence/context parallelism over ICI) via
+  ``shard_map`` + ``ppermute``.
+* ``dist``     — multi-host bring-up: ``jax.distributed.initialize`` from the
+  TPU worker env the platform's webhook injects into notebook pods.
+"""
+
+from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
+from kubeflow_tpu.parallel.sharding import (
+    batch_sharding,
+    infer_state_shardings,
+    llama_rules,
+    shard_params,
+)
+from kubeflow_tpu.parallel.train import make_sharded_train_step
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "batch_sharding",
+    "infer_state_shardings",
+    "llama_rules",
+    "shard_params",
+    "make_sharded_train_step",
+]

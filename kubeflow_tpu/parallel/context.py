@@ -1,0 +1,29 @@
+"""Global mesh context: lets leaf ops (ring attention) find the active mesh
+without threading it through every model signature."""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_CURRENT_MESH: Optional[Mesh] = None
+
+
+def set_global_mesh(mesh: Optional[Mesh]) -> None:
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH
+
+
+@contextlib.contextmanager
+def global_mesh(mesh: Mesh):
+    prev = get_global_mesh()
+    set_global_mesh(mesh)
+    try:
+        yield mesh
+    finally:
+        set_global_mesh(prev)

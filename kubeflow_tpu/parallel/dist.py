@@ -1,0 +1,50 @@
+"""Multi-host bring-up inside notebook pods.
+
+The platform side injects per-worker env into every pod of a multi-host
+slice notebook (see kubeflow_tpu/platform/controllers/notebook.py and the
+TPU PodDefaults): ``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``,
+``TPU_TOPOLOGY`` — the same contract GKE's TPU webhook uses.  This module is
+the compute-side consumer: call ``initialize_from_env()`` first thing in a
+multi-host notebook and every worker joins the jax.distributed barrier, after
+which ``jax.devices()`` spans the whole slice and collectives ride ICI.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def worker_env() -> dict:
+    return {
+        "worker_id": os.environ.get("TPU_WORKER_ID"),
+        "hostnames": os.environ.get("TPU_WORKER_HOSTNAMES"),
+        "topology": os.environ.get("TPU_TOPOLOGY"),
+        "accelerator": os.environ.get("TPU_ACCELERATOR_TYPE"),
+    }
+
+
+def initialize_from_env(*, coordinator_port: int = DEFAULT_COORDINATOR_PORT) -> bool:
+    """Join the slice's jax.distributed cluster if this is a multi-host pod.
+
+    Returns True if distributed init ran, False for single-host (no-op).
+    Worker 0 (the StatefulSet's ``<name>-0`` pod, routed by the headless
+    service the notebook controller creates) is the coordinator.
+    """
+    env = worker_env()
+    if not env["hostnames"]:
+        return False
+    hosts = [h.strip() for h in env["hostnames"].split(",") if h.strip()]
+    if len(hosts) <= 1:
+        return False
+    worker_id = int(env["worker_id"] or 0)
+    coordinator = f"{hosts[0]}:{coordinator_port}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(hosts),
+        process_id=worker_id,
+    )
+    return True

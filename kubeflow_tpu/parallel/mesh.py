@@ -1,0 +1,92 @@
+"""Device-mesh construction.
+
+Axis convention (outer → inner, matching ICI locality on TPU slices):
+
+* ``dp``   — pure data parallelism (gradients all-reduced)
+* ``fsdp`` — data parallelism with sharded params/optimizer (ZeRO-3 style;
+  XLA turns the annotations into all-gather / reduce-scatter)
+* ``tp``   — tensor (Megatron) parallelism inside matmuls
+* ``sp``   — sequence/context parallelism (ring attention)
+
+Inner axes get the fastest ICI loops; ``tp`` and ``sp`` traffic is
+latency-sensitive per-layer, while ``dp``/``fsdp`` traffic amortizes per
+step, so the default order places tp/sp innermost.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def axis_sizes(self) -> tuple[int, ...]:
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a Mesh. ``make_mesh(dp=2, tp=4)`` or ``make_mesh(MeshConfig(...))``.
+
+    One axis may be -1 (inferred from the device count, like a reshape).
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes)
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis sizes, not both")
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = list(config.axis_sizes())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {known}"
+            )
+        sizes[sizes.index(-1)] = len(devices) // known
+    if math.prod(sizes) != len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(AXIS_NAMES, sizes))} needs {math.prod(sizes)} "
+            f"devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, AXIS_NAMES)
+
+
+def default_mesh_config(n_devices: int) -> MeshConfig:
+    """Reasonable split for a given device count: favor fsdp, give tp the
+    innermost factor once the slice is big enough to pay for it."""
+    if n_devices == 1:
+        return MeshConfig()
+    tp = 1
+    for cand in (8, 4, 2):
+        if n_devices % cand == 0 and n_devices // cand >= 2:
+            tp = cand
+            break
+    if n_devices % tp or n_devices // tp < 1:
+        tp = 1
+    rest = n_devices // tp
+    # Split the remainder between dp and fsdp: fsdp gets everything by
+    # default (params sharded as widely as possible).
+    return MeshConfig(dp=1, fsdp=rest, tp=tp, sp=1)

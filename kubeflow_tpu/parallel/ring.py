@@ -1,0 +1,143 @@
+"""Ring attention: exact attention over sequence shards via ICI neighbor
+exchange (context parallelism).
+
+Each device holds a contiguous sequence chunk of q/k/v.  K/V chunks rotate
+around the ``sp`` ring with ``lax.ppermute`` while every device folds each
+visiting block into a running (max, denom, accumulator) — the flash-attention
+merge applied across devices, so the full [S, S] score matrix never exists
+anywhere and sequence length scales linearly with ring size.
+
+This is the long-context path the reference platform has no analogue for
+(SURVEY.md §5.7): there, long-context is "whatever the user runs"; here it is
+a library call:
+
+    with mesh:
+        out = ring_attention(q, k, v, mesh=mesh, causal=True)
+
+Causality across chunks uses global positions: block j vs. query chunk i is
+fully-masked (skipped via where), diagonal (triangular mask), or dense.
+Compute is overlapped with the ppermute by XLA's async collectives.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, *, scale, mode, q_offset, k_offset):
+    """Attention over one (q-chunk, k-block) pair → (out*l, m, l) pieces.
+
+    mode: 0 = dense, 1 = causal-diagonal, 2 = masked-out (returns -inf m).
+    Shapes: q [b, sq, h, d]; k/v [b, sk, kh, d].  Returns f32.
+    """
+    from kubeflow_tpu.ops.attention import _repeat_kv
+
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    sq, sk = q.shape[1], k.shape[1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_offset
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + k_offset
+    causal_mask = rows[None, None] >= cols[None, None]
+    # mode==1: apply triangular mask; mode==2: everything masked.
+    logits = jnp.where(mode == 1, jnp.where(causal_mask, logits, _NEG_INF), logits)
+    logits = jnp.where(mode == 2, _NEG_INF, logits)
+    m = jnp.max(logits, axis=-1, keepdims=True)  # [b,h,sq,1]
+    # Guard fully-masked rows.
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(logits - m_safe)
+    p = jnp.where(m <= _NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)  # [b,h,sq,1]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    return pv, m_safe, l
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+    """Body run per-device under shard_map. q/k/v: local chunks [b,s,h,d]."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    q32 = q.astype(jnp.float32)
+
+    def step(carry, r):
+        k_blk, v_blk, acc, m, l = carry
+        src_idx = (my_idx - r) % axis_size  # whose chunk we currently hold
+        if causal:
+            mode = jnp.where(
+                src_idx == my_idx, 1, jnp.where(src_idx < my_idx, 0, 2)
+            )
+        else:
+            mode = jnp.zeros((), jnp.int32)
+        pv, bm, bl = _block_attn(
+            q32,
+            k_blk.astype(jnp.float32),
+            v_blk.astype(jnp.float32),
+            scale=scale,
+            mode=mode,
+            q_offset=my_idx * s_local,
+            k_offset=src_idx * s_local,
+        )
+        # Online merge: bm/bl are [b,h,sq,1]; acc is [b,sq,h,d].
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(bm - m_new)
+        l_new = alpha * l + beta * bl
+        # [b,h,sq,1] -> [b,sq,h,1] to scale BSHD accumulators.
+        tr = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+        acc_new = acc * tr(alpha) + pv * tr(beta)
+        # Rotate kv to the next device (ring).
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_next, v_next, acc_new, m_new, l_new), None
+
+    b, s, h, d = q.shape
+    acc0 = jnp.zeros((b, s, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s, 1), jnp.float32)
+    (k_f, v_f, acc, m, l), _ = jax.lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(axis_size)
+    )
+    l_t = jnp.transpose(l, (0, 2, 1, 3))
+    out = acc / jnp.where(l_t == 0.0, 1.0, l_t)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+):
+    """Exact attention with the sequence dimension sharded over ``axis_name``.
+
+    Inputs are global-view BSHD arrays (sharded or shardable on seq); output
+    has the same sharding.  Works under jit and composes with dp/fsdp/tp on
+    the other mesh axes.
+    """
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    spec = P(("dp", "fsdp"), axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

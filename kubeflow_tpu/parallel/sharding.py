@@ -1,0 +1,154 @@
+"""Partition rules: param-path patterns → PartitionSpec.
+
+Models stay mesh-agnostic; sharding is decided here by matching the param
+pytree paths (the flax module/param names) against ordered regex rules —
+first match wins.  This is the pjit idiom: annotate, let XLA insert the
+collectives (all-gather for fsdp params, psum for tp partials, reduce-scatter
+for fsdp grads), never hand-write them in the model.
+
+Llama layout (Megatron TP + FSDP on the orthogonal axis):
+
+| param                     | shape                  | spec                        |
+|---------------------------|------------------------|-----------------------------|
+| embed.embedding           | (vocab, dim)           | P("tp", "fsdp")             |
+| attn q/k/v_proj.kernel    | (dim, heads, head_dim) | P("fsdp", "tp", None)       |
+| attn o_proj.kernel        | (heads, head_dim, dim) | P("tp", None, "fsdp")       |
+| mlp gate/up_proj.kernel   | (dim, ffn)             | P("fsdp", "tp")             |
+| mlp down_proj.kernel      | (ffn, dim)             | P("tp", "fsdp")             |
+| norms' scale              | (dim,)                 | P(None)                     |
+| lm_head.kernel            | (dim, vocab)           | P("fsdp", "tp")             |
+
+Column-parallel qkv/gate/up followed by row-parallel o/down means the only
+TP collective per block is one psum after o_proj and one after down_proj —
+the textbook Megatron pattern, expressed purely through shardings.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def llama_rules() -> Rules:
+    return (
+        (r".*embed.*embedding$", P("tp", "fsdp")),
+        (r".*(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp", None)),
+        (r".*o_proj.*kernel$", P("tp", None, "fsdp")),
+        (r".*(gate_proj|up_proj).*kernel$", P("fsdp", "tp")),
+        (r".*down_proj.*kernel$", P("tp", "fsdp")),
+        (r".*lm_head.*kernel$", P("fsdp", "tp")),
+        (r".*", P()),  # norms, biases: replicated
+    )
+
+
+def vit_rules() -> Rules:
+    return (
+        (r".*(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp", None)),
+        (r".*o_proj.*kernel$", P("tp", None, "fsdp")),
+        (r".*fc1.*kernel$", P("fsdp", "tp")),
+        (r".*fc2.*kernel$", P("tp", "fsdp")),
+        (r".*head.*kernel$", P("fsdp", "tp")),
+        (r".*", P()),
+    )
+
+
+def resnet_rules() -> Rules:
+    # Convs: shard output channels on tp, nothing else; batch-norm stats
+    # replicated.  FSDP on convnets this small isn't worth the gathers.
+    return (
+        (r".*head.*kernel$", P("fsdp", "tp")),
+        (r".*", P()),
+    )
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for_path(path_string: str, rules: Rules) -> P:
+    for pattern, spec in rules:
+        if re.fullmatch(pattern, path_string):
+            return spec
+    return P()
+
+
+def _clamp_spec(spec: P, ndim: int) -> P:
+    """Trim/pad a spec to the array rank (rules are written for the common
+    shapes; scalars and odd ranks degrade to replication on extra axes)."""
+    parts = list(spec)[:ndim]
+    parts += [None] * (ndim - len(parts))
+    return P(*parts)
+
+
+def tree_specs(tree: Any, rules: Rules) -> Any:
+    """PartitionSpec pytree matching ``tree`` by path rules."""
+
+    def one(path, leaf):
+        spec = spec_for_path(_path_str(path), rules)
+        return _clamp_spec(spec, getattr(leaf, "ndim", 0))
+
+    return jax.tree_util.tree_map_with_path(one, tree)
+
+
+def tree_shardings(tree: Any, mesh: Mesh, rules: Rules) -> Any:
+    specs = tree_specs(tree, rules)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Any, mesh: Mesh, rules: Rules) -> Any:
+    """device_put a param pytree according to the rules."""
+    return jax.device_put(params, tree_shardings(params, mesh, rules))
+
+
+def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
+    """Batch data over all data-parallel axes; optionally shard sequence on sp."""
+    if seq_axis:
+        return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    return NamedSharding(mesh, P(("dp", "fsdp")))
+
+
+def infer_state_shardings(state: Any, mesh: Mesh, rules: Rules) -> Any:
+    """Shardings for a full TrainState: params and opt_state follow the param
+    rules (optax states mirror the param tree), scalars replicate."""
+    from kubeflow_tpu.train.steps import TrainState  # local import, no cycle
+
+    assert isinstance(state, TrainState)
+
+    def shard_like_params(tree):
+        return tree_shardings(tree, mesh, rules)
+
+    replicated = NamedSharding(mesh, P())
+
+    def opt_sharding(leaf_path, leaf):
+        # Optax state leaves that mirror a param keep its sharding; scalar
+        # counters replicate.  Matching by shape: mirrors have ndim>0 and the
+        # same path tail inside the state pytree.
+        spec = spec_for_path(_path_str(leaf_path), rules)
+        spec = _clamp_spec(spec, getattr(leaf, "ndim", 0))
+        return NamedSharding(mesh, spec)
+
+    return TrainState(
+        step=replicated,
+        params=shard_like_params(state.params),
+        opt_state=jax.tree_util.tree_map_with_path(opt_sharding, state.opt_state),
+        batch_stats=(
+            None
+            if state.batch_stats is None
+            else jax.tree.map(lambda _: replicated, state.batch_stats)
+        ),
+        tx=state.tx,
+        apply_fn=state.apply_fn,
+    )

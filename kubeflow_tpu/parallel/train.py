@@ -1,0 +1,57 @@
+"""Sharded train-step construction: pure step + mesh + rules → pjit'd step."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.sharding import (
+    Rules,
+    batch_sharding,
+    infer_state_shardings,
+    shard_params,
+    tree_shardings,
+)
+from kubeflow_tpu.train.steps import TrainState
+
+
+def shard_train_state(state: TrainState, mesh: Mesh, rules: Rules) -> TrainState:
+    """Place an (unsharded, host-built) TrainState onto the mesh."""
+    shardings = infer_state_shardings(state, mesh, rules)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, s) if hasattr(x, "shape") else x,
+        state,
+        shardings,
+    )
+
+
+def make_sharded_train_step(
+    step: Callable,
+    state: TrainState,
+    mesh: Mesh,
+    rules: Rules,
+    *,
+    shard_sequence: bool = False,
+    donate_state: bool = True,
+):
+    """jit the step with explicit in/out shardings.
+
+    ``state`` is only used for its pytree structure.  Batches are sharded
+    [batch → (dp, fsdp), seq → sp if shard_sequence].  XLA lowers the
+    annotations to psum/all-gather/reduce-scatter over ICI.
+    """
+    state_sh = infer_state_shardings(state, mesh, rules)
+    data_sh = batch_sharding(mesh, seq_axis=shard_sequence)
+    repl = NamedSharding(mesh, P())
+
+    def wrapped(state, batch):
+        return step(state, batch)
+
+    jit_kwargs: dict = dict(
+        in_shardings=(state_sh, None),
+        out_shardings=(state_sh, repl),
+    )
+    if donate_state:
+        jit_kwargs["donate_argnums"] = (0,)
+    return jax.jit(wrapped, **jit_kwargs), data_sh

@@ -1,0 +1,15 @@
+"""Training utilities: train states, jit-able steps, optimizer factories."""
+
+from kubeflow_tpu.train.steps import (
+    TrainState,
+    create_train_state,
+    make_classification_train_step,
+    make_lm_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_classification_train_step",
+    "make_lm_train_step",
+]

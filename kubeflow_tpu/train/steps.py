@@ -1,0 +1,120 @@
+"""Jit-able train steps.
+
+Design: a step is a pure function ``(state, batch, rng) -> (state, metrics)``
+built once by a factory and then wrapped by the caller in ``jax.jit`` with
+whatever shardings apply (see kubeflow_tpu.parallel.train).  No
+data-dependent Python control flow — everything traces once.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # None for stat-less models
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    apply_fn: Callable = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt = self.tx.update(grads, self.opt_state, self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt,
+        )
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    example_input,
+    tx: optax.GradientTransformation,
+    *,
+    init_kwargs: Optional[dict] = None,
+) -> TrainState:
+    variables = model.init(rng, example_input, **(init_kwargs or {}))
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        batch_stats=batch_stats,
+        tx=tx,
+        apply_fn=model.apply,
+    )
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over integer labels, f32."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool = False):
+    """Step for image/sequence classifiers: batch = (inputs, int labels)."""
+
+    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+        inputs, labels = batch
+
+        def loss_fn(params):
+            variables = {"params": params}
+            kwargs: dict = {"train": True}
+            mutable = []
+            if has_batch_stats:
+                variables["batch_stats"] = state.batch_stats
+                mutable = ["batch_stats"]
+            if has_dropout:
+                kwargs["rngs"] = {"dropout": rng}
+            out = state.apply_fn(variables, inputs, mutable=mutable, **kwargs)
+            logits, new_model_state = out if mutable else (out, {})
+            loss = cross_entropy(logits, labels)
+            acc = jnp.mean(jnp.argmax(logits, -1) == labels)
+            return loss, (new_model_state, acc)
+
+        (loss, (new_model_state, acc)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        state = state.apply_gradients(grads)
+        if has_batch_stats:
+            state = state.replace(batch_stats=new_model_state["batch_stats"])
+        return state, {"loss": loss, "accuracy": acc}
+
+    return step
+
+
+def make_lm_train_step():
+    """Next-token-prediction step: batch = tokens[b,s] or (tokens, segment_ids)
+    for packed sequences (segment_ids are threaded into attention masking)."""
+
+    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+        if isinstance(batch, (tuple, list)):
+            tokens = batch[0]
+            segment_ids = batch[1] if len(batch) > 1 else None
+        else:
+            tokens, segment_ids = batch, None
+
+        def loss_fn(params):
+            kwargs = {} if segment_ids is None else {"segment_ids": segment_ids}
+            logits = state.apply_fn({"params": params}, tokens, **kwargs)
+            # Shift: predict token t+1 from prefix..t.
+            logits = logits[:, :-1]
+            targets = tokens[:, 1:]
+            loss = cross_entropy(logits, targets)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        state = state.apply_gradients(grads)
+        return state, {"loss": loss}
+
+    return step

@@ -1,0 +1,28 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+The image registers an ``axon`` TPU backend via sitecustomize
+(JAX_PLATFORMS=axon); tests must not depend on the tunnelled chip, and the
+multi-chip sharding tests need 8 devices.  This file runs before any test
+module imports jax, so the platform/device-count knobs still take effect.
+"""
+import os
+
+# Must be set before the XLA CPU client initializes.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs[:8]

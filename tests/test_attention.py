@@ -1,0 +1,57 @@
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.ops.attention import dot_product_attention, xla_attention
+from kubeflow_tpu.ops.pallas import flash_attention as fa
+
+
+def _qkv(b=2, s=256, h=4, kh=4, d=64, dtype=jnp.float32, seed=0):
+    k0 = jax.random.key(seed)
+    q = jax.random.normal(jax.random.fold_in(k0, 1), (b, s, h, d), dtype)
+    k = jax.random.normal(jax.random.fold_in(k0, 2), (b, s, kh, d), dtype)
+    v = jax.random.normal(jax.random.fold_in(k0, 3), (b, s, kh, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("kh", [4, 2, 1])
+def test_flash_matches_reference(causal, kh):
+    q, k, v = _qkv(kh=kh)
+    out = fa.flash_attention(q, k, v, causal=causal)
+    ref = xla_attention(q, k, v, causal=causal)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+
+def test_flash_grads_match_reference():
+    q, k, v = _qkv(s=256)
+    g1 = jax.grad(lambda q: fa.flash_attention(q, k, v, causal=True).sum())(q)
+    g2 = jax.grad(lambda q: xla_attention(q, k, v, causal=True).sum())(q)
+    assert jnp.max(jnp.abs(g1 - g2)) < 2e-4
+
+
+def test_supported_gates():
+    q, k, v = _qkv()
+    assert fa.supported(q, k, v)
+    assert not fa.supported(q, k, v, bias=jnp.zeros((1, 1, 256, 256)))
+    q2, k2, v2 = _qkv(d=48)  # not 64-aligned
+    assert not fa.supported(q2, k2, v2)
+
+
+def test_public_op_segment_ids_block_cross_attention():
+    q, k, v = _qkv(s=32)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 16), jnp.int32), jnp.ones((2, 16), jnp.int32)], axis=1
+    )
+    out = dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+    # Changing segment-1 values must not change segment-0 outputs.
+    v2 = v.at[:, 16:].add(1.0)
+    out2 = dot_product_attention(q, k, v2, segment_ids=seg, impl="xla")
+    assert jnp.allclose(out[:, :16], out2[:, :16], atol=1e-6)
+    assert not jnp.allclose(out[:, 16:], out2[:, 16:], atol=1e-3)
+
+
+def test_bad_impl_raises():
+    q, k, v = _qkv(s=32)
+    with pytest.raises(ValueError):
+        dot_product_attention(q, k, v, impl="cuda")

@@ -1,0 +1,77 @@
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from kubeflow_tpu.models import create_model, list_models
+from kubeflow_tpu.train import (
+    create_train_state,
+    make_classification_train_step,
+    make_lm_train_step,
+)
+
+
+def test_registry_lists_all_families():
+    names = list_models()
+    for required in ("resnet50", "llama2_7b", "vit_b16", "bert_base"):
+        assert required in names
+
+
+def test_resnet_tiny_forward_and_learns():
+    model = create_model("resnet_tiny")
+    rng = jax.random.key(0)
+    images = jax.random.normal(rng, (8, 32, 32, 3))
+    labels = jnp.arange(8) % 10
+    state = create_train_state(
+        rng, model, images, optax.sgd(0.05, momentum=0.9),
+        init_kwargs={"train": False},
+    )
+    step = jax.jit(make_classification_train_step(has_batch_stats=True))
+    state, first = step(state, (images, labels))
+    last = first
+    for _ in range(10):
+        state, last = step(state, (images, labels))
+    assert float(last["loss"]) < float(first["loss"])
+
+
+def test_llama_debug_forward_and_learns():
+    model = create_model("llama_debug")
+    rng = jax.random.key(0)
+    tokens = jax.random.randint(rng, (4, 32), 0, 256)
+    state = create_train_state(rng, model, tokens, optax.adamw(1e-2))
+    logits = model.apply({"params": state.params}, tokens)
+    assert logits.shape == (4, 32, 256)
+    step = jax.jit(make_lm_train_step())
+    state, first = step(state, tokens)
+    last = first
+    for _ in range(15):
+        state, last = step(state, tokens)
+    assert float(last["loss"]) < float(first["loss"])
+
+
+def test_vit_debug_forward():
+    model = create_model("vit_debug")
+    rng = jax.random.key(0)
+    images = jax.random.normal(rng, (2, 32, 32, 3))
+    variables = model.init(rng, images, train=False)
+    logits = model.apply(variables, images, train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_bert_debug_forward_with_mask():
+    model = create_model("bert_debug")
+    rng = jax.random.key(0)
+    tokens = jax.random.randint(rng, (2, 16), 0, 128)
+    mask = jnp.ones((2, 16)).at[:, 8:].set(0)
+    variables = model.init(rng, tokens, train=False)
+    logits = model.apply(variables, tokens, attention_mask=mask, train=False)
+    assert logits.shape == (2, 2)
+    # Masked positions must not affect the output.
+    tokens2 = tokens.at[:, 12].set(0)
+    logits2 = model.apply(variables, tokens2, attention_mask=mask, train=False)
+    assert jnp.allclose(logits, logits2, atol=1e-5)
+
+
+def test_unknown_model_raises():
+    with pytest.raises(KeyError):
+        create_model("resnet9000")
