@@ -1,0 +1,23 @@
+"""The control plane: a TPU-first rebuild of the Kubeflow notebooks platform.
+
+Layer map (mirrors SURVEY.md §1, re-architected for this stack):
+
+* ``k8s``        — a small native Kubernetes REST client + unstructured
+  object helpers (the reference uses client-go / the python ``kubernetes``
+  package; this is a ground-up minimal client).
+* ``testing``    — in-memory fake API server with resourceVersions, watches
+  and ownerReference GC: the envtest analogue (SURVEY.md §4 tier 2).
+* ``apis``       — CRD schemas: Notebook (with first-class ``spec.tpu``),
+  Profile, PodDefault, Tensorboard; defaulting + validation + manifests.
+* ``runtime``    — controller runtime: watch → workqueue → level-triggered
+  reconcile, event recording, Prometheus metrics.
+* ``tpu``        — accelerator/topology tables (chips per host, node
+  selectors, slice math): the scheduling brain the GPU reference never had.
+* ``controllers``— notebook / culling / profile / tensorboard reconcilers.
+* ``webhook``    — PodDefault mutating admission webhook (TPU env injection).
+* ``kfam``       — access management REST service.
+* ``web``        — CRUD web-app backends (jupyter/volumes/tensorboards) on a
+  shared werkzeug micro-framework + crud_backend library.
+* ``dashboard``  — central dashboard API server.
+* ``images``     — notebook server image recipes (jupyter-jax-tpu etc.).
+"""

@@ -1,0 +1,138 @@
+"""Notebook CRD schema: defaulting, validation, well-known annotations.
+
+Shape mirrors the reference CRD (reference notebook_types.go:27-88 — a
+PodSpec template + status mirroring pod state) with one structural addition:
+a first-class ``spec.tpu`` block instead of GPU limits buried in the
+template:
+
+    apiVersion: kubeflow.org/v1beta1
+    kind: Notebook
+    spec:
+      template:
+        spec: {containers: [...], volumes: [...]}     # corev1.PodSpec shape
+      tpu:
+        accelerator: v5e        # key into platform.tpu.ACCELERATORS
+        topology: "4x4"         # optional; accelerator default otherwise
+    status:
+      conditions: [...]         # mirrored from worker-0 pod
+      readyReplicas: int
+      containerState: {...}
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.platform.k8s.types import Resource, deep_get
+from kubeflow_tpu.platform.tpu import SliceSpec, slice_spec
+
+# Annotation contract shared with the reference ecosystem (set by the web
+# app's stop action and the culler; reference culling_controller.go:50).
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+# Istio routing annotations (reference notebook_controller.go:471-565).
+ANNOTATION_REWRITE_URI = "notebooks.kubeflow.org/http-rewrite-uri"
+ANNOTATION_HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-set"
+
+DEFAULT_PORT = 8888
+LABEL_NOTEBOOK_NAME = "notebook-name"
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate(notebook: Resource) -> None:
+    containers = deep_get(notebook, "spec", "template", "spec", "containers")
+    if not containers:
+        raise ValidationError("spec.template.spec.containers must be non-empty")
+    name = deep_get(notebook, "metadata", "name", default="")
+    if not name or len(name) > 52:
+        # 52 = 63-char DNS label minus room for "-<ordinal>" pod suffixes.
+        raise ValidationError("metadata.name required, max 52 chars")
+    tpu = notebook.get("spec", {}).get("tpu")
+    if tpu:
+        try:
+            slice_spec(tpu.get("accelerator", ""), tpu.get("topology"))
+        except ValueError as e:
+            raise ValidationError(str(e)) from None
+
+
+def tpu_slice(notebook: Resource) -> Optional[SliceSpec]:
+    tpu = deep_get(notebook, "spec", "tpu")
+    if not tpu or not tpu.get("accelerator"):
+        return None
+    return slice_spec(tpu["accelerator"], tpu.get("topology"))
+
+
+def is_stopped(notebook: Resource) -> bool:
+    return STOP_ANNOTATION in (
+        deep_get(notebook, "metadata", "annotations", default={}) or {}
+    )
+
+
+def notebook_port(notebook: Resource) -> int:
+    ports = deep_get(
+        notebook, "spec", "template", "spec", "containers", default=[{}]
+    )[0].get("ports") or []
+    for p in ports:
+        if p.get("containerPort"):
+            return int(p["containerPort"])
+    return DEFAULT_PORT
+
+
+def nb_prefix(namespace: str, name: str) -> str:
+    return f"/notebook/{namespace}/{name}"
+
+
+def crd_manifest() -> Resource:
+    """The CustomResourceDefinition to install (structural schema kept
+    permissive around the PodSpec, like the reference CRD)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "notebooks.kubeflow.org"},
+        "spec": {
+            "group": "kubeflow.org",
+            "names": {
+                "kind": "Notebook",
+                "plural": "notebooks",
+                "singular": "notebook",
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": "v1beta1",
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "properties": {
+                                        "template": {
+                                            "type": "object",
+                                            "x-kubernetes-preserve-unknown-fields": True,
+                                        },
+                                        "tpu": {
+                                            "type": "object",
+                                            "properties": {
+                                                "accelerator": {"type": "string"},
+                                                "topology": {"type": "string"},
+                                            },
+                                        },
+                                    },
+                                },
+                                "status": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields": True,
+                                },
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }

@@ -1,0 +1,23 @@
+"""Env-driven configuration (the reference's GetEnvDefault pattern,
+culling_controller.go:385-391 / notebook_controller.go:203,427,489,503)."""
+from __future__ import annotations
+
+import os
+
+
+def env(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default

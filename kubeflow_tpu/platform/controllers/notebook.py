@@ -1,0 +1,454 @@
+"""Notebook reconciler: Notebook CR → StatefulSet + Services + VirtualService.
+
+The TPU-first re-architecture of the reference control loop (reference
+notebook_controller.go:89-225 / 361-565).  Structural differences, all
+driven by multi-host TPU slices:
+
+* ``replicas = num_hosts(topology)`` instead of the reference's hard-coded 1
+  (notebook_controller.go:362) — one pod per TPU host, StatefulSet ordinal
+  == TPU worker id.
+* A headless service always exists for stable per-worker DNS
+  (``<name>-<i>.<name>-workers.<ns>``), published before readiness so
+  ``jax.distributed.initialize`` can rendezvous during bring-up.
+* The user-facing Service targets **worker 0 only** (pod-name selector) —
+  the Jupyter kernel and the culling probe live on the coordinator.
+* TPU env (TPU_WORKER_ID via the pod-index label downward API,
+  TPU_WORKER_HOSTNAMES, TPU_TOPOLOGY, TPU_ACCELERATOR_TYPE) and
+  ``google.com/tpu`` chip limits + GKE topology node selectors are injected
+  from ``spec.tpu`` — the path the reference routes through a GPU-vendor
+  limits dict (form.py:226-250) is a scheduling concern here, not a form
+  concern.
+* Stop/start (``kubeflow-resource-stopped``) scales the whole slice to 0
+  and back atomically — all workers, one replicas field.
+"""
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import time
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.platform import config
+from kubeflow_tpu.platform.apis import notebook as nbapi
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    NOTEBOOK,
+    POD,
+    SERVICE,
+    STATEFULSET,
+    VIRTUALSERVICE,
+    Resource,
+    deep_get,
+    meta,
+    name_of,
+    set_owner,
+)
+from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
+from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.tpu import SliceSpec
+
+HASH_ANNOTATION = "notebooks.kubeflow.org/generated-hash"
+
+
+def _content_hash(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:16]
+
+
+class NotebookReconciler(Reconciler):
+    def __init__(self, client, *, use_istio: Optional[bool] = None,
+                 istio_gateway: Optional[str] = None,
+                 cluster_domain: Optional[str] = None,
+                 add_fsgroup: Optional[bool] = None):
+        self.client = client
+        self.recorder = EventRecorder(client, "notebook-controller")
+        self.use_istio = (
+            use_istio if use_istio is not None else config.env_bool("USE_ISTIO", True)
+        )
+        self.istio_gateway = istio_gateway or config.env(
+            "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
+        )
+        self.cluster_domain = cluster_domain or config.env("CLUSTER_DOMAIN", "cluster.local")
+        self.add_fsgroup = (
+            add_fsgroup if add_fsgroup is not None else config.env_bool("ADD_FSGROUP", True)
+        )
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        try:
+            notebook = self.client.get(NOTEBOOK, req.name, req.namespace)
+        except errors.NotFound:
+            # ownerReference GC tears down children; refresh the gauges so a
+            # deleted notebook's chips don't linger in the metrics.
+            self._update_namespace_gauges(req.namespace)
+            return None
+
+        # Invalid specs (bad TPU topology etc.) are terminal user errors:
+        # surface them as a Warning event + status instead of crash-looping
+        # the queue (a probe found exactly that failure mode).
+        try:
+            nbapi.validate(notebook)
+        except nbapi.ValidationError as e:
+            status = {"conditions": [{
+                "type": "Degraded", "status": "True",
+                "reason": "InvalidSpec", "message": str(e),
+            }]}
+            if notebook.get("status") != status:
+                self.recorder.event(notebook, "Warning", "InvalidNotebook", str(e))
+                notebook = copy.deepcopy(notebook)
+                notebook["status"] = status
+                self.client.update_status(notebook)
+            return None
+
+        sts = self._reconcile_statefulset(notebook)
+        self._reconcile_service(notebook)
+        self._reconcile_headless_service(notebook)
+        if self.use_istio:
+            self._reconcile_virtual_service(notebook)
+        self._update_status(notebook, sts)
+        self._update_namespace_gauges(req.namespace)
+        return None
+
+    def _update_namespace_gauges(self, ns: str) -> None:
+        """Aggregate per-namespace gauges over ALL notebooks in the
+        namespace (a per-reconcile set would reflect only the last one)."""
+        chips = 0
+        running = 0
+        for nb in self.client.list(NOTEBOOK, ns):
+            if nbapi.is_stopped(nb):
+                continue
+            s = nbapi.tpu_slice(nb)
+            if s:
+                chips += s.chips
+            running += 1
+        metrics.tpu_chips_requested.labels(namespace=ns).set(chips)
+        metrics.notebook_running.labels(namespace=ns).set(running)
+
+    # -- statefulset ---------------------------------------------------------
+
+    def generate_statefulset(self, notebook: Resource) -> Resource:
+        ns = meta(notebook)["namespace"]
+        name = name_of(notebook)
+        tpu = nbapi.tpu_slice(notebook)
+        replicas = 0 if nbapi.is_stopped(notebook) else (tpu.num_hosts if tpu else 1)
+
+        pod_spec = copy.deepcopy(
+            deep_get(notebook, "spec", "template", "spec", default={})
+        )
+        containers = pod_spec.get("containers") or [{}]
+        main = containers[0]
+        main.setdefault("name", name)
+
+        self._inject_prefix_env(main, ns, name)
+        if tpu:
+            self._inject_tpu(pod_spec, main, ns, name, tpu)
+        if self.add_fsgroup:
+            pod_spec.setdefault("securityContext", {}).setdefault("fsGroup", 100)
+
+        labels = {
+            "statefulset": name,
+            nbapi.LABEL_NOTEBOOK_NAME: name,
+        }
+        sts = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": dict(labels),
+            },
+            "spec": {
+                "replicas": replicas,
+                "serviceName": f"{name}-workers",
+                "podManagementPolicy": "Parallel",  # all TPU workers at once
+                "selector": {"matchLabels": {"statefulset": name}},
+                "template": {
+                    "metadata": {"labels": dict(labels)},
+                    "spec": pod_spec,
+                },
+            },
+        }
+        set_owner(sts, notebook)
+        return sts
+
+    def _inject_prefix_env(self, container: dict, ns: str, name: str) -> None:
+        env = container.setdefault("env", [])
+        if not any(e.get("name") == "NB_PREFIX" for e in env):
+            env.append({"name": "NB_PREFIX", "value": nbapi.nb_prefix(ns, name)})
+
+    def _inject_tpu(self, pod_spec: dict, container: dict, ns: str, name: str,
+                    tpu: SliceSpec) -> None:
+        # Chip limits on the main container (per pod == per host).
+        resources = container.setdefault("resources", {})
+        limits = resources.setdefault("limits", {})
+        limits.update(tpu.pod_resources())
+        requests = resources.setdefault("requests", {})
+        requests.update(tpu.pod_resources())
+        # Topology-aware placement.
+        selectors = pod_spec.setdefault("nodeSelector", {})
+        selectors.update(tpu.node_selectors())
+        # Worker env: ordinal from the pod-index label (statefulset pods get
+        # apps.kubernetes.io/pod-index), hostnames from the headless service.
+        hostnames = ",".join(
+            f"{name}-{i}.{name}-workers.{ns}.svc.{self.cluster_domain}"
+            for i in range(tpu.num_hosts)
+        )
+        env = container.setdefault("env", [])
+        have = {e.get("name") for e in env}
+        injected = [
+            {"name": "TPU_WORKER_ID", "valueFrom": {"fieldRef": {
+                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
+            }}},
+            {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
+            {"name": "TPU_TOPOLOGY", "value": tpu.topology},
+            {"name": "TPU_ACCELERATOR_TYPE",
+             "value": f"{tpu.accelerator.name}-{tpu.chips}"},
+            {"name": "TPU_CHIPS_PER_HOST", "value": str(tpu.chips_per_pod)},
+        ]
+        env.extend(e for e in injected if e["name"] not in have)
+
+    def _reconcile_statefulset(self, notebook: Resource) -> Resource:
+        desired = self.generate_statefulset(notebook)
+        ns, name = meta(desired)["namespace"], name_of(desired)
+        # Semantic ownership via content hash: the live object accretes
+        # server defaults (imagePullPolicy, dnsPolicy, ...) that make
+        # subtree equality always-false against a real API server; a hash
+        # annotation of the *generated* template compares desired-vs-desired
+        # (the Deployment pod-template-hash idiom).
+        desired_hash = _content_hash(desired["spec"]["template"])
+        meta(desired).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
+        try:
+            current = self.client.get(STATEFULSET, name, ns)
+        except errors.NotFound:
+            try:
+                created = self.client.create(desired)
+            except errors.ApiError:
+                metrics.notebook_create_failed_total.inc()
+                raise
+            metrics.notebook_create_total.inc()
+            self.recorder.event(
+                notebook, "Normal", "CreatedStatefulSet",
+                f"Created StatefulSet {name} "
+                f"(replicas={deep_get(desired, 'spec', 'replicas')})",
+            )
+            return created
+        changed = False
+        if deep_get(current, "spec", "replicas") != deep_get(desired, "spec", "replicas"):
+            current["spec"]["replicas"] = desired["spec"]["replicas"]
+            changed = True
+        current_hash = deep_get(current, "metadata", "annotations", HASH_ANNOTATION)
+        if current_hash != desired_hash:
+            current["spec"]["template"] = desired["spec"]["template"]
+            meta(current).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
+            changed = True
+        if changed:
+            return self.client.update(current)
+        return current
+
+    # -- services ------------------------------------------------------------
+
+    def generate_service(self, notebook: Resource) -> Resource:
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        tpu = nbapi.tpu_slice(notebook)
+        port = nbapi.notebook_port(notebook)
+        # Multi-host: route the UI to worker 0, where the kernel lives.
+        selector = (
+            {"statefulset.kubernetes.io/pod-name": f"{name}-0"}
+            if tpu and tpu.multi_host
+            else {"statefulset": name}
+        )
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {
+                "type": "ClusterIP",
+                "selector": selector,
+                "ports": [{
+                    # http- prefix drives Istio protocol selection (the
+                    # reference relies on the same convention, :438-465).
+                    "name": f"http-{name}"[:15],
+                    "port": 80,
+                    "targetPort": port,
+                    "protocol": "TCP",
+                }],
+            },
+        }
+        set_owner(svc, notebook)
+        return svc
+
+    def generate_headless_service(self, notebook: Resource) -> Resource:
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        port = nbapi.notebook_port(notebook)
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": f"{name}-workers", "namespace": ns},
+            "spec": {
+                "clusterIP": "None",
+                # Resolve worker DNS before readiness: jax.distributed
+                # rendezvous happens while pods are still NotReady.
+                "publishNotReadyAddresses": True,
+                "selector": {"statefulset": name},
+                "ports": [{"name": "coordinator", "port": port, "protocol": "TCP"}],
+            },
+        }
+        set_owner(svc, notebook)
+        return svc
+
+    def _reconcile_service(self, notebook: Resource) -> Resource:
+        return self._create_or_update_service(self.generate_service(notebook))
+
+    def _reconcile_headless_service(self, notebook: Resource) -> Resource:
+        return self._create_or_update_service(self.generate_headless_service(notebook))
+
+    def _create_or_update_service(self, desired: Resource) -> Resource:
+        ns, name = meta(desired)["namespace"], name_of(desired)
+        desired_hash = _content_hash(desired["spec"])
+        meta(desired).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
+        try:
+            current = self.client.get(SERVICE, name, ns)
+        except errors.NotFound:
+            return self.client.create(desired)
+        if deep_get(current, "metadata", "annotations", HASH_ANNOTATION) == desired_hash:
+            return current
+        # Overwrite only controller-owned fields; keep server-populated ones
+        # (clusterIP is immutable — reference CopyServiceFields preserves it).
+        want = copy.deepcopy(desired["spec"])
+        if "clusterIP" in current.get("spec", {}) and want.get("clusterIP") != "None":
+            want["clusterIP"] = current["spec"]["clusterIP"]
+        current["spec"] = want
+        meta(current).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
+        return self.client.update(current)
+
+    # -- istio ---------------------------------------------------------------
+
+    def generate_virtual_service(self, notebook: Resource) -> Resource:
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        prefix = nbapi.nb_prefix(ns, name) + "/"
+        annotations = deep_get(notebook, "metadata", "annotations", default={}) or {}
+        rewrite = annotations.get(nbapi.ANNOTATION_REWRITE_URI) or "/"
+        route: dict = {
+            "destination": {
+                "host": f"{name}.{ns}.svc.{self.cluster_domain}",
+                "port": {"number": 80},
+            }
+        }
+        headers_set = annotations.get(nbapi.ANNOTATION_HEADERS_REQUEST_SET)
+        http_route: dict = {
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": rewrite},
+            "route": [route],
+            "timeout": "300s",
+        }
+        if headers_set:
+            import json
+
+            try:
+                http_route["headers"] = {"request": {"set": json.loads(headers_set)}}
+            except ValueError:
+                pass
+        vs = {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {"name": f"notebook-{ns}-{name}", "namespace": ns},
+            "spec": {
+                "hosts": ["*"],
+                "gateways": [self.istio_gateway],
+                "http": [http_route],
+            },
+        }
+        set_owner(vs, notebook)
+        return vs
+
+    def _reconcile_virtual_service(self, notebook: Resource) -> Resource:
+        desired = self.generate_virtual_service(notebook)
+        ns, name = meta(desired)["namespace"], name_of(desired)
+        try:
+            current = self.client.get(VIRTUALSERVICE, name, ns)
+        except errors.NotFound:
+            return self.client.create(desired)
+        if current.get("spec") != desired.get("spec"):
+            current["spec"] = desired["spec"]
+            return self.client.update(current)
+        return current
+
+    # -- status --------------------------------------------------------------
+
+    def _update_status(self, notebook: Resource, sts: Resource) -> None:
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        pods = self.client.list(
+            POD, ns, label_selector={"statefulset": name}
+        )
+        ready = sum(1 for p in pods if _pod_ready(p))
+        worker0 = next(
+            (p for p in pods if name_of(p) == f"{name}-0"), None
+        )
+        status: dict = {
+            "readyReplicas": ready,
+            "replicas": deep_get(sts, "spec", "replicas", default=0),
+        }
+        if worker0:
+            status["conditions"] = deep_get(worker0, "status", "conditions", default=[])
+            cstates = deep_get(worker0, "status", "containerStatuses", default=[])
+            if cstates:
+                status["containerState"] = cstates[0].get("state", {})
+        if notebook.get("status") != status:
+            replicas = status["replicas"]
+            was_ready = deep_get(notebook, "status", "readyReplicas", default=0)
+            if replicas and ready == replicas and was_ready < replicas:
+                # First transition to fully-ready: the spawn-to-ready metric
+                # (BASELINE.md headline on the platform side).
+                created = deep_get(notebook, "metadata", "creationTimestamp")
+                elapsed = _seconds_since(created)
+                if elapsed is not None:
+                    metrics.notebook_spawn_seconds.observe(elapsed)
+            notebook = copy.deepcopy(notebook)
+            notebook["status"] = status
+            self.client.update_status(notebook)
+
+
+def _seconds_since(timestamp: Optional[str]) -> Optional[float]:
+    if not timestamp:
+        return None
+    import calendar
+
+    try:
+        then = calendar.timegm(time.strptime(timestamp, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return None
+    return max(0.0, time.time() - then)
+
+
+def _pod_ready(pod: Resource) -> bool:
+    for cond in deep_get(pod, "status", "conditions", default=[]):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
+
+
+def pods_to_notebook_requests(obj: Resource) -> List[Request]:
+    """Watch mapper: pod events → owning Notebook (by notebook-name label)."""
+    labels = deep_get(obj, "metadata", "labels", default={}) or {}
+    nb = labels.get(nbapi.LABEL_NOTEBOOK_NAME)
+    if not nb:
+        return []
+    return [Request(deep_get(obj, "metadata", "namespace", default=""), nb)]
+
+
+def make_controller(client, **kwargs):
+    from kubeflow_tpu.platform.runtime import Controller
+
+    return Controller(
+        "notebook-controller",
+        NotebookReconciler(client, **kwargs),
+        primary=NOTEBOOK,
+        owns=[STATEFULSET, SERVICE, VIRTUALSERVICE],
+        watches=[(POD, pods_to_notebook_requests)],
+        # Safety net for drift no watch covers (and for the REST client's
+        # bounded watch windows): re-list the primaries periodically.
+        resync_period=300.0,
+    )

@@ -1,0 +1,26 @@
+from kubeflow_tpu.platform.k8s.errors import ApiError, Conflict, Forbidden, NotFound
+from kubeflow_tpu.platform.k8s.types import (
+    GVK,
+    Resource,
+    api_version_of,
+    gvk_of,
+    meta,
+    name_of,
+    namespace_of,
+    owner_reference,
+)
+
+__all__ = [
+    "ApiError",
+    "Conflict",
+    "Forbidden",
+    "NotFound",
+    "GVK",
+    "Resource",
+    "api_version_of",
+    "gvk_of",
+    "meta",
+    "name_of",
+    "namespace_of",
+    "owner_reference",
+]

@@ -1,0 +1,289 @@
+"""Kubernetes API client: a small native REST client.
+
+The reference platform talks to the API server through client-go (Go) and
+the ``kubernetes`` python package; neither is assumed here.  This client
+speaks the REST conventions directly (JSON over HTTPS, optimistic
+concurrency via resourceVersion, watch streams as chunked JSON lines) and is
+the single seam the controllers/web-apps depend on — ``FakeKube``
+(kubeflow_tpu.platform.testing) implements the same interface in memory for
+the envtest-style suites.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Protocol, Tuple
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import GVK, Resource, gvk_of, meta, name_of, namespace_of
+
+WatchEvent = Tuple[str, Resource]  # ("ADDED"|"MODIFIED"|"DELETED"|"BOOKMARK", obj)
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeClient(Protocol):
+    """The verbs the platform uses.  All objects are unstructured dicts."""
+
+    def get(self, gvk: GVK, name: str, namespace: Optional[str] = None) -> Resource: ...
+
+    def list(
+        self,
+        gvk: GVK,
+        namespace: Optional[str] = None,
+        *,
+        label_selector: Optional[Dict[str, str]] = None,
+    ) -> List[Resource]: ...
+
+    def create(self, obj: Resource, *, dry_run: bool = False) -> Resource: ...
+
+    def update(self, obj: Resource) -> Resource: ...
+
+    def update_status(self, obj: Resource) -> Resource: ...
+
+    def patch(
+        self,
+        gvk: GVK,
+        name: str,
+        patch: Any,
+        namespace: Optional[str] = None,
+        *,
+        patch_type: str = "merge",
+    ) -> Resource: ...
+
+    def delete(
+        self,
+        gvk: GVK,
+        name: str,
+        namespace: Optional[str] = None,
+        *,
+        propagation: str = "Background",
+    ) -> None: ...
+
+    def watch(
+        self,
+        gvk: GVK,
+        namespace: Optional[str] = None,
+        *,
+        resource_version: Optional[str] = None,
+        label_selector: Optional[Dict[str, str]] = None,
+        stop: Optional[threading.Event] = None,
+    ) -> Iterator[WatchEvent]: ...
+
+    def can_i(
+        self,
+        user: str,
+        verb: str,
+        gvk: GVK,
+        namespace: Optional[str] = None,
+        *,
+        groups: Optional[List[str]] = None,
+        subresource: str = "",
+    ) -> bool: ...
+
+
+def _selector_string(label_selector: Optional[Dict[str, str]]) -> Optional[str]:
+    if not label_selector:
+        return None
+    return ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
+
+
+class RestKubeClient:
+    """KubeClient over the real API server.
+
+    Config resolution: explicit args → in-cluster service account →
+    $KUBECONFIG/~/.kube/config (current-context, token or client-cert auth).
+    """
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        *,
+        token: Optional[str] = None,
+        ca_cert: Optional[str] = None,
+        client_cert: Optional[Tuple[str, str]] = None,
+        verify: Optional[bool] = None,
+        timeout: float = 30.0,
+    ):
+        import requests
+
+        if base_url is None:
+            base_url, token, ca_cert, client_cert = self._resolve_config()
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._session = requests.Session()
+        if token:
+            self._session.headers["Authorization"] = f"Bearer {token}"
+        if client_cert:
+            self._session.cert = client_cert
+        if verify is not None:
+            self._session.verify = verify
+        elif ca_cert:
+            self._session.verify = ca_cert
+
+    @staticmethod
+    def _resolve_config() -> Tuple[str, Optional[str], Optional[str], Optional[Tuple[str, str]]]:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        if host and os.path.exists(f"{SERVICE_ACCOUNT_DIR}/token"):
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            with open(f"{SERVICE_ACCOUNT_DIR}/token") as f:
+                token = f.read().strip()
+            ca = f"{SERVICE_ACCOUNT_DIR}/ca.crt"
+            return f"https://{host}:{port}", token, ca if os.path.exists(ca) else None, None
+        # kubeconfig
+        import yaml
+
+        path = os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        if not os.path.exists(path):
+            raise RuntimeError(
+                "no API server config: not in-cluster and no kubeconfig at " + path
+            )
+        with open(path) as f:
+            kc = yaml.safe_load(f)
+        ctx_name = kc.get("current-context")
+        ctx = next(c["context"] for c in kc["contexts"] if c["name"] == ctx_name)
+        cluster = next(c["cluster"] for c in kc["clusters"] if c["name"] == ctx["cluster"])
+        user = next(u["user"] for u in kc["users"] if u["name"] == ctx["user"])
+        token = user.get("token")
+        cert = None
+        if "client-certificate" in user:
+            cert = (user["client-certificate"], user["client-key"])
+        ca = cluster.get("certificate-authority")
+        return cluster["server"], token, ca, cert
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _request(self, method: str, path: str, *, params: Optional[dict] = None,
+                 body: Optional[Any] = None, stream: bool = False):
+        headers = {}
+        if method == "PATCH":
+            ptype = (params or {}).pop("_patch_type", "merge")
+            headers["Content-Type"] = {
+                "merge": "application/merge-patch+json",
+                "json": "application/json-patch+json",
+                "strategic": "application/strategic-merge-patch+json",
+                "apply": "application/apply-patch+yaml",
+            }[ptype]
+        resp = self._session.request(
+            method,
+            self.base_url + path,
+            params=params,
+            json=body,
+            headers=headers or None,
+            stream=stream,
+            timeout=None if stream else self.timeout,
+        )
+        if resp.status_code >= 400:
+            try:
+                status = resp.json()
+                message = status.get("message", resp.text)
+            except Exception:
+                status, message = None, resp.text
+            raise errors.error_for_status(resp.status_code, message, status)
+        return resp
+
+    # -- verbs ---------------------------------------------------------------
+
+    def get(self, gvk: GVK, name: str, namespace: Optional[str] = None) -> Resource:
+        return self._request("GET", gvk.path(namespace, name)).json()
+
+    def list(self, gvk, namespace=None, *, label_selector=None) -> List[Resource]:
+        params = {}
+        sel = _selector_string(label_selector)
+        if sel:
+            params["labelSelector"] = sel
+        data = self._request("GET", gvk.path(namespace), params=params).json()
+        return data.get("items", [])
+
+    def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
+        gvk = gvk_of(obj)
+        params = {"dryRun": "All"} if dry_run else None
+        return self._request(
+            "POST", gvk.path(namespace_of(obj)), params=params, body=obj
+        ).json()
+
+    def update(self, obj: Resource) -> Resource:
+        gvk = gvk_of(obj)
+        return self._request(
+            "PUT", gvk.path(namespace_of(obj), name_of(obj)), body=obj
+        ).json()
+
+    def update_status(self, obj: Resource) -> Resource:
+        gvk = gvk_of(obj)
+        path = gvk.path(namespace_of(obj), name_of(obj)) + "/status"
+        return self._request("PUT", path, body=obj).json()
+
+    def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge") -> Resource:
+        return self._request(
+            "PATCH",
+            gvk.path(namespace, name),
+            params={"_patch_type": patch_type},
+            body=patch,
+        ).json()
+
+    def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:
+        self._request(
+            "DELETE",
+            gvk.path(namespace, name),
+            body={"propagationPolicy": propagation},
+        )
+
+    # Watch streams are bounded server-side so a half-dead connection can't
+    # freeze the controller silently: the server closes after
+    # WATCH_TIMEOUT_SECONDS and the caller's watch loop re-establishes; the
+    # client read timeout is slightly larger as a backstop (it fires as an
+    # exception the watch loop also treats as a reconnect).
+    WATCH_TIMEOUT_SECONDS = 300
+
+    def watch(self, gvk, namespace=None, *, resource_version=None,
+              label_selector=None, stop: Optional[threading.Event] = None):
+        params: Dict[str, Any] = {
+            "watch": "true",
+            "timeoutSeconds": str(self.WATCH_TIMEOUT_SECONDS),
+        }
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        sel = _selector_string(label_selector)
+        if sel:
+            params["labelSelector"] = sel
+        resp = self._session.request(
+            "GET",
+            self.base_url + gvk.path(namespace),
+            params=params,
+            stream=True,
+            timeout=(10, self.WATCH_TIMEOUT_SECONDS + 30),
+        )
+        if resp.status_code >= 400:
+            raise errors.error_for_status(resp.status_code, resp.text)
+        try:
+            for line in resp.iter_lines():
+                if stop is not None and stop.is_set():
+                    return
+                if not line:
+                    continue
+                evt = json.loads(line)
+                yield evt.get("type", ""), evt.get("object", {})
+        finally:
+            resp.close()
+
+    def can_i(self, user, verb, gvk, namespace=None, *, groups=None, subresource="") -> bool:
+        review = {
+            "apiVersion": "authorization.k8s.io/v1",
+            "kind": "SubjectAccessReview",
+            "spec": {
+                "user": user,
+                "groups": groups or [],
+                "resourceAttributes": {
+                    "group": gvk.group,
+                    "resource": gvk.plural,
+                    "subresource": subresource,
+                    "namespace": namespace or "",
+                    "verb": verb,
+                },
+            },
+        }
+        resp = self._request(
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews", body=review
+        ).json()
+        return bool(resp.get("status", {}).get("allowed"))

@@ -1,0 +1,65 @@
+"""Kubernetes API error taxonomy (maps HTTP status ↔ typed exceptions)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ApiError(Exception):
+    status: int = 500
+    reason: str = "InternalError"
+
+    def __init__(self, message: str = "", *, status: Optional[int] = None,
+                 reason: Optional[str] = None, body: Optional[dict] = None):
+        super().__init__(message or self.reason)
+        if status is not None:
+            self.status = status
+        if reason is not None:
+            self.reason = reason
+        self.body = body or {}
+
+    def to_status(self) -> dict:
+        """Render as a k8s Status object (what a real API server returns)."""
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "message": str(self),
+            "reason": self.reason,
+            "code": self.status,
+        }
+
+
+class NotFound(ApiError):
+    status = 404
+    reason = "NotFound"
+
+
+class Conflict(ApiError):
+    status = 409
+    reason = "Conflict"
+
+
+class AlreadyExists(Conflict):
+    reason = "AlreadyExists"
+
+
+class Forbidden(ApiError):
+    status = 403
+    reason = "Forbidden"
+
+
+class BadRequest(ApiError):
+    status = 400
+    reason = "BadRequest"
+
+
+class Invalid(ApiError):
+    status = 422
+    reason = "Invalid"
+
+
+def error_for_status(status: int, message: str = "", body: Optional[dict] = None) -> ApiError:
+    for cls in (NotFound, Conflict, Forbidden, BadRequest, Invalid):
+        if cls.status == status:
+            return cls(message, body=body)
+    return ApiError(message, status=status, body=body)

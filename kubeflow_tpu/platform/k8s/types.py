@@ -1,0 +1,183 @@
+"""Unstructured Kubernetes objects: plain dicts + typed helpers.
+
+Instead of generating hundreds of model classes (the reference leans on
+client-go structs and the python ``kubernetes`` models), every object here is
+a plain ``dict`` shaped exactly like its JSON wire form, with a small helper
+layer for the fields the platform actually touches.  This keeps the client
+dependency-free and round-trip faithful.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterable, Optional
+
+Resource = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class GVK:
+    """Group/version/kind + the REST plural for the resource."""
+
+    group: str  # "" for core
+    version: str
+    kind: str
+    plural: str
+    namespaced: bool = True
+
+    @property
+    def api_version(self) -> str:
+        return self.version if not self.group else f"{self.group}/{self.version}"
+
+    def path(self, namespace: Optional[str] = None, name: Optional[str] = None) -> str:
+        root = "/api" if not self.group else "/apis"
+        parts = [root, self.api_version]
+        if self.namespaced and namespace:
+            parts += ["namespaces", namespace]
+        parts.append(self.plural)
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+
+# --- Well-known kinds -------------------------------------------------------
+
+CORE = ""
+POD = GVK(CORE, "v1", "Pod", "pods")
+SERVICE = GVK(CORE, "v1", "Service", "services")
+NAMESPACE = GVK(CORE, "v1", "Namespace", "namespaces", namespaced=False)
+NODE = GVK(CORE, "v1", "Node", "nodes", namespaced=False)
+EVENT = GVK(CORE, "v1", "Event", "events")
+SECRET = GVK(CORE, "v1", "Secret", "secrets")
+CONFIGMAP = GVK(CORE, "v1", "ConfigMap", "configmaps")
+SERVICEACCOUNT = GVK(CORE, "v1", "ServiceAccount", "serviceaccounts")
+PVC = GVK(CORE, "v1", "PersistentVolumeClaim", "persistentvolumeclaims")
+RESOURCEQUOTA = GVK(CORE, "v1", "ResourceQuota", "resourcequotas")
+
+STATEFULSET = GVK("apps", "v1", "StatefulSet", "statefulsets")
+DEPLOYMENT = GVK("apps", "v1", "Deployment", "deployments")
+
+ROLEBINDING = GVK("rbac.authorization.k8s.io", "v1", "RoleBinding", "rolebindings")
+CLUSTERROLE = GVK("rbac.authorization.k8s.io", "v1", "ClusterRole", "clusterroles", namespaced=False)
+STORAGECLASS = GVK("storage.k8s.io", "v1", "StorageClass", "storageclasses", namespaced=False)
+
+VIRTUALSERVICE = GVK("networking.istio.io", "v1beta1", "VirtualService", "virtualservices")
+AUTHORIZATIONPOLICY = GVK("security.istio.io", "v1beta1", "AuthorizationPolicy", "authorizationpolicies")
+
+NOTEBOOK = GVK("kubeflow.org", "v1beta1", "Notebook", "notebooks")
+PROFILE = GVK("kubeflow.org", "v1", "Profile", "profiles", namespaced=False)
+PODDEFAULT = GVK("kubeflow.org", "v1alpha1", "PodDefault", "poddefaults")
+TENSORBOARD = GVK("tensorboard.kubeflow.org", "v1alpha1", "Tensorboard", "tensorboards")
+
+WELL_KNOWN: tuple[GVK, ...] = (
+    POD, SERVICE, NAMESPACE, NODE, EVENT, SECRET, CONFIGMAP, SERVICEACCOUNT,
+    PVC, RESOURCEQUOTA, STATEFULSET, DEPLOYMENT, ROLEBINDING, CLUSTERROLE,
+    STORAGECLASS, VIRTUALSERVICE, AUTHORIZATIONPOLICY, NOTEBOOK, PROFILE,
+    PODDEFAULT, TENSORBOARD,
+)
+
+
+def gvk_for(api_version: str, kind: str) -> GVK:
+    for g in WELL_KNOWN:
+        if g.api_version == api_version and g.kind == kind:
+            return g
+    group, _, version = api_version.rpartition("/")
+    # Fall back to the conventional lowercase-plural guess.
+    return GVK(group, version or api_version, kind, kind.lower() + "s")
+
+
+# --- Object helpers ---------------------------------------------------------
+
+
+def new(gvk: GVK, name: str, namespace: Optional[str] = None, *,
+        labels: Optional[dict] = None, annotations: Optional[dict] = None) -> Resource:
+    obj: Resource = {
+        "apiVersion": gvk.api_version,
+        "kind": gvk.kind,
+        "metadata": {"name": name},
+    }
+    if gvk.namespaced and namespace:
+        obj["metadata"]["namespace"] = namespace
+    if labels:
+        obj["metadata"]["labels"] = dict(labels)
+    if annotations:
+        obj["metadata"]["annotations"] = dict(annotations)
+    return obj
+
+
+def meta(obj: Resource) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def name_of(obj: Resource) -> str:
+    return meta(obj).get("name", "")
+
+
+def namespace_of(obj: Resource) -> Optional[str]:
+    return meta(obj).get("namespace")
+
+
+def api_version_of(obj: Resource) -> str:
+    return obj.get("apiVersion", "")
+
+
+def gvk_of(obj: Resource) -> GVK:
+    return gvk_for(api_version_of(obj), obj.get("kind", ""))
+
+
+def labels_of(obj: Resource) -> dict:
+    return meta(obj).get("labels") or {}
+
+
+def annotations_of(obj: Resource) -> dict:
+    return meta(obj).get("annotations") or {}
+
+
+def set_annotation(obj: Resource, key: str, value: str) -> None:
+    meta(obj).setdefault("annotations", {})[key] = value
+
+
+def owner_reference(owner: Resource, *, controller: bool = True,
+                    block_owner_deletion: bool = True) -> dict:
+    return {
+        "apiVersion": api_version_of(owner),
+        "kind": owner.get("kind", ""),
+        "name": name_of(owner),
+        "uid": meta(owner).get("uid", ""),
+        "controller": controller,
+        "blockOwnerDeletion": block_owner_deletion,
+    }
+
+
+def set_owner(obj: Resource, owner: Resource) -> None:
+    refs = meta(obj).setdefault("ownerReferences", [])
+    ref = owner_reference(owner)
+    for existing in refs:
+        if existing.get("uid") == ref["uid"] and existing.get("name") == ref["name"]:
+            return
+    refs.append(ref)
+
+
+def is_owned_by(obj: Resource, owner: Resource) -> bool:
+    owner_uid = meta(owner).get("uid")
+    return any(r.get("uid") == owner_uid for r in meta(obj).get("ownerReferences", []))
+
+
+def controller_of(obj: Resource) -> Optional[dict]:
+    for r in meta(obj).get("ownerReferences", []):
+        if r.get("controller"):
+            return r
+    return None
+
+
+def match_labels(obj: Resource, selector: Dict[str, str]) -> bool:
+    labels = labels_of(obj)
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def deep_get(obj: Resource, *path: str, default: Any = None) -> Any:
+    cur: Any = obj
+    for p in path:
+        if not isinstance(cur, dict) or p not in cur:
+            return default
+        cur = cur[p]
+    return cur

@@ -1,0 +1,10 @@
+from kubeflow_tpu.platform.runtime.controller import (
+    Controller,
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+)
+from kubeflow_tpu.platform.runtime.events import EventRecorder
+
+__all__ = ["Controller", "Manager", "Reconciler", "Request", "Result", "EventRecorder"]

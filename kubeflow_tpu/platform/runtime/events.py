@@ -1,0 +1,53 @@
+"""Event recording: surface reconcile outcomes as v1 Events on objects.
+
+The reference re-emits pod/statefulset events onto the Notebook CR so users
+see scheduling failures in the UI (reference notebook_controller.go:94-118);
+this recorder is the write side of that pattern.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from kubeflow_tpu.platform.k8s.types import EVENT, Resource, api_version_of, meta, name_of, namespace_of
+
+
+class EventRecorder:
+    def __init__(self, client, component: str):
+        self.client = client
+        self.component = component
+
+    def event(
+        self,
+        obj: Resource,
+        event_type: str,  # "Normal" | "Warning"
+        reason: str,
+        message: str,
+        *,
+        namespace: Optional[str] = None,
+    ) -> Resource:
+        ns = namespace or namespace_of(obj) or "default"
+        ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "generateName": f"{name_of(obj)}.",
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "apiVersion": api_version_of(obj),
+                "kind": obj.get("kind", ""),
+                "name": name_of(obj),
+                "namespace": namespace_of(obj) or "",
+                "uid": meta(obj).get("uid", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "source": {"component": self.component},
+            "firstTimestamp": ts,
+            "lastTimestamp": ts,
+            "count": 1,
+        }
+        return self.client.create(ev)

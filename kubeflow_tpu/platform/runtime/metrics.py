@@ -1,0 +1,55 @@
+"""Prometheus metrics for the control plane.
+
+Same metric surface as the reference (reference
+notebook-controller/pkg/metrics/metrics.go:13-99 and profile-controller
+monitoring.go:28-60) plus the TPU-specific gauges the north star asks for
+(chips requested/allocated per namespace).
+"""
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
+
+registry = CollectorRegistry()
+
+notebook_create_total = Counter(
+    "notebook_create_total", "Total Notebook creations handled", registry=registry
+)
+notebook_create_failed_total = Counter(
+    "notebook_create_failed_total", "Failed Notebook creations", registry=registry
+)
+notebook_culling_total = Counter(
+    "notebook_culling_total", "Total notebooks culled for idleness", registry=registry
+)
+last_culling_timestamp = Gauge(
+    "last_notebook_culling_timestamp_seconds",
+    "Timestamp of the last culling operation",
+    registry=registry,
+)
+notebook_running = Gauge(
+    "notebook_running",
+    "Running notebooks by namespace",
+    ["namespace"],
+    registry=registry,
+)
+notebook_spawn_seconds = Histogram(
+    "notebook_spawn_to_ready_seconds",
+    "Seconds from Notebook creation to all workers Ready (the BASELINE.md metric)",
+    buckets=(5, 10, 20, 30, 60, 120, 300, 600),
+    registry=registry,
+)
+tpu_chips_requested = Gauge(
+    "tpu_chips_requested",
+    "google.com/tpu chips requested by notebooks, per namespace",
+    ["namespace"],
+    registry=registry,
+)
+reconcile_errors_total = Counter(
+    "reconcile_errors_total",
+    "Reconcile errors by controller",
+    ["controller"],
+    registry=registry,
+)
+
+
+def render() -> bytes:
+    return generate_latest(registry)

@@ -1,0 +1,3 @@
+from kubeflow_tpu.platform.testing.fake import FakeKube
+
+__all__ = ["FakeKube"]

@@ -1,0 +1,317 @@
+"""In-memory Kubernetes API: the envtest analogue.
+
+The reference tests its reconcilers against a real API server spun up by
+``setup-envtest`` (SURVEY.md §4 tier 2 — suite_test.go files).  Here the
+same role is played by an in-memory store that implements the KubeClient
+protocol with the API-server semantics the controllers rely on:
+
+* resourceVersion bumping + optimistic-concurrency conflicts on update
+* status as a separate subresource (update doesn't clobber status and
+  update_status doesn't clobber spec)
+* uid/creationTimestamp/generation defaulting on create
+* label-selector list/watch
+* watch streams with sequenced events per (gvk, namespace)
+* ownerReference cascade deletion (synchronous — deterministic for tests)
+* namespace existence checks and a pluggable SubjectAccessReview policy
+
+Plus test-only helpers: ``set_pod_phase`` to simulate kubelet, and node
+fixtures with TPU capacity (``add_tpu_node``) — the "fake TPU node" fixture
+SURVEY.md §4 calls out as the thing the reference lacks.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    GVK,
+    NAMESPACE,
+    NODE,
+    POD,
+    Resource,
+    gvk_of,
+    match_labels,
+    meta,
+    name_of,
+    namespace_of,
+)
+
+Key = Tuple[str, str, str, str]  # (api_version, kind, namespace, name)
+
+
+def _key(gvk: GVK, namespace: Optional[str], name: str) -> Key:
+    return (gvk.api_version, gvk.kind, namespace or "", name)
+
+
+class FakeKube:
+    """KubeClient backed by a dict.  Thread-safe."""
+
+    def __init__(self, *, now: Optional[Callable[[], float]] = None):
+        self._objects: Dict[Key, Resource] = {}
+        self._lock = threading.RLock()
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+        self._watchers: List[Tuple[GVK, Optional[str], Optional[dict], queue.Queue]] = []
+        self._now = now or time.time
+        # SubjectAccessReview policy: (user, verb, gvk, namespace) -> bool.
+        self.authz_policy: Optional[Callable[..., bool]] = None
+
+    # -- helpers -------------------------------------------------------------
+
+    def _bump(self, obj: Resource) -> None:
+        meta(obj)["resourceVersion"] = str(next(self._rv))
+
+    def _emit(self, event_type: str, obj: Resource) -> None:
+        gvk = gvk_of(obj)
+        for (wgvk, wns, wsel, q) in list(self._watchers):
+            if wgvk.kind != gvk.kind or wgvk.api_version != gvk.api_version:
+                continue
+            if wns and namespace_of(obj) != wns:
+                continue
+            if wsel and not match_labels(obj, wsel):
+                continue
+            q.put((event_type, copy.deepcopy(obj)))
+
+    def _get_ref(self, gvk: GVK, name: str, namespace: Optional[str]) -> Resource:
+        try:
+            return self._objects[_key(gvk, namespace if gvk.namespaced else None, name)]
+        except KeyError:
+            raise errors.NotFound(
+                f'{gvk.plural} "{name}" not found'
+                + (f' in namespace "{namespace}"' if namespace else "")
+            ) from None
+
+    # -- verbs ---------------------------------------------------------------
+
+    def get(self, gvk: GVK, name: str, namespace: Optional[str] = None) -> Resource:
+        with self._lock:
+            return copy.deepcopy(self._get_ref(gvk, name, namespace))
+
+    def list(self, gvk, namespace=None, *, label_selector=None) -> List[Resource]:
+        with self._lock:
+            out = []
+            for (av, kind, ns, _), obj in self._objects.items():
+                if av != gvk.api_version or kind != gvk.kind:
+                    continue
+                if gvk.namespaced and namespace and ns != namespace:
+                    continue
+                if label_selector and not match_labels(obj, label_selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
+        with self._lock:
+            obj = copy.deepcopy(obj)
+            gvk = gvk_of(obj)
+            name = name_of(obj)
+            ns = namespace_of(obj)
+            if not name:
+                gen = meta(obj).get("generateName")
+                if not gen:
+                    raise errors.Invalid("name or generateName required")
+                name = gen + f"{next(self._uid):05x}"
+                meta(obj)["name"] = name
+            if gvk.namespaced:
+                if not ns:
+                    raise errors.Invalid(f"{gvk.kind} requires a namespace")
+                if _key(NAMESPACE, None, ns) not in self._objects:
+                    raise errors.NotFound(f'namespaces "{ns}" not found')
+            key = _key(gvk, ns if gvk.namespaced else None, name)
+            if key in self._objects:
+                raise errors.AlreadyExists(f'{gvk.plural} "{name}" already exists')
+            if dry_run:
+                return obj
+            m = meta(obj)
+            m.setdefault("uid", f"uid-{next(self._uid)}")
+            m.setdefault("creationTimestamp", self._timestamp())
+            m.setdefault("generation", 1)
+            m.setdefault("labels", m.get("labels", {}))
+            self._bump(obj)
+            self._objects[key] = obj
+            self._emit("ADDED", obj)
+            return copy.deepcopy(obj)
+
+    def update(self, obj: Resource) -> Resource:
+        with self._lock:
+            gvk = gvk_of(obj)
+            current = self._get_ref(gvk, name_of(obj), namespace_of(obj))
+            self._check_rv(obj, current)
+            obj = copy.deepcopy(obj)
+            # status is a subresource: PUT on the main resource keeps it.
+            if "status" in current:
+                obj["status"] = copy.deepcopy(current["status"])
+            if obj.get("spec") != current.get("spec"):
+                meta(obj)["generation"] = meta(current).get("generation", 1) + 1
+            else:
+                meta(obj)["generation"] = meta(current).get("generation", 1)
+            for field in ("uid", "creationTimestamp"):
+                meta(obj)[field] = meta(current).get(field)
+            self._bump(obj)
+            key = _key(gvk, namespace_of(obj) if gvk.namespaced else None, name_of(obj))
+            self._objects[key] = obj
+            self._emit("MODIFIED", obj)
+            return copy.deepcopy(obj)
+
+    def update_status(self, obj: Resource) -> Resource:
+        with self._lock:
+            gvk = gvk_of(obj)
+            current = self._get_ref(gvk, name_of(obj), namespace_of(obj))
+            self._check_rv(obj, current)
+            current["status"] = copy.deepcopy(obj.get("status", {}))
+            self._bump(current)
+            self._emit("MODIFIED", current)
+            return copy.deepcopy(current)
+
+    def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge") -> Resource:
+        with self._lock:
+            current = self._get_ref(gvk, name, namespace)
+            if patch_type == "merge" or patch_type == "strategic":
+                _merge_patch(current, patch)
+            elif patch_type == "json":
+                from kubeflow_tpu.platform.webhook.jsonpatch import apply_patch
+
+                patched = apply_patch(copy.deepcopy(current), patch)
+                current.clear()
+                current.update(patched)
+            else:
+                raise errors.BadRequest(f"unsupported patch type {patch_type}")
+            self._bump(current)
+            self._emit("MODIFIED", current)
+            return copy.deepcopy(current)
+
+    def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:
+        with self._lock:
+            obj = self._get_ref(gvk, name, namespace)
+            key = _key(gvk, namespace if gvk.namespaced else None, name)
+            del self._objects[key]
+            self._emit("DELETED", obj)
+            self._cascade(meta(obj).get("uid"))
+
+    def _cascade(self, owner_uid: Optional[str]) -> None:
+        if not owner_uid:
+            return
+        doomed = []
+        for key, obj in self._objects.items():
+            for ref in meta(obj).get("ownerReferences", []):
+                if ref.get("uid") == owner_uid:
+                    doomed.append((key, obj))
+                    break
+        for key, obj in doomed:
+            if key in self._objects:
+                del self._objects[key]
+                self._emit("DELETED", obj)
+                self._cascade(meta(obj).get("uid"))
+
+    def watch(self, gvk, namespace=None, *, resource_version=None,
+              label_selector=None, stop: Optional[threading.Event] = None
+              ) -> Iterator[Tuple[str, Resource]]:
+        q: queue.Queue = queue.Queue()
+        entry = (gvk, namespace, label_selector, q)
+        with self._lock:
+            # List+watch semantics: emit current state first unless the
+            # caller resumes from a resourceVersion.
+            backlog = [] if resource_version else [
+                ("ADDED", obj) for obj in self.list(
+                    gvk, namespace, label_selector=label_selector
+                )
+            ]
+            self._watchers.append(entry)
+        try:
+            for evt in backlog:
+                yield evt
+            while stop is None or not stop.is_set():
+                try:
+                    yield q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+    def can_i(self, user, verb, gvk, namespace=None, *, groups=None, subresource="") -> bool:
+        if self.authz_policy is None:
+            return True
+        return self.authz_policy(
+            user=user, verb=verb, gvk=gvk, namespace=namespace,
+            groups=groups or [], subresource=subresource,
+        )
+
+    # -- internals -----------------------------------------------------------
+
+    def _check_rv(self, incoming: Resource, current: Resource) -> None:
+        rv = meta(incoming).get("resourceVersion")
+        if rv and rv != meta(current).get("resourceVersion"):
+            raise errors.Conflict(
+                f'operation cannot be fulfilled: object was modified '
+                f'(have {rv}, current {meta(current).get("resourceVersion")})'
+            )
+
+    def _timestamp(self) -> str:
+        import datetime
+
+        return datetime.datetime.fromtimestamp(
+            self._now(), tz=datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+    # -- test fixtures -------------------------------------------------------
+
+    def add_namespace(self, name: str, *, labels: Optional[dict] = None) -> Resource:
+        return self.create(
+            {"apiVersion": "v1", "kind": "Namespace",
+             "metadata": {"name": name, **({"labels": labels} if labels else {})}}
+        )
+
+    def add_tpu_node(self, name: str, *, accelerator: str = "tpu-v5-lite-podslice",
+                     topology: str = "2x4", chips: int = 8) -> Resource:
+        """Fake TPU node: capacity + GKE-style topology labels (SURVEY §4)."""
+        return self.create({
+            "apiVersion": "v1",
+            "kind": "Node",
+            "metadata": {
+                "name": name,
+                "labels": {
+                    "cloud.google.com/gke-tpu-accelerator": accelerator,
+                    "cloud.google.com/gke-tpu-topology": topology,
+                },
+            },
+            "status": {
+                "capacity": {"google.com/tpu": str(chips), "cpu": "96", "memory": "192Gi"},
+                "allocatable": {"google.com/tpu": str(chips)},
+            },
+        })
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str, *,
+                      ready: Optional[bool] = None,
+                      conditions: Optional[list] = None) -> Resource:
+        """Simulate the kubelet moving a pod through its lifecycle."""
+        pod = self.get(POD, name, namespace)
+        status = pod.setdefault("status", {})
+        status["phase"] = phase
+        if conditions is not None:
+            status["conditions"] = conditions
+        elif ready is not None:
+            status["conditions"] = [
+                {"type": "Ready", "status": "True" if ready else "False",
+                 "lastTransitionTime": self._timestamp()}
+            ]
+        return self.update_status(pod)
+
+
+def _merge_patch(target: Resource, patch: Any) -> None:
+    """RFC 7386 merge patch, in place."""
+    if not isinstance(patch, dict):
+        raise errors.BadRequest("merge patch must be an object")
+    for k, v in patch.items():
+        if v is None:
+            target.pop(k, None)
+        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+            _merge_patch(target[k], v)
+        else:
+            target[k] = copy.deepcopy(v)

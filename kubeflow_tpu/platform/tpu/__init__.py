@@ -1,0 +1,19 @@
+from kubeflow_tpu.platform.tpu.topology import (
+    ACCELERATORS,
+    RESOURCE_TPU,
+    SliceSpec,
+    TpuAccelerator,
+    parse_topology,
+    slice_spec,
+    topologies_on_nodes,
+)
+
+__all__ = [
+    "ACCELERATORS",
+    "RESOURCE_TPU",
+    "SliceSpec",
+    "TpuAccelerator",
+    "parse_topology",
+    "slice_spec",
+    "topologies_on_nodes",
+]

@@ -1,0 +1,133 @@
+"""TPU accelerator + topology tables: the platform's scheduling brain.
+
+The reference's device story is a GPU-vendor dropdown writing
+``limits["nvidia.com/gpu"]=N`` on one pod (reference
+jupyter/backend/apps/common/form.py:226-250) — single node, no topology.
+TPU slices are different: a topology like ``4x8`` is a *multi-host* object
+(32 chips over 4 hosts for v5e), and scheduling one means:
+
+* per-pod chip limits  (``google.com/tpu: chips_per_host``)
+* node selectors       (``cloud.google.com/gke-tpu-accelerator`` +
+                        ``cloud.google.com/gke-tpu-topology``)
+* replica count        (one pod per host, StatefulSet ordinal = worker id)
+* worker env           (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES / TPU_TOPOLOGY)
+
+This module owns the math; the notebook controller and the spawner API both
+consume it, so the two can never disagree about what a topology means.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+RESOURCE_TPU = "google.com/tpu"
+LABEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+LABEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuAccelerator:
+    """One TPU generation as GKE schedules it."""
+
+    name: str                # short name used in Notebook specs ("v5e")
+    gke_accelerator: str     # node-label value
+    chips_per_host: int      # chips a single host exposes (max per pod)
+    dims: int                # topology rank: 2 for v5e/v6e, 3 for v4/v5p
+    default_topology: str
+    hbm_gb_per_chip: int     # surfaced in the spawner UI
+
+
+ACCELERATORS: Dict[str, TpuAccelerator] = {
+    "v4": TpuAccelerator("v4", "tpu-v4-podslice", 4, 3, "2x2x1", 32),
+    "v5e": TpuAccelerator("v5e", "tpu-v5-lite-podslice", 8, 2, "2x4", 16),
+    "v5p": TpuAccelerator("v5p", "tpu-v5p-slice", 4, 3, "2x2x1", 95),
+    "v6e": TpuAccelerator("v6e", "tpu-v6e-slice", 8, 2, "2x4", 32),
+}
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"invalid TPU topology {topology!r}") from None
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"invalid TPU topology {topology!r}")
+    return dims
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceSpec:
+    """Everything the scheduler-facing side needs to place one slice."""
+
+    accelerator: TpuAccelerator
+    topology: str
+    chips: int
+    num_hosts: int
+    chips_per_pod: int
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    def node_selectors(self) -> Dict[str, str]:
+        return {
+            LABEL_ACCELERATOR: self.accelerator.gke_accelerator,
+            LABEL_TOPOLOGY: self.topology,
+        }
+
+    def pod_resources(self) -> Dict[str, str]:
+        return {RESOURCE_TPU: str(self.chips_per_pod)}
+
+
+def slice_spec(accelerator: str, topology: Optional[str] = None) -> SliceSpec:
+    """Resolve (accelerator, topology) → SliceSpec, validating the shape."""
+    if accelerator not in ACCELERATORS:
+        raise ValueError(
+            f"unknown TPU accelerator {accelerator!r}; known: {sorted(ACCELERATORS)}"
+        )
+    acc = ACCELERATORS[accelerator]
+    topo = topology or acc.default_topology
+    dims = parse_topology(topo)
+    if len(dims) != acc.dims:
+        raise ValueError(
+            f"{acc.name} topologies have {acc.dims} dims, got {topo!r}"
+        )
+    chips = math.prod(dims)
+    # Multi-host slices must fill whole hosts: a '3x3' on v5e (9 chips,
+    # 8/host) has no valid host decomposition and no matching GKE nodepool.
+    if chips > acc.chips_per_host and chips % acc.chips_per_host != 0:
+        raise ValueError(
+            f"topology {topo!r} = {chips} chips does not pack into "
+            f"{acc.chips_per_host}-chip {acc.name} hosts"
+        )
+    num_hosts = max(1, math.ceil(chips / acc.chips_per_host))
+    chips_per_pod = chips if num_hosts == 1 else acc.chips_per_host
+    return SliceSpec(
+        accelerator=acc,
+        topology=topo,
+        chips=chips,
+        num_hosts=num_hosts,
+        chips_per_pod=chips_per_pod,
+    )
+
+
+def topologies_on_nodes(nodes) -> Dict[str, list]:
+    """Scan node labels/capacity → {accelerator_short_name: [topologies]}.
+
+    Feeds the spawner's ``GET /api/tpus`` (the analogue of the reference's
+    ``GET /api/gpus`` node-capacity scan, get.py:102-123).
+    """
+    by_label = {a.gke_accelerator: a.name for a in ACCELERATORS.values()}
+    out: Dict[str, set] = {}
+    for node in nodes:
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        cap = ((node.get("status") or {}).get("capacity") or {})
+        acc_label = labels.get(LABEL_ACCELERATOR)
+        topo = labels.get(LABEL_TOPOLOGY)
+        if not acc_label or acc_label not in by_label:
+            continue
+        if not cap.get(RESOURCE_TPU):
+            continue
+        out.setdefault(by_label[acc_label], set()).add(topo or "")
+    return {k: sorted(t for t in v if t) for k, v in out.items()}

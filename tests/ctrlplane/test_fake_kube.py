@@ -1,0 +1,132 @@
+import threading
+
+import pytest
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import CONFIGMAP, NAMESPACE, NOTEBOOK, POD, new, set_owner
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("user1")
+    return k
+
+
+def test_create_get_roundtrip(kube):
+    obj = new(CONFIGMAP, "cm", "user1")
+    obj["data"] = {"k": "v"}
+    created = kube.create(obj)
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"]
+    got = kube.get(CONFIGMAP, "cm", "user1")
+    assert got["data"] == {"k": "v"}
+
+
+def test_create_requires_namespace(kube):
+    with pytest.raises(errors.NotFound):
+        kube.create(new(CONFIGMAP, "cm", "nope"))
+    with pytest.raises(errors.Invalid):
+        kube.create({"apiVersion": "v1", "kind": "ConfigMap", "metadata": {}})
+
+
+def test_duplicate_create_conflicts(kube):
+    kube.create(new(CONFIGMAP, "cm", "user1"))
+    with pytest.raises(errors.Conflict):
+        kube.create(new(CONFIGMAP, "cm", "user1"))
+
+
+def test_update_bumps_rv_and_detects_conflict(kube):
+    created = kube.create(new(CONFIGMAP, "cm", "user1"))
+    stale_rv = created["metadata"]["resourceVersion"]
+    created["data"] = {"a": "1"}
+    updated = kube.update(created)
+    assert updated["metadata"]["resourceVersion"] != stale_rv
+    created["metadata"]["resourceVersion"] = stale_rv
+    with pytest.raises(errors.Conflict):
+        kube.update(created)
+
+
+def test_status_is_a_subresource(kube):
+    nb = new(NOTEBOOK, "nb", "user1")
+    nb["spec"] = {"template": {"spec": {"containers": [{"image": "x"}]}}}
+    created = kube.create(nb)
+    created["status"] = {"readyReplicas": 1}
+    kube.update_status(created)
+    # A spec update must not clobber status…
+    got = kube.get(NOTEBOOK, "nb", "user1")
+    got["spec"]["tpu"] = {"accelerator": "v5e"}
+    kube.update(got)
+    assert kube.get(NOTEBOOK, "nb", "user1")["status"] == {"readyReplicas": 1}
+    # …and a status update must not clobber spec.
+    s = kube.get(NOTEBOOK, "nb", "user1")
+    s["spec"] = {"junk": True}
+    s["status"] = {"readyReplicas": 2}
+    kube.update_status(s)
+    final = kube.get(NOTEBOOK, "nb", "user1")
+    assert final["spec"]["tpu"] == {"accelerator": "v5e"}
+    assert final["status"] == {"readyReplicas": 2}
+
+
+def test_label_selector_list(kube):
+    for i, team in enumerate(["a", "a", "b"]):
+        obj = new(CONFIGMAP, f"cm{i}", "user1", labels={"team": team})
+        kube.create(obj)
+    assert len(kube.list(CONFIGMAP, "user1", label_selector={"team": "a"})) == 2
+
+
+def test_owner_cascade_delete(kube):
+    nb = kube.create(
+        {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+         "metadata": {"name": "nb", "namespace": "user1"}}
+    )
+    child = new(POD, "nb-0", "user1")
+    set_owner(child, nb)
+    kube.create(child)
+    grandchild = new(CONFIGMAP, "cm", "user1")
+    set_owner(grandchild, kube.get(POD, "nb-0", "user1"))
+    kube.create(grandchild)
+    kube.delete(NOTEBOOK, "nb", "user1")
+    with pytest.raises(errors.NotFound):
+        kube.get(POD, "nb-0", "user1")
+    with pytest.raises(errors.NotFound):
+        kube.get(CONFIGMAP, "cm", "user1")
+
+
+def test_merge_patch(kube):
+    obj = new(CONFIGMAP, "cm", "user1")
+    obj["data"] = {"keep": "1", "drop": "2"}
+    kube.create(obj)
+    out = kube.patch(CONFIGMAP, "cm", {"data": {"drop": None, "new": "3"}}, "user1")
+    assert out["data"] == {"keep": "1", "new": "3"}
+
+
+def test_watch_sees_initial_state_and_updates(kube):
+    kube.create(new(CONFIGMAP, "cm0", "user1"))
+    stop = threading.Event()
+    events = []
+
+    def consume():
+        for evt in kube.watch(CONFIGMAP, "user1", stop=stop):
+            events.append(evt)
+            if len(events) >= 2:
+                stop.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    kube.create(new(CONFIGMAP, "cm1", "user1"))
+    t.join(timeout=5)
+    assert [e[0] for e in events] == ["ADDED", "ADDED"]
+    assert {e[1]["metadata"]["name"] for e in events} == {"cm0", "cm1"}
+
+
+def test_generate_name(kube):
+    obj = {"apiVersion": "v1", "kind": "ConfigMap",
+           "metadata": {"generateName": "ev-", "namespace": "user1"}}
+    a, b = kube.create(dict(obj)), kube.create(dict(obj))
+    assert a["metadata"]["name"] != b["metadata"]["name"]
+    assert a["metadata"]["name"].startswith("ev-")

@@ -1,0 +1,225 @@
+import pytest
+
+from kubeflow_tpu.platform.apis import notebook as nbapi
+from kubeflow_tpu.platform.controllers.notebook import (
+    NotebookReconciler,
+    pods_to_notebook_requests,
+)
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    NOTEBOOK,
+    SERVICE,
+    STATEFULSET,
+    VIRTUALSERVICE,
+    deep_get,
+    new,
+)
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_notebook(name="nb", ns="user1", tpu=None, annotations=None):
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "template": {
+                "spec": {
+                    "containers": [{
+                        "name": name,
+                        "image": "jupyter-jax-tpu:latest",
+                    }]
+                }
+            }
+        },
+    }
+    if tpu:
+        nb["spec"]["tpu"] = tpu
+    if annotations:
+        nb["metadata"]["annotations"] = annotations
+    return nb
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("user1")
+    return k
+
+
+@pytest.fixture
+def reconciler(kube):
+    return NotebookReconciler(kube, use_istio=True, add_fsgroup=True)
+
+
+def reconcile(reconciler, name="nb", ns="user1"):
+    reconciler.reconcile(Request(ns, name))
+
+
+def test_single_host_notebook(kube, reconciler):
+    kube.create(make_notebook())
+    reconcile(reconciler)
+    sts = kube.get(STATEFULSET, "nb", "user1")
+    assert deep_get(sts, "spec", "replicas") == 1
+    assert deep_get(sts, "spec", "podManagementPolicy") == "Parallel"
+    container = deep_get(sts, "spec", "template", "spec", "containers")[0]
+    env = {e["name"]: e.get("value") for e in container["env"]}
+    assert env["NB_PREFIX"] == "/notebook/user1/nb"
+    assert "TPU_TOPOLOGY" not in env
+    assert deep_get(sts, "spec", "template", "spec", "securityContext", "fsGroup") == 100
+    svc = kube.get(SERVICE, "nb", "user1")
+    assert svc["spec"]["selector"] == {"statefulset": "nb"}
+    assert svc["spec"]["ports"][0]["port"] == 80
+    assert svc["spec"]["ports"][0]["targetPort"] == 8888
+    assert svc["spec"]["ports"][0]["name"].startswith("http-")
+
+
+def test_multi_host_tpu_notebook(kube, reconciler):
+    kube.create(make_notebook(tpu={"accelerator": "v5e", "topology": "4x4"}))
+    reconcile(reconciler)
+    sts = kube.get(STATEFULSET, "nb", "user1")
+    # 16 chips / 8 per host = 2 hosts.
+    assert deep_get(sts, "spec", "replicas") == 2
+    pod = deep_get(sts, "spec", "template", "spec")
+    assert pod["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x4",
+    }
+    container = pod["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    env = {e["name"]: e for e in container["env"]}
+    assert env["TPU_TOPOLOGY"]["value"] == "4x4"
+    assert env["TPU_ACCELERATOR_TYPE"]["value"] == "v5e-16"
+    hostnames = env["TPU_WORKER_HOSTNAMES"]["value"].split(",")
+    assert hostnames == [
+        "nb-0.nb-workers.user1.svc.cluster.local",
+        "nb-1.nb-workers.user1.svc.cluster.local",
+    ]
+    assert env["TPU_WORKER_ID"]["valueFrom"]["fieldRef"]["fieldPath"] == (
+        "metadata.labels['apps.kubernetes.io/pod-index']"
+    )
+    # Worker-0 routing for the UI service.
+    svc = kube.get(SERVICE, "nb", "user1")
+    assert svc["spec"]["selector"] == {"statefulset.kubernetes.io/pod-name": "nb-0"}
+    # Headless service with not-ready publishing for jax.distributed.
+    headless = kube.get(SERVICE, "nb-workers", "user1")
+    assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+
+
+def test_stop_annotation_scales_to_zero(kube, reconciler):
+    kube.create(make_notebook(tpu={"accelerator": "v5e", "topology": "4x4"}))
+    reconcile(reconciler)
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    nb["metadata"].setdefault("annotations", {})[nbapi.STOP_ANNOTATION] = "2026-07-29"
+    kube.update(nb)
+    reconcile(reconciler)
+    assert deep_get(kube.get(STATEFULSET, "nb", "user1"), "spec", "replicas") == 0
+    # Restart: annotation removed → full slice back.
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    del nb["metadata"]["annotations"][nbapi.STOP_ANNOTATION]
+    kube.update(nb)
+    reconcile(reconciler)
+    assert deep_get(kube.get(STATEFULSET, "nb", "user1"), "spec", "replicas") == 2
+
+
+def test_virtual_service(kube, reconciler):
+    kube.create(make_notebook())
+    reconcile(reconciler)
+    vs = kube.get(VIRTUALSERVICE, "notebook-user1-nb", "user1")
+    http = vs["spec"]["http"][0]
+    assert http["match"][0]["uri"]["prefix"] == "/notebook/user1/nb/"
+    assert http["route"][0]["destination"]["host"] == "nb.user1.svc.cluster.local"
+
+
+def test_status_mirrors_worker0(kube, reconciler):
+    kube.create(make_notebook())
+    reconcile(reconciler)
+    # Simulate the kubelet: create the pod the STS would produce.
+    pod = new(
+        __import__("kubeflow_tpu.platform.k8s.types", fromlist=["POD"]).POD,
+        "nb-0", "user1",
+        labels={"statefulset": "nb", "notebook-name": "nb"},
+    )
+    kube.create(pod)
+    kube.set_pod_phase("user1", "nb-0", "Running", ready=True)
+    reconcile(reconciler)
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    assert nb["status"]["readyReplicas"] == 1
+    assert nb["status"]["conditions"][0]["type"] == "Ready"
+
+
+def test_user_env_and_selectors_preserved(kube, reconciler):
+    nb = make_notebook(tpu={"accelerator": "v5e", "topology": "2x4"})
+    spec = nb["spec"]["template"]["spec"]
+    spec["nodeSelector"] = {"disk": "ssd"}
+    spec["containers"][0]["env"] = [{"name": "MY_VAR", "value": "7"}]
+    kube.create(nb)
+    reconcile(reconciler)
+    sts = kube.get(STATEFULSET, "nb", "user1")
+    pod = deep_get(sts, "spec", "template", "spec")
+    assert pod["nodeSelector"]["disk"] == "ssd"
+    assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x4"
+    env = {e["name"] for e in pod["containers"][0]["env"]}
+    assert {"MY_VAR", "NB_PREFIX", "TPU_WORKER_HOSTNAMES"} <= env
+    # Single-host 2x4: service routes to the statefulset selector.
+    svc = kube.get(SERVICE, "nb", "user1")
+    assert svc["spec"]["selector"] == {"statefulset": "nb"}
+    assert deep_get(sts, "spec", "replicas") == 1
+
+
+def test_idempotent_reconcile(kube, reconciler):
+    kube.create(make_notebook(tpu={"accelerator": "v5e"}))
+    reconcile(reconciler)
+    sts1 = kube.get(STATEFULSET, "nb", "user1")
+    reconcile(reconciler)
+    sts2 = kube.get(STATEFULSET, "nb", "user1")
+    assert sts1["metadata"]["resourceVersion"] == sts2["metadata"]["resourceVersion"]
+
+
+def test_deleted_notebook_is_noop(kube, reconciler):
+    reconcile(reconciler)  # no error
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "nb", "user1")
+
+
+def test_pod_event_mapper():
+    pod = {"metadata": {"namespace": "user1", "name": "nb-0",
+                        "labels": {"notebook-name": "nb"}}}
+    assert pods_to_notebook_requests(pod) == [Request("user1", "nb")]
+    assert pods_to_notebook_requests({"metadata": {"labels": {}}}) == []
+
+
+def test_validation():
+    with pytest.raises(nbapi.ValidationError):
+        nbapi.validate({"metadata": {"name": "x"}, "spec": {}})
+    with pytest.raises(nbapi.ValidationError):
+        nbapi.validate(make_notebook(tpu={"accelerator": "v99"}))
+    nbapi.validate(make_notebook(tpu={"accelerator": "v5e", "topology": "2x4"}))
+
+
+def test_namespace_chip_gauge_aggregates(kube, reconciler):
+    from kubeflow_tpu.platform.runtime import metrics
+
+    kube.create(make_notebook("nb-a", tpu={"accelerator": "v5e", "topology": "4x4"}))
+    kube.create(make_notebook("nb-b", tpu={"accelerator": "v5e", "topology": "2x4"}))
+    reconcile(reconciler, "nb-a")
+    reconcile(reconciler, "nb-b")
+    gauge = metrics.tpu_chips_requested.labels(namespace="user1")
+    assert gauge._value.get() == 24  # 16 + 8
+    kube.delete(
+        __import__("kubeflow_tpu.platform.k8s.types", fromlist=["NOTEBOOK"]).NOTEBOOK,
+        "nb-a", "user1",
+    )
+    reconcile(reconciler, "nb-a")  # NotFound path refreshes gauges
+    assert gauge._value.get() == 8
+
+
+def test_invalid_topology_rejected_at_slice_math():
+    import pytest as _pytest
+
+    from kubeflow_tpu.platform.tpu import slice_spec
+
+    with _pytest.raises(ValueError, match="does not pack"):
+        slice_spec("v5e", "3x3")

@@ -1,0 +1,47 @@
+import pytest
+
+from kubeflow_tpu.platform.tpu import slice_spec, topologies_on_nodes
+from kubeflow_tpu.platform.testing import FakeKube
+from kubeflow_tpu.platform.k8s.types import NODE
+
+
+@pytest.mark.parametrize(
+    "acc,topo,chips,hosts,per_pod",
+    [
+        ("v5e", "1x1", 1, 1, 1),
+        ("v5e", "2x2", 4, 1, 4),
+        ("v5e", "2x4", 8, 1, 8),
+        ("v5e", "4x4", 16, 2, 8),
+        ("v5e", "4x8", 32, 4, 8),
+        ("v5e", "8x16", 128, 16, 8),
+        ("v4", "2x2x2", 8, 2, 4),
+        ("v5p", "2x2x1", 4, 1, 4),
+        ("v6e", "2x4", 8, 1, 8),
+    ],
+)
+def test_slice_math(acc, topo, chips, hosts, per_pod):
+    s = slice_spec(acc, topo)
+    assert s.chips == chips
+    assert s.num_hosts == hosts
+    assert s.chips_per_pod == per_pod
+    assert s.multi_host == (hosts > 1)
+
+
+def test_defaults_and_errors():
+    assert slice_spec("v5e").topology == "2x4"
+    with pytest.raises(ValueError):
+        slice_spec("h100")
+    with pytest.raises(ValueError):
+        slice_spec("v5e", "2x2x2")  # wrong rank
+    with pytest.raises(ValueError):
+        slice_spec("v5e", "2xbanana")
+
+
+def test_node_scan():
+    kube = FakeKube()
+    kube.add_tpu_node("tpu-node-1", accelerator="tpu-v5-lite-podslice", topology="2x4")
+    kube.add_tpu_node("tpu-node-2", accelerator="tpu-v5-lite-podslice", topology="4x4")
+    kube.add_tpu_node("tpu-node-3", accelerator="tpu-v4-podslice", topology="2x2x2", chips=4)
+    kube.create({"apiVersion": "v1", "kind": "Node", "metadata": {"name": "cpu-node"}})
+    found = topologies_on_nodes(kube.list(NODE))
+    assert found == {"v4": ["2x2x2"], "v5e": ["2x4", "4x4"]}

@@ -1,0 +1,41 @@
+import time
+
+from kubeflow_tpu.platform.runtime.controller import Request, _WorkQueue
+
+
+def test_dedup_of_pending_items():
+    q = _WorkQueue()
+    r = Request("ns", "a")
+    q.add(r)
+    q.add(r)
+    assert q.get(timeout=0.5) == r
+    assert q.get(timeout=0.05) is None
+
+
+def test_immediate_add_preempts_backoff():
+    q = _WorkQueue(base_delay=5.0)  # backoff would be ~5s
+    r = Request("ns", "a")
+    q.add_rate_limited(r)
+    # A watch event arrives: must be served now, not after the backoff.
+    q.add(r)
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == r
+    assert time.monotonic() - t0 < 1.0
+    # The superseded delayed entry must not deliver a duplicate.
+    assert q.get(timeout=0.1) is None
+
+
+def test_backoff_grows_and_forget_resets():
+    q = _WorkQueue(base_delay=0.05, max_delay=0.2)
+    r = Request("ns", "a")
+    q.add_rate_limited(r)  # 0.05
+    assert q.get(timeout=1.0) == r
+    q.add_rate_limited(r)  # 0.1
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == r
+    assert time.monotonic() - t0 >= 0.08
+    q.forget(r)
+    q.add_rate_limited(r)  # back to 0.05
+    t0 = time.monotonic()
+    assert q.get(timeout=1.0) == r
+    assert time.monotonic() - t0 < 0.09
