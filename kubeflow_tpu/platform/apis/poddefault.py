@@ -1,0 +1,75 @@
+"""PodDefault CRD schema + TPU PodDefault factories.
+
+Field set mirrors the reference CRD (reference poddefault_types.go:27-112):
+selector, env, envFrom, volumes, volumeMounts, initContainers, sidecars,
+tolerations, labels, annotations, command, args, serviceAccountName,
+automountServiceAccountToken, imagePullSecrets, desc.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.platform.k8s.types import Resource
+from kubeflow_tpu.platform.tpu import slice_spec
+
+
+def tpu_pod_default(namespace: str, accelerator: str,
+                    topology: Optional[str] = None) -> Resource:
+    """A PodDefault that injects TPU runtime env into any pod that opts in
+    via the ``tpu-<accelerator>`` label (the spawner's configurations
+    checklist sets exactly that label) — the north-star injection path."""
+    s = slice_spec(accelerator, topology)
+    label = f"tpu-{s.accelerator.name}"
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "PodDefault",
+        "metadata": {"name": label, "namespace": namespace},
+        "spec": {
+            "desc": f"TPU {s.accelerator.name} runtime "
+                    f"({s.topology}, {s.chips} chips)",
+            "selector": {"matchLabels": {label: "true"}},
+            "env": [
+                {"name": "TPU_TOPOLOGY", "value": s.topology},
+                {"name": "TPU_ACCELERATOR_TYPE",
+                 "value": f"{s.accelerator.name}-{s.chips}"},
+                {"name": "TPU_RUNTIME_METRICS_PORTS", "value": "8431"},
+                # libtpu premapped-buffer default tuned for notebook use.
+                {"name": "TPU_PREMAPPED_BUFFER_SIZE", "value": "17179869184"},
+            ],
+            # TPU runtimes want a big /dev/shm for cross-process transfers.
+            "volumes": [{
+                "name": "tpu-shm",
+                "emptyDir": {"medium": "Memory", "sizeLimit": "16Gi"},
+            }],
+            "volumeMounts": [{"name": "tpu-shm", "mountPath": "/dev/shm"}],
+        },
+    }
+
+
+def crd_manifest() -> Resource:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "poddefaults.kubeflow.org"},
+        "spec": {
+            "group": "kubeflow.org",
+            "names": {"kind": "PodDefault", "plural": "poddefaults",
+                      "singular": "poddefault"},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                            "required": ["selector"],
+                        },
+                    },
+                }},
+            }],
+        },
+    }

@@ -1,0 +1,406 @@
+"""Profile controller: one Profile CR = one user workspace.
+
+Mirrors the reference semantics (reference profile_controller.go:105-331):
+create/adopt the namespace (rejecting takeover of foreign namespaces),
+stamp RBAC (editor/viewer service accounts + role bindings, owner admin
+binding), emit the Istio AuthorizationPolicy that makes the trusted
+user-header model safe, and materialize the per-namespace ResourceQuota —
+which on this platform is where **TPU chip quotas** live
+(``google.com/tpu`` in ``spec.resourceQuotaSpec.hard``, the north-star
+quota hook; reference :253-280 only ever carried CPU/memory).
+
+Cloud-identity plugins (GCP Workload Identity / AWS IRSA,
+reference plugin_workload_identity.go / plugin_iam.go) keep the same CR
+contract; the cloud IAM round-trip is behind an injectable interface so the
+in-cluster annotation side works everywhere and clouds plug in via config.
+A ``profile-finalizer`` drives revocation on delete.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional
+
+from kubeflow_tpu.platform import config
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    AUTHORIZATIONPOLICY,
+    NAMESPACE,
+    PROFILE,
+    RESOURCEQUOTA,
+    ROLEBINDING,
+    SERVICEACCOUNT,
+    Resource,
+    deep_get,
+    meta,
+    name_of,
+    set_owner,
+)
+from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
+
+OWNER_ANNOTATION = "owner"
+FINALIZER = "profile-finalizer"
+QUOTA_NAME = "kf-resource-quota"
+AUTH_POLICY_NAME = "ns-owner-access-istio"
+
+EDITOR_SA = "default-editor"
+VIEWER_SA = "default-viewer"
+ADMIN_BINDING = "namespaceAdmin"
+CLUSTER_ROLE_ADMIN = "kubeflow-admin"
+CLUSTER_ROLE_EDIT = "kubeflow-edit"
+CLUSTER_ROLE_VIEW = "kubeflow-view"
+
+
+class ProfilePlugin:
+    """Apply/Revoke contract (reference profile_controller.go:77-83)."""
+
+    kind = ""
+
+    def apply(self, client, profile: Resource, plugin_spec: dict) -> None: ...
+
+    def revoke(self, client, profile: Resource, plugin_spec: dict) -> None: ...
+
+
+class WorkloadIdentityPlugin(ProfilePlugin):
+    """GCP: annotate the editor KSA; IAM binding via injected callback.
+
+    The IAM member must carry the cluster's workload-identity pool
+    (``PROJECT_ID.svc.id.goog``), resolved from the constructor or the
+    WORKLOAD_IDENTITY_POOL / GCP_PROJECT env (reference
+    plugin_workload_identity.go builds the same member string).
+    """
+
+    kind = "WorkloadIdentity"
+
+    def __init__(self, bind_iam: Optional[Callable[[str, str, bool], None]] = None,
+                 *, identity_pool: Optional[str] = None):
+        self.bind_iam = bind_iam  # (gcp_sa, member, add) -> None
+        pool = identity_pool or config.env("WORKLOAD_IDENTITY_POOL")
+        if not pool and config.env("GCP_PROJECT"):
+            pool = f"{config.env('GCP_PROJECT')}.svc.id.goog"
+        self.identity_pool = pool
+
+    def _member(self, profile: Resource) -> str:
+        return (
+            f"serviceAccount:{self.identity_pool}"
+            f"[{name_of(profile)}/{EDITOR_SA}]"
+        )
+
+    def _annotate(self, client, profile, gcp_sa: Optional[str]) -> None:
+        ns = name_of(profile)
+        sa = client.get(SERVICEACCOUNT, EDITOR_SA, ns)
+        annotations = meta(sa).setdefault("annotations", {})
+        if gcp_sa:
+            annotations["iam.gke.io/gcp-service-account"] = gcp_sa
+        else:
+            annotations.pop("iam.gke.io/gcp-service-account", None)
+        client.update(sa)
+
+    def apply(self, client, profile, plugin_spec) -> None:
+        gcp_sa = plugin_spec.get("gcpServiceAccount", "")
+        self._annotate(client, profile, gcp_sa)
+        if self.bind_iam and gcp_sa and self.identity_pool:
+            self.bind_iam(gcp_sa, self._member(profile), True)
+
+    def revoke(self, client, profile, plugin_spec) -> None:
+        gcp_sa = plugin_spec.get("gcpServiceAccount", "")
+        if self.bind_iam and gcp_sa and self.identity_pool:
+            self.bind_iam(gcp_sa, self._member(profile), False)
+
+
+class IrsaPlugin(ProfilePlugin):
+    """AWS IRSA: role-arn annotation; trust-policy edit via injected callback."""
+
+    kind = "AwsIamForServiceAccount"
+
+    def __init__(self, edit_trust: Optional[Callable[[str, str, bool], None]] = None):
+        self.edit_trust = edit_trust
+
+    def apply(self, client, profile, plugin_spec) -> None:
+        arn = plugin_spec.get("awsIamRole", "")
+        ns = name_of(profile)
+        sa = client.get(SERVICEACCOUNT, EDITOR_SA, ns)
+        meta(sa).setdefault("annotations", {})["eks.amazonaws.com/role-arn"] = arn
+        client.update(sa)
+        if self.edit_trust and arn:
+            self.edit_trust(arn, f"system:serviceaccount:{ns}:{EDITOR_SA}", True)
+
+    def revoke(self, client, profile, plugin_spec) -> None:
+        arn = plugin_spec.get("awsIamRole", "")
+        if self.edit_trust and arn:
+            ns = name_of(profile)
+            self.edit_trust(arn, f"system:serviceaccount:{ns}:{EDITOR_SA}", False)
+
+
+class ProfileReconciler(Reconciler):
+    def __init__(
+        self,
+        client,
+        *,
+        userid_header: Optional[str] = None,
+        userid_prefix: Optional[str] = None,
+        default_namespace_labels: Optional[Dict[str, str]] = None,
+        plugins: Optional[List[ProfilePlugin]] = None,
+        notebook_controller_sa: str = "system:serviceaccount:kubeflow:notebook-controller-service-account",
+    ):
+        self.client = client
+        self.recorder = EventRecorder(client, "profile-controller")
+        self.userid_header = userid_header or config.env("USERID_HEADER", "kubeflow-userid")
+        self.userid_prefix = (
+            userid_prefix if userid_prefix is not None else config.env("USERID_PREFIX", "")
+        )
+        self.default_labels = default_namespace_labels or {
+            "istio-injection": "enabled",
+            "app.kubernetes.io/part-of": "kubeflow-profile",
+        }
+        self.plugins = {p.kind: p for p in (plugins or [WorkloadIdentityPlugin(), IrsaPlugin()])}
+        self.notebook_controller_sa = notebook_controller_sa
+
+    # -- reconcile -----------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        try:
+            profile = self.client.get(PROFILE, req.name)
+        except errors.NotFound:
+            return None
+
+        if meta(profile).get("deletionTimestamp"):
+            self._revoke_plugins(profile)
+            finalizers = [f for f in meta(profile).get("finalizers", []) if f != FINALIZER]
+            profile = copy.deepcopy(profile)
+            meta(profile)["finalizers"] = finalizers
+            self.client.update(profile)
+            return None
+
+        if FINALIZER not in meta(profile).get("finalizers", []):
+            profile = copy.deepcopy(profile)
+            meta(profile).setdefault("finalizers", []).append(FINALIZER)
+            profile = self.client.update(profile)
+
+        if not self._reconcile_namespace(profile):
+            return None  # ownership conflict surfaced on status
+        self._reconcile_service_accounts(profile)
+        self._reconcile_role_bindings(profile)
+        self._reconcile_authorization_policy(profile)
+        self._reconcile_resource_quota(profile)
+        self._apply_plugins(profile)
+        self._set_ready(profile)
+        return None
+
+    # -- namespace -----------------------------------------------------------
+
+    def _reconcile_namespace(self, profile: Resource) -> bool:
+        name = name_of(profile)
+        owner = deep_get(profile, "spec", "owner", "name", default="")
+        try:
+            ns = self.client.get(NAMESPACE, name)
+        except errors.NotFound:
+            ns = {
+                "apiVersion": "v1",
+                "kind": "Namespace",
+                "metadata": {
+                    "name": name,
+                    "annotations": {OWNER_ANNOTATION: owner},
+                    "labels": dict(self.default_labels),
+                },
+            }
+            set_owner(ns, profile)
+            self.client.create(ns)
+            return True
+        existing_owner = deep_get(ns, "metadata", "annotations", OWNER_ANNOTATION)
+        if existing_owner is None:
+            # Pre-existing namespace not created for a profile: refuse to
+            # take it over (reference :127-198 ownership check).
+            self._set_failed(
+                profile,
+                f"namespace {name} exists and is not owned by any profile",
+            )
+            return False
+        if existing_owner != owner:
+            self._set_failed(
+                profile,
+                f"namespace {name} is owned by {existing_owner!r}, not {owner!r}",
+            )
+            return False
+        changed = False
+        labels = meta(ns).setdefault("labels", {})
+        for k, v in self.default_labels.items():
+            if labels.get(k) != v:
+                labels[k] = v
+                changed = True
+        if changed:
+            self.client.update(ns)
+        return True
+
+    # -- rbac ----------------------------------------------------------------
+
+    def _reconcile_service_accounts(self, profile: Resource) -> None:
+        ns = name_of(profile)
+        for sa_name in (EDITOR_SA, VIEWER_SA):
+            sa = {
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": {"name": sa_name, "namespace": ns},
+            }
+            set_owner(sa, profile)
+            try:
+                self.client.create(sa)
+            except errors.Conflict:
+                pass
+
+    def _reconcile_role_bindings(self, profile: Resource) -> None:
+        ns = name_of(profile)
+        owner = deep_get(profile, "spec", "owner", default={})
+        bindings = [
+            (EDITOR_SA, CLUSTER_ROLE_EDIT,
+             {"kind": "ServiceAccount", "name": EDITOR_SA, "namespace": ns}),
+            (VIEWER_SA, CLUSTER_ROLE_VIEW,
+             {"kind": "ServiceAccount", "name": VIEWER_SA, "namespace": ns}),
+            (ADMIN_BINDING, CLUSTER_ROLE_ADMIN, {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": owner.get("kind", "User"),
+                "name": owner.get("name", ""),
+            }),
+        ]
+        for binding_name, role, subject in bindings:
+            rb = {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "RoleBinding",
+                "metadata": {
+                    "name": binding_name,
+                    "namespace": ns,
+                    "annotations": {"role": role.removeprefix("kubeflow-"),
+                                    "user": subject.get("name", "")},
+                },
+                "roleRef": {
+                    "apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole",
+                    "name": role,
+                },
+                "subjects": [subject],
+            }
+            set_owner(rb, profile)
+            self._create_or_replace(ROLEBINDING, rb)
+
+    # -- istio ---------------------------------------------------------------
+
+    def _reconcile_authorization_policy(self, profile: Resource) -> None:
+        ns = name_of(profile)
+        owner = deep_get(profile, "spec", "owner", "name", default="")
+        header_value = f"{self.userid_prefix}{owner}"
+        policy = {
+            "apiVersion": "security.istio.io/v1beta1",
+            "kind": "AuthorizationPolicy",
+            "metadata": {"name": AUTH_POLICY_NAME, "namespace": ns},
+            "spec": {
+                "rules": [
+                    # Owner traffic, identified by the trusted gateway header.
+                    {"when": [{
+                        "key": f"request.headers[{self.userid_header}]",
+                        "values": [header_value],
+                    }]},
+                    # In-namespace traffic (sidecar-to-sidecar).
+                    {"from": [{"source": {"namespaces": [ns]}}]},
+                    # Culling probe: the notebook controller SA may GET the
+                    # kernels API (reference :470-488).
+                    {
+                        "from": [{"source": {
+                            "principals": [self.notebook_controller_sa],
+                        }}],
+                        "to": [{"operation": {
+                            "methods": ["GET"],
+                            "paths": ["*/api/kernels"],
+                        }}],
+                    },
+                ]
+            },
+        }
+        set_owner(policy, profile)
+        self._create_or_replace(AUTHORIZATIONPOLICY, policy)
+
+    # -- quota (the TPU hook) ------------------------------------------------
+
+    def _reconcile_resource_quota(self, profile: Resource) -> None:
+        ns = name_of(profile)
+        spec = deep_get(profile, "spec", "resourceQuotaSpec", default={}) or {}
+        if not spec.get("hard"):
+            # No quota requested: remove a previously-managed one.
+            try:
+                self.client.delete(RESOURCEQUOTA, QUOTA_NAME, ns)
+            except errors.NotFound:
+                pass
+            return
+        quota = {
+            "apiVersion": "v1",
+            "kind": "ResourceQuota",
+            "metadata": {"name": QUOTA_NAME, "namespace": ns},
+            "spec": spec,
+        }
+        set_owner(quota, profile)
+        self._create_or_replace(RESOURCEQUOTA, quota)
+
+    # -- plugins -------------------------------------------------------------
+
+    def _apply_plugins(self, profile: Resource) -> None:
+        for plugin_cfg in deep_get(profile, "spec", "plugins", default=[]) or []:
+            kind = plugin_cfg.get("kind", "")
+            plugin = self.plugins.get(kind)
+            if plugin is None:
+                self.recorder.event(
+                    profile, "Warning", "UnknownPlugin", f"no plugin {kind!r}"
+                )
+                continue
+            plugin.apply(self.client, profile, plugin_cfg.get("spec", {}) or {})
+
+    def _revoke_plugins(self, profile: Resource) -> None:
+        for plugin_cfg in deep_get(profile, "spec", "plugins", default=[]) or []:
+            plugin = self.plugins.get(plugin_cfg.get("kind", ""))
+            if plugin is not None:
+                try:
+                    plugin.revoke(
+                        self.client, profile, plugin_cfg.get("spec", {}) or {}
+                    )
+                except Exception:
+                    self.recorder.event(
+                        profile, "Warning", "PluginRevokeFailed",
+                        f"revoke {plugin_cfg.get('kind')} failed",
+                    )
+
+    # -- status/helpers ------------------------------------------------------
+
+    def _create_or_replace(self, gvk, desired: Resource) -> None:
+        ns = deep_get(desired, "metadata", "namespace")
+        name = name_of(desired)
+        try:
+            current = self.client.get(gvk, name, ns)
+        except errors.NotFound:
+            self.client.create(desired)
+            return
+        interesting = ("spec", "roleRef", "subjects")
+        if any(current.get(k) != desired.get(k) for k in interesting if k in desired):
+            current.update({k: desired[k] for k in interesting if k in desired})
+            self.client.update(current)
+
+    def _set_ready(self, profile: Resource) -> None:
+        self._set_status(profile, {"status": "Succeeded", "message": ""})
+
+    def _set_failed(self, profile: Resource, message: str) -> None:
+        self.recorder.event(profile, "Warning", "ProfileFailed", message,
+                            namespace="default")
+        self._set_status(profile, {"status": "Failed", "message": message})
+
+    def _set_status(self, profile: Resource, status: dict) -> None:
+        if profile.get("status") != status:
+            profile = copy.deepcopy(profile)
+            profile["status"] = status
+            self.client.update_status(profile)
+
+
+def make_controller(client, **kwargs):
+    from kubeflow_tpu.platform.runtime import Controller
+
+    return Controller(
+        "profile-controller",
+        ProfileReconciler(client, **kwargs),
+        primary=PROFILE,
+        resync_period=300.0,
+    )

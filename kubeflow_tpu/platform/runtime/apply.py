@@ -1,0 +1,54 @@
+"""create-or-update with content-hash ownership.
+
+Raw subtree equality between a generated spec and the live object is
+always-false against a real API server (server-side defaulting), so every
+reconcile would rewrite the object.  Instead the controller stamps a hash of
+what it generated; updates happen only when the *generated* content changes
+— the Deployment pod-template-hash idiom, shared by all controllers here
+(the reference's reconcilehelper/util.go solves this with per-kind semantic
+field copies; a hash is kind-agnostic).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Iterable, Optional
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import GVK, Resource, deep_get, meta, name_of
+
+HASH_ANNOTATION = "kubeflow.org/generated-hash"
+
+
+def content_hash(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:16]
+
+
+def create_or_update(
+    client,
+    gvk: GVK,
+    desired: Resource,
+    *,
+    owned_fields: Iterable[str] = ("spec",),
+    hash_annotation: str = HASH_ANNOTATION,
+) -> Resource:
+    """Create the object, or overwrite its owned fields when the generated
+    content hash changed.  Server-populated fields outside ``owned_fields``
+    survive untouched."""
+    owned = {k: desired[k] for k in owned_fields if k in desired}
+    desired_hash = content_hash(owned)
+    meta(desired).setdefault("annotations", {})[hash_annotation] = desired_hash
+    ns = meta(desired).get("namespace")
+    name = name_of(desired)
+    try:
+        current = client.get(gvk, name, ns)
+    except errors.NotFound:
+        return client.create(desired)
+    if deep_get(current, "metadata", "annotations", hash_annotation) == desired_hash:
+        return current
+    for k, v in owned.items():
+        current[k] = v
+    meta(current).setdefault("annotations", {})[hash_annotation] = desired_hash
+    return client.update(current)

@@ -152,8 +152,16 @@ class FakeKube:
                 meta(obj)["generation"] = meta(current).get("generation", 1)
             for field in ("uid", "creationTimestamp"):
                 meta(obj)[field] = meta(current).get(field)
+            if meta(current).get("deletionTimestamp"):
+                meta(obj)["deletionTimestamp"] = meta(current)["deletionTimestamp"]
             self._bump(obj)
             key = _key(gvk, namespace_of(obj) if gvk.namespaced else None, name_of(obj))
+            # A terminating object whose last finalizer was removed is gone.
+            if meta(obj).get("deletionTimestamp") and not meta(obj).get("finalizers"):
+                del self._objects[key]
+                self._emit("DELETED", obj)
+                self._cascade(meta(obj).get("uid"))
+                return copy.deepcopy(obj)
             self._objects[key] = obj
             self._emit("MODIFIED", obj)
             return copy.deepcopy(obj)
@@ -182,6 +190,14 @@ class FakeKube:
             else:
                 raise errors.BadRequest(f"unsupported patch type {patch_type}")
             self._bump(current)
+            # Same terminating-object rule as update(): stripping the last
+            # finalizer from a deletionTimestamp'd object deletes it.
+            if meta(current).get("deletionTimestamp") and not meta(current).get("finalizers"):
+                key = _key(gvk, namespace if gvk.namespaced else None, name)
+                del self._objects[key]
+                self._emit("DELETED", current)
+                self._cascade(meta(current).get("uid"))
+                return copy.deepcopy(current)
             self._emit("MODIFIED", current)
             return copy.deepcopy(current)
 
@@ -189,6 +205,14 @@ class FakeKube:
         with self._lock:
             obj = self._get_ref(gvk, name, namespace)
             key = _key(gvk, namespace if gvk.namespaced else None, name)
+            # Finalizer semantics: mark for deletion, keep the object until
+            # controllers strip their finalizers (via update()).
+            if meta(obj).get("finalizers"):
+                if not meta(obj).get("deletionTimestamp"):
+                    meta(obj)["deletionTimestamp"] = self._timestamp()
+                    self._bump(obj)
+                    self._emit("MODIFIED", obj)
+                return
             del self._objects[key]
             self._emit("DELETED", obj)
             self._cascade(meta(obj).get("uid"))

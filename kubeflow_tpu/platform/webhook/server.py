@@ -1,0 +1,129 @@
+"""Admission webhook HTTP server.
+
+Single route ``POST /apply-poddefault`` (same as the reference,
+admission-webhook/main.go:753-770) plus health/metrics; werkzeug WSGI with
+TLS via ssl context (the API server only talks HTTPS to webhooks).  Cert
+rotation: certificates are re-read from disk on a timer, matching the
+reference's certwatcher behavior without inotify.
+"""
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+from typing import Optional
+
+from werkzeug.serving import make_server
+from werkzeug.wrappers import Request as WsgiRequest, Response as WsgiResponse
+
+from kubeflow_tpu.platform.k8s.types import PODDEFAULT
+from kubeflow_tpu.platform.webhook.mutate import mutate_admission_review
+
+
+class WebhookApp:
+    def __init__(self, client):
+        self.client = client
+
+    def __call__(self, environ, start_response):
+        request = WsgiRequest(environ)
+        response = self.dispatch(request)
+        return response(environ, start_response)
+
+    def dispatch(self, request: WsgiRequest) -> WsgiResponse:
+        if request.path == "/healthz":
+            return WsgiResponse("ok")
+        if request.path == "/apply-poddefault" and request.method == "POST":
+            return self.apply_poddefault(request)
+        return WsgiResponse("not found", status=404)
+
+    def apply_poddefault(self, request: WsgiRequest) -> WsgiResponse:
+        if not (request.content_type or "").startswith("application/json"):
+            return WsgiResponse("expected application/json", status=415)
+        try:
+            review = json.loads(request.get_data(as_text=True))
+        except json.JSONDecodeError:
+            return WsgiResponse("bad json", status=400)
+        try:
+            namespace = (
+                (review.get("request") or {}).get("namespace")
+                or (review.get("request") or {}).get("object", {})
+                .get("metadata", {})
+                .get("namespace", "")
+            )
+            pod_defaults = self.client.list(PODDEFAULT, namespace) if namespace else []
+            out = mutate_admission_review(review, pod_defaults)
+        except Exception as e:  # fail OPEN with a valid AdmissionReview:
+            # a malformed PodDefault (permissive CRD schema) must not block
+            # pod creation via a 500 + failurePolicy.
+            uid = ((review.get("request") or {}).get("uid", ""))
+            out = {
+                "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+                "kind": "AdmissionReview",
+                "response": {
+                    "uid": uid,
+                    "allowed": True,
+                    "status": {"message": f"poddefault mutation skipped: {e}"},
+                },
+            }
+        return WsgiResponse(json.dumps(out), content_type="application/json")
+
+
+class WebhookServer:
+    CERT_RELOAD_SECONDS = 60.0
+
+    def __init__(self, client, *, host: str = "0.0.0.0", port: int = 4443,
+                 cert_file: Optional[str] = None, key_file: Optional[str] = None):
+        self.app = WebhookApp(client)
+        self._cert_file, self._key_file = cert_file, key_file
+        self._cert_mtimes = self._mtimes()
+        self._ctx = None
+        if cert_file and key_file:
+            self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ctx.load_cert_chain(cert_file, key_file)
+        self._server = make_server(
+            host, port, self.app, ssl_context=self._ctx, threaded=True
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_port
+
+    def _mtimes(self):
+        import os
+
+        out = []
+        for path in (self._cert_file, self._key_file):
+            try:
+                out.append(os.stat(path).st_mtime if path else None)
+            except OSError:
+                out.append(None)
+        return out
+
+    def _cert_reload_loop(self) -> None:
+        # cert-manager style rotation: when the mounted cert/key change on
+        # disk, reload them into the live SSLContext — new handshakes pick
+        # up the new chain, no restart (the reference uses certwatcher).
+        while not self._stop.wait(self.CERT_RELOAD_SECONDS):
+            current = self._mtimes()
+            if current != self._cert_mtimes and all(current):
+                try:
+                    self._ctx.load_cert_chain(self._cert_file, self._key_file)
+                    self._cert_mtimes = current
+                except (OSError, ssl.SSLError):
+                    pass  # partial write mid-rotation; retry next tick
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="webhook", daemon=True
+        )
+        self._thread.start()
+        if self._ctx is not None:
+            threading.Thread(
+                target=self._cert_reload_loop, name="webhook-certs", daemon=True
+            ).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()

@@ -1,0 +1,95 @@
+import datetime
+
+import pytest
+
+from kubeflow_tpu.platform.apis import notebook as nbapi
+from kubeflow_tpu.platform.controllers.culling import CullingReconciler
+from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.testing import FakeKube
+
+from .test_notebook_controller import make_notebook
+
+T0 = datetime.datetime(2026, 7, 29, 12, 0, 0, tzinfo=datetime.timezone.utc)
+
+
+class Clock:
+    def __init__(self):
+        self.now = T0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, minutes):
+        self.now += datetime.timedelta(minutes=minutes)
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("user1")
+    k.create(make_notebook())
+    return k
+
+
+def kernels(state, last):
+    return [{"execution_state": state, "last_activity": last}]
+
+
+def test_busy_kernels_record_activity_not_cull(kube):
+    clock = Clock()
+    r = CullingReconciler(
+        kube, prober=lambda url: kernels("busy", "2026-07-29T11:59:00Z"),
+        idle_minutes=30, now=clock,
+    )
+    result = r.reconcile(Request("user1", "nb"))
+    assert result and result.requeue_after == 60.0
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    assert not nbapi.is_stopped(nb)
+    assert nb["metadata"]["annotations"][nbapi.LAST_ACTIVITY_ANNOTATION]
+
+
+def test_idle_past_window_culls(kube):
+    clock = Clock()
+    r = CullingReconciler(
+        kube, prober=lambda url: kernels("idle", "2026-07-29T11:00:00Z"),
+        idle_minutes=30, now=clock,
+    )
+    clock.advance(45)  # now 12:45; last activity 11:00 → 105 min idle
+    r.reconcile(Request("user1", "nb"))
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    assert nbapi.is_stopped(nb)
+
+
+def test_idle_within_window_spares(kube):
+    clock = Clock()
+    r = CullingReconciler(
+        kube, prober=lambda url: kernels("idle", "2026-07-29T11:50:00Z"),
+        idle_minutes=30, now=clock,
+    )
+    r.reconcile(Request("user1", "nb"))
+    assert not nbapi.is_stopped(kube.get(NOTEBOOK, "nb", "user1"))
+
+
+def test_unreachable_notebook_not_culled(kube):
+    r = CullingReconciler(kube, prober=lambda url: None, idle_minutes=0)
+    result = r.reconcile(Request("user1", "nb"))
+    assert result is not None  # requeues
+    assert not nbapi.is_stopped(kube.get(NOTEBOOK, "nb", "user1"))
+
+
+def test_already_stopped_is_noop(kube):
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    nb["metadata"].setdefault("annotations", {})[nbapi.STOP_ANNOTATION] = "x"
+    kube.update(nb)
+    calls = []
+    r = CullingReconciler(kube, prober=lambda url: calls.append(url))
+    assert r.reconcile(Request("user1", "nb")) is None
+    assert calls == []  # no probe of a stopped notebook
+
+
+def test_probe_url_targets_worker0_service():
+    r = CullingReconciler(FakeKube(), prober=lambda url: None)
+    assert r.kernels_url("user1", "nb") == (
+        "http://nb.user1.svc.cluster.local/notebook/user1/nb/api/kernels"
+    )

@@ -1,0 +1,127 @@
+import pytest
+
+from kubeflow_tpu.platform.controllers.profile import (
+    AUTH_POLICY_NAME,
+    QUOTA_NAME,
+    ProfileReconciler,
+    WorkloadIdentityPlugin,
+)
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    AUTHORIZATIONPOLICY,
+    NAMESPACE,
+    PROFILE,
+    RESOURCEQUOTA,
+    ROLEBINDING,
+    SERVICEACCOUNT,
+    deep_get,
+)
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_profile(name="alice", owner="alice@example.com", quota=None, plugins=None):
+    p = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "Profile",
+        "metadata": {"name": name},
+        "spec": {"owner": {"kind": "User", "name": owner}},
+    }
+    if quota:
+        p["spec"]["resourceQuotaSpec"] = quota
+    if plugins:
+        p["spec"]["plugins"] = plugins
+    return p
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("default")
+    return k
+
+
+def reconcile(kube, **kwargs):
+    r = ProfileReconciler(kube, **kwargs)
+    r.reconcile(Request("", "alice"))
+    return r
+
+
+def test_profile_creates_workspace(kube):
+    kube.create(make_profile(quota={"hard": {"google.com/tpu": "32", "cpu": "64"}}))
+    reconcile(kube)
+    ns = kube.get(NAMESPACE, "alice")
+    assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+    for sa in ("default-editor", "default-viewer"):
+        kube.get(SERVICEACCOUNT, sa, "alice")
+    rb = kube.get(ROLEBINDING, "namespaceAdmin", "alice")
+    assert rb["roleRef"]["name"] == "kubeflow-admin"
+    assert rb["subjects"][0]["name"] == "alice@example.com"
+    quota = kube.get(RESOURCEQUOTA, QUOTA_NAME, "alice")
+    assert quota["spec"]["hard"]["google.com/tpu"] == "32"
+    policy = kube.get(AUTHORIZATIONPOLICY, AUTH_POLICY_NAME, "alice")
+    rules = policy["spec"]["rules"]
+    assert rules[0]["when"][0]["values"] == ["alice@example.com"]
+    assert rules[2]["to"][0]["operation"]["paths"] == ["*/api/kernels"]
+    assert kube.get(PROFILE, "alice")["status"]["status"] == "Succeeded"
+
+
+def test_foreign_namespace_not_taken_over(kube):
+    kube.add_namespace("alice")  # pre-existing, no owner annotation
+    kube.create(make_profile())
+    reconcile(kube)
+    profile = kube.get(PROFILE, "alice")
+    assert profile["status"]["status"] == "Failed"
+    with pytest.raises(errors.NotFound):
+        kube.get(SERVICEACCOUNT, "default-editor", "alice")
+
+
+def test_quota_removed_when_spec_cleared(kube):
+    kube.create(make_profile(quota={"hard": {"cpu": "4"}}))
+    reconcile(kube)
+    kube.get(RESOURCEQUOTA, QUOTA_NAME, "alice")
+    p = kube.get(PROFILE, "alice")
+    del p["spec"]["resourceQuotaSpec"]
+    kube.update(p)
+    reconcile(kube)
+    with pytest.raises(errors.NotFound):
+        kube.get(RESOURCEQUOTA, QUOTA_NAME, "alice")
+
+
+def test_workload_identity_plugin_and_finalizer(kube):
+    iam_calls = []
+    plugin = WorkloadIdentityPlugin(
+        bind_iam=lambda sa, member, add: iam_calls.append((sa, member, add)),
+        identity_pool="proj.svc.id.goog",
+    )
+    kube.create(make_profile(plugins=[{
+        "kind": "WorkloadIdentity",
+        "spec": {"gcpServiceAccount": "ml@proj.iam.gserviceaccount.com"},
+    }]))
+    r = ProfileReconciler(kube, plugins=[plugin])
+    r.reconcile(Request("", "alice"))
+    sa = kube.get(SERVICEACCOUNT, "default-editor", "alice")
+    assert sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"] == (
+        "ml@proj.iam.gserviceaccount.com"
+    )
+    assert iam_calls[-1] == (
+        "ml@proj.iam.gserviceaccount.com",
+        "serviceAccount:proj.svc.id.goog[alice/default-editor]",
+        True,
+    )
+    # Delete → finalizer drives revocation, then the profile disappears.
+    kube.delete(PROFILE, "alice")
+    r.reconcile(Request("", "alice"))
+    assert iam_calls[-1][2] is False
+    with pytest.raises(errors.NotFound):
+        kube.get(PROFILE, "alice")
+
+
+def test_idempotent(kube):
+    kube.create(make_profile(quota={"hard": {"cpu": "4"}}))
+    reconcile(kube)
+    rv1 = kube.get(RESOURCEQUOTA, QUOTA_NAME, "alice")["metadata"]["resourceVersion"]
+    reconcile(kube)
+    rv2 = kube.get(RESOURCEQUOTA, QUOTA_NAME, "alice")["metadata"]["resourceVersion"]
+    assert rv1 == rv2

@@ -1,0 +1,85 @@
+import pytest
+
+from kubeflow_tpu.platform.controllers.tensorboard import TensorboardReconciler
+from kubeflow_tpu.platform.k8s.types import DEPLOYMENT, SERVICE, VIRTUALSERVICE, deep_get
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_tb(name="tb", logspath="pvc://logs-claim/run1"):
+    return {
+        "apiVersion": "tensorboard.kubeflow.org/v1alpha1",
+        "kind": "Tensorboard",
+        "metadata": {"name": name, "namespace": "user1"},
+        "spec": {"logspath": logspath},
+    }
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("user1")
+    return k
+
+
+def test_pvc_logspath(kube):
+    kube.create(make_tb())
+    TensorboardReconciler(kube).reconcile(Request("user1", "tb"))
+    dep = kube.get(DEPLOYMENT, "tb", "user1")
+    spec = deep_get(dep, "spec", "template", "spec")
+    assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "logs-claim"
+    mount = spec["containers"][0]["volumeMounts"][0]
+    assert mount["mountPath"] == "/logs" and mount["subPath"] == "run1"
+    args = spec["containers"][0]["args"]
+    assert "--logdir=/logs" in args
+    assert "--path_prefix=/tensorboard/user1/tb" in args
+    svc = kube.get(SERVICE, "tb", "user1")
+    assert svc["spec"]["ports"][0]["targetPort"] == 6006
+    kube.get(VIRTUALSERVICE, "tensorboard-user1-tb", "user1")
+
+
+def test_gcs_logspath_mounts_creds_when_secret_exists(kube):
+    kube.create({"apiVersion": "v1", "kind": "Secret",
+                 "metadata": {"name": "user-gcp-sa", "namespace": "user1"}})
+    kube.create(make_tb(logspath="gs://bucket/logs"))
+    TensorboardReconciler(kube).reconcile(Request("user1", "tb"))
+    spec = deep_get(kube.get(DEPLOYMENT, "tb", "user1"), "spec", "template", "spec")
+    env = {e["name"]: e["value"] for e in spec["containers"][0]["env"]}
+    assert env["GOOGLE_APPLICATION_CREDENTIALS"].endswith("user-gcp-sa.json")
+    assert "--logdir=gs://bucket/logs" in spec["containers"][0]["args"]
+
+
+def test_rwo_scheduling_affinity(kube):
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "nb-0", "namespace": "user1"},
+        "spec": {
+            "nodeName": "node-7",
+            "volumes": [{"name": "ws",
+                         "persistentVolumeClaim": {"claimName": "logs-claim"}}],
+        },
+    })
+    kube.create(make_tb())
+    TensorboardReconciler(kube, rwo_pvc_scheduling=True).reconcile(
+        Request("user1", "tb")
+    )
+    spec = deep_get(kube.get(DEPLOYMENT, "tb", "user1"), "spec", "template", "spec")
+    terms = spec["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"]["nodeSelectorTerms"]
+    assert terms[0]["matchExpressions"][0]["values"] == ["node-7"]
+
+
+def test_status_from_deployment(kube):
+    kube.create(make_tb())
+    r = TensorboardReconciler(kube)
+    r.reconcile(Request("user1", "tb"))
+    dep = kube.get(DEPLOYMENT, "tb", "user1")
+    dep["status"] = {"readyReplicas": 1,
+                     "conditions": [{"type": "Available", "status": "True"}]}
+    kube.update_status(dep)
+    r.reconcile(Request("user1", "tb"))
+    tb = kube.get(
+        __import__("kubeflow_tpu.platform.k8s.types", fromlist=["TENSORBOARD"]).TENSORBOARD,
+        "tb", "user1",
+    )
+    assert tb["status"]["readyReplicas"] == 1

@@ -1,0 +1,224 @@
+import base64
+import json
+
+import pytest
+
+from kubeflow_tpu.platform.apis.poddefault import tpu_pod_default
+from kubeflow_tpu.platform.webhook.jsonpatch import apply_patch, create_patch
+from kubeflow_tpu.platform.webhook.mutate import (
+    EXCLUDE_ANNOTATION,
+    MergeConflict,
+    apply_pod_defaults,
+    filter_pod_defaults,
+    mutate_admission_review,
+    safe_to_apply,
+)
+
+
+def make_pod(labels=None, annotations=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "nb-0", "namespace": "user1",
+            "labels": labels or {}, "annotations": annotations or {},
+        },
+        "spec": {
+            "containers": [
+                {"name": "notebook", "image": "jupyter", "env": []},
+                {"name": "istio-proxy", "image": "proxy"},
+            ],
+        },
+    }
+
+
+def make_pd(name="pd", selector=None, **spec):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1",
+        "kind": "PodDefault",
+        "metadata": {"name": name, "namespace": "user1", "resourceVersion": "7"},
+        "spec": {"selector": selector or {"matchLabels": {"use-pd": "true"}}, **spec},
+    }
+
+
+def test_selector_filtering():
+    pod = make_pod(labels={"use-pd": "true"})
+    pds = [make_pd("a"), make_pd("b", selector={"matchLabels": {"other": "x"}})]
+    assert [p["metadata"]["name"] for p in filter_pod_defaults(pds, pod)] == ["a"]
+
+
+def test_match_expressions():
+    pod = make_pod(labels={"tier": "gold"})
+    pd = make_pd(selector={"matchExpressions": [
+        {"key": "tier", "operator": "In", "values": ["gold", "silver"]},
+        {"key": "legacy", "operator": "DoesNotExist"},
+    ]})
+    assert filter_pod_defaults([pd], pod)
+    pod2 = make_pod(labels={"tier": "bronze"})
+    assert not filter_pod_defaults([pd], pod2)
+
+
+def test_exclusion_annotation():
+    pod = make_pod(labels={"use-pd": "true"},
+                   annotations={EXCLUDE_ANNOTATION: "true"})
+    assert filter_pod_defaults([make_pd()], pod) == []
+
+
+def test_env_and_volume_merge_skips_istio_proxy():
+    pod = make_pod(labels={"use-pd": "true"})
+    pd = make_pd(
+        env=[{"name": "FOO", "value": "1"}],
+        volumes=[{"name": "v", "emptyDir": {}}],
+        volumeMounts=[{"name": "v", "mountPath": "/v"}],
+    )
+    out = apply_pod_defaults(pod, [pd])
+    notebook = out["spec"]["containers"][0]
+    istio = out["spec"]["containers"][1]
+    assert {"name": "FOO", "value": "1"} in notebook["env"]
+    assert notebook["volumeMounts"] == [{"name": "v", "mountPath": "/v"}]
+    assert "volumeMounts" not in istio and "env" not in istio
+    assert out["spec"]["volumes"] == [{"name": "v", "emptyDir": {}}]
+    assert out["metadata"]["annotations"][
+        "poddefault.admission.kubeflow.org/poddefault-pd"
+    ] == "7"
+
+
+def test_identical_collision_ok_different_conflicts():
+    pod = make_pod(labels={"use-pd": "true"})
+    pod["spec"]["containers"][0]["env"] = [{"name": "FOO", "value": "1"}]
+    ok_pd = make_pd(env=[{"name": "FOO", "value": "1"}])
+    assert safe_to_apply(pod, [ok_pd]) is None
+    bad_pd = make_pd(env=[{"name": "FOO", "value": "2"}])
+    msg = safe_to_apply(pod, [bad_pd])
+    assert msg and "FOO" in msg
+    with pytest.raises(MergeConflict):
+        apply_pod_defaults(pod, [bad_pd])
+
+
+def test_command_only_if_unset():
+    pod = make_pod(labels={"use-pd": "true"})
+    pd = make_pd(command=["run.sh"], args=["--fast"])
+    out = apply_pod_defaults(pod, [pd])
+    assert out["spec"]["containers"][0]["command"] == ["run.sh"]
+    pod2 = make_pod(labels={"use-pd": "true"})
+    pod2["spec"]["containers"][0]["command"] = ["mine.sh"]
+    out2 = apply_pod_defaults(pod2, [pd])
+    assert out2["spec"]["containers"][0]["command"] == ["mine.sh"]
+
+
+def test_sidecar_and_tolerations():
+    pod = make_pod(labels={"use-pd": "true"})
+    pd = make_pd(
+        sidecars=[{"name": "logger", "image": "fluentd"}],
+        tolerations=[{"key": "google.com/tpu", "operator": "Exists",
+                      "effect": "NoSchedule"}],
+    )
+    out = apply_pod_defaults(pod, [pd])
+    assert any(c["name"] == "logger" for c in out["spec"]["containers"])
+    assert out["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+
+
+def test_tpu_pod_default_injects_runtime_env():
+    pod = make_pod(labels={"tpu-v5e": "true"})
+    pd = tpu_pod_default("user1", "v5e", "2x4")
+    out = apply_pod_defaults(pod, [pd])
+    env = {e["name"]: e.get("value") for e in out["spec"]["containers"][0]["env"]}
+    assert env["TPU_TOPOLOGY"] == "2x4"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5e-8"
+    mounts = out["spec"]["containers"][0]["volumeMounts"]
+    assert {"name": "tpu-shm", "mountPath": "/dev/shm"} in mounts
+
+
+def test_admission_review_roundtrip():
+    pod = make_pod(labels={"use-pd": "true"})
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {
+            "uid": "u1",
+            "resource": {"group": "", "version": "v1", "resource": "pods"},
+            "namespace": "user1",
+            "object": pod,
+        },
+    }
+    pd = make_pd(env=[{"name": "FOO", "value": "1"}])
+    out = mutate_admission_review(review, [pd])
+    resp = out["response"]
+    assert resp["uid"] == "u1" and resp["allowed"]
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    mutated = apply_patch(pod, patch)
+    assert {"name": "FOO", "value": "1"} in mutated["spec"]["containers"][0]["env"]
+
+
+def test_admission_review_conflict_allows_without_patch():
+    pod = make_pod(labels={"use-pd": "true"})
+    pod["spec"]["containers"][0]["env"] = [{"name": "FOO", "value": "mine"}]
+    review = {"request": {
+        "uid": "u2", "namespace": "user1",
+        "resource": {"resource": "pods"}, "object": pod,
+    }}
+    out = mutate_admission_review(review, [make_pd(env=[{"name": "FOO", "value": "other"}])])
+    assert out["response"]["allowed"]
+    assert "patch" not in out["response"]
+
+
+def test_jsonpatch_diff_apply_roundtrip():
+    before = {"a": {"b": 1, "drop": 2}, "list": [1, 2], "keep": "x"}
+    after = {"a": {"b": 9, "new": {"deep": True}}, "list": [1, 2, 3], "keep": "x"}
+    patch = create_patch(before, after)
+    assert apply_patch(before, patch) == after
+
+
+def test_jsonpatch_escaping():
+    before = {"metadata": {"annotations": {}}}
+    after = {"metadata": {"annotations": {"a/b~c": "v"}}}
+    patch = create_patch(before, after)
+    assert apply_patch(before, patch) == after
+
+
+def test_malformed_poddefault_fails_open_over_http():
+    """A PodDefault with garbage fields must not 500 (which would block all
+    pod admission under failurePolicy=Fail) — the server fails open."""
+    import requests
+
+    from kubeflow_tpu.platform.testing import FakeKube
+    from kubeflow_tpu.platform.webhook.server import WebhookServer
+
+    kube = FakeKube()
+    kube.add_namespace("user1")
+    bad = make_pd("bad")
+    bad["spec"]["env"] = "not-a-list"
+    kube.create(bad)
+    srv = WebhookServer(kube, host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        review = {"request": {
+            "uid": "u3", "namespace": "user1",
+            "resource": {"resource": "pods"},
+            "object": make_pod(labels={"use-pd": "true"}),
+        }}
+        r = requests.post(
+            f"http://127.0.0.1:{srv.port}/apply-poddefault", json=review, timeout=10
+        )
+        assert r.status_code == 200
+        resp = r.json()["response"]
+        assert resp["allowed"] is True
+        assert "patch" not in resp
+        assert "skipped" in resp["status"]["message"]
+    finally:
+        srv.stop()
+
+
+def test_fake_patch_strips_last_finalizer_deletes():
+    from kubeflow_tpu.platform.k8s import errors as kerrors
+    from kubeflow_tpu.platform.k8s.types import PROFILE
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.create({"apiVersion": "kubeflow.org/v1", "kind": "Profile",
+                 "metadata": {"name": "p", "finalizers": ["profile-finalizer"]}})
+    kube.delete(PROFILE, "p")
+    assert kube.get(PROFILE, "p")["metadata"]["deletionTimestamp"]
+    kube.patch(PROFILE, "p", {"metadata": {"finalizers": None}})
+    with pytest.raises(kerrors.NotFound):
+        kube.get(PROFILE, "p")
