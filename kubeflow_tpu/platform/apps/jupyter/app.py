@@ -1,0 +1,219 @@
+"""Jupyter web app (JWA) backend: the spawner + notebook table REST API.
+
+Routes mirror the reference (reference jupyter/backend/apps/common/routes/
+get.py:15-123, default/routes/post.py:11-72, common/routes/patch.py:17-80,
+delete.py:8-17) with the GPU endpoint replaced by ``GET /api/tpus`` —
+offered (accelerator, topology) pairs intersected with what cluster nodes
+actually expose.
+"""
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+from werkzeug.wrappers import Request
+
+from kubeflow_tpu.platform.apis import notebook as nbapi
+from kubeflow_tpu.platform.apps.jupyter import form as form_mod
+from kubeflow_tpu.platform.apps.jupyter.status import process_status
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    EVENT,
+    NODE,
+    NOTEBOOK,
+    POD,
+    PODDEFAULT,
+    PVC,
+    deep_get,
+    name_of,
+)
+from kubeflow_tpu.platform.tpu import topologies_on_nodes
+from kubeflow_tpu.platform.web.crud_backend import (
+    CrudBackend,
+    current_user,
+    install_standard_middleware,
+)
+from kubeflow_tpu.platform.web.framework import App, HttpError, success
+
+
+def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
+               secure_cookies: Optional[bool] = None) -> App:
+    app = App("jupyter-web-app")
+    backend = CrudBackend(client, auth)
+    install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    cfg_path = spawner_config_path
+
+    # -- config & environment -------------------------------------------------
+
+    @app.route("/api/config")
+    def get_config(request: Request):
+        return success({"config": form_mod.load_spawner_config(cfg_path)})
+
+    @app.route("/api/namespaces/<ns>/tpus")
+    def get_tpus(request: Request, ns: str):
+        """Offered TPU options ∩ node capacity — the analogue of the
+        reference's GET /api/gpus vendor∩capacity scan (get.py:102-123)."""
+        user = current_user(request)
+        nodes = backend.list_resources(user, NODE)
+        present = topologies_on_nodes(nodes)
+        offered = form_mod.load_spawner_config(cfg_path).get("tpus", {}).get(
+            "options", []
+        )
+        out = []
+        for option in offered:
+            acc = option.get("accelerator")
+            if acc not in present:
+                continue
+            # Strict intersection: every node of a multi-host slice carries
+            # the slice's topology label, so present[acc] covers multi-host
+            # pools too.  Never surface topologies the admin didn't offer —
+            # the spawn endpoint would reject them.
+            topologies = [t for t in option.get("topologies", [])
+                          if t in set(present[acc])]
+            if topologies:
+                out.append({"accelerator": acc, "topologies": topologies})
+        return success({"tpus": out})
+
+    # -- notebooks ------------------------------------------------------------
+
+    @app.route("/api/namespaces/<ns>/notebooks")
+    def list_notebooks(request: Request, ns: str):
+        user = current_user(request)
+        notebooks = backend.list_resources(user, NOTEBOOK, ns)
+        events_by_nb = _warning_events(user, ns)
+        out = [
+            _notebook_row(nb, events_by_nb.get(name_of(nb), []))
+            for nb in notebooks
+        ]
+        return success({"notebooks": out})
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>")
+    def get_notebook(request: Request, ns: str, name: str):
+        user = current_user(request)
+        nb = backend.get_resource(user, NOTEBOOK, name, ns)
+        return success({"notebook": nb})
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>/pod")
+    def get_notebook_pod(request: Request, ns: str, name: str):
+        user = current_user(request)
+        pods = backend.list_resources(
+            user, POD, ns, label_selector={nbapi.LABEL_NOTEBOOK_NAME: name}
+        )
+        if not pods:
+            raise HttpError(404, f"no pods for notebook {name}")
+        return success({"pod": pods[0]})
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>/events")
+    def get_notebook_events(request: Request, ns: str, name: str):
+        user = current_user(request)
+        def involves(ev) -> bool:
+            obj = deep_get(ev, "involvedObject", "name", default="")
+            # Exact object or its children (nb-0, nb.17c9...), NOT prefix
+            # siblings (nb10 must not show in nb1's drawer).
+            return obj == name or obj.startswith(name + "-") or obj.startswith(name + ".")
+
+        events = [ev for ev in backend.list_resources(user, EVENT, ns) if involves(ev)]
+        return success({"events": events})
+
+    @app.route("/api/namespaces/<ns>/notebooks", methods=["POST"])
+    def post_notebook(request: Request, ns: str):
+        user = current_user(request)
+        body = request.get_json(force=True, silent=True) or {}
+        body["namespace"] = ns
+        defaults = form_mod.load_spawner_config(cfg_path)
+        nb, pvcs = form_mod.build_notebook(body, defaults)
+        nbapi.validate(nb)
+        # Dry-run first (reference post.py:48-54): catch quota/validation
+        # rejections before any PVC is created.
+        backend.create_resource(user, nb, dry_run=True)
+        for pvc in pvcs:
+            try:
+                backend.create_resource(user, pvc)
+            except errors.Conflict:
+                pass  # existing claim reused
+        created = backend.create_resource(user, nb)
+        return success({"notebook": created}, status=200)
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=["PATCH"])
+    def patch_notebook(request: Request, ns: str, name: str):
+        user = current_user(request)
+        body = request.get_json(force=True, silent=True) or {}
+        stopped = body.get("stopped")
+        if stopped is None:
+            raise HttpError(400, "body must include 'stopped': true|false")
+        if stopped:
+            patch = {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: datetime.datetime.now(
+                    datetime.timezone.utc
+                ).strftime("%Y-%m-%dT%H:%M:%SZ"),
+            }}}
+        else:
+            patch = {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}}
+        out = backend.patch_resource(user, NOTEBOOK, name, patch, ns)
+        return success({"notebook": out})
+
+    @app.route("/api/namespaces/<ns>/notebooks/<name>", methods=["DELETE"])
+    def delete_notebook(request: Request, ns: str, name: str):
+        user = current_user(request)
+        backend.delete_resource(user, NOTEBOOK, name, ns)
+        return success()
+
+    # -- supporting resources -------------------------------------------------
+
+    @app.route("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(request: Request, ns: str):
+        user = current_user(request)
+        return success({"pvcs": backend.list_resources(user, PVC, ns)})
+
+    @app.route("/api/namespaces/<ns>/poddefaults")
+    def list_poddefaults(request: Request, ns: str):
+        user = current_user(request)
+        pds = backend.list_resources(user, PODDEFAULT, ns)
+        out = [{
+            "label": _pd_label(pd),
+            "desc": deep_get(pd, "spec", "desc", default=name_of(pd)),
+            "name": name_of(pd),
+        } for pd in pds]
+        return success({"poddefaults": out})
+
+    # -- helpers --------------------------------------------------------------
+
+    def _warning_events(user, ns):
+        out: dict = {}
+        try:
+            events = backend.list_resources(user, EVENT, ns)
+        except HttpError:
+            return out
+        for ev in events:
+            name = deep_get(ev, "involvedObject", "name", default="")
+            base = name.split(".")[0].rsplit("-", 1)[0] if "-" in name else name
+            out.setdefault(base, []).append(ev)
+            out.setdefault(name, []).append(ev)
+        return out
+
+    return app
+
+
+def _pd_label(pd) -> str:
+    match = deep_get(pd, "spec", "selector", "matchLabels", default={}) or {}
+    return next(iter(match), name_of(pd))
+
+
+def _notebook_row(nb, events) -> dict:
+    tpu = deep_get(nb, "spec", "tpu", default=None)
+    container = deep_get(
+        nb, "spec", "template", "spec", "containers", default=[{}]
+    )[0]
+    row = {
+        "name": name_of(nb),
+        "namespace": deep_get(nb, "metadata", "namespace"),
+        "image": container.get("image", ""),
+        "shortImage": (container.get("image", "").split("/")[-1]),
+        "cpu": deep_get(container, "resources", "requests", "cpu", default=""),
+        "memory": deep_get(container, "resources", "requests", "memory", default=""),
+        "tpu": tpu,
+        "age": deep_get(nb, "metadata", "creationTimestamp", default=""),
+        "labels": deep_get(nb, "metadata", "labels", default={}),
+        "status": process_status(nb, events),
+    }
+    return row

@@ -1,0 +1,61 @@
+"""Tensorboards web app (TWA): Tensorboard CR CRUD.
+
+Mirrors the reference TWA backend (reference tensorboards/backend/app/
+routes/post.py:14-38 and friends).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from werkzeug.wrappers import Request
+
+from kubeflow_tpu.platform.k8s.types import TENSORBOARD, deep_get, name_of
+from kubeflow_tpu.platform.web.crud_backend import (
+    CrudBackend,
+    current_user,
+    install_standard_middleware,
+)
+from kubeflow_tpu.platform.web.framework import App, HttpError, success
+
+
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+    app = App("tensorboards-web-app")
+    backend = CrudBackend(client, auth)
+    install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+
+    @app.route("/api/namespaces/<ns>/tensorboards")
+    def list_tensorboards(request: Request, ns: str):
+        user = current_user(request)
+        tbs = backend.list_resources(user, TENSORBOARD, ns)
+        out = [{
+            "name": name_of(tb),
+            "namespace": ns,
+            "logspath": deep_get(tb, "spec", "logspath", default=""),
+            "age": deep_get(tb, "metadata", "creationTimestamp", default=""),
+            "ready": bool(deep_get(tb, "status", "readyReplicas", default=0)),
+        } for tb in tbs]
+        return success({"tensorboards": out})
+
+    @app.route("/api/namespaces/<ns>/tensorboards", methods=["POST"])
+    def post_tensorboard(request: Request, ns: str):
+        user = current_user(request)
+        body = request.get_json(force=True, silent=True) or {}
+        name = body.get("name", "")
+        logspath = body.get("logspath", "")
+        if not name or not logspath:
+            raise HttpError(400, "name and logspath are required")
+        tb = {
+            "apiVersion": "tensorboard.kubeflow.org/v1alpha1",
+            "kind": "Tensorboard",
+            "metadata": {"name": name, "namespace": ns},
+            "spec": {"logspath": logspath},
+        }
+        return success({"tensorboard": backend.create_resource(user, tb)})
+
+    @app.route("/api/namespaces/<ns>/tensorboards/<name>", methods=["DELETE"])
+    def delete_tensorboard(request: Request, ns: str, name: str):
+        user = current_user(request)
+        backend.delete_resource(user, TENSORBOARD, name, ns)
+        return success()
+
+    return app

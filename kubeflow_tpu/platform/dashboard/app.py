@@ -1,0 +1,226 @@
+"""Central dashboard backend: the landing API every page load hits.
+
+Mirrors the reference Express server's surface (reference
+centraldashboard/app/server.ts:26-95, api.ts:29-103,
+api_workgroup.ts:40-118): namespaces, activities (events), dashboard
+links/settings from a ConfigMap, env-info with role mapping, registration
+flow (create Profile), and contributor management — the KFAM bridge is a
+direct library call instead of an HTTP hop.
+
+TPU-native addition: ``/api/tpu-overview`` aggregates chip capacity /
+requests per namespace from node + notebook state (the reference's only
+metrics view is Stackdriver-backed and GCP-only, metrics_service.ts:20-42).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from werkzeug.wrappers import Request
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    CONFIGMAP,
+    EVENT,
+    NAMESPACE,
+    NODE,
+    NOTEBOOK,
+    PROFILE,
+    deep_get,
+    name_of,
+)
+from kubeflow_tpu.platform.kfam.bindings import BindingManager
+from kubeflow_tpu.platform.tpu import RESOURCE_TPU
+from kubeflow_tpu.platform.web.crud_backend import (
+    CrudBackend,
+    current_user,
+    install_standard_middleware,
+)
+from kubeflow_tpu.platform.web.framework import App, HttpError, success
+
+SETTINGS_CONFIGMAP = "kubeflow-dashboard-settings"
+SETTINGS_NAMESPACE = "kubeflow"
+
+ROLE_MAP = {"admin": "owner", "edit": "contributor", "view": "viewer"}
+
+
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+    app = App("centraldashboard")
+    backend = CrudBackend(client, auth)
+    install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    manager = BindingManager(client)
+
+    # -- /api ------------------------------------------------------------------
+
+    @app.route("/api/namespaces")
+    def namespaces(request: Request):
+        user = current_user(request)
+        out = [name_of(ns) for ns in backend.list_resources(user, NAMESPACE)]
+        return success({"namespaces": out})
+
+    @app.route("/api/activities/<ns>")
+    def activities(request: Request, ns: str):
+        user = current_user(request)
+        events = backend.list_resources(user, EVENT, ns)
+        events.sort(key=lambda e: e.get("lastTimestamp", ""), reverse=True)
+        return success({"events": events[:100]})
+
+    @app.route("/api/dashboard-links")
+    def dashboard_links(request: Request):
+        return success({"links": _settings(client).get("links", {
+            "menuLinks": [
+                {"link": "/jupyter/", "text": "Notebooks", "icon": "book"},
+                {"link": "/volumes/", "text": "Volumes", "icon": "device:storage"},
+                {"link": "/tensorboards/", "text": "TensorBoards",
+                 "icon": "assessment"},
+            ],
+            "externalLinks": [],
+            "quickLinks": [
+                {"desc": "Create a new Notebook server",
+                 "link": "/jupyter/new"},
+            ],
+        })})
+
+    @app.route("/api/dashboard-settings")
+    def dashboard_settings(request: Request):
+        return success({"settings": _settings(client).get("settings", {
+            "DASHBOARD_FORCE_IFRAME": True,
+        })})
+
+    @app.route("/api/tpu-overview")
+    def tpu_overview(request: Request):
+        user = current_user(request)
+        capacity = 0
+        for node in backend.list_resources(user, NODE):
+            capacity += int(deep_get(node, "status", "capacity", RESOURCE_TPU,
+                                     default="0") or 0)
+        requested = {}
+        for ns in backend.list_resources(user, NAMESPACE):
+            ns_name = name_of(ns)
+            try:
+                notebooks = client.list(NOTEBOOK, ns_name)
+            except errors.ApiError:
+                continue
+            total = 0
+            for nb in notebooks:
+                from kubeflow_tpu.platform.apis.notebook import tpu_slice
+
+                s = tpu_slice(nb)
+                if s:
+                    total += s.chips
+            if total:
+                requested[ns_name] = total
+        return success({
+            "clusterCapacityChips": capacity,
+            "requestedChipsByNamespace": requested,
+        })
+
+    # -- /api/workgroup --------------------------------------------------------
+
+    @app.route("/api/workgroup/env-info")
+    def env_info(request: Request):
+        user = current_user(request)
+        profiles = {name_of(p): p for p in client.list(PROFILE)}
+        namespaces = []
+        for binding in manager.list_bindings(user=user):
+            role = binding["roleRef"]["name"].removeprefix("kubeflow-")
+            namespaces.append({
+                "namespace": binding["referredNamespace"],
+                "role": ROLE_MAP.get(role, role),
+                "user": user,
+            })
+        owned = [
+            name_of(p) for p in profiles.values()
+            if deep_get(p, "spec", "owner", "name") == user
+        ]
+        for ns in owned:
+            if not any(n["namespace"] == ns for n in namespaces):
+                namespaces.append({"namespace": ns, "role": "owner", "user": user})
+        return success({
+            "user": user,
+            "platform": {"kubeflowVersion": "tpu-native-0.1.0"},
+            "hasWorkgroup": bool(owned),
+            "hasAuth": not backend.auth.disable_auth,
+            "namespaces": namespaces,
+            "isClusterAdmin": manager.is_cluster_admin(user),
+        })
+
+    @app.route("/api/workgroup/create", methods=["POST"])
+    def workgroup_create(request: Request):
+        user = current_user(request)
+        body = request.get_json(force=True, silent=True) or {}
+        requested = body.get("namespace")
+        default = _default_namespace(user)
+        # Self-registration claims only the user's own derived namespace;
+        # arbitrary namespace names need cluster admin (same hole as KFAM
+        # profile creation otherwise).
+        if requested and requested != default and not manager.is_cluster_admin(user):
+            raise HttpError(
+                403, f"only cluster admins may register namespace {requested!r}"
+            )
+        name = requested or default
+        try:
+            manager.create_profile(name, user)
+        except errors.Conflict:
+            raise HttpError(409, f"namespace {name} already exists") from None
+        return success({"namespace": name})
+
+    @app.route("/api/workgroup/nuke-self", methods=["DELETE"])
+    def workgroup_nuke(request: Request):
+        user = current_user(request)
+        victims = [
+            name_of(p) for p in client.list(PROFILE)
+            if deep_get(p, "spec", "owner", "name") == user
+        ]
+        for name in victims:
+            manager.delete_profile(name)
+        return success({"deleted": victims})
+
+    @app.route("/api/workgroup/add-contributor", methods=["POST"])
+    def add_contributor(request: Request):
+        caller = current_user(request)
+        body = request.get_json(force=True, silent=True) or {}
+        contributor = body.get("contributor", "")
+        namespace = body.get("namespace", "")
+        if not contributor or not namespace:
+            raise HttpError(400, "contributor and namespace required")
+        if not (manager.is_owner(caller, namespace)
+                or manager.is_cluster_admin(caller)):
+            raise HttpError(403, "only the namespace owner may add contributors")
+        manager.create_binding(contributor, namespace, "edit")
+        return success()
+
+    @app.route("/api/workgroup/remove-contributor", methods=["DELETE"])
+    def remove_contributor(request: Request):
+        caller = current_user(request)
+        body = request.get_json(force=True, silent=True) or {}
+        contributor = body.get("contributor", "")
+        namespace = body.get("namespace", "")
+        if not (manager.is_owner(caller, namespace)
+                or manager.is_cluster_admin(caller)):
+            raise HttpError(403, "only the namespace owner may remove contributors")
+        manager.delete_binding(contributor, namespace, "edit")
+        return success()
+
+    return app
+
+
+def _settings(client) -> dict:
+    import json
+
+    try:
+        cm = client.get(CONFIGMAP, SETTINGS_CONFIGMAP, SETTINGS_NAMESPACE)
+    except errors.ApiError:
+        return {}
+    out = {}
+    for key, raw in (cm.get("data") or {}).items():
+        try:
+            out[key] = json.loads(raw)
+        except (TypeError, ValueError):
+            out[key] = raw
+    return out
+
+
+def _default_namespace(user: str) -> str:
+    from kubeflow_tpu.platform.kfam.bindings import _sanitize
+
+    return "kubeflow-" + _sanitize(user.split("@")[0])

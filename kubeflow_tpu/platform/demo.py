@@ -28,7 +28,14 @@ def main(argv=None) -> int:
     ap.add_argument("--image", default="ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest")
     ap.add_argument("--tpu", default=None, help="TPU accelerator (e.g. v5e)")
     ap.add_argument("--topology", default=None, help="TPU topology (e.g. 4x4)")
+    ap.add_argument(
+        "--serve", action="store_true",
+        help="boot the FULL platform (controllers + webhook + web apps) "
+             "against the in-memory API server and keep serving",
+    )
     args = ap.parse_args(argv)
+    if args.serve:
+        return serve_full_platform(args)
 
     kube = FakeKube()
     kube.add_namespace(args.namespace)
@@ -93,6 +100,65 @@ def main(argv=None) -> int:
           f"/{nb['status']['replicas']}")
     print("OK: spawn flow complete")
     mgr.stop()
+    return 0
+
+
+def serve_full_platform(args) -> int:
+    """Every service of the platform, live on localhost ports, backed by the
+    in-memory API server — the whole SURVEY.md §1 layer map in one process."""
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app as jwa
+    from kubeflow_tpu.platform.apps.tensorboards.app import create_app as twa
+    from kubeflow_tpu.platform.apps.volumes.app import create_app as vwa
+    from kubeflow_tpu.platform.controllers import culling, profile, tensorboard
+    from kubeflow_tpu.platform.dashboard.app import create_app as dashboard
+    from kubeflow_tpu.platform.kfam.app import create_app as kfam
+    from kubeflow_tpu.platform.apis.poddefault import tpu_pod_default
+    from kubeflow_tpu.platform.webhook.server import WebhookServer
+
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    kube.add_tpu_node("tpu-node-1", topology="2x4")
+    kube.add_tpu_node("tpu-node-2", topology="4x4")
+    # Seed the TPU runtime PodDefault so the webhook path is exercisable.
+    kube.create(tpu_pod_default("kubeflow", "v5e", "2x4"))
+
+    mgr = Manager(kube)
+    mgr.add(make_controller(kube, use_istio=True))
+    mgr.add(profile.make_controller(kube))
+    mgr.add(tensorboard.make_controller(kube))
+    mgr.add(culling.make_controller(kube, prober=lambda url: None))
+    mgr.start()
+
+    webhook = WebhookServer(kube, host="127.0.0.1", port=0)
+    webhook.start()
+
+    servers = {}
+    for name, factory in [
+        ("jupyter", jwa), ("volumes", vwa), ("tensorboards", twa),
+        ("kfam", kfam), ("dashboard", dashboard),
+    ]:
+        # Demo rides plain HTTP on localhost: secure-cookie CSRF mode would
+        # 403 every mutation (browsers/curl won't return Secure cookies).
+        app = factory(kube, secure_cookies=False)
+        srv, base = app.test_server()
+        servers[name] = (srv, base)
+
+    print("platform up (in-memory API server):")
+    print(f"  webhook    https-less http://127.0.0.1:{webhook.port}/apply-poddefault")
+    for name, (_, base) in servers.items():
+        print(f"  {name:<11}{base}")
+    print("identity: pass header 'kubeflow-userid: <email>'")
+    print("Ctrl-C to stop")
+    try:
+        import signal
+
+        signal.pause()
+    except (KeyboardInterrupt, AttributeError):
+        pass
+    mgr.stop()
+    webhook.stop()
+    for srv, _ in servers.values():
+        srv.shutdown()
     return 0
 
 

@@ -1,0 +1,105 @@
+"""KFAM REST service: profiles + contributor bindings.
+
+Interface mirrors the reference (reference access-management/kfam/
+api_default.go:36-43 → routes /kfam/v1/bindings, /kfam/v1/profiles,
+/kfam/v1/role/clusteradmin), including the owner-or-cluster-admin gate
+before binding mutations (:104-120).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from werkzeug.wrappers import Request
+
+from kubeflow_tpu.platform.kfam.bindings import BindingManager
+from kubeflow_tpu.platform.web.crud_backend import (
+    AuthContext,
+    CrudBackend,
+    current_user,
+    install_standard_middleware,
+)
+from kubeflow_tpu.platform.web.framework import App, HttpError, success
+
+
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+    app = App("kfam")
+    backend = CrudBackend(client, auth)
+    install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    manager = BindingManager(client)
+
+    def _require_admin(user: str, namespace: str) -> None:
+        if manager.is_owner(user, namespace) or manager.is_cluster_admin(user):
+            return
+        raise HttpError(
+            403, f"user {user!r} is not the owner of {namespace} nor cluster admin"
+        )
+
+    def _parse_binding(body: dict):
+        user = (body.get("user") or {}).get("name", "")
+        namespace = body.get("referredNamespace", "")
+        role_ref = (body.get("roleRef") or {}).get("name", "")
+        role = role_ref.removeprefix("kubeflow-")
+        if not user or not namespace or not role:
+            raise HttpError(400, "user.name, referredNamespace, roleRef.name required")
+        return user, namespace, role
+
+    @app.route("/kfam/v1/bindings")
+    def get_bindings(request: Request):
+        namespace = request.args.get("namespace")
+        user = request.args.get("user")
+        return success({"bindings": manager.list_bindings(namespace, user)})
+
+    @app.route("/kfam/v1/bindings", methods=["POST"])
+    def create_binding(request: Request):
+        caller = current_user(request)
+        user, namespace, role = _parse_binding(
+            request.get_json(force=True, silent=True) or {}
+        )
+        _require_admin(caller, namespace)
+        try:
+            manager.create_binding(user, namespace, role)
+        except ValueError as e:
+            raise HttpError(400, str(e)) from None
+        return success()
+
+    @app.route("/kfam/v1/bindings", methods=["DELETE"])
+    def delete_binding(request: Request):
+        caller = current_user(request)
+        user, namespace, role = _parse_binding(
+            request.get_json(force=True, silent=True) or {}
+        )
+        _require_admin(caller, namespace)
+        manager.delete_binding(user, namespace, role)
+        return success()
+
+    @app.route("/kfam/v1/profiles", methods=["POST"])
+    def create_profile(request: Request):
+        body = request.get_json(force=True, silent=True) or {}
+        name = (body.get("metadata") or {}).get("name", "")
+        owner = ((body.get("spec") or {}).get("owner") or {}).get("name", "")
+        if not name:
+            raise HttpError(400, "metadata.name required")
+        caller = current_user(request)
+        # Self-registration only, unless cluster admin: without this gate any
+        # authenticated user could claim ownership of a profile-less
+        # namespace and then grant themselves bindings in it.
+        if owner and owner != caller and not manager.is_cluster_admin(caller):
+            raise HttpError(
+                403, "only cluster admins may create profiles for other users"
+            )
+        manager.create_profile(name, owner or caller)
+        return success()
+
+    @app.route("/kfam/v1/profiles/<name>", methods=["DELETE"])
+    def delete_profile(request: Request, name: str):
+        caller = current_user(request)
+        _require_admin(caller, name)
+        manager.delete_profile(name)
+        return success()
+
+    @app.route("/kfam/v1/role/clusteradmin")
+    def cluster_admin(request: Request):
+        user = request.args.get("user") or current_user(request)
+        return success({"user": user, "isClusterAdmin": manager.is_cluster_admin(user)})
+
+    return app

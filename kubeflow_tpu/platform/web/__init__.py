@@ -1,0 +1,3 @@
+from kubeflow_tpu.platform.web.framework import App, Blueprint, HttpError, json_response
+
+__all__ = ["App", "Blueprint", "HttpError", "json_response"]

@@ -1,0 +1,167 @@
+"""Shared CRUD-backend library: authn, authz, CSRF, probes, k8s helpers.
+
+The rebuild of the reference's ``kubeflow.kubeflow.crud_backend`` package
+(reference crud-web-apps/common/backend/..., SURVEY.md §2.6): every web app
+(jupyter/volumes/tensorboards) composes these pieces.
+
+Security model (identical to the reference): identity is a **trusted HTTP
+header** set by the Istio gateway (authn.py:12-67 there), authorization is a
+SubjectAccessReview per k8s-touching call (authz.py:25-60), CSRF is a
+double-submit cookie (csrf.py).
+"""
+from __future__ import annotations
+
+import secrets as pysecrets
+from typing import Callable, List, Optional
+
+from werkzeug.wrappers import Request, Response
+
+from kubeflow_tpu.platform import config
+from kubeflow_tpu.platform.k8s.types import GVK
+from kubeflow_tpu.platform.web.framework import App, HttpError, json_response
+
+
+class AuthContext:
+    def __init__(self, *, userid_header: Optional[str] = None,
+                 userid_prefix: Optional[str] = None,
+                 disable_auth: Optional[bool] = None):
+        self.userid_header = userid_header or config.env("USERID_HEADER", "kubeflow-userid")
+        self.userid_prefix = (
+            userid_prefix if userid_prefix is not None
+            else config.env("USERID_PREFIX", "")
+        )
+        self.disable_auth = (
+            disable_auth if disable_auth is not None
+            else config.env_bool("APP_DISABLE_AUTH", False)
+        )
+
+    def user_of(self, request: Request) -> Optional[str]:
+        if self.disable_auth:
+            return config.env("DEV_USER", "dev-user@kubeflow.org")
+        raw = request.headers.get(self.userid_header)
+        if raw is None:
+            return None
+        if self.userid_prefix and raw.startswith(self.userid_prefix):
+            raw = raw[len(self.userid_prefix):]
+        return raw
+
+
+def no_authentication(fn):
+    """Route decorator: skip the authn gate (liveness probes etc.)."""
+    fn._no_auth = True
+    return fn
+
+
+class CrudBackend:
+    """Bundles client + auth for the per-resource API helpers."""
+
+    def __init__(self, client, auth: Optional[AuthContext] = None):
+        self.client = client
+        self.auth = auth or AuthContext()
+
+    # -- authz gate ----------------------------------------------------------
+
+    def ensure(self, user: str, verb: str, gvk: GVK, namespace: Optional[str] = None):
+        if self.auth.disable_auth:
+            return
+        if not self.client.can_i(user, verb, gvk, namespace):
+            raise HttpError(
+                403,
+                f"user {user!r} cannot {verb} {gvk.plural}"
+                + (f" in namespace {namespace}" if namespace else ""),
+            )
+
+    # -- generic verbs (each authz-gated like the reference api/ wrappers) ---
+
+    def list_resources(self, user, gvk, namespace=None, label_selector=None):
+        self.ensure(user, "list", gvk, namespace)
+        return self.client.list(gvk, namespace, label_selector=label_selector)
+
+    def get_resource(self, user, gvk, name, namespace=None):
+        self.ensure(user, "get", gvk, namespace)
+        return self.client.get(gvk, name, namespace)
+
+    def create_resource(self, user, obj, *, dry_run=False):
+        from kubeflow_tpu.platform.k8s.types import gvk_of, namespace_of
+
+        self.ensure(user, "create", gvk_of(obj), namespace_of(obj))
+        return self.client.create(obj, dry_run=dry_run)
+
+    def patch_resource(self, user, gvk, name, patch, namespace=None):
+        self.ensure(user, "patch", gvk, namespace)
+        return self.client.patch(gvk, name, patch, namespace)
+
+    def delete_resource(self, user, gvk, name, namespace=None):
+        self.ensure(user, "delete", gvk, namespace)
+        return self.client.delete(gvk, name, namespace)
+
+
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-XSRF-TOKEN"
+SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
+
+
+def install_standard_middleware(app: App, backend: CrudBackend, *,
+                                secure_cookies: Optional[bool] = None) -> None:
+    """authn gate + CSRF double-submit + probes, shared by every web app."""
+    secure = (
+        secure_cookies if secure_cookies is not None
+        else config.env("APP_SECURE_COOKIES", "true").lower() == "true"
+    )
+
+    @app.before_request
+    def authn_gate(request: Request) -> Optional[Response]:
+        adapter = app._url_map.bind_to_environ(request.environ)
+        try:
+            endpoint, _ = adapter.match()
+            view = app._views.get(endpoint)
+        except Exception:
+            view = None
+        if view is not None and getattr(view, "_no_auth", False):
+            return None
+        user = backend.auth.user_of(request)
+        if user is None:
+            return json_response(
+                {"success": False, "status": 401,
+                 "log": f"missing identity header {backend.auth.userid_header}"},
+                401,
+            )
+        request.environ["kubeflow.user"] = user
+        return None
+
+    @app.before_request
+    def csrf_gate(request: Request) -> Optional[Response]:
+        if not secure or request.method in SAFE_METHODS:
+            return None
+        cookie = request.cookies.get(CSRF_COOKIE)
+        header = request.headers.get(CSRF_HEADER)
+        if not cookie or cookie != header:
+            return json_response(
+                {"success": False, "status": 403, "log": "CSRF check failed"}, 403
+            )
+        return None
+
+    @app.after_request
+    def set_csrf_cookie(request: Request, response: Response) -> Response:
+        if secure and CSRF_COOKIE not in request.cookies:
+            response.set_cookie(
+                CSRF_COOKIE, pysecrets.token_urlsafe(32),
+                secure=True, samesite="Strict", path="/",
+            )
+        return response
+
+    @app.route("/healthz")
+    @no_authentication
+    def healthz(request: Request):
+        return json_response({"status": "ok"})
+
+    @app.route("/metrics")
+    @no_authentication
+    def metrics_route(request: Request):
+        from kubeflow_tpu.platform.runtime import metrics as m
+
+        return Response(m.render(), content_type="text/plain; version=0.0.4")
+
+
+def current_user(request: Request) -> str:
+    return request.environ.get("kubeflow.user", "")

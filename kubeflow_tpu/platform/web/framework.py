@@ -1,0 +1,151 @@
+"""A small WSGI web framework on werkzeug (routing + blueprints + JSON).
+
+The reference's CRUD backends are Flask apps (reference
+crud-web-apps/common/backend/.../__init__.py:16-35 builds an app factory
+from blueprints); Flask isn't in this image, so this module provides the
+slice of it the platform needs — app factory, blueprints, before-request
+hooks, JSON envelopes, error handlers — on werkzeug primitives.
+"""
+from __future__ import annotations
+
+import json
+import traceback
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from werkzeug.exceptions import HTTPException
+from werkzeug.routing import Map, Rule
+from werkzeug.serving import make_server
+from werkzeug.wrappers import Request, Response
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def json_response(data: Any, status: int = 200, *, headers: Optional[dict] = None) -> Response:
+    return Response(
+        json.dumps(data), status=status, content_type="application/json",
+        headers=headers,
+    )
+
+
+def success(data: Any = None, status: int = 200, **extra) -> Response:
+    # The reference's envelope: {"success": true, "status": 200, ...}
+    body = {"success": True, "status": status}
+    if data is not None:
+        body.update(data if isinstance(data, dict) else {"data": data})
+    body.update(extra)
+    return json_response(body, status)
+
+
+def failure(message: str, status: int = 400) -> Response:
+    return json_response(
+        {"success": False, "status": status, "log": message, "user_action": message},
+        status,
+    )
+
+
+class Blueprint:
+    def __init__(self, name: str, url_prefix: str = ""):
+        self.name = name
+        self.url_prefix = url_prefix.rstrip("/")
+        self.routes: List[Tuple[str, List[str], Callable]] = []
+
+    def route(self, rule: str, methods: Optional[List[str]] = None):
+        def deco(fn):
+            self.routes.append((rule, methods or ["GET"], fn))
+            return fn
+
+        return deco
+
+
+class App:
+    def __init__(self, name: str):
+        self.name = name
+        self._url_map = Map()
+        self._views: Dict[str, Callable] = {}
+        self.before_request_hooks: List[Callable[[Request], Optional[Response]]] = []
+        self.after_request_hooks: List[Callable[[Request, Response], Response]] = []
+        self.config: Dict[str, Any] = {}
+
+    # -- wiring --------------------------------------------------------------
+
+    def register_blueprint(self, bp: Blueprint) -> None:
+        for rule, methods, fn in bp.routes:
+            endpoint = f"{bp.name}.{fn.__name__}"
+            path = bp.url_prefix + rule
+            self._url_map.add(Rule(path, endpoint=endpoint, methods=methods))
+            self._views[endpoint] = fn
+
+    def route(self, rule: str, methods: Optional[List[str]] = None):
+        def deco(fn):
+            endpoint = fn.__name__
+            self._url_map.add(Rule(rule, endpoint=endpoint, methods=methods or ["GET"]))
+            self._views[endpoint] = fn
+            return fn
+
+        return deco
+
+    def before_request(self, fn):
+        self.before_request_hooks.append(fn)
+        return fn
+
+    def after_request(self, fn):
+        self.after_request_hooks.append(fn)
+        return fn
+
+    # -- wsgi ----------------------------------------------------------------
+
+    def __call__(self, environ, start_response):
+        request = Request(environ)
+        response = self._dispatch(request)
+        return response(environ, start_response)
+
+    def _dispatch(self, request: Request) -> Response:
+        adapter = self._url_map.bind_to_environ(request.environ)
+        try:
+            endpoint, args = adapter.match()
+            for hook in self.before_request_hooks:
+                early = hook(request)
+                if early is not None:
+                    response = early
+                    break
+            else:
+                response = self._views[endpoint](request, **args)
+            if not isinstance(response, Response):
+                response = json_response(response)
+        except HttpError as e:
+            response = failure(e.message, e.status)
+        except HTTPException as e:
+            response = failure(e.description or e.name, e.code or 500)
+        except Exception as e:
+            # Kubernetes API errors keep their own status (409 AlreadyExists
+            # on duplicate spawn, 404, 403 ...); everything else is a 500.
+            from kubeflow_tpu.platform.k8s.errors import ApiError
+
+            if isinstance(e, ApiError):
+                response = failure(str(e), e.status)
+            else:
+                response = failure("internal error", 500)
+                traceback.print_exc()
+        for hook in self.after_request_hooks:
+            response = hook(request, response)
+        return response
+
+    # -- serving -------------------------------------------------------------
+
+    def serve(self, host: str = "0.0.0.0", port: int = 5000):
+        """Blocking server (production runs behind the Istio gateway)."""
+        make_server(host, port, self, threaded=True).serve_forever()
+
+    def test_server(self, host: str = "127.0.0.1"):
+        """(server, base_url) on an ephemeral port, running on a thread."""
+        import threading
+
+        server = make_server(host, 0, self, threaded=True)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server, f"http://{host}:{server.server_port}"

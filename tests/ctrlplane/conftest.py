@@ -1,0 +1,5 @@
+import os
+
+# Web-app tests run over plain HTTP on localhost; secure-cookie CSRF mode is
+# exercised explicitly in test_csrf_double_submit.
+os.environ.setdefault("APP_SECURE_COOKIES", "false")
