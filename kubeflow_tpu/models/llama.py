@@ -40,6 +40,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     attn_impl: str = "auto"
+    # MoE (Mixtral-style): n_experts == 0 means a dense SwiGLU MLP.
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def head_dim(self) -> int:
@@ -62,6 +66,15 @@ CONFIGS = {
     "llama3_8b": LlamaConfig(vocab_size=128256, dim=4096, n_layers=32,
                              n_heads=32, n_kv_heads=8, ffn_dim=14336,
                              rope_theta=500000.0, max_seq_len=8192),
+    # Mixtral-style sparse MoE decoders (expert-parallel over the ep axis).
+    "mixtral_debug": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=256, dtype=jnp.float32, n_experts=4,
+    ),
+    "mixtral_8x7b": LlamaConfig(
+        dim=4096, n_layers=32, n_heads=32, n_kv_heads=8, ffn_dim=14336,
+        max_seq_len=32768, rope_theta=1000000.0, n_experts=8, top_k=2,
+    ),
 }
 
 
@@ -85,7 +98,19 @@ class LlamaBlock(nn.Module):
         )(h, positions=positions, segment_ids=segment_ids)
         x = x + h
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
-        h = SwiGLU(hidden_dim=cfg.ffn_dim, dtype=cfg.dtype, name="mlp")(h)
+        if cfg.n_experts > 0:
+            from kubeflow_tpu.models.moe import MoeMlp
+
+            h = MoeMlp(
+                n_experts=cfg.n_experts,
+                hidden_dim=cfg.ffn_dim,
+                top_k=cfg.top_k,
+                capacity_factor=cfg.capacity_factor,
+                dtype=cfg.dtype,
+                name="mlp",
+            )(h)
+        else:
+            h = SwiGLU(hidden_dim=cfg.ffn_dim, dtype=cfg.dtype, name="mlp")(h)
         return x + h
 
 

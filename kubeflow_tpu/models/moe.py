@@ -1,0 +1,118 @@
+"""Mixture-of-experts MLP with expert parallelism, GShard/Switch style.
+
+The reference platform ships no model code at all (SURVEY.md §2.13); MoE is
+part of this stack's compute layer so the v5e-16 pjit flagship config has an
+expert-parallel variant.  TPU-first design decisions:
+
+* **Dense one-hot dispatch** (einsums over a [tokens, experts, capacity]
+  mask) instead of gather/scatter: every op is a large static-shape matmul
+  or mask product that XLA tiles onto the MXU.  No dynamic shapes, no
+  sorting networks.
+* **Experts live in one batched param tensor** ``(n_experts, ...)`` sharded
+  ``P("ep", ...)``; the dispatch einsum's output carries the expert axis, so
+  sharding propagation turns token movement into a single XLA all-to-all
+  over the ``ep`` mesh axis (ICI), exactly the GShard lowering.
+* **Capacity-factor truncation** keeps shapes static: each expert processes
+  at most ``capacity`` tokens per group; overflow tokens fall through the
+  residual connection (standard Switch behavior).
+* The router runs in f32 (softmax stability) regardless of model dtype.
+
+The load-balancing auxiliary loss is sowed into the ``"losses"`` collection
+as ``moe_aux_loss``; ``kubeflow_tpu.train.steps.make_lm_train_step`` picks it
+up when ``aux_loss_weight > 0``.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _constrain(x: jax.Array, spec: P) -> jax.Array:
+    """Best-effort sharding constraint via the ambient mesh (no-op without)."""
+    from kubeflow_tpu.parallel.context import get_global_mesh
+
+    mesh = get_global_mesh()
+    if mesh is None or "ep" not in mesh.axis_names:
+        return x
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed SwiGLU experts over a batched expert weight tensor."""
+
+    n_experts: int
+    hidden_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, s, d = x.shape
+        e, k, f = self.n_experts, self.top_k, self.hidden_dim
+        # Per-group capacity: each batch row is a routing group, so capacity
+        # stays local and the dispatch tensors shard cleanly on the data axes.
+        capacity = max(1, int(s * k * self.capacity_factor / e))
+
+        router = nn.Dense(e, use_bias=False, dtype=jnp.float32, name="router")
+        logits = router(x.astype(jnp.float32))  # [b, s, e]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # Top-k expert choice per token, k one-hot masks [b, s, e].
+        _, topk_idx = jax.lax.top_k(probs, k)  # [b, s, k]
+        onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [b, s, k, e]
+
+        # Position of each (token, choice) in its expert's buffer, counted in
+        # routing order along the sequence; beyond-capacity slots are dropped.
+        flat = onehot.reshape(b, s * k, e)
+        pos = jnp.cumsum(flat, axis=1) - flat  # [b, s*k, e]
+        pos = pos.reshape(b, s, k, e)
+        keep = (pos < capacity) * onehot  # [b, s, k, e]
+        pos_oh = jax.nn.one_hot(
+            pos.astype(jnp.int32), capacity, dtype=jnp.float32
+        )  # [b,s,k,e,c]
+
+        # dispatch[b,s,e,c] ∈ {0,1}; combine carries the router prob.
+        dispatch = jnp.einsum("bske,bskec->bsec", keep, pos_oh)
+        gates = jnp.einsum("bse,bske->bsk", probs, keep)
+        combine = jnp.einsum("bsk,bske,bskec->bsec", gates, keep, pos_oh)
+
+        # Aux load-balancing loss (Switch eq. 4): e * Σ_e f_e · p̄_e.
+        token_frac = jnp.mean(onehot.sum(2), axis=(0, 1))  # [e]
+        prob_frac = jnp.mean(probs, axis=(0, 1))  # [e]
+        aux = e * jnp.sum(token_frac * prob_frac) / k
+        self.sow("losses", "moe_aux_loss", aux)
+
+        # Token movement: [b, s, d] → expert buffers [e, b, c, d].  With x on
+        # the data axes and the output constrained to P("ep", ...), XLA
+        # lowers this einsum to an all-to-all over the ep axis.
+        xin = jnp.einsum(
+            "bsec,bsd->ebcd", dispatch.astype(self.dtype), x.astype(self.dtype)
+        )
+        xin = _constrain(xin, P("ep", ("dp", "fsdp"), None, None))
+
+        w_gate = self.param(
+            "w_gate", nn.initializers.lecun_normal(), (e, d, f), jnp.float32
+        ).astype(self.dtype)
+        w_up = self.param(
+            "w_up", nn.initializers.lecun_normal(), (e, d, f), jnp.float32
+        ).astype(self.dtype)
+        w_down = self.param(
+            "w_down", nn.initializers.lecun_normal(), (e, f, d), jnp.float32
+        ).astype(self.dtype)
+
+        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", xin, w_gate)) * jnp.einsum(
+            "ebcd,edf->ebcf", xin, w_up
+        )
+        out = jnp.einsum("ebcf,efd->ebcd", h, w_down)
+        out = _constrain(out, P("ep", ("dp", "fsdp"), None, None))
+
+        # Return trip (second all-to-all) + weighted combine.
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(self.dtype), out)
+        return y.astype(x.dtype)

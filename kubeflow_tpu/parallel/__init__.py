@@ -4,7 +4,7 @@ The reference platform's "distributed backend" is nothing but pod scheduling
 (SURVEY.md §5.8): NCCL/MPI never appear; multi-device is the user's problem.
 In the TPU rebuild the compute-side story is explicit and first-class:
 
-* ``mesh``     — build ``jax.sharding.Mesh``es over (dp, fsdp, tp, sp) axes;
+* ``mesh``     — build ``jax.sharding.Mesh``es over (dp, fsdp, ep, tp, sp) axes;
   ICI-friendly axis ordering.
 * ``sharding`` — param-pytree partition rules (Megatron-style TP + FSDP) that
   keep models mesh-agnostic.

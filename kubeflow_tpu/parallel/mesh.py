@@ -5,12 +5,15 @@ Axis convention (outer → inner, matching ICI locality on TPU slices):
 * ``dp``   — pure data parallelism (gradients all-reduced)
 * ``fsdp`` — data parallelism with sharded params/optimizer (ZeRO-3 style;
   XLA turns the annotations into all-gather / reduce-scatter)
+* ``ep``   — expert parallelism (MoE experts sharded; token dispatch becomes
+  an XLA all-to-all).  Doubles as a data axis in non-MoE layers.
 * ``tp``   — tensor (Megatron) parallelism inside matmuls
 * ``sp``   — sequence/context parallelism (ring attention)
 
 Inner axes get the fastest ICI loops; ``tp`` and ``sp`` traffic is
-latency-sensitive per-layer, while ``dp``/``fsdp`` traffic amortizes per
-step, so the default order places tp/sp innermost.
+latency-sensitive per-layer, while ``dp``/``fsdp``/``ep`` traffic amortizes
+per step (grad sync, per-layer all-to-all), so the default order places
+tp/sp innermost.
 """
 from __future__ import annotations
 
@@ -22,22 +25,23 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_NAMES = ("dp", "fsdp", "tp", "sp")
+AXIS_NAMES = ("dp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.ep * self.tp * self.sp
 
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.tp, self.sp)
+        return (self.dp, self.fsdp, self.ep, self.tp, self.sp)
 
 
 def make_mesh(

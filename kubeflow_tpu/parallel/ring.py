@@ -130,7 +130,8 @@ def ring_attention(
     the other mesh axes.
     """
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    spec = P(("dp", "fsdp"), axis_name, None, None)
+    data_axes = ("dp", "fsdp", "ep") if "ep" in mesh.axis_names else ("dp", "fsdp")
+    spec = P(data_axes, axis_name, None, None)
     fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale

@@ -36,6 +36,11 @@ Rules = Sequence[Tuple[str, P]]
 def llama_rules() -> Rules:
     return (
         (r".*embed.*embedding$", P("tp", "fsdp")),
+        # MoE experts: batched (n_experts, ...) tensors sharded on ep; the
+        # in/out feature axes keep the Megatron column/row split on fsdp/tp.
+        (r".*(w_gate|w_up)$", P("ep", "fsdp", "tp")),
+        (r".*w_down$", P("ep", "tp", "fsdp")),
+        (r".*router.*kernel$", P("fsdp", None)),
         (r".*(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp", None)),
         (r".*o_proj.*kernel$", P("tp", None, "fsdp")),
         (r".*(gate_proj|up_proj).*kernel$", P("fsdp", "tp")),
@@ -114,10 +119,12 @@ def shard_params(params: Any, mesh: Mesh, rules: Rules) -> Any:
 
 
 def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
-    """Batch data over all data-parallel axes; optionally shard sequence on sp."""
+    """Batch data over all data-parallel axes (ep doubles as a data axis
+    outside MoE layers); optionally shard sequence on sp."""
+    data_axes = ("dp", "fsdp", "ep") if "ep" in mesh.axis_names else ("dp", "fsdp")
     if seq_axis:
-        return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
-    return NamedSharding(mesh, P(("dp", "fsdp")))
+        return NamedSharding(mesh, P(data_axes, "sp"))
+    return NamedSharding(mesh, P(data_axes))
 
 
 def infer_state_shardings(state: Any, mesh: Mesh, rules: Rules) -> Any:

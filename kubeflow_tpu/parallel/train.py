@@ -38,8 +38,8 @@ def make_sharded_train_step(
     """jit the step with explicit in/out shardings.
 
     ``state`` is only used for its pytree structure.  Batches are sharded
-    [batch → (dp, fsdp), seq → sp if shard_sequence].  XLA lowers the
-    annotations to psum/all-gather/reduce-scatter over ICI.
+    [batch → (dp, fsdp, ep), seq → sp if shard_sequence].  XLA lowers the
+    annotations to psum/all-gather/reduce-scatter/all-to-all over ICI.
     """
     state_sh = infer_state_shardings(state, mesh, rules)
     data_sh = batch_sharding(mesh, seq_axis=shard_sequence)

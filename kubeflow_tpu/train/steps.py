@@ -93,9 +93,13 @@ def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool =
     return step
 
 
-def make_lm_train_step():
+def make_lm_train_step(*, aux_loss_weight: float = 0.0):
     """Next-token-prediction step: batch = tokens[b,s] or (tokens, segment_ids)
-    for packed sequences (segment_ids are threaded into attention masking)."""
+    for packed sequences (segment_ids are threaded into attention masking).
+
+    ``aux_loss_weight`` > 0 collects the ``"losses"`` collection sowed by MoE
+    layers (``moe_aux_loss``) and adds the weighted sum to the objective.
+    """
 
     def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
         if isinstance(batch, (tuple, list)):
@@ -106,15 +110,28 @@ def make_lm_train_step():
 
         def loss_fn(params):
             kwargs = {} if segment_ids is None else {"segment_ids": segment_ids}
-            logits = state.apply_fn({"params": params}, tokens, **kwargs)
+            if aux_loss_weight:
+                logits, cols = state.apply_fn(
+                    {"params": params}, tokens, mutable=["losses"], **kwargs
+                )
+                sowed = jax.tree.leaves(cols.get("losses", {}))
+                aux = sum(sowed) / max(1, len(sowed)) if sowed else 0.0
+            else:
+                logits = state.apply_fn({"params": params}, tokens, **kwargs)
+                aux = 0.0
             # Shift: predict token t+1 from prefix..t.
             logits = logits[:, :-1]
             targets = tokens[:, 1:]
             loss = cross_entropy(logits, targets)
-            return loss
+            return loss + aux_loss_weight * aux, (loss, aux)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
         state = state.apply_gradients(grads)
-        return state, {"loss": loss}
+        metrics = {"loss": loss}
+        if aux_loss_weight:
+            metrics["moe_aux_loss"] = aux
+        return state, metrics
 
     return step
