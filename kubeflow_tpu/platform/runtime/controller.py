@@ -109,6 +109,20 @@ class _WorkQueue:
             self._cond.notify_all()
 
 
+def make_workqueue(*, base_delay: float = 0.05, max_delay: float = 30.0):
+    """Prefer the native C++ workqueue (libkfnative kfq_*); fall back to
+    the pure-Python _WorkQueue.  Interfaces are identical; parity is
+    enforced by tests/ctrlplane/test_native.py."""
+    from kubeflow_tpu.platform import native
+
+    if native.available():
+        try:
+            return native.NativeWorkQueue(base_delay=base_delay, max_delay=max_delay)
+        except Exception:
+            pass
+    return _WorkQueue(base_delay=base_delay, max_delay=max_delay)
+
+
 EventMapper = Callable[[Resource], List[Request]]
 
 
@@ -133,7 +147,7 @@ class Controller:
         self.namespace = namespace
         self.resync_period = resync_period
         self.workers = workers
-        self.queue = _WorkQueue()
+        self.queue = make_workqueue()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self.reconcile_count = 0
@@ -247,6 +261,11 @@ class Manager:
         self.client = client
         self.controllers: List[Controller] = []
         self._started = False
+        # Eagerly load/build libkfnative so the first watch event doesn't
+        # pay for it (see native.preload()).
+        from kubeflow_tpu.platform import native
+
+        native.preload()
 
     def add(self, controller: Controller) -> Controller:
         self.controllers.append(controller)

@@ -112,6 +112,24 @@ def apply_patch_inplace_add(doc: Any, path: str, value: Any) -> None:
         parent[last] = value
 
 
+def create_patch_fast(before: Any, after: Any) -> List[Dict[str, Any]]:
+    """Diff via the native C++ engine (libkfnative) when available.
+
+    The webhook response path runs this for every admitted pod; the native
+    engine avoids the recursive-Python cost on large pod specs.  Falls back
+    to the pure-Python ``create_patch`` (semantics are identical — parity is
+    enforced by tests/ctrlplane/test_native.py).
+    """
+    from kubeflow_tpu.platform import native
+
+    if native.available():
+        try:
+            return native.create_patch(before, after)
+        except Exception:
+            pass
+    return create_patch(before, after)
+
+
 def create_patch(before: Any, after: Any, path: str = "") -> List[Dict[str, Any]]:
     """Minimal-ish diff: recurse into dicts, replace scalars/arrays."""
     if type(before) is not type(after):

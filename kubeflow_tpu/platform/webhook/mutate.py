@@ -199,7 +199,7 @@ def mutate_admission_review(review: Resource, pod_defaults: List[Resource]) -> R
     import base64
     import json
 
-    from kubeflow_tpu.platform.webhook.jsonpatch import create_patch
+    from kubeflow_tpu.platform.webhook.jsonpatch import create_patch_fast as create_patch
 
     request = review.get("request", {}) or {}
     uid = request.get("uid", "")

@@ -23,6 +23,12 @@ from kubeflow_tpu.platform.webhook.mutate import mutate_admission_review
 class WebhookApp:
     def __init__(self, client):
         self.client = client
+        # Load/build libkfnative now: the admission request path must never
+        # absorb the one-time native build (API-server webhook timeout is
+        # 10-30 s).
+        from kubeflow_tpu.platform import native
+
+        native.preload()
 
     def __call__(self, environ, start_response):
         request = WsgiRequest(environ)

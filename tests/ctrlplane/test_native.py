@@ -1,0 +1,214 @@
+"""Parity tests: native C++ engine (libkfnative) vs pure-Python implementations.
+
+The native library mirrors two hot paths:
+  * kfp_* JSON patch engine  vs  platform/webhook/jsonpatch.py
+  * kfq_* workqueue          vs  platform/runtime/controller.py::_WorkQueue
+
+Every case runs against BOTH backends and asserts identical behavior, so the
+implementations cannot drift.  If g++/make is unavailable the native half
+skips (the platform then runs pure-Python everywhere).
+"""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubeflow_tpu.platform import native
+from kubeflow_tpu.platform.runtime.controller import Request, _WorkQueue, make_workqueue
+from kubeflow_tpu.platform.webhook import jsonpatch
+
+NATIVE = native.available()
+needs_native = pytest.mark.skipif(not NATIVE, reason="libkfnative not built")
+
+# Representative documents: pod-spec-shaped, escapes, unicode, nesting,
+# arrays, numbers, null/bool transitions.
+DIFF_CASES = [
+    ({}, {}),
+    ({"a": 1}, {"a": 1}),
+    ({"a": 1}, {"a": 2}),
+    ({"a": 1}, {}),
+    ({}, {"a": [1, 2, {"b": None}]}),
+    ({"a": {"b": {"c": 1}}}, {"a": {"b": {"c": 2, "d": "x"}}}),
+    ({"arr": [1, 2, 3]}, {"arr": [1, 2]}),
+    ({"s": "héllo\n\"quoted\""}, {"s": "wörld/~tilde"}),
+    ({"a/b": 1, "m~n": 2}, {"a/b": 3, "m~n": 2}),
+    ({"x": True}, {"x": False}),
+    ({"x": None}, {"x": 0}),
+    ({"x": True}, {"x": 1}),  # Python ==: no patch op
+    ({"x": 1}, {"x": 1.0}),  # Python ==: no patch op
+    ({"x": 1}, {"x": True}),
+    ({"big": 2**63}, {"big": 2**63 + 1}),  # beyond int64: must still diff
+    ({"big": 2**63 + 1}, {"big": 2**63 + 1}),
+    ({"big": -(2**70)}, {"big": 2**70}),
+    (
+        {
+            "metadata": {"name": "nb", "labels": {"app": "notebook"}},
+            "spec": {
+                "containers": [
+                    {"name": "main", "image": "jax:tpu", "env": [{"name": "A", "value": "1"}]}
+                ]
+            },
+        },
+        {
+            "metadata": {
+                "name": "nb",
+                "labels": {"app": "notebook", "tpu": "v5e"},
+                "annotations": {"poddefault.kubeflow.org/tpu-env": "42"},
+            },
+            "spec": {
+                "containers": [
+                    {
+                        "name": "main",
+                        "image": "jax:tpu",
+                        "env": [{"name": "A", "value": "1"}, {"name": "TPU_TOPOLOGY", "value": "2x4"}],
+                        "resources": {"limits": {"google.com/tpu": 8}},
+                    }
+                ],
+                "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x4"},
+                "tolerations": [{"key": "google.com/tpu", "operator": "Exists"}],
+            },
+        },
+    ),
+]
+
+
+@needs_native
+@pytest.mark.parametrize("before,after", DIFF_CASES)
+def test_create_patch_parity(before, after):
+    py = jsonpatch.create_patch(before, after)
+    nat = native.create_patch(before, after)
+    assert nat == py
+
+
+@needs_native
+@pytest.mark.parametrize("before,after", DIFF_CASES)
+def test_patch_round_trip_both_backends(before, after):
+    ops = jsonpatch.create_patch(before, after)
+    assert jsonpatch.apply_patch(before, ops) == after
+    assert native.apply_patch(before, ops) == after
+    ops_nat = native.create_patch(before, after)
+    assert jsonpatch.apply_patch(before, ops_nat) == after
+    assert native.apply_patch(before, ops_nat) == after
+
+
+@needs_native
+def test_apply_patch_ops_parity():
+    doc = {"a": {"b": [1, 2, 3]}, "keep": True}
+    ops = [
+        {"op": "add", "path": "/a/b/1", "value": 99},
+        {"op": "add", "path": "/a/b/-", "value": 4},
+        {"op": "replace", "path": "/keep", "value": False},
+        {"op": "add", "path": "/new/nested", "value": "x"},
+        {"op": "test", "path": "/a/b/0", "value": 1},
+        {"op": "copy", "from": "/a/b/0", "path": "/copied"},
+        {"op": "move", "from": "/a/b/2", "path": "/moved"},
+        {"op": "remove", "path": "/a/b/0"},
+    ]
+    assert native.apply_patch(doc, ops) == jsonpatch.apply_patch(doc, ops)
+
+
+@needs_native
+def test_apply_patch_errors_parity():
+    bad = [
+        [{"op": "remove", "path": "/missing"}],
+        [{"op": "replace", "path": "/missing", "value": 1}],
+        [{"op": "test", "path": "/a", "value": 2}],
+        [{"op": "bogus", "path": "/a"}],
+    ]
+    for ops in bad:
+        with pytest.raises(jsonpatch.PatchError):
+            jsonpatch.apply_patch({"a": 1}, ops)
+        with pytest.raises(native.NativeError):
+            native.apply_patch({"a": 1}, ops)
+
+
+@needs_native
+def test_fast_path_used_in_webhook():
+    before = {"spec": {"containers": [{"name": "m"}]}}
+    after = {"spec": {"containers": [{"name": "m"}], "nodeSelector": {"t": "v5e"}}}
+    assert jsonpatch.create_patch_fast(before, after) == jsonpatch.create_patch(before, after)
+
+
+# -- workqueue parity ---------------------------------------------------------
+
+
+def _queues():
+    qs = [_WorkQueue(base_delay=0.01, max_delay=0.1)]
+    if NATIVE:
+        qs.append(native.NativeWorkQueue(base_delay=0.01, max_delay=0.1))
+    return qs
+
+
+@pytest.mark.parametrize("q", _queues(), ids=lambda q: type(q).__name__)
+def test_queue_dedup_and_order(q):
+    r1, r2 = Request("ns", "a"), Request("ns", "b")
+    q.add(r1)
+    q.add(r1)  # dedup
+    q.add(r2, delay=0.05)
+    assert q.get(0.5) == r1
+    assert q.get(1.0) == r2  # delivered after its delay, exactly once
+    assert q.get(0.02) is None
+    q.shut_down()
+
+
+@pytest.mark.parametrize("q", _queues(), ids=lambda q: type(q).__name__)
+def test_queue_immediate_add_preempts_delayed(q):
+    r = Request("ns", "x")
+    q.add(r, delay=5.0)
+    q.add(r)  # immediate entry supersedes the delayed one
+    t0 = time.monotonic()
+    assert q.get(1.0) == r
+    assert time.monotonic() - t0 < 0.5
+    assert q.get(0.02) is None  # no duplicate delivery from stale entry
+    q.shut_down()
+
+
+@pytest.mark.parametrize("q", _queues(), ids=lambda q: type(q).__name__)
+def test_queue_rate_limit_backoff_and_forget(q):
+    r = Request("ns", "err")
+    q.add_rate_limited(r)  # 0.01
+    assert q.get(1.0) == r
+    q.add_rate_limited(r)  # 0.02
+    t0 = time.monotonic()
+    assert q.get(1.0) == r
+    assert time.monotonic() - t0 >= 0.01
+    q.forget(r)
+    q.add_rate_limited(r)  # back to base delay
+    assert q.get(1.0) == r
+    q.shut_down()
+
+
+@pytest.mark.parametrize("q", _queues(), ids=lambda q: type(q).__name__)
+def test_queue_shutdown_unblocks(q):
+    import threading
+
+    results = []
+    t = threading.Thread(target=lambda: results.append(q.get(5.0)))
+    t.start()
+    time.sleep(0.05)
+    q.shut_down()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+    assert results == [None]
+
+
+@needs_native
+def test_native_queue_id_maps_stay_bounded():
+    q = native.NativeWorkQueue(base_delay=0.01, max_delay=0.1)
+    for i in range(200):
+        r = Request("ns", f"nb-{i}")
+        q.add(r)
+        assert q.get(1.0) == r
+        q.forget(r)
+    assert len(q._to_id) == 0  # pruned at pop (no pending, no failures)
+    q.shut_down()
+
+
+def test_make_workqueue_returns_native_when_available():
+    q = make_workqueue()
+    if NATIVE:
+        assert isinstance(q, native.NativeWorkQueue)
+    else:
+        assert isinstance(q, _WorkQueue)
+    q.shut_down()
