@@ -1,0 +1,17 @@
+"""Host-side data pipeline: per-host loading → global sharded arrays.
+
+The reference platform has no data loading (SURVEY.md §2.13 — data is the
+user's notebook's problem).  Here the multi-host story is first-class: each
+host produces only its local shard of the global batch and
+``jax.make_array_from_process_local_data`` assembles the global array with
+the training sharding — no host ever materializes the full batch, and no
+device-device traffic is spent re-sharding input.
+"""
+
+from kubeflow_tpu.data.loader import (
+    ShardedLoader,
+    synthetic_image_batches,
+    synthetic_lm_batches,
+)
+
+__all__ = ["ShardedLoader", "synthetic_lm_batches", "synthetic_image_batches"]

@@ -78,26 +78,36 @@ def dot_product_attention(
 ) -> jax.Array:
     """Scaled dot-product attention, BSHD layout.
 
-    impl: "auto" | "pallas" | "xla" | "ring".  "auto" prefers the Pallas
-    flash kernel on TPU for bias-free shapes it supports, else falls back to
-    XLA.  "ring" runs sequence-parallel ring attention over the active
-    mesh's ``sp`` axis (kubeflow_tpu.parallel.ring).
+    impl: "auto" | "pallas" | "xla" | "ring" | "ulysses".  "auto" prefers
+    the Pallas flash kernel on TPU for bias-free shapes it supports, else
+    falls back to XLA.  "ring" runs sequence-parallel ring attention over
+    the active mesh's ``sp`` axis (kubeflow_tpu.parallel.ring); "ulysses"
+    re-shards head↔sequence with all-to-alls instead
+    (kubeflow_tpu.parallel.ulysses) — better when heads divide the axis and
+    per-device sequence fits HBM.
     """
-    if impl not in ("auto", "pallas", "xla", "ring"):
+    if impl not in ("auto", "pallas", "xla", "ring", "ulysses"):
         raise ValueError(f"unknown impl {impl!r}")
-    if impl == "ring":
+    if impl in ("ring", "ulysses"):
         from kubeflow_tpu.parallel.context import get_global_mesh
-        from kubeflow_tpu.parallel.ring import ring_attention
 
         mesh = get_global_mesh()
         if mesh is None:
             raise RuntimeError(
-                "impl='ring' needs an active mesh; wrap the call in "
+                f"impl={impl!r} needs an active mesh; wrap the call in "
                 "kubeflow_tpu.parallel.context.global_mesh(mesh)"
             )
         if bias is not None or segment_ids is not None:
-            raise NotImplementedError("ring attention: bias/segment_ids TODO")
-        return ring_attention(
+            raise NotImplementedError(f"{impl} attention: bias/segment_ids TODO")
+        if impl == "ring":
+            from kubeflow_tpu.parallel.ring import ring_attention
+
+            return ring_attention(
+                q, k, v, mesh=mesh, causal=causal, softmax_scale=softmax_scale
+            )
+        from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(
             q, k, v, mesh=mesh, causal=causal, softmax_scale=softmax_scale
         )
 

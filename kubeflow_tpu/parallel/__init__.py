@@ -32,4 +32,23 @@ __all__ = [
     "llama_rules",
     "shard_params",
     "make_sharded_train_step",
+    "ring_attention",
+    "ulysses_attention",
+    "pipeline_apply",
 ]
+
+
+def __getattr__(name):  # lazy: ring/ulysses/pipeline pull in shard_map deps
+    if name == "ring_attention":
+        from kubeflow_tpu.parallel.ring import ring_attention
+
+        return ring_attention
+    if name == "ulysses_attention":
+        from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+        return ulysses_attention
+    if name == "pipeline_apply":
+        from kubeflow_tpu.parallel.pipeline import pipeline_apply
+
+        return pipeline_apply
+    raise AttributeError(name)

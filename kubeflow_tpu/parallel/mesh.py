@@ -2,6 +2,9 @@
 
 Axis convention (outer → inner, matching ICI locality on TPU slices):
 
+* ``pp``   — pipeline parallelism (outermost: stage hand-offs are
+  neighbor-to-neighbor once per microbatch, the most DCN-tolerant traffic,
+  so this axis spans slice boundaries first)
 * ``dp``   — pure data parallelism (gradients all-reduced)
 * ``fsdp`` — data parallelism with sharded params/optimizer (ZeRO-3 style;
   XLA turns the annotations into all-gather / reduce-scatter)
@@ -25,11 +28,16 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_NAMES = ("dp", "fsdp", "ep", "tp", "sp")
+AXIS_NAMES = ("pp", "dp", "fsdp", "ep", "tp", "sp")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
+    """``pp`` is outermost: pipeline traffic is neighbor-to-neighbor once per
+    microbatch, the most DCN-tolerant axis, so it spans slice boundaries
+    first (scaling-book recipe: pipeline across, shard within)."""
+
+    pp: int = 1
     dp: int = 1
     fsdp: int = 1
     ep: int = 1
@@ -38,10 +46,10 @@ class MeshConfig:
 
     @property
     def size(self) -> int:
-        return self.dp * self.fsdp * self.ep * self.tp * self.sp
+        return self.pp * self.dp * self.fsdp * self.ep * self.tp * self.sp
 
     def axis_sizes(self) -> tuple[int, ...]:
-        return (self.dp, self.fsdp, self.ep, self.tp, self.sp)
+        return (self.pp, self.dp, self.fsdp, self.ep, self.tp, self.sp)
 
 
 def make_mesh(

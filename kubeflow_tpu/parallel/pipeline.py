@@ -1,0 +1,146 @@
+"""Pipeline parallelism: GPipe-style microbatching over the ``pp`` mesh axis.
+
+The reference platform has no parallelism code at all (SURVEY.md §2.13); this
+is part of the first-class distributed story of the TPU rebuild.  Design is
+the TPU-idiomatic one (scaling-book "pipelining" chapter), not a scheduler
+translation: every stage runs the *same* jitted program under ``shard_map``;
+activations hop to the next stage with ``lax.ppermute``; the schedule is a
+``lax.scan`` over ``n_micro + n_stages - 1`` ticks, so the whole pipeline is
+one XLA computation with static shapes — no host round-trips between ticks.
+
+Semantics: ``pipeline_apply(fn, stage_params, x)`` ≡ feeding ``x`` through
+``fn(params_0) ∘ fn(params_1) … ∘ fn(params_{P-1})`` applied stage 0 → P-1,
+microbatched along the leading axis.  Stage parameters live sharded on
+``pp`` (each device holds only its stage's slice — pipeline parallelism *is*
+that placement); inputs/outputs are replicated across ``pp`` and may be
+sharded on the other axes as usual.
+
+The bubble is the standard GPipe one: P-1 idle ticks out of M + P - 1, so
+choose n_micro ≫ n_stages.  Backward runs by differentiating through the
+scan — XLA re-plays the schedule in reverse, which is exactly the GPipe
+backward (activations rematerialized per ``jax.checkpoint`` policy if the
+caller wraps ``fn``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(params, x, *, fn, axis_name, n_micro):
+    """Per-device body under shard_map.
+
+    params: this stage's param pytree (leading ``pp`` axis already split
+    away by shard_map, leaving one stage's params).
+    x: full input batch [B, ...] (replicated over pp), microbatched here.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
+    micro = batch // n_micro
+    # [M, micro, ...]
+    xs = x.reshape((n_micro, micro) + x.shape[1:])
+
+    state = jnp.zeros_like(xs[0])  # activation currently held by this stage
+    outputs = jnp.zeros_like(xs)
+
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t (zeros once the stream is drained —
+        # those results are never read back).
+        inject = jax.lax.dynamic_index_in_dim(
+            xs, jnp.minimum(t, n_micro - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where(stage == 0, inject, state)
+        y = fn(params, x_in)
+        # Last stage records its result for microbatch t - (P-1); every
+        # other (stage, tick) combination writes the previous value back
+        # (a no-op), keeping the scan branch-free.
+        out_idx = t - (n_stages - 1)
+        idx = jnp.maximum(out_idx, 0)
+        prev = jax.lax.dynamic_index_in_dim(outputs, idx, axis=0, keepdims=False)
+        val = jnp.where((stage == n_stages - 1) & (out_idx >= 0), y, prev)
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, idx, 0)
+        # Hand the activation to the next stage.
+        state = jax.lax.ppermute(y, axis_name, fwd_perm)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(
+        tick, (state, outputs), jnp.arange(n_micro + n_stages - 1)
+    )
+    # Results live on the last stage; broadcast them to every stage so the
+    # output is replicated over pp (psum of one-hot contribution).
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    outputs = jax.lax.psum(outputs, axis_name)
+    return outputs.reshape((batch,) + outputs.shape[2:])
+
+
+def pipeline_apply(
+    fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_micro: int,
+    axis_name: str = "pp",
+    param_specs: Any = None,
+    x_spec: P = None,
+):
+    """Run ``x`` through P pipeline stages of ``fn`` (one per ``pp`` device).
+
+    ``stage_params``: pytree whose leaves have a leading stage axis of size
+    P — leaf shape [P, ...]; each device receives its own [...] slice.
+    ``n_micro`` divides the *per-device* batch (the global batch divided by
+    the data-axis extent), since microbatching happens after the data split.
+    ``param_specs``: optional PartitionSpec pytree for the *per-stage* param
+    leaves (the ``pp`` leading axis is prepended here); defaults to stage
+    sharding only.  ``x_spec``: spec for inputs/outputs (no ``pp`` entry —
+    they are replicated over pp); defaults to batch over (dp, fsdp).
+    """
+    n_stages = mesh.shape[axis_name]
+    leaves = jax.tree.leaves(stage_params)
+    for leaf in leaves:
+        if leaf.shape[0] != n_stages:
+            raise ValueError(
+                f"stage_params leaves need leading axis {n_stages}, got {leaf.shape}"
+            )
+    if x_spec is None:
+        from kubeflow_tpu.parallel.sharding import data_axes
+
+        x_spec = P(data_axes(mesh))
+    if param_specs is None:
+        in_param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    else:
+        in_param_specs = jax.tree.map(
+            lambda s: P(axis_name, *s), param_specs, is_leaf=lambda s: isinstance(s, P)
+        )
+
+    def body(params, x):
+        # shard_map leaves the leading pp axis of size 1 on each device's
+        # param block; strip it so fn sees one stage's params.
+        params = jax.tree.map(lambda p: p[0], params)
+        return _pipeline_local(
+            params, x, fn=fn, axis_name=axis_name, n_micro=n_micro
+        )
+
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(in_param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)
+
+
+def stack_stage_params(per_stage: list) -> Any:
+    """Stack a list of per-stage param pytrees into the [P, ...] layout."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage)

@@ -129,9 +129,10 @@ def ring_attention(
     has the same sharding.  Works under jit and composes with dp/fsdp/tp on
     the other mesh axes.
     """
+    from kubeflow_tpu.parallel.sharding import data_axes
+
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
-    data_axes = ("dp", "fsdp", "ep") if "ep" in mesh.axis_names else ("dp", "fsdp")
-    spec = P(data_axes, axis_name, None, None)
+    spec = P(data_axes(mesh), axis_name, None, None)
     fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale

@@ -118,13 +118,18 @@ def shard_params(params: Any, mesh: Mesh, rules: Rules) -> Any:
     return jax.device_put(params, tree_shardings(params, mesh, rules))
 
 
+def data_axes(mesh: Mesh) -> tuple:
+    """The mesh axes the batch dimension shards over: dp, fsdp, and ep (ep
+    doubles as a data axis outside MoE layers).  Single source of truth —
+    ring/ulysses/pipeline and batch_sharding all consult this."""
+    return tuple(a for a in ("dp", "fsdp", "ep") if a in mesh.axis_names)
+
+
 def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
-    """Batch data over all data-parallel axes (ep doubles as a data axis
-    outside MoE layers); optionally shard sequence on sp."""
-    data_axes = ("dp", "fsdp", "ep") if "ep" in mesh.axis_names else ("dp", "fsdp")
+    """Batch data over all data-parallel axes; optionally shard seq on sp."""
     if seq_axis:
-        return NamedSharding(mesh, P(data_axes, "sp"))
-    return NamedSharding(mesh, P(data_axes))
+        return NamedSharding(mesh, P(data_axes(mesh), "sp"))
+    return NamedSharding(mesh, P(data_axes(mesh)))
 
 
 def infer_state_shardings(state: Any, mesh: Mesh, rules: Rules) -> Any:

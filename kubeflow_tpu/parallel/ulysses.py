@@ -1,0 +1,103 @@
+"""Ulysses sequence parallelism: all-to-all head↔sequence re-sharding.
+
+The second long-context strategy next to ``ring`` (SURVEY.md §5.7 — the
+reference platform has no analogue; PAPERS.md: DeepSpeed-Ulysses).  Where
+ring attention rotates K/V blocks around the ``sp`` ring (good when sequence
+≫ heads), Ulysses re-shards with two all-to-alls: each device starts with a
+sequence chunk of all heads, trades it for the *full* sequence of ``h/N``
+heads, runs ordinary (flash) attention locally, and trades back.  On TPU the
+all-to-all rides ICI and costs O(bytes/N) per device — cheaper than the ring
+when heads divide evenly and the per-device sequence fits HBM.
+
+    with mesh:
+        out = ulysses_attention(q, k, v, mesh=mesh, causal=True)
+
+Constraints: n_heads % sp == 0; n_kv_heads are repeated up to n_heads first
+when they don't divide the axis (GQA).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _local_ulysses(q, k, v, *, axis_name, causal, scale, attn_fn):
+    """Per-device body. q/k/v: [b, s_local, h, d] (full heads, seq chunk)."""
+    from kubeflow_tpu.ops.attention import _repeat_kv
+
+    axis_size = jax.lax.psum(1, axis_name)
+    n_heads = q.shape[2]
+    if k.shape[2] != n_heads and k.shape[2] % axis_size:
+        # GQA with kv-head count not divisible by the axis: repeat to full.
+        k = _repeat_kv(k, n_heads // k.shape[2])
+        v = _repeat_kv(v, n_heads // v.shape[2])
+
+    # seq-sharded/all-heads -> head-sharded/all-seq: split heads (axis 2)
+    # across devices, concatenate sequence chunks (axis 1).
+    def scatter_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    q_g, k_g, v_g = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    out = attn_fn(q_g, k_g, v_g, causal=causal, scale=scale)
+    return gather_heads(out.astype(q.dtype))
+
+
+def _default_attn(q, k, v, *, causal, scale):
+    """Plain XLA attention on the local head group (full sequence)."""
+    from kubeflow_tpu.ops.attention import xla_attention
+
+    return xla_attention(q, k, v, causal=causal, softmax_scale=scale)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sp",
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    attn_fn=None,
+):
+    """Exact attention with sequence sharded on ``axis_name`` via all-to-all.
+
+    Same contract as ``ring_attention``: global-view BSHD in, same sharding
+    out; composes with dp/fsdp/tp on the other mesh axes.  ``attn_fn`` lets
+    callers swap the local kernel (e.g. the Pallas flash attention).
+    """
+    sp = mesh.shape[axis_name]
+    if q.shape[2] % sp:
+        raise ValueError(
+            f"n_heads={q.shape[2]} must divide the {axis_name!r} axis ({sp})"
+        )
+    from kubeflow_tpu.parallel.sharding import data_axes
+
+    scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    spec = P(data_axes(mesh), axis_name, None, None)
+    fn = shard_map(
+        functools.partial(
+            _local_ulysses,
+            axis_name=axis_name,
+            causal=causal,
+            scale=scale,
+            attn_fn=attn_fn or _default_attn,
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

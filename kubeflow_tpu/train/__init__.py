@@ -12,4 +12,13 @@ __all__ = [
     "create_train_state",
     "make_classification_train_step",
     "make_lm_train_step",
+    "CheckpointManager",
 ]
+
+
+def __getattr__(name):  # lazy: orbax import is heavy
+    if name == "CheckpointManager":
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        return CheckpointManager
+    raise AttributeError(name)

@@ -1,0 +1,112 @@
+"""Checkpoint/resume for sharded train states (Orbax-backed).
+
+The reference platform's checkpoint story is PVC persistence + stop/start
+annotations (SURVEY.md §5 "checkpoint/resume" — no model checkpointing, it
+has no models).  The TPU framework adds the model half: async Orbax
+checkpoints of the full TrainState, restored *directly into the mesh
+sharding* (each host reads only its shard — no host-RAM blowup on multi-host
+slices), with best-k retention and resume-from-latest.
+
+    mgr = CheckpointManager(dir, max_to_keep=3)
+    mgr.save(step, state)                   # async, non-blocking
+    state = mgr.restore(state_template)     # template carries shardings
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from kubeflow_tpu.train.steps import TrainState
+
+
+def _as_pytree(state: TrainState) -> dict:
+    """The savable part of a TrainState (tx/apply_fn are code, not data)."""
+    tree = {
+        "step": state.step,
+        "params": state.params,
+        "opt_state": state.opt_state,
+    }
+    if state.batch_stats is not None:
+        tree["batch_stats"] = state.batch_stats
+    return tree
+
+
+class CheckpointManager:
+    """Thin wrapper over orbax.checkpoint.CheckpointManager for TrainStates."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+        async_save: bool = True,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+
+    def save(self, step: int, state: TrainState, *, force: bool = False) -> bool:
+        """Queue an async save; returns False if skipped by save_interval."""
+        return self._mgr.save(
+            int(step),
+            args=self._ocp.args.StandardSave(_as_pytree(state)),
+            force=force,
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def restore(
+        self, template: TrainState, *, step: Optional[int] = None
+    ) -> Optional[TrainState]:
+        """Restore into the shardings/dtypes of ``template``.
+
+        ``template`` is a fully-built (possibly freshly-initialized and
+        mesh-sharded) TrainState; restored arrays land with the template
+        leaves' shardings.  Returns None when no checkpoint exists —
+        callers start from scratch (the resume-or-init idiom).
+        """
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array)
+            else x,
+            _as_pytree(template),
+        )
+        restored = self._mgr.restore(
+            int(step), args=self._ocp.args.StandardRestore(abstract)
+        )
+        return template.replace(
+            step=restored["step"],
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            batch_stats=restored.get("batch_stats", template.batch_stats),
+        )
+
+    def wait(self) -> None:
+        """Block until queued async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.wait()
+        self.close()

@@ -22,7 +22,7 @@ from kubeflow_tpu.train import create_train_state, make_lm_train_step
 
 def test_make_mesh_shapes(devices8):
     mesh = make_mesh(dp=2, fsdp=2, tp=2, devices=devices8)
-    assert mesh.devices.shape == (2, 2, 1, 2, 1)
+    assert mesh.devices.shape == (1, 2, 2, 1, 2, 1)
     mesh = make_mesh(fsdp=-1, tp=2, devices=devices8)
     assert mesh.shape["fsdp"] == 4
 

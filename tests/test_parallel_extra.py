@@ -1,0 +1,251 @@
+"""Tests: Ulysses SP, pipeline parallelism, checkpoint/resume, data loader.
+
+All on the 8-virtual-CPU-device mesh from conftest — the same surface the
+driver's dryrun_multichip uses.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.data import ShardedLoader, synthetic_image_batches, synthetic_lm_batches
+from kubeflow_tpu.ops.attention import xla_attention
+from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
+from kubeflow_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(devices8, causal):
+    mesh = make_mesh(dp=2, sp=4, devices=devices8)
+    k0 = jax.random.key(0)
+    q = jax.random.normal(jax.random.fold_in(k0, 1), (4, 64, 8, 16))
+    k = jax.random.normal(jax.random.fold_in(k0, 2), (4, 64, 4, 16))
+    v = jax.random.normal(jax.random.fold_in(k0, 3), (4, 64, 4, 16))
+    out = jax.jit(
+        lambda q, k, v: ulysses_attention(q, k, v, mesh=mesh, causal=causal)
+    )(q, k, v)
+    ref = xla_attention(q, k, v, causal=causal)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+
+def test_ulysses_rejects_indivisible_heads(devices8):
+    mesh = make_mesh(sp=8, devices=devices8)
+    q = jnp.zeros((2, 16, 6, 8))
+    with pytest.raises(ValueError, match="must divide"):
+        ulysses_attention(q, q, q, mesh=mesh)
+
+
+def test_ulysses_composes_with_tp_axis(devices8):
+    # sp=2, tp=2, dp=2: attention must ignore the other axes correctly.
+    mesh = make_mesh(dp=2, tp=2, sp=2, devices=devices8)
+    q = jax.random.normal(jax.random.key(1), (4, 32, 4, 8))
+    out = jax.jit(lambda q: ulysses_attention(q, q, q, mesh=mesh, causal=True))(q)
+    ref = xla_attention(q, q, q, causal=True)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-5
+
+
+def test_llama_ulysses_impl_matches_xla(devices8):
+    import dataclasses
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.parallel.context import global_mesh
+
+    mesh = make_mesh(dp=2, sp=4, devices=devices8)
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], attn_impl="xla")
+    tokens = jax.random.randint(jax.random.key(0), (2, 64), 0, 256)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(1), tokens)["params"]
+    ref = model.apply({"params": params}, tokens)
+
+    cfg_u = dataclasses.replace(cfg, attn_impl="ulysses")
+    with global_mesh(mesh):
+        out = jax.jit(
+            lambda p, t: Llama(cfg_u).apply({"params": p}, t)
+        )(params, tokens)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-4
+
+
+# -- pipeline -----------------------------------------------------------------
+
+
+def _mlp_stage(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _stage_params(rng, n_stages, d, hidden):
+    per_stage = []
+    for i in range(n_stages):
+        k1, k2, rng = jax.random.split(rng, 3)
+        per_stage.append(
+            {
+                "w1": jax.random.normal(k1, (d, hidden)) / np.sqrt(d),
+                "b1": jnp.zeros((hidden,)),
+                "w2": jax.random.normal(k2, (hidden, d)) / np.sqrt(hidden),
+                "b2": jnp.zeros((d,)),
+            }
+        )
+    return per_stage
+
+
+@pytest.mark.parametrize("pp,n_micro", [(2, 4), (4, 8)])
+def test_pipeline_matches_sequential(devices8, pp, n_micro):
+    mesh = make_mesh(pp=pp, dp=8 // pp, devices=devices8)
+    d, hidden, batch = 8, 16, 16
+    per_stage = _stage_params(jax.random.key(0), pp, d, hidden)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.key(1), (batch, d))
+
+    out = jax.jit(
+        lambda p, x: pipeline_apply(_mlp_stage, p, x, mesh=mesh, n_micro=n_micro)
+    )(stacked, x)
+
+    ref = x
+    for p in per_stage:
+        ref = _mlp_stage(p, ref)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+def test_pipeline_differentiable(devices8):
+    pp, d, hidden, batch = 2, 4, 8, 8
+    mesh = make_mesh(pp=pp, dp=4, devices=devices8)
+    stacked = stack_stage_params(_stage_params(jax.random.key(0), pp, d, hidden))
+    x = jax.random.normal(jax.random.key(1), (batch, d))
+
+    def loss(p, x):
+        y = pipeline_apply(_mlp_stage, p, x, mesh=mesh, n_micro=2)
+        return jnp.mean(y**2)
+
+    g = jax.jit(jax.grad(loss))(stacked, x)
+
+    def loss_ref(p_list, x):
+        for p in p_list:
+            x = _mlp_stage(p, x)
+        return jnp.mean(x**2)
+
+    per_stage = [jax.tree.map(lambda l: l[i], stacked) for i in range(pp)]
+    g_ref_list = jax.grad(loss_ref)(per_stage, x)
+    g_ref = jax.tree.map(lambda *xs: jnp.stack(xs), *g_ref_list)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref)):
+        assert jnp.max(jnp.abs(a - b)) < 1e-4
+
+
+def test_pipeline_rejects_bad_stage_axis(devices8):
+    mesh = make_mesh(pp=2, dp=4, devices=devices8)
+    bad = {"w": jnp.zeros((3, 4, 4))}  # leading axis 3 != pp 2
+    with pytest.raises(ValueError, match="leading axis"):
+        pipeline_apply(lambda p, x: x, bad, jnp.zeros((8, 4)), mesh=mesh, n_micro=2)
+
+
+# -- checkpoint/resume --------------------------------------------------------
+
+
+def test_checkpoint_save_restore_roundtrip(tmp_path, devices8):
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.parallel.sharding import llama_rules
+    from kubeflow_tpu.parallel.train import shard_train_state
+    from kubeflow_tpu.train import CheckpointManager, create_train_state
+
+    mesh = make_mesh(fsdp=4, tp=2, devices=devices8)
+    model = create_model("llama_debug")
+    tokens = jnp.ones((2, 16), jnp.int32)
+    state = create_train_state(jax.random.key(0), model, tokens, optax.adamw(1e-3))
+    state = shard_train_state(state, mesh, llama_rules())
+
+    with CheckpointManager(str(tmp_path / "ckpt"), async_save=False) as mgr:
+        assert mgr.restore(state) is None  # fresh dir → start from scratch
+        mgr.save(0, state)
+        bumped = state.replace(
+            step=state.step + 5,
+            params=jax.tree.map(lambda x: x + 1.0, state.params),
+        )
+        mgr.save(5, bumped)
+        mgr.wait()
+        assert mgr.latest_step() == 5
+
+        restored = mgr.restore(state)
+        assert int(restored.step) == int(bumped.step)
+        for a, b in zip(jax.tree.leaves(restored.params), jax.tree.leaves(bumped.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # Restored arrays carry the template's mesh sharding.
+        leaf = jax.tree.leaves(restored.params)[0]
+        assert leaf.sharding.mesh.shape == mesh.shape
+
+
+def test_checkpoint_retention(tmp_path, devices8):
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.train import CheckpointManager, create_train_state
+
+    model = create_model("llama_debug")
+    tokens = jnp.ones((1, 8), jnp.int32)
+    state = create_train_state(jax.random.key(0), model, tokens, optax.sgd(0.1))
+    with CheckpointManager(str(tmp_path / "c"), max_to_keep=2, async_save=False) as mgr:
+        for s in (1, 2, 3):
+            mgr.save(s, state.replace(step=jnp.asarray(s)))
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        assert len(mgr.all_steps()) <= 2
+
+
+# -- data loader --------------------------------------------------------------
+
+
+def test_sharded_loader_lm(devices8):
+    mesh = make_mesh(dp=4, sp=2, devices=devices8)
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    it = synthetic_lm_batches(global_batch=8, seq_len=16, vocab_size=100, steps=3)
+    batches = list(ShardedLoader(it, sharding, prefetch=2))
+    assert len(batches) == 3
+    for b in batches:
+        assert b.shape == (8, 16)
+        assert b.sharding.spec == P(("dp", "fsdp"))
+
+
+def test_sharded_loader_images_tuple(devices8):
+    mesh = make_mesh(dp=8, devices=devices8)
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    it = synthetic_image_batches(global_batch=8, image_size=8, num_classes=10, steps=2)
+    batches = list(ShardedLoader(it, sharding, prefetch=0))
+    assert len(batches) == 2
+    images, labels = batches[0]
+    assert images.shape == (8, 8, 8, 3) and labels.shape == (8,)
+
+
+def test_sharded_loader_propagates_iterator_errors(devices8):
+    mesh = make_mesh(dp=8, devices=devices8)
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    def bad_iter():
+        yield np.zeros((8, 4), np.int32)
+        raise RuntimeError("corrupt shard")
+
+    loader = ShardedLoader(bad_iter(), sharding, prefetch=2)
+    with pytest.raises(RuntimeError, match="corrupt shard"):
+        list(loader)
+
+
+def test_sharded_loader_early_break_releases_feeder(devices8):
+    import time
+
+    mesh = make_mesh(dp=8, devices=devices8)
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+    it = synthetic_lm_batches(global_batch=8, seq_len=4, vocab_size=10, steps=None)
+    loader = ShardedLoader(it, sharding, prefetch=1)
+    for batch in loader:
+        break  # infinite stream abandoned early
+    deadline = time.monotonic() + 5.0
+    while loader._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not loader._thread.is_alive()  # feeder released, not blocked on put
+
+
+def test_host_batch_size_requires_divisibility(monkeypatch):
+    from kubeflow_tpu.data import loader
+
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    with pytest.raises(ValueError, match="divisible"):
+        loader._host_batch_size(6)
+    assert loader._host_batch_size(8) == 2
