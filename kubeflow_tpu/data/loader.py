@@ -81,6 +81,12 @@ class ShardedLoader:
             for item in self._it:
                 yield self._assemble(item)
             return
+        if self._thread is not None:
+            # A previous iteration was abandoned: release and retire its
+            # feeder before re-arming, so two feeders never share self._it
+            # or push stale items into the new queue.
+            self._stop.set()
+            self._thread.join()
         self._q = queue.Queue(maxsize=self._prefetch)
         self._stop.clear()
         self._thread = threading.Thread(target=self._feeder, daemon=True)

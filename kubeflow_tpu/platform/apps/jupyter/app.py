@@ -41,6 +41,9 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
     app = App("jupyter-web-app")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    from kubeflow_tpu.platform.web.static_serving import install_frontend
+
+    install_frontend(app, "jupyter")
     cfg_path = spawner_config_path
 
     # -- config & environment -------------------------------------------------

@@ -22,6 +22,9 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
     app = App("tensorboards-web-app")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    from kubeflow_tpu.platform.web.static_serving import install_frontend
+
+    install_frontend(app, "tensorboards")
 
     @app.route("/api/namespaces/<ns>/tensorboards")
     def list_tensorboards(request: Request, ns: str):

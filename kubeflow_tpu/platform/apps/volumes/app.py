@@ -43,6 +43,9 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
     app = App("volumes-web-app")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    from kubeflow_tpu.platform.web.static_serving import install_frontend
+
+    install_frontend(app, "volumes")
 
     @app.route("/api/namespaces/<ns>/pvcs")
     def list_pvcs(request: Request, ns: str):

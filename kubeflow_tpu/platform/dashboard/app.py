@@ -47,6 +47,9 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
     app = App("centraldashboard")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
+    from kubeflow_tpu.platform.web.static_serving import install_frontend
+
+    install_frontend(app, "dashboard")
     manager = BindingManager(client)
 
     # -- /api ------------------------------------------------------------------
@@ -174,6 +177,33 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
         for name in victims:
             manager.delete_profile(name)
         return success({"deleted": victims})
+
+    @app.route("/api/workgroup/contributors/<ns>")
+    def list_contributors(request: Request, ns: str):
+        """All bindings for a namespace (owner + contributors) — what the
+        manage-contributors view renders (reference api_workgroup.ts binding
+        mapping :63-100 reads the namespace's bindings, not the caller's)."""
+        caller = current_user(request)
+        if not (manager.is_owner(caller, ns) or manager.is_cluster_admin(caller)
+                or any(b["referredNamespace"] == ns
+                       for b in manager.list_bindings(user=caller))):
+            raise HttpError(403, f"no access to namespace {ns!r}")
+        out = []
+        profile_owner = None
+        try:
+            profile = client.get(PROFILE, ns)
+            profile_owner = deep_get(profile, "spec", "owner", "name")
+        except errors.ApiError:
+            pass
+        if profile_owner:
+            out.append({"user": profile_owner, "role": "owner"})
+        for binding in manager.list_bindings(namespace=ns):
+            role = binding["roleRef"]["name"].removeprefix("kubeflow-")
+            bound = binding["user"]["name"]
+            if bound == profile_owner:
+                continue
+            out.append({"user": bound, "role": ROLE_MAP.get(role, role)})
+        return success({"contributors": out})
 
     @app.route("/api/workgroup/add-contributor", methods=["POST"])
     def add_contributor(request: Request):

@@ -1,0 +1,170 @@
+/* Central dashboard shell: namespace selector, sidebar navigation that
+   iframes the child apps (reference main-page.js + iframe-container.js),
+   overview cards, activity feed, contributor management. */
+import { api, el, toast, age } from "./shared/common.js";
+
+let envInfo = null;
+let currentNs = null;
+
+const frame = document.getElementById("app-frame");
+const views = {
+  home: document.getElementById("view-home"),
+  contributors: document.getElementById("view-contributors"),
+};
+
+function show(view, href) {
+  for (const main of Object.values(views)) main.hidden = true;
+  frame.hidden = true;
+  if (view && views[view]) {
+    views[view].hidden = false;
+    if (view === "contributors") loadContributors();
+  } else if (href) {
+    frame.hidden = false;
+    const url = new URL(href, window.location.origin);
+    url.searchParams.set("ns", currentNs || "");
+    frame.src = url.pathname + url.search;
+  }
+  for (const a of document.querySelectorAll("nav.sidebar a")) {
+    a.classList.toggle("active", a.dataset.view === view || (!view && a.dataset.href === href));
+  }
+}
+
+async function loadEnvInfo() {
+  envInfo = await api("/api/workgroup/env-info");
+  document.getElementById("user-label").textContent = envInfo.user || "";
+  const select = document.getElementById("ns-select");
+  select.replaceChildren();
+  for (const item of envInfo.namespaces || []) {
+    select.append(el("option", { value: item.namespace }, `${item.namespace} (${item.role})`));
+  }
+  currentNs = select.value || null;
+  select.addEventListener("change", () => {
+    currentNs = select.value;
+    refreshHome();
+    if (!frame.hidden && frame.src) {
+      const url = new URL(frame.src);
+      url.searchParams.set("ns", currentNs);
+      frame.src = url.pathname + url.search;
+    }
+  });
+  document.getElementById("stat-namespaces").textContent =
+    String((envInfo.namespaces || []).length);
+  document.getElementById("register-card").hidden = envInfo.hasWorkgroup;
+}
+
+async function loadLinks() {
+  const links = (await api("/api/dashboard-links")).links;
+  const sidebar = document.getElementById("sidebar");
+  const anchor = sidebar.querySelector("[data-view=contributors]");
+  for (const item of (links.menuLinks || [])) {
+    const a = el("a", { href: "#", "data-href": item.link }, item.text);
+    a.addEventListener("click", (ev) => {
+      ev.preventDefault();
+      show(null, item.link);
+    });
+    sidebar.insertBefore(a, anchor);
+  }
+}
+
+async function refreshHome() {
+  try {
+    const overview = await api("/api/tpu-overview");
+    document.getElementById("stat-capacity").textContent =
+      String(overview.clusterCapacityChips);
+    const requested = Object.values(overview.requestedChipsByNamespace || {})
+      .reduce((a, b) => a + b, 0);
+    document.getElementById("stat-requested").textContent = String(requested);
+  } catch (e) { /* nodes may be unlistable for plain users */ }
+  if (!currentNs) return;
+  try {
+    const events = (await api(`/api/activities/${currentNs}`)).events;
+    const tbody = document.querySelector("#activity-table tbody");
+    document.getElementById("activity-empty").hidden = events.length > 0;
+    tbody.replaceChildren();
+    for (const ev of events.slice(0, 25)) {
+      tbody.append(el("tr", {},
+        el("td", {}, age(ev.lastTimestamp) || ""),
+        el("td", { class: "mono" },
+          `${(ev.involvedObject || {}).kind || ""}/${(ev.involvedObject || {}).name || ""}`),
+        el("td", {}, ev.reason || ""),
+        el("td", {}, ev.message || ""),
+      ));
+    }
+  } catch (e) { /* no access yet */ }
+}
+
+async function loadContributors() {
+  document.getElementById("contrib-ns").textContent = currentNs || "—";
+  const tbody = document.querySelector("#contrib-table tbody");
+  tbody.replaceChildren();
+  if (!currentNs) return;
+  let contributors = [];
+  try {
+    contributors = (await api(`/api/workgroup/contributors/${currentNs}`)).contributors;
+  } catch (e) {
+    toast(e.message, true);
+    return;
+  }
+  for (const item of contributors) {
+    tbody.append(el("tr", {},
+      el("td", {}, item.user),
+      el("td", {}, item.role),
+      el("td", {}, item.role === "contributor"
+        ? el("button", { class: "danger", onclick: () => removeContributor(item.user) }, "Remove")
+        : ""),
+    ));
+  }
+}
+
+async function removeContributor(user) {
+  try {
+    await api("/api/workgroup/remove-contributor", {
+      method: "DELETE",
+      body: JSON.stringify({ contributor: user, namespace: currentNs }),
+    });
+    toast("Removed " + user);
+    await loadEnvInfo();
+    loadContributors();
+  } catch (e) {
+    toast(e.message, true);
+  }
+}
+
+document.getElementById("contrib-form").addEventListener("submit", async (ev) => {
+  ev.preventDefault();
+  const contributor = new FormData(ev.target).get("contributor");
+  try {
+    await api("/api/workgroup/add-contributor", {
+      method: "POST",
+      body: JSON.stringify({ contributor, namespace: currentNs }),
+    });
+    toast("Added " + contributor);
+    ev.target.reset();
+    await loadEnvInfo();
+    loadContributors();
+  } catch (e) {
+    toast(e.message, true);
+  }
+});
+
+document.getElementById("register-btn").addEventListener("click", async () => {
+  try {
+    const out = await api("/api/workgroup/create", { method: "POST", body: "{}" });
+    toast("Created namespace " + out.namespace);
+    await loadEnvInfo();
+    refreshHome();
+  } catch (e) {
+    toast(e.message, true);
+  }
+});
+
+for (const a of document.querySelectorAll("nav.sidebar a[data-view]")) {
+  a.addEventListener("click", (ev) => {
+    ev.preventDefault();
+    show(a.dataset.view);
+  });
+}
+
+loadEnvInfo()
+  .then(() => Promise.all([loadLinks(), refreshHome()]))
+  .catch((e) => toast(e.message, true));

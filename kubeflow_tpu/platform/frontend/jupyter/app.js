@@ -1,0 +1,192 @@
+/* Notebook spawner + table SPA.  The TPU accelerator/topology selector
+   replaces the reference's GPU vendor dropdown (form-gpus component). */
+import {
+  api, namespace, el, toast, statusDot, age, poll, confirmDialog,
+} from "./shared/common.js";
+
+const ns = namespace();
+document.getElementById("ns-label").textContent = "namespace: " + ns;
+
+let config = null;
+
+async function loadConfig() {
+  config = (await api("/api/config")).config;
+  const select = document.getElementById("image-select");
+  select.replaceChildren();
+  for (const image of config.image.options || [config.image.value]) {
+    select.append(el("option", { value: image, selected: image === config.image.value ? "" : null }, image.split("/").pop()));
+  }
+  select.append(el("option", { value: "__custom__" }, "custom image…"));
+  select.addEventListener("change", () => {
+    document.getElementById("custom-image-row").hidden = select.value !== "__custom__";
+  });
+  document.querySelector("[name=cpu]").value = config.cpu.value;
+  document.querySelector("[name=memory]").value = config.memory.value;
+}
+
+let offeredTpus = [];
+
+function syncTopologies() {
+  const acc = document.getElementById("tpu-acc");
+  const topo = document.getElementById("tpu-topo");
+  const sel = offeredTpus.find((o) => o.accelerator === acc.value);
+  topo.disabled = !sel;
+  topo.replaceChildren();
+  for (const t of (sel ? sel.topologies : [])) {
+    topo.append(el("option", { value: t }, t));
+  }
+}
+
+async function loadTpus() {
+  const acc = document.getElementById("tpu-acc");
+  try {
+    offeredTpus = (await api(`/api/namespaces/${ns}/tpus`)).tpus;
+  } catch (e) {
+    /* no nodes visible: fall back to the admin-offered list */
+    offeredTpus = (config && config.tpus && config.tpus.options) || [];
+  }
+  acc.replaceChildren(el("option", { value: "" }, "none"));
+  for (const option of offeredTpus) {
+    acc.append(el("option", { value: option.accelerator }, option.accelerator));
+  }
+  syncTopologies();
+}
+
+async function loadPoddefaults() {
+  const chips = document.getElementById("poddefault-chips");
+  chips.replaceChildren();
+  let pds = [];
+  try {
+    pds = (await api(`/api/namespaces/${ns}/poddefaults`)).poddefaults;
+  } catch (e) { /* none */ }
+  if (!pds.length) {
+    chips.append(el("span", { class: "muted" }, "none available"));
+    return;
+  }
+  for (const pd of pds) {
+    const chip = el("span", { class: "chip", "data-label": pd.label, title: pd.desc }, pd.desc);
+    chip.addEventListener("click", () => chip.classList.toggle("on"));
+    chips.append(chip);
+  }
+}
+
+function connectUrl(nb) {
+  return `/notebook/${nb.namespace}/${nb.name}/`;
+}
+
+async function refreshTable() {
+  let notebooks = [];
+  try {
+    notebooks = (await api(`/api/namespaces/${ns}/notebooks`)).notebooks;
+  } catch (e) {
+    toast(e.message, true);
+    return;
+  }
+  const tbody = document.querySelector("#nb-table tbody");
+  document.getElementById("nb-empty").hidden = notebooks.length > 0;
+  tbody.replaceChildren();
+  for (const nb of notebooks) {
+    const stopped = nb.status && nb.status.phase === "stopped";
+    const tpuText = nb.tpu
+      ? `${nb.tpu.accelerator}${nb.tpu.topology ? " " + nb.tpu.topology : ""}`
+      : "—";
+    tbody.append(el("tr", {},
+      el("td", {}, statusDot((nb.status && nb.status.phase) || "waiting")),
+      el("td", {}, el("a", { href: connectUrl(nb), target: "_blank" }, nb.name)),
+      el("td", { class: "mono", title: nb.image }, nb.shortImage),
+      el("td", {}, tpuText),
+      el("td", {}, nb.cpu || "—"),
+      el("td", {}, nb.memory || "—"),
+      el("td", {}, age(nb.age)),
+      el("td", {},
+        el("button", {
+          class: "ghost",
+          onclick: () => toggleStop(nb, !stopped),
+        }, stopped ? "Start" : "Stop"),
+        el("button", {
+          class: "danger",
+          onclick: () => removeNotebook(nb),
+        }, "Delete"),
+      ),
+    ));
+  }
+}
+
+async function toggleStop(nb, stop) {
+  try {
+    await api(`/api/namespaces/${ns}/notebooks/${nb.name}`, {
+      method: "PATCH",
+      body: JSON.stringify({ stopped: stop }),
+    });
+    toast((stop ? "Stopping " : "Starting ") + nb.name);
+    refreshTable();
+  } catch (e) {
+    toast(e.message, true);
+  }
+}
+
+async function removeNotebook(nb) {
+  if (!confirmDialog(`Delete notebook ${nb.name}? Its workspace PVC is kept.`)) return;
+  try {
+    await api(`/api/namespaces/${ns}/notebooks/${nb.name}`, { method: "DELETE" });
+    toast("Deleted " + nb.name);
+    refreshTable();
+  } catch (e) {
+    toast(e.message, true);
+  }
+}
+
+function spawnBody(form) {
+  const data = new FormData(form);
+  const body = {
+    name: data.get("name"),
+    cpu: data.get("cpu"),
+    memory: data.get("memory"),
+    configurations: [...document.querySelectorAll("#poddefault-chips .chip.on")]
+      .map((chip) => chip.dataset.label),
+  };
+  if (data.get("image") === "__custom__") {
+    body.customImage = data.get("customImage");
+    body.customImageCheck = true;
+  } else {
+    body.image = data.get("image");
+  }
+  const accelerator = data.get("tpuAccelerator");
+  if (accelerator) {
+    body.tpus = { accelerator, topology: data.get("tpuTopology") || "" };
+  }
+  if (data.get("workspace") === "none") body.workspaceVolume = null;
+  return body;
+}
+
+function wireSpawner() {
+  const dialog = document.getElementById("spawner");
+  document.getElementById("tpu-acc").addEventListener("change", syncTopologies);
+  document.getElementById("new-notebook").addEventListener("click", () => {
+    loadTpus();
+    loadPoddefaults();
+    dialog.showModal();
+  });
+  document.getElementById("spawn-cancel").addEventListener("click", () => dialog.close());
+  document.getElementById("spawn-form").addEventListener("submit", async (ev) => {
+    ev.preventDefault();
+    const body = spawnBody(ev.target);
+    try {
+      await api(`/api/namespaces/${ns}/notebooks`, {
+        method: "POST",
+        body: JSON.stringify(body),
+      });
+      toast("Launching " + body.name);
+      dialog.close();
+      ev.target.reset();
+      refreshTable();
+    } catch (e) {
+      toast(e.message, true);
+    }
+  });
+}
+
+loadConfig().then(() => {
+  wireSpawner();
+  poll(refreshTable, 10000);
+}).catch((e) => toast(e.message, true));

@@ -1,0 +1,96 @@
+/* Shared SPA helpers: API fetch with the CSRF double-submit header, the
+   namespace query param convention (?ns=, kept in sync with the dashboard
+   shell), toasts, and small DOM utilities. */
+
+export function getCookie(name) {
+  const m = document.cookie.match(new RegExp("(?:^|; )" + name + "=([^;]*)"));
+  return m ? decodeURIComponent(m[1]) : null;
+}
+
+export async function api(path, opts = {}) {
+  const headers = Object.assign(
+    { "Content-Type": "application/json" },
+    opts.headers || {}
+  );
+  const method = (opts.method || "GET").toUpperCase();
+  if (!["GET", "HEAD", "OPTIONS"].includes(method)) {
+    const token = getCookie("XSRF-TOKEN");
+    if (token) headers["X-XSRF-TOKEN"] = token;
+  }
+  const resp = await fetch(path, Object.assign({}, opts, { headers }));
+  let body = null;
+  try {
+    body = await resp.json();
+  } catch (e) {
+    /* non-JSON error page */
+  }
+  if (!resp.ok || (body && body.success === false)) {
+    const msg = (body && (body.user_action || body.log)) || resp.statusText;
+    throw new Error(msg);
+  }
+  return body;
+}
+
+export function namespace() {
+  return new URLSearchParams(window.location.search).get("ns") || "kubeflow-user";
+}
+
+export function setNamespace(ns) {
+  const url = new URL(window.location);
+  url.searchParams.set("ns", ns);
+  window.history.replaceState({}, "", url);
+}
+
+export function el(tag, attrs = {}, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs)) {
+    if (k === "class") node.className = v;
+    else if (k.startsWith("on")) node.addEventListener(k.slice(2), v);
+    else if (v !== null && v !== undefined) node.setAttribute(k, v);
+  }
+  for (const child of children.flat()) {
+    node.append(child instanceof Node ? child : document.createTextNode(String(child)));
+  }
+  return node;
+}
+
+let toastTimer = null;
+export function toast(message, isError = false) {
+  let box = document.getElementById("toast");
+  if (!box) {
+    box = el("div", { id: "toast" });
+    document.body.append(box);
+  }
+  box.textContent = message;
+  box.className = "show" + (isError ? " error" : "");
+  clearTimeout(toastTimer);
+  toastTimer = setTimeout(() => (box.className = ""), 4000);
+}
+
+export function statusDot(phase) {
+  return el("span", { class: "status" },
+    el("span", { class: "dot " + phase }),
+    el("span", {}, phase));
+}
+
+export function age(timestamp) {
+  if (!timestamp) return "";
+  const s = Math.max(0, (Date.now() - new Date(timestamp).getTime()) / 1000);
+  if (s < 90) return Math.round(s) + "s";
+  if (s < 5400) return Math.round(s / 60) + "m";
+  if (s < 129600) return Math.round(s / 3600) + "h";
+  return Math.round(s / 86400) + "d";
+}
+
+export function confirmDialog(text) {
+  return window.confirm(text);
+}
+
+/* Poll helper: run fn now and on an interval; pause while the tab is hidden. */
+export function poll(fn, ms) {
+  fn();
+  const timer = setInterval(() => {
+    if (!document.hidden) fn();
+  }, ms);
+  return () => clearInterval(timer);
+}

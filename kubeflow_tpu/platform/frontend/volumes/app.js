@@ -1,0 +1,86 @@
+/* Volumes SPA: PVC table with mount usage, create + guarded delete. */
+import {
+  api, namespace, el, toast, statusDot, age, poll, confirmDialog,
+} from "./shared/common.js";
+
+const ns = namespace();
+document.getElementById("ns-label").textContent = "namespace: " + ns;
+
+const PHASES = { Bound: "ready", Pending: "waiting", Lost: "warning" };
+
+async function refresh() {
+  let pvcs = [];
+  try {
+    pvcs = (await api(`/api/namespaces/${ns}/pvcs`)).pvcs;
+  } catch (e) {
+    toast(e.message, true);
+    return;
+  }
+  const tbody = document.querySelector("#pvc-table tbody");
+  document.getElementById("pvc-empty").hidden = pvcs.length > 0;
+  tbody.replaceChildren();
+  for (const pvc of pvcs) {
+    tbody.append(el("tr", {},
+      el("td", {}, statusDot(PHASES[pvc.status] || "waiting")),
+      el("td", {}, pvc.name),
+      el("td", {}, pvc.capacity),
+      el("td", {}, (pvc.modes || []).join(", ")),
+      el("td", {}, pvc.class || "default"),
+      el("td", { class: "mono" }, (pvc.usedBy || []).join(", ") || "—"),
+      el("td", {}, age(pvc.age)),
+      el("td", {}, el("button", {
+        class: "danger",
+        disabled: (pvc.usedBy || []).length ? "" : null,
+        title: (pvc.usedBy || []).length ? "mounted by a pod" : "",
+        onclick: () => remove(pvc),
+      }, "Delete")),
+    ));
+  }
+}
+
+async function remove(pvc) {
+  if (!confirmDialog(`Delete volume ${pvc.name}? Data is lost permanently.`)) return;
+  try {
+    await api(`/api/namespaces/${ns}/pvcs/${pvc.name}`, { method: "DELETE" });
+    toast("Deleted " + pvc.name);
+    refresh();
+  } catch (e) {
+    toast(e.message, true);
+  }
+}
+
+async function loadClasses() {
+  try {
+    const classes = (await api("/api/storageclasses")).storageClasses;
+    const select = document.getElementById("class-select");
+    for (const c of classes) select.append(el("option", { value: c }, c));
+  } catch (e) { /* listing may be forbidden; default remains */ }
+}
+
+const dialog = document.getElementById("creator");
+document.getElementById("new-pvc").addEventListener("click", () => dialog.showModal());
+document.getElementById("create-cancel").addEventListener("click", () => dialog.close());
+document.getElementById("create-form").addEventListener("submit", async (ev) => {
+  ev.preventDefault();
+  const data = new FormData(ev.target);
+  try {
+    await api(`/api/namespaces/${ns}/pvcs`, {
+      method: "POST",
+      body: JSON.stringify({
+        name: data.get("name"),
+        size: data.get("size"),
+        mode: data.get("mode"),
+        class: data.get("class"),
+      }),
+    });
+    toast("Created " + data.get("name"));
+    dialog.close();
+    ev.target.reset();
+    refresh();
+  } catch (e) {
+    toast(e.message, true);
+  }
+});
+
+loadClasses();
+poll(refresh, 10000);

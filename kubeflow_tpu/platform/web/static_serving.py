@@ -1,0 +1,68 @@
+"""Static frontend serving for the platform web apps.
+
+The reference ships compiled Angular bundles served by a Flask blueprint
+(reference crud_backend/serving.py); this build's frontends are dependency-
+free ES modules served straight from ``kubeflow_tpu/platform/frontend/`` —
+no node toolchain in the loop.  Each app serves:
+
+    /                     -> frontend/<app>/index.html
+    /app.js               -> frontend/<app>/app.js
+    /shared/<file>        -> frontend/shared/<file>   (css + common js)
+
+Static routes skip the authn gate (the SPA shell is public; every API call
+it makes is authenticated + CSRF-checked as usual).
+"""
+from __future__ import annotations
+
+import os
+
+from werkzeug.wrappers import Request, Response
+
+from kubeflow_tpu.platform.web.crud_backend import no_authentication
+from kubeflow_tpu.platform.web.framework import App, HttpError
+
+FRONTEND_ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "frontend")
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".js": "application/javascript; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".svg": "image/svg+xml",
+    ".json": "application/json",
+    ".ico": "image/x-icon",
+}
+
+
+def _serve_file(root: str, filename: str) -> Response:
+    # Normalize and refuse traversal out of the frontend root.
+    path = os.path.normpath(os.path.join(root, filename))
+    if not path.startswith(os.path.normpath(root) + os.sep) and path != os.path.normpath(root):
+        raise HttpError(404, "not found")
+    if not os.path.isfile(path):
+        raise HttpError(404, f"no such asset {filename!r}")
+    ext = os.path.splitext(path)[1]
+    with open(path, "rb") as f:
+        body = f.read()
+    return Response(body, content_type=_CONTENT_TYPES.get(ext, "application/octet-stream"))
+
+
+def install_frontend(app: App, name: str, *, root: str = None) -> None:
+    """Serve the named app's SPA (index.html, app.js, shared assets)."""
+    root = root or FRONTEND_ROOT
+    app_dir = os.path.join(root, name)
+    shared_dir = os.path.join(root, "shared")
+
+    @app.route("/")
+    @no_authentication
+    def index(request: Request):
+        return _serve_file(app_dir, "index.html")
+
+    @app.route("/app.js")
+    @no_authentication
+    def app_js(request: Request):
+        return _serve_file(app_dir, "app.js")
+
+    @app.route("/shared/<path:filename>")
+    @no_authentication
+    def shared(request: Request, filename: str):
+        return _serve_file(shared_dir, filename)

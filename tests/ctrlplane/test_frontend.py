@@ -1,0 +1,131 @@
+"""Frontend serving tests: every app serves its SPA shell + shared assets,
+static routes skip authn, traversal is refused, API routes still guarded."""
+from __future__ import annotations
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def _client(create_app):
+    kube = FakeKube()
+    kube.add_namespace("alice")
+    app = create_app(kube, secure_cookies=False)
+    return Client(app)
+
+
+APPS = []
+from kubeflow_tpu.platform.apps.jupyter.app import create_app as jupyter_app
+from kubeflow_tpu.platform.apps.tensorboards.app import create_app as tb_app
+from kubeflow_tpu.platform.apps.volumes.app import create_app as volumes_app
+from kubeflow_tpu.platform.dashboard.app import create_app as dashboard_app
+
+APPS = [
+    ("jupyter", jupyter_app),
+    ("volumes", volumes_app),
+    ("tensorboards", tb_app),
+    ("dashboard", dashboard_app),
+]
+
+
+@pytest.mark.parametrize("name,factory", APPS, ids=[a[0] for a in APPS])
+def test_spa_shell_served_without_auth(name, factory):
+    client = _client(factory)
+    # No identity header at all: static shell must still load.
+    resp = client.get("/")
+    assert resp.status_code == 200
+    assert resp.content_type.startswith("text/html")
+    body = resp.get_data(as_text=True)
+    assert "app.js" in body and "shared/kubeflow.css" in body
+
+    js = client.get("/app.js")
+    assert js.status_code == 200
+    assert js.content_type.startswith("application/javascript")
+
+    css = client.get("/shared/kubeflow.css")
+    assert css.status_code == 200
+    assert css.content_type.startswith("text/css")
+
+    common = client.get("/shared/common.js")
+    assert common.status_code == 200
+
+
+@pytest.mark.parametrize("name,factory", APPS, ids=[a[0] for a in APPS])
+def test_api_still_requires_identity(name, factory):
+    client = _client(factory)
+    path = {
+        "jupyter": "/api/namespaces/alice/notebooks",
+        "volumes": "/api/namespaces/alice/pvcs",
+        "tensorboards": "/api/namespaces/alice/tensorboards",
+        "dashboard": "/api/namespaces",
+    }[name]
+    assert client.get(path).status_code == 401
+    assert client.get(path, headers={"kubeflow-userid": "alice@x.io"}).status_code == 200
+
+
+def test_traversal_refused():
+    client = _client(jupyter_app)
+    for path in ("/shared/../jupyter/app.js", "/shared/..%2f..%2fnative%2fMakefile",
+                 "/shared/does-not-exist.css"):
+        resp = client.get(path, headers={"kubeflow-userid": "a@x.io"})
+        assert resp.status_code == 404, path
+
+
+def test_dashboard_lists_namespace_contributors():
+    """The manage-contributors view needs the NAMESPACE's bindings (owner +
+    contributors), not the caller's own — regression for the env-info-only
+    first cut."""
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    # Nobody is cluster admin (the cluster-admin probe is delete-on-Profile);
+    # access must come from ownership/bindings alone.
+    kube.authz_policy = (
+        lambda user, verb, gvk, **kw: not (verb == "delete" and gvk.kind == "Profile")
+    )
+    app = dashboard_app(kube, secure_cookies=False)
+    client = Client(app)
+    owner = {"kubeflow-userid": "alice@x.io"}
+
+    resp = client.post("/api/workgroup/create", json={}, headers=owner)
+    assert resp.status_code == 200
+    ns = resp.get_json()["namespace"]
+    # The profile controller (not running here) would create the namespace.
+    kube.add_namespace(ns)
+
+    resp = client.post(
+        "/api/workgroup/add-contributor",
+        json={"contributor": "bob@x.io", "namespace": ns},
+        headers=owner,
+    )
+    assert resp.status_code == 200
+
+    resp = client.get(f"/api/workgroup/contributors/{ns}", headers=owner)
+    assert resp.status_code == 200
+    contributors = resp.get_json()["contributors"]
+    roles = {c["user"]: c["role"] for c in contributors}
+    assert roles.get("bob@x.io") == "contributor"
+    assert "owner" in roles.values()
+
+    # Outsiders are refused.
+    resp = client.get(
+        f"/api/workgroup/contributors/{ns}",
+        headers={"kubeflow-userid": "mallory@x.io"},
+    )
+    assert resp.status_code == 403
+
+
+def test_spawner_form_fields_match_backend_contract():
+    """The JS form posts these field names; build_notebook must accept them
+    (regression guard tying frontend to form.py)."""
+    import os
+
+    js = open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..",
+            "kubeflow_tpu", "platform", "frontend", "jupyter", "app.js",
+        )
+    ).read()
+    for field in ("name", "cpu", "memory", "tpus", "customImage",
+                  "customImageCheck", "configurations", "workspaceVolume"):
+        assert field in js, f"spawner JS no longer sends {field}"
