@@ -37,9 +37,11 @@ class ShardedLoader:
         self._it = iter(local_batches)
         self._sharding = sharding
         self._prefetch = prefetch
-        self._q: Optional[queue.Queue] = None
+        # Per-generation feeder state: each __iter__ captures its OWN stop
+        # event and queue, so an abandoned older generator's cleanup can
+        # never kill or starve the live one.
         self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
+        self._thread_stop: Optional[threading.Event] = None
         self._done = object()
 
     def _assemble(self, local: Any) -> Any:
@@ -52,29 +54,29 @@ class ShardedLoader:
             return jax.tree.map(lambda x: one(x, self._sharding), local)
         return jax.tree.map(one, local, self._sharding)
 
-    def _put(self, item) -> bool:
-        """Bounded put that gives up when the consumer stopped (a consumer
-        that breaks out of its loop must not leave this thread blocked
-        holding assembled device batches)."""
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+    def _feeder(self, q: queue.Queue, stop: threading.Event):
+        def put(item) -> bool:
+            # Bounded put that gives up when this generation's consumer
+            # stopped (a consumer that breaks out of its loop must not leave
+            # this thread blocked holding assembled device batches).
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
-    def _feeder(self):
         sentinel = self._done
         try:
             for item in self._it:
-                if self._stop.is_set():
+                if stop.is_set():
                     return
-                if not self._put(self._assemble(item)):
+                if not put(self._assemble(item)):
                     return
         except BaseException as exc:  # propagated to the consumer, not lost
             sentinel = exc
-        self._put(sentinel)
+        put(sentinel)
 
     def __iter__(self):
         if self._prefetch <= 0:
@@ -83,29 +85,31 @@ class ShardedLoader:
             return
         if self._thread is not None:
             # A previous iteration was abandoned: release and retire its
-            # feeder before re-arming, so two feeders never share self._it
-            # or push stale items into the new queue.
-            self._stop.set()
+            # feeder before re-arming, so two feeders never share self._it.
+            self._thread_stop.set()
             self._thread.join()
-        self._q = queue.Queue(maxsize=self._prefetch)
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._feeder, daemon=True)
+        q = queue.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+        self._thread_stop = stop
+        self._thread = threading.Thread(
+            target=self._feeder, args=(q, stop), daemon=True
+        )
         self._thread.start()
         try:
             while True:
-                item = self._q.get()
+                item = q.get()
                 if item is self._done:
                     return
                 if isinstance(item, BaseException):
                     raise item
                 yield item
         finally:
-            # Consumer finished or broke out early: release the feeder and
-            # drop any prefetched batches so device memory is freed.
-            self._stop.set()
+            # Consumer finished or broke out early: release THIS generation's
+            # feeder and drop its prefetched batches so device memory frees.
+            stop.set()
             try:
                 while True:
-                    self._q.get_nowait()
+                    q.get_nowait()
             except queue.Empty:
                 pass
 

@@ -143,6 +143,26 @@ def serve_full_platform(args) -> int:
         srv, base = app.test_server()
         servers[name] = (srv, base)
 
+    # Point the dashboard's menu at the live per-port app URLs (production
+    # uses path-prefix routes behind the Istio gateway; this demo topology
+    # has no gateway, so absolute URLs make the iframe navigation work).
+    kube.create({
+        "apiVersion": "v1", "kind": "ConfigMap",
+        "metadata": {"name": "kubeflow-dashboard-settings",
+                     "namespace": "kubeflow"},
+        "data": {"links": json.dumps({
+            "menuLinks": [
+                {"link": servers["jupyter"][1] + "/", "text": "Notebooks",
+                 "icon": "book"},
+                {"link": servers["volumes"][1] + "/", "text": "Volumes",
+                 "icon": "device:storage"},
+                {"link": servers["tensorboards"][1] + "/",
+                 "text": "TensorBoards", "icon": "assessment"},
+            ],
+            "externalLinks": [], "quickLinks": [],
+        })},
+    })
+
     print("platform up (in-memory API server):")
     print(f"  webhook    https-less http://127.0.0.1:{webhook.port}/apply-poddefault")
     for name, (_, base) in servers.items():

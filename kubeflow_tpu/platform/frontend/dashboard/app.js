@@ -22,7 +22,7 @@ function show(view, href) {
     frame.hidden = false;
     const url = new URL(href, window.location.origin);
     url.searchParams.set("ns", currentNs || "");
-    frame.src = url.pathname + url.search;
+    frame.src = url.href;  // absolute: links may be cross-origin (demo topology)
   }
   for (const a of document.querySelectorAll("nav.sidebar a")) {
     a.classList.toggle("active", a.dataset.view === view || (!view && a.dataset.href === href));
@@ -33,24 +33,32 @@ async function loadEnvInfo() {
   envInfo = await api("/api/workgroup/env-info");
   document.getElementById("user-label").textContent = envInfo.user || "";
   const select = document.getElementById("ns-select");
+  const previous = currentNs;
   select.replaceChildren();
   for (const item of envInfo.namespaces || []) {
     select.append(el("option", { value: item.namespace }, `${item.namespace} (${item.role})`));
   }
+  // Idempotent: keep the user's selection across refreshes (a contributor
+  // mutation must not silently retarget another namespace).
+  if (previous && [...select.options].some((o) => o.value === previous)) {
+    select.value = previous;
+  }
   currentNs = select.value || null;
-  select.addEventListener("change", () => {
-    currentNs = select.value;
-    refreshHome();
-    if (!frame.hidden && frame.src) {
-      const url = new URL(frame.src);
-      url.searchParams.set("ns", currentNs);
-      frame.src = url.pathname + url.search;
-    }
-  });
   document.getElementById("stat-namespaces").textContent =
     String((envInfo.namespaces || []).length);
   document.getElementById("register-card").hidden = envInfo.hasWorkgroup;
 }
+
+document.getElementById("ns-select").addEventListener("change", (ev) => {
+  currentNs = ev.target.value;
+  refreshHome();
+  if (!views.contributors.hidden) loadContributors();
+  if (!frame.hidden && frame.src) {
+    const url = new URL(frame.src);
+    url.searchParams.set("ns", currentNs);
+    frame.src = url.href;
+  }
+});
 
 async function loadLinks() {
   const links = (await api("/api/dashboard-links")).links;

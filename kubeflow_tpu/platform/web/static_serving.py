@@ -14,8 +14,10 @@ it makes is authenticated + CSRF-checked as usual).
 """
 from __future__ import annotations
 
+import mimetypes
 import os
 
+from werkzeug.security import safe_join
 from werkzeug.wrappers import Request, Response
 
 from kubeflow_tpu.platform.web.crud_backend import no_authentication
@@ -23,27 +25,18 @@ from kubeflow_tpu.platform.web.framework import App, HttpError
 
 FRONTEND_ROOT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "frontend")
 
-_CONTENT_TYPES = {
-    ".html": "text/html; charset=utf-8",
-    ".js": "application/javascript; charset=utf-8",
-    ".css": "text/css; charset=utf-8",
-    ".svg": "image/svg+xml",
-    ".json": "application/json",
-    ".ico": "image/x-icon",
-}
+# mimetypes guesses text/javascript on some systems; pin the modern type.
+mimetypes.add_type("application/javascript; charset=utf-8", ".js")
 
 
 def _serve_file(root: str, filename: str) -> Response:
-    # Normalize and refuse traversal out of the frontend root.
-    path = os.path.normpath(os.path.join(root, filename))
-    if not path.startswith(os.path.normpath(root) + os.sep) and path != os.path.normpath(root):
-        raise HttpError(404, "not found")
-    if not os.path.isfile(path):
+    path = safe_join(root, filename)  # refuses traversal/absolute/encoded
+    if path is None or not os.path.isfile(path):
         raise HttpError(404, f"no such asset {filename!r}")
-    ext = os.path.splitext(path)[1]
+    content_type = mimetypes.guess_type(path)[0] or "application/octet-stream"
     with open(path, "rb") as f:
         body = f.read()
-    return Response(body, content_type=_CONTENT_TYPES.get(ext, "application/octet-stream"))
+    return Response(body, content_type=content_type)
 
 
 def install_frontend(app: App, name: str, *, root: str = None) -> None:

@@ -70,10 +70,11 @@ def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool =
         def loss_fn(params):
             variables = {"params": params}
             kwargs: dict = {"train": True}
-            mutable = []
+            # mutable must be False (not []) when nothing is collected:
+            # flax returns an (out, vars) tuple for ANY non-False mutable.
+            mutable = ["batch_stats"] if has_batch_stats else False
             if has_batch_stats:
                 variables["batch_stats"] = state.batch_stats
-                mutable = ["batch_stats"]
             if has_dropout:
                 kwargs["rngs"] = {"dropout": rng}
             out = state.apply_fn(variables, inputs, mutable=mutable, **kwargs)
