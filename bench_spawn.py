@@ -1,0 +1,54 @@
+#!/usr/bin/env python3
+"""Platform half of the BASELINE.md metric pair: notebook spawn-to-ready.
+
+Measures the control-plane path (spawner POST -> reconcile -> webhook
+admission -> status converged) over N iterations on the in-memory API
+server; image pull and kubelet start are simulated (those costs belong to
+the image-size work, images/README.md).  Prints ONE JSON line in the same
+shape as bench.py.
+"""
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+from ci.e2e import E2E
+
+ITERATIONS = 10
+# Control-plane spawn-to-ready established at round 1 on this harness
+# (median of 10, in-memory API server; BASELINE.md).
+BASELINE_SPAWN_S = 0.046
+
+
+def main() -> int:
+    latencies = []
+    e2e = E2E()
+    try:
+        ns = e2e.register()
+        for i in range(ITERATIONS):
+            name = f"bench-nb-{i}"
+            latencies.append(e2e.spawn(ns, name))
+            e2e.delete(ns, name)
+    finally:
+        e2e.close()
+
+    median = statistics.median(latencies)
+    vs = 1.0 if BASELINE_SPAWN_S is None else BASELINE_SPAWN_S / median
+    print(
+        json.dumps(
+            {
+                "metric": "notebook_spawn_to_ready_s",
+                "value": round(median, 4),
+                "unit": "seconds",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,256 @@
+#!/usr/bin/env python3
+"""End-to-end platform gate: the whole SURVEY.md §3.1 spawn call stack, live.
+
+Boots every platform service in one process against the in-memory API server
+(controllers with real watch/queue threads, the admission webhook over real
+HTTPS-less HTTP, the web apps over WSGI), then drives the user journey the
+reference's KinD workflows gate (reference .github/workflows/
+nb_controller_intergration_test.yaml:27-58 — "pods Ready <= 300 s"):
+
+  1. register a workspace (dashboard -> profile controller -> namespace/RBAC)
+  2. spawn a TPU notebook through the spawner API (dry-run, PVCs, create)
+  3. admission-webhook merge of the TPU PodDefault on the worker pods
+  4. kubelet-sim brings workers Running -> Notebook status converges
+  5. stop via culling annotation -> replicas 0; start again -> Ready
+  6. delete -> garbage collection
+
+Prints the spawn-to-ready latency (the BASELINE.md platform metric) and
+exits non-zero on any step failure.  Used by ci/run.sh and bench_spawn.py.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from werkzeug.test import Client
+
+
+class E2E:
+    def __init__(self, *, hosts_sim: bool = True):
+        from kubeflow_tpu.platform.apis.poddefault import tpu_pod_default
+        from kubeflow_tpu.platform.apps.jupyter.app import create_app as jwa
+        from kubeflow_tpu.platform.controllers import culling, profile, tensorboard
+        from kubeflow_tpu.platform.controllers.notebook import make_controller
+        from kubeflow_tpu.platform.dashboard.app import create_app as dashboard
+        from kubeflow_tpu.platform.runtime import Manager
+        from kubeflow_tpu.platform.testing import FakeKube
+        from kubeflow_tpu.platform.webhook.server import WebhookServer
+
+        import logging
+
+        logging.getLogger("werkzeug").setLevel(logging.ERROR)
+
+        self.kube = FakeKube()
+        self.kube.add_namespace("kubeflow")
+        self.kube.add_tpu_node("tpu-node-1", topology="2x4")
+        self.kube.create(tpu_pod_default("kubeflow", "v5e", "2x4"))
+
+        self.mgr = Manager(self.kube)
+        self.mgr.add(make_controller(self.kube, use_istio=True))
+        self.mgr.add(profile.make_controller(self.kube))
+        self.mgr.add(tensorboard.make_controller(self.kube))
+        self.mgr.add(culling.make_controller(self.kube, prober=lambda url: None))
+        self.mgr.start()
+
+        self.webhook = WebhookServer(self.kube, host="127.0.0.1", port=0)
+        self.webhook.start()
+
+        self.jupyter = Client(jwa(self.kube, secure_cookies=False))
+        self.dashboard = Client(dashboard(self.kube, secure_cookies=False))
+        self.user = {"kubeflow-userid": "e2e-user@kubeflow.org"}
+        self.hosts_sim = hosts_sim
+
+    def close(self):
+        self.mgr.stop()
+        self.webhook.stop()
+
+    # -- steps ---------------------------------------------------------------
+
+    def register(self) -> str:
+        resp = self.dashboard.post("/api/workgroup/create", json={}, headers=self.user)
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        ns = resp.get_json()["namespace"]
+        self._wait(lambda: self._ns_ready(ns), "namespace provisioning")
+        return ns
+
+    def _ns_ready(self, ns: str) -> bool:
+        from kubeflow_tpu.platform.k8s import errors
+        from kubeflow_tpu.platform.k8s.types import NAMESPACE, SERVICEACCOUNT
+
+        try:
+            self.kube.get(NAMESPACE, ns)
+            self.kube.get(SERVICEACCOUNT, "default-editor", ns)
+            return True
+        except errors.ApiError:
+            return False
+
+    def spawn(self, ns: str, name: str = "e2e-nb") -> float:
+        """POST the spawner form; returns spawn-to-ready seconds."""
+        from kubeflow_tpu.platform.k8s.types import STATEFULSET, deep_get
+
+        t0 = time.perf_counter()
+        resp = self.jupyter.post(
+            f"/api/namespaces/{ns}/notebooks",
+            json={"name": name, "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+            headers=self.user,
+        )
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+
+        sts = self._wait(
+            lambda: self._get(STATEFULSET, name, ns), "StatefulSet creation"
+        )
+        replicas = deep_get(sts, "spec", "replicas")
+        assert replicas == 1, f"2x4 is single-host (8 chips): replicas={replicas}"
+        limits = deep_get(
+            sts, "spec", "template", "spec", "containers", default=[{}]
+        )[0].get("resources", {}).get("limits", {})
+        assert limits.get("google.com/tpu") == "8", limits
+
+        if self.hosts_sim:
+            self._kubelet_sim(ns, name, replicas)
+        self._wait(lambda: self._phase(ns, name) == "running", "notebook Ready")
+        return time.perf_counter() - t0
+
+    def _kubelet_sim(self, ns: str, name: str, replicas: int):
+        """Admit each worker pod through the real webhook, then mark Running."""
+        import urllib.request
+
+        from kubeflow_tpu.platform.k8s.types import STATEFULSET, deep_get
+
+        sts = self.kube.get(STATEFULSET, name, ns)
+        pod_template = deep_get(sts, "spec", "template")
+        for i in range(replicas):
+            pod = {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {
+                    "name": f"{name}-{i}",
+                    "namespace": ns,
+                    "labels": dict(deep_get(pod_template, "metadata", "labels",
+                                            default={}) or {},
+                                   **{"notebook-name": name}),
+                },
+                "spec": deep_get(pod_template, "spec"),
+            }
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {
+                    "uid": f"e2e-{name}-{i}",
+                    "namespace": ns,
+                    "object": pod,
+                },
+            }
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.webhook.port}/apply-poddefault",
+                data=json.dumps(review).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.load(resp)
+            assert out["response"]["allowed"], out
+            self.kube.create(pod)
+            self.kube.set_pod_phase(ns, f"{name}-{i}", "Running", ready=True)
+
+    def stop_start(self, ns: str, name: str = "e2e-nb"):
+        resp = self.jupyter.patch(
+            f"/api/namespaces/{ns}/notebooks/{name}",
+            json={"stopped": True}, headers=self.user,
+        )
+        assert resp.status_code == 200
+        self._wait(lambda: self._replicas(ns, name) == 0, "scale to zero")
+        # Stopping deletes the pods in a real cluster; mirror that.
+        self._delete_pods(ns, name)
+        self._wait(lambda: self._phase(ns, name) == "stopped", "stopped status")
+
+        resp = self.jupyter.patch(
+            f"/api/namespaces/{ns}/notebooks/{name}",
+            json={"stopped": False}, headers=self.user,
+        )
+        assert resp.status_code == 200
+        self._wait(lambda: (self._replicas(ns, name) or 0) >= 1, "scale back up")
+        if self.hosts_sim:
+            self._kubelet_sim(ns, name, self._replicas(ns, name))
+        self._wait(lambda: self._phase(ns, name) == "running", "running again")
+
+    def delete(self, ns: str, name: str = "e2e-nb"):
+        resp = self.jupyter.delete(
+            f"/api/namespaces/{ns}/notebooks/{name}", headers=self.user
+        )
+        assert resp.status_code == 200
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+
+        self._wait(lambda: self._get(NOTEBOOK, name, ns) is None, "deletion")
+
+    # -- helpers -------------------------------------------------------------
+
+    def _get(self, gvk, name, ns):
+        from kubeflow_tpu.platform.k8s import errors
+
+        try:
+            return self.kube.get(gvk, name, ns)
+        except errors.ApiError:
+            return None
+
+    def _replicas(self, ns, name):
+        from kubeflow_tpu.platform.k8s.types import STATEFULSET, deep_get
+
+        sts = self._get(STATEFULSET, name, ns)
+        return None if sts is None else deep_get(sts, "spec", "replicas")
+
+    def _phase(self, ns, name):
+        resp = self.jupyter.get(
+            f"/api/namespaces/{ns}/notebooks", headers=self.user
+        )
+        for row in resp.get_json().get("notebooks", []):
+            if row["name"] == name:
+                return row["status"]["phase"]
+        return None
+
+    def _wait(self, fn, what: str, timeout: float = 20.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            out = fn()
+            if out:
+                return out
+            time.sleep(0.02)
+        raise TimeoutError(f"e2e: timed out waiting for {what}")
+
+    def _delete_pods(self, ns, name):
+        from kubeflow_tpu.platform.k8s.types import POD, name_of
+
+        for pod in self.kube.list(POD, ns):
+            if name_of(pod).startswith(name + "-"):
+                self.kube.delete(POD, name_of(pod), ns)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", help="print metrics JSON only")
+    args = ap.parse_args(argv)
+
+    e2e = E2E()
+    try:
+        ns = e2e.register()
+        spawn_s = e2e.spawn(ns)
+        e2e.stop_start(ns)
+        e2e.delete(ns)
+    finally:
+        e2e.close()
+
+    out = {"spawn_to_ready_s": round(spawn_s, 3), "namespace": ns, "ok": True}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        print(f"E2E OK: spawn-to-ready {out['spawn_to_ready_s']}s (control "
+              f"plane only; image pull excluded) in namespace {ns}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

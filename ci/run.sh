@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# The CI gate, runnable anywhere (no cluster, no TPU): unit + integration
+# tests on the virtual 8-device CPU mesh, native-library build + parity,
+# the end-to-end platform flow, and the driver contract dry-runs.
+# Mirrors the reference's per-component GitHub workflows
+# (reference .github/workflows/*_intergration_test.yaml) collapsed into one
+# hermetic script.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== native build ==="
+make -C native
+
+echo "=== unit + integration tests ==="
+python -m pytest tests/ -q
+
+echo "=== end-to-end platform gate ==="
+python ci/e2e.py
+
+echo "=== driver contract: single-chip compile ==="
+JAX_PLATFORMS=cpu python -c "
+import __graft_entry__ as g, jax
+fn, a = g.entry()
+jax.jit(fn).lower(*a).compile()
+print('entry() compiles')"
+
+echo "=== driver contract: multi-chip dryrun ==="
+XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "=== spawn benchmark ==="
+python bench_spawn.py
+
+echo "CI PASS"

@@ -1,0 +1,148 @@
+"""Production entrypoint: run any platform service against a real cluster.
+
+    python -m kubeflow_tpu.platform.main controllers   # all reconcilers + /healthz
+    python -m kubeflow_tpu.platform.main webhook       # PodDefault admission (TLS)
+    python -m kubeflow_tpu.platform.main jupyter|volumes|tensorboards|kfam|dashboard
+
+Config comes from the environment (in-cluster service account or
+$KUBECONFIG; see RestKubeClient._resolve_config) and the same knobs the
+reference binaries take (USE_ISTIO, ENABLE_CULLING, CULL_IDLE_TIME,
+USERID_HEADER, ...; SURVEY.md §5 "config/flag system").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+from wsgiref.simple_server import WSGIRequestHandler, make_server
+
+from kubeflow_tpu.platform import config
+
+
+def _client():
+    from kubeflow_tpu.platform.k8s.client import RestKubeClient
+
+    return RestKubeClient()
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, *args):  # structured logs only
+        pass
+
+
+def _serve_health(manager, port: int) -> None:
+    """/healthz + /metrics for the controller deployment's probes."""
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "")
+        if path == "/healthz":
+            ok = manager.healthy()
+            start_response("200 OK" if ok else "503 Service Unavailable",
+                           [("Content-Type", "application/json")])
+            return [json.dumps({"healthy": ok}).encode()]
+        if path == "/metrics":
+            from kubeflow_tpu.platform.runtime import metrics
+
+            start_response("200 OK", [("Content-Type", "text/plain; version=0.0.4")])
+            return [metrics.render()]
+        start_response("404 Not Found", [("Content-Type", "text/plain")])
+        return [b"not found"]
+
+    server = make_server("0.0.0.0", port, app, handler_class=_QuietHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+
+def run_controllers(args) -> int:
+    from kubeflow_tpu.platform.controllers import culling, profile, tensorboard
+    from kubeflow_tpu.platform.controllers.notebook import make_controller
+    from kubeflow_tpu.platform.runtime import Manager
+
+    client = _client()
+    mgr = Manager(client)
+    mgr.add(make_controller(client, use_istio=config.env_bool("USE_ISTIO", True)))
+    mgr.add(profile.make_controller(client))
+    mgr.add(tensorboard.make_controller(client))
+    if config.env_bool("ENABLE_CULLING", False):
+        mgr.add(culling.make_controller(client))
+    mgr.start()
+    _serve_health(mgr, args.health_port)
+    logging.info("controllers running (health on :%d)", args.health_port)
+    _wait_for_term()
+    mgr.stop()
+    return 0
+
+
+def run_webhook(args) -> int:
+    from kubeflow_tpu.platform.webhook.server import WebhookServer
+
+    client = _client()
+    server = WebhookServer(
+        client,
+        host="0.0.0.0",
+        port=int(config.env("WEBHOOK_PORT", "4443")),
+        cert_file=config.env("TLS_CERT_FILE"),
+        key_file=config.env("TLS_KEY_FILE"),
+    )
+    server.start()
+    logging.info("webhook serving on :%d", server.port)
+    _wait_for_term()
+    server.stop()
+    return 0
+
+
+def run_web_app(name: str, args) -> int:
+    factories = {
+        "jupyter": "kubeflow_tpu.platform.apps.jupyter.app",
+        "volumes": "kubeflow_tpu.platform.apps.volumes.app",
+        "tensorboards": "kubeflow_tpu.platform.apps.tensorboards.app",
+        "kfam": "kubeflow_tpu.platform.kfam.app",
+        "dashboard": "kubeflow_tpu.platform.dashboard.app",
+    }
+    import importlib
+
+    module = importlib.import_module(factories[name])
+    app = module.create_app(_client())
+    from werkzeug.serving import make_server as wz_make_server
+
+    server = wz_make_server("0.0.0.0", args.port, app, threaded=True)
+    logging.info("%s serving on :%d", name, args.port)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    _wait_for_term()
+    server.shutdown()
+    return 0
+
+
+def _wait_for_term() -> None:
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("service", choices=[
+        "controllers", "webhook", "jupyter", "volumes", "tensorboards",
+        "kfam", "dashboard",
+    ])
+    ap.add_argument("--port", type=int, default=int(config.env("PORT", "5000")))
+    ap.add_argument("--health-port", type=int,
+                    default=int(config.env("HEALTH_PORT", "8080")))
+    args = ap.parse_args(argv)
+
+    if args.service == "controllers":
+        return run_controllers(args)
+    if args.service == "webhook":
+        return run_webhook(args)
+    return run_web_app(args.service, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Pin manifest image tags to a release version (role of reference
+releasing/update-manifests-images): rewrites `:latest` on
+ghcr.io/kubeflow-tpu images in manifests/ to the tag in releasing/VERSION
+(or --tag).
+"""
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+IMAGE_RE = re.compile(r"(ghcr\.io/kubeflow-tpu/[\w.-]+):[\w.-]+")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tag", default=None)
+    ap.add_argument("--check", action="store_true",
+                    help="fail if any :latest remains (release gate)")
+    args = ap.parse_args(argv)
+    tag = args.tag or (ROOT / "releasing" / "VERSION").read_text().strip()
+
+    changed = 0
+    for path in sorted((ROOT / "manifests").rglob("*.yaml")):
+        text = path.read_text()
+        new = IMAGE_RE.sub(rf"\1:{tag}", text)
+        if new != text:
+            path.write_text(new)
+            changed += 1
+            print(f"pinned images in {path.relative_to(ROOT)} -> {tag}")
+    if args.check:
+        stale = [
+            str(p.relative_to(ROOT))
+            for p in (ROOT / "manifests").rglob("*.yaml")
+            if ":latest" in p.read_text()
+        ]
+        if stale:
+            print("ERROR: :latest images remain in", ", ".join(stale))
+            return 1
+    print(f"{changed} file(s) updated")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Deployment artifacts stay consistent: manifests parse, reference only
+services main.py provides, CRDs match the API layer's GVKs, and the release
+pinning script works."""
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import yaml
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+MANIFESTS = ROOT / "manifests"
+
+
+def _docs():
+    for path in sorted(MANIFESTS.rglob("*.yaml")):
+        with open(path) as f:
+            for doc in yaml.safe_load_all(f):
+                if doc:
+                    yield path.name, doc
+
+
+def test_manifests_parse_and_have_kinds():
+    docs = list(_docs())
+    assert len(docs) > 15
+    for name, doc in docs:
+        assert "kind" in doc and "apiVersion" in doc, name
+
+
+def test_deployment_commands_are_real_services():
+    import importlib
+
+    main = importlib.import_module("kubeflow_tpu.platform.main")
+    valid = {"controllers", "webhook", "jupyter", "volumes", "tensorboards",
+             "kfam", "dashboard"}
+    seen = set()
+    for name, doc in _docs():
+        if doc["kind"] != "Deployment":
+            continue
+        for container in doc["spec"]["template"]["spec"]["containers"]:
+            cmd = container.get("command", [])
+            if "kubeflow_tpu.platform.main" in cmd:
+                service = cmd[-1]
+                assert service in valid, f"{name}: unknown service {service}"
+                seen.add(service)
+    assert seen == valid  # every service has a Deployment
+
+
+def test_crds_match_api_layer():
+    from kubeflow_tpu.platform.k8s.types import (
+        NOTEBOOK, PODDEFAULT, PROFILE, TENSORBOARD,
+    )
+
+    by_plural = {}
+    for _, doc in _docs():
+        if doc["kind"] == "CustomResourceDefinition":
+            spec = doc["spec"]
+            by_plural[spec["names"]["plural"]] = (
+                spec["group"],
+                {v["name"] for v in spec["versions"] if v.get("served")},
+            )
+    for gvk in (NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD):
+        assert gvk.plural in by_plural, f"no CRD for {gvk.kind}"
+        group, versions = by_plural[gvk.plural]
+        assert group == gvk.group
+        assert gvk.version in versions
+
+
+def test_release_pinning_roundtrip(tmp_path):
+    # Copy manifests, pin to a tag, verify no :latest remains.
+    import shutil
+
+    work = tmp_path / "repo"
+    (work / "releasing").mkdir(parents=True)
+    shutil.copytree(MANIFESTS, work / "manifests")
+    shutil.copy(ROOT / "releasing" / "update-manifest-images.py",
+                work / "releasing" / "update-manifest-images.py")
+    (work / "releasing" / "VERSION").write_text("v9.9.9\n")
+    out = subprocess.run(
+        [sys.executable, str(work / "releasing" / "update-manifest-images.py"),
+         "--check"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    pinned = (work / "manifests" / "controllers.yaml").read_text()
+    assert "ghcr.io/kubeflow-tpu/platform:v9.9.9" in pinned
+
+
+def test_main_entrypoint_parses():
+    out = subprocess.run(
+        [sys.executable, "-m", "kubeflow_tpu.platform.main", "--help"],
+        capture_output=True, text=True, cwd=ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0
+    for service in ("controllers", "webhook", "dashboard"):
+        assert service in out.stdout
