@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 # Established on TPU v5e (single chip, bf16, batch 256, synthetic ImageNet
 # shapes) at round 1.  Update only with justification in BASELINE.md.
-BASELINE_IMAGES_PER_SEC = None  # set after first hardware measurement
+BASELINE_IMAGES_PER_SEC = 2538.49  # first hardware measurement, 2026-07-29
 
 BATCH = 256
 IMAGE = 224
@@ -50,12 +50,15 @@ def main() -> int:
     batch = (images, labels)
     for _ in range(WARMUP):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    # A scalar device→host fetch, not block_until_ready: on tunneled/async
+    # backends block_until_ready can return before execution completes, which
+    # inflates throughput ~60x (BASELINE.md).  float() forces the whole chain.
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(STEPS):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     ips = BATCH * STEPS / dt
