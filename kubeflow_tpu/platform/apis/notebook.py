@@ -36,6 +36,19 @@ ANNOTATION_HEADERS_REQUEST_SET = "notebooks.kubeflow.org/http-headers-request-se
 DEFAULT_PORT = 8888
 LABEL_NOTEBOOK_NAME = "notebook-name"
 
+GROUP = "kubeflow.org"
+HUB_VERSION = "v1beta1"
+# Served versions, oldest first.  v1beta1 is the hub/storage version (the
+# reference does the same, notebook-controller/api/v1/notebook_conversion.go:25-60,
+# where v1 and v1alpha1 are spokes converting through the v1beta1 hub).
+VERSIONS = ("v1alpha1", "v1", "v1beta1")
+# Legacy (v1alpha1/v1) representation of the TPU request: the GKE-idiomatic
+# chip limit on the main container plus annotations, instead of the
+# first-class spec.tpu block the hub version has.
+TPU_RESOURCE = "google.com/tpu"
+ANNOTATION_TPU_ACCELERATOR = "notebooks.kubeflow.org/tpu-accelerator"
+ANNOTATION_TPU_TOPOLOGY = "notebooks.kubeflow.org/tpu-topology"
+
 
 class ValidationError(ValueError):
     pass
@@ -84,6 +97,122 @@ def nb_prefix(namespace: str, name: str) -> str:
     return f"/notebook/{namespace}/{name}"
 
 
+# -- multi-version conversion (hub/spoke) ------------------------------------
+#
+# v1beta1 (hub):   spec.tpu: {accelerator, topology}
+# v1, v1alpha1:    chip limits on containers[0] + tpu annotations; v1alpha1
+#                  additionally has no containerState in status (mirrors the
+#                  reference's v1alpha1→v1beta1 status widening).
+
+
+class ConversionError(ValueError):
+    pass
+
+
+def version_of(notebook: Resource) -> str:
+    api_version = notebook.get("apiVersion", "")
+    group, _, version = api_version.partition("/")
+    if group != GROUP or version not in VERSIONS:
+        raise ConversionError(f"not a served Notebook apiVersion: {api_version!r}")
+    return version
+
+
+def _to_hub(notebook: Resource) -> Resource:
+    """Spoke → hub: lift annotation/limit TPU shape into spec.tpu."""
+    import copy
+
+    version = version_of(notebook)
+    nb = copy.deepcopy(notebook)
+    nb["apiVersion"] = f"{GROUP}/{HUB_VERSION}"
+    if version == HUB_VERSION:
+        return nb
+    annotations = deep_get(nb, "metadata", "annotations", default={}) or {}
+    accelerator = annotations.pop(ANNOTATION_TPU_ACCELERATOR, None)
+    topology = annotations.pop(ANNOTATION_TPU_TOPOLOGY, None)
+    containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
+    # Only lift the chip limit into spec.tpu when the accelerator annotation
+    # identifies the TPU generation; a bare google.com/tpu limit with no
+    # annotation stays as-is in the template rather than being dropped.
+    if accelerator and containers:
+        resources = containers[0].get("resources") or {}
+        limits = resources.get("limits") or {}
+        limits.pop(TPU_RESOURCE, None)
+        if not limits:
+            resources.pop("limits", None)
+        if not resources:
+            containers[0].pop("resources", None)
+    if accelerator:
+        tpu = {"accelerator": accelerator}
+        if topology:
+            tpu["topology"] = topology
+        nb.setdefault("spec", {})["tpu"] = tpu
+    if annotations == {}:
+        deep_get(nb, "metadata", default={}).pop("annotations", None)
+    return nb
+
+
+def _from_hub(notebook: Resource, version: str) -> Resource:
+    """Hub → spoke: lower spec.tpu into chip limits + annotations."""
+    import copy
+
+    if version not in VERSIONS:
+        raise ConversionError(f"unknown Notebook version {version!r}")
+    nb = copy.deepcopy(notebook)
+    nb["apiVersion"] = f"{GROUP}/{version}"
+    if version == HUB_VERSION:
+        return nb
+    tpu = (nb.get("spec") or {}).pop("tpu", None)
+    if tpu and tpu.get("accelerator"):
+        annotations = nb.setdefault("metadata", {}).setdefault("annotations", {})
+        annotations[ANNOTATION_TPU_ACCELERATOR] = tpu["accelerator"]
+        if tpu.get("topology"):
+            annotations[ANNOTATION_TPU_TOPOLOGY] = tpu["topology"]
+        try:
+            spec = slice_spec(tpu["accelerator"], tpu.get("topology"))
+        except ValueError:
+            spec = None
+        containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
+        if spec and containers:
+            containers[0].setdefault("resources", {}).setdefault("limits", {})[
+                TPU_RESOURCE
+            ] = str(spec.chips_per_pod)
+    if version == "v1alpha1":
+        (nb.get("status") or {}).pop("containerState", None)
+    return nb
+
+
+def convert(notebook: Resource, to_version: str) -> Resource:
+    """Convert a Notebook between served versions through the v1beta1 hub."""
+    return _from_hub(_to_hub(notebook), to_version)
+
+
+def convert_review(review: Resource) -> Resource:
+    """Handle an apiextensions ConversionReview (the CRD conversion webhook
+    body): convert request.objects to request.desiredAPIVersion."""
+    if not isinstance(review, dict):
+        review = {}
+    request = review.get("request") or {}
+    if not isinstance(request, dict):
+        request = {}
+    uid = request.get("uid", "")
+    desired = request.get("desiredAPIVersion", "")
+    _, _, version = str(desired).partition("/")
+    converted, result = [], {"status": "Success"}
+    try:
+        for obj in request.get("objects") or []:
+            if not isinstance(obj, dict):
+                raise ConversionError(f"object is not a Notebook: {obj!r:.80}")
+            converted.append(convert(obj, version))
+    except ConversionError as e:
+        result = {"status": "Failed", "message": str(e)}
+        converted = []
+    return {
+        "apiVersion": review.get("apiVersion", "apiextensions.k8s.io/v1"),
+        "kind": "ConversionReview",
+        "response": {"uid": uid, "result": result, "convertedObjects": converted},
+    }
+
+
 def crd_manifest() -> Resource:
     """The CustomResourceDefinition to install (structural schema kept
     permissive around the PodSpec, like the reference CRD)."""
@@ -99,40 +228,59 @@ def crd_manifest() -> Resource:
                 "singular": "notebook",
             },
             "scope": "Namespaced",
-            "versions": [
-                {
-                    "name": "v1beta1",
-                    "served": True,
-                    "storage": True,
-                    "subresources": {"status": {}},
-                    "schema": {
-                        "openAPIV3Schema": {
-                            "type": "object",
-                            "properties": {
-                                "spec": {
-                                    "type": "object",
-                                    "properties": {
-                                        "template": {
-                                            "type": "object",
-                                            "x-kubernetes-preserve-unknown-fields": True,
-                                        },
-                                        "tpu": {
-                                            "type": "object",
-                                            "properties": {
-                                                "accelerator": {"type": "string"},
-                                                "topology": {"type": "string"},
-                                            },
-                                        },
-                                    },
-                                },
-                                "status": {
-                                    "type": "object",
-                                    "x-kubernetes-preserve-unknown-fields": True,
-                                },
-                            },
+            "conversion": {
+                "strategy": "Webhook",
+                "webhook": {
+                    "conversionReviewVersions": ["v1"],
+                    "clientConfig": {
+                        # Matches the deployed Service (manifests/webhook.yaml:
+                        # kubeflow-tpu-webhook, port 443 → targetPort 4443).
+                        "service": {
+                            "name": "kubeflow-tpu-webhook",
+                            "namespace": "kubeflow",
+                            "path": "/convert",
+                            "port": 443,
                         }
                     },
-                }
+                },
+            },
+            "versions": [
+                _crd_version(v, storage=(v == HUB_VERSION)) for v in VERSIONS
             ],
+        },
+    }
+
+
+def _crd_version(name: str, *, storage: bool) -> dict:
+    spec_properties: dict = {
+        "template": {
+            "type": "object",
+            "x-kubernetes-preserve-unknown-fields": True,
+        },
+    }
+    if name == HUB_VERSION:
+        spec_properties["tpu"] = {
+            "type": "object",
+            "properties": {
+                "accelerator": {"type": "string"},
+                "topology": {"type": "string"},
+            },
+        }
+    return {
+        "name": name,
+        "served": True,
+        "storage": storage,
+        "subresources": {"status": {}},
+        "schema": {
+            "openAPIV3Schema": {
+                "type": "object",
+                "properties": {
+                    "spec": {"type": "object", "properties": spec_properties},
+                    "status": {
+                        "type": "object",
+                        "x-kubernetes-preserve-unknown-fields": True,
+                    },
+                },
+            }
         },
     }

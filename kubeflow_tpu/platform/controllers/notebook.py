@@ -33,6 +33,7 @@ from kubeflow_tpu.platform import config
 from kubeflow_tpu.platform.apis import notebook as nbapi
 from kubeflow_tpu.platform.k8s import errors
 from kubeflow_tpu.platform.k8s.types import (
+    EVENT,
     NOTEBOOK,
     POD,
     SERVICE,
@@ -109,6 +110,7 @@ class NotebookReconciler(Reconciler):
         if self.use_istio:
             self._reconcile_virtual_service(notebook)
         self._update_status(notebook, sts)
+        self._mirror_events(notebook)
         self._update_namespace_gauges(req.namespace)
         return None
 
@@ -376,6 +378,89 @@ class NotebookReconciler(Reconciler):
             return self.client.update(current)
         return current
 
+    # -- event mirroring -----------------------------------------------------
+
+    MIRROR_ANNOTATION = "notebooks.kubeflow.org/mirrored-from"
+
+    def _mirror_events(self, notebook: Resource) -> None:
+        """Re-emit Pod/StatefulSet Events onto the Notebook CR so users see
+        scheduling failures (FailedScheduling on TPU capacity, image pulls)
+        in the UI without inspecting pods — the reference does the same
+        (reference notebook_controller.go:94-118, event→notebook mapping
+        :608-644).  Idempotent: the mirror's deterministic name encodes the
+        source event uid + count, so re-reconciles hit AlreadyExists."""
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        created_ts = deep_get(notebook, "metadata", "creationTimestamp")
+        try:
+            events = self.client.list(EVENT, ns)
+        except errors.ApiError:
+            return
+        # The listing already contains previously-created mirrors (they
+        # involve the Notebook) — dedup locally instead of a guaranteed-409
+        # create per mirrored event on every reconcile.
+        existing = {
+            name_of(e)
+            for e in events
+            if (e.get("involvedObject") or {}).get("kind") == NOTEBOOK.kind
+        }
+        for ev in events:
+            if not _event_involves_notebook(ev, name):
+                continue
+            # Only events from this notebook's lifetime: a recreated
+            # notebook must not inherit its predecessor's failures.
+            # events.k8s.io-style events carry eventTime instead of the
+            # deprecated first/lastTimestamp; metadata.creationTimestamp is
+            # the final fallback so the filter can't be skipped entirely.
+            last_ts = (
+                ev.get("lastTimestamp")
+                or ev.get("firstTimestamp")
+                or ev.get("eventTime")
+                or deep_get(ev, "metadata", "creationTimestamp")
+                or ""
+            )
+            if created_ts and last_ts and last_ts[:19] < created_ts[:19]:
+                continue
+            src_uid = deep_get(ev, "metadata", "uid") or _content_hash(
+                [ev.get("reason"), ev.get("message"), last_ts]
+            )
+            mirror_name = f"{name}.{src_uid[:10]}.{ev.get('count', 1)}"
+            if mirror_name in existing:
+                continue
+            mirror = {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {
+                    "name": mirror_name,
+                    "namespace": ns,
+                    "annotations": {
+                        self.MIRROR_ANNOTATION: (
+                            f"{ev.get('involvedObject', {}).get('kind', '')}/"
+                            f"{ev.get('involvedObject', {}).get('name', '')}"
+                        )
+                    },
+                },
+                "involvedObject": {
+                    "apiVersion": f"{NOTEBOOK.group}/{NOTEBOOK.version}",
+                    "kind": NOTEBOOK.kind,
+                    "name": name,
+                    "namespace": ns,
+                    "uid": meta(notebook).get("uid", ""),
+                },
+                "reason": ev.get("reason", ""),
+                "message": ev.get("message", ""),
+                "type": ev.get("type", "Normal"),
+                "source": {"component": "notebook-controller"},
+                "firstTimestamp": ev.get("firstTimestamp", last_ts),
+                "lastTimestamp": last_ts,
+                "count": ev.get("count", 1),
+            }
+            try:
+                self.client.create(mirror)
+            except errors.AlreadyExists:
+                pass
+            except errors.ApiError:
+                continue
+
     # -- status --------------------------------------------------------------
 
     def _update_status(self, notebook: Resource, sts: Resource) -> None:
@@ -439,6 +524,34 @@ def pods_to_notebook_requests(obj: Resource) -> List[Request]:
     return [Request(deep_get(obj, "metadata", "namespace", default=""), nb)]
 
 
+def _event_involves_notebook(ev: Resource, name: str) -> bool:
+    io = ev.get("involvedObject") or {}
+    kind, obj_name = io.get("kind"), io.get("name", "")
+    if kind == "StatefulSet":
+        return obj_name == name
+    if kind == "Pod":
+        prefix, _, ordinal = obj_name.rpartition("-")
+        return prefix == name and ordinal.isdigit()
+    return False
+
+
+def events_to_notebook_requests(obj: Resource) -> List[Request]:
+    """Watch mapper: a k8s Event on a notebook pod/STS → the owning Notebook
+    (reference notebook_controller.go:608-644).  Pods named <nb>-<ordinal>
+    map by stripping the StatefulSet ordinal; non-notebook hits resolve to
+    NotFound in reconcile and are dropped there."""
+    ns = deep_get(obj, "metadata", "namespace", default="")
+    io = obj.get("involvedObject") or {}
+    kind, obj_name = io.get("kind"), io.get("name", "")
+    if kind == "StatefulSet":
+        return [Request(ns, obj_name)]
+    if kind == "Pod":
+        prefix, _, ordinal = obj_name.rpartition("-")
+        if prefix and ordinal.isdigit():
+            return [Request(ns, prefix)]
+    return []
+
+
 def make_controller(client, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
 
@@ -447,7 +560,10 @@ def make_controller(client, **kwargs):
         NotebookReconciler(client, **kwargs),
         primary=NOTEBOOK,
         owns=[STATEFULSET, SERVICE, VIRTUALSERVICE],
-        watches=[(POD, pods_to_notebook_requests)],
+        watches=[
+            (POD, pods_to_notebook_requests),
+            (EVENT, events_to_notebook_requests),
+        ],
         # Safety net for drift no watch covers (and for the REST client's
         # bounded watch windows): re-list the primaries periodically.
         resync_period=300.0,

@@ -40,7 +40,39 @@ class WebhookApp:
             return WsgiResponse("ok")
         if request.path == "/apply-poddefault" and request.method == "POST":
             return self.apply_poddefault(request)
+        if request.path == "/convert" and request.method == "POST":
+            return self.convert(request)
         return WsgiResponse("not found", status=404)
+
+    def convert(self, request: WsgiRequest) -> WsgiResponse:
+        """CRD conversion webhook for the multi-version Notebook CRD
+        (apis.notebook.convert_review; reference: hub/spoke conversion in
+        notebook-controller/api/v1/notebook_conversion.go:25-60, served by
+        controller-runtime's conversion webhook)."""
+        from kubeflow_tpu.platform.apis import notebook as nbapi
+
+        try:
+            review = json.loads(request.get_data(as_text=True))
+        except json.JSONDecodeError:
+            return WsgiResponse("bad json", status=400)
+        try:
+            out = nbapi.convert_review(review)
+        except Exception as e:
+            # Always answer with a ConversionReview (Failed), never a bare
+            # 500 — the API server surfaces the message to the client.
+            uid = ""
+            if isinstance(review, dict):
+                uid = (review.get("request") or {}).get("uid", "")
+            out = {
+                "apiVersion": "apiextensions.k8s.io/v1",
+                "kind": "ConversionReview",
+                "response": {
+                    "uid": uid,
+                    "result": {"status": "Failed", "message": str(e)},
+                    "convertedObjects": [],
+                },
+            }
+        return WsgiResponse(json.dumps(out), content_type="application/json")
 
     def apply_poddefault(self, request: WsgiRequest) -> WsgiResponse:
         if not (request.content_type or "").startswith("application/json"):

@@ -223,3 +223,81 @@ def test_invalid_topology_rejected_at_slice_math():
 
     with _pytest.raises(ValueError, match="does not pack"):
         slice_spec("v5e", "3x3")
+
+
+# -- event mirroring (reference notebook_controller.go:94-118, :608-644) ------
+
+
+def _pod_event(kube, pod_name, ns="user1", reason="FailedScheduling",
+               message="0/3 nodes available: insufficient google.com/tpu",
+               etype="Warning", count=1):
+    return kube.create({
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {"generateName": f"{pod_name}.", "namespace": ns},
+        "involvedObject": {"kind": "Pod", "name": pod_name, "namespace": ns},
+        "reason": reason, "message": message, "type": etype, "count": count,
+        "firstTimestamp": "2099-01-01T00:00:00Z",
+        "lastTimestamp": "2099-01-01T00:00:00Z",
+    })
+
+
+def test_pod_events_mirrored_onto_notebook(kube, reconciler):
+    from kubeflow_tpu.platform.k8s.types import EVENT
+
+    kube.create(make_notebook("nb", tpu={"accelerator": "v5e", "topology": "4x4"}))
+    reconcile(reconciler)
+    _pod_event(kube, "nb-1")
+    _pod_event(kube, "other-app-1")  # not this notebook
+    reconcile(reconciler)
+
+    mirrored = [
+        e for e in kube.list(EVENT, "user1")
+        if e["involvedObject"].get("kind") == "Notebook"
+        and e.get("reason") == "FailedScheduling"
+    ]
+    assert len(mirrored) == 1
+    ev = mirrored[0]
+    assert ev["involvedObject"]["name"] == "nb"
+    assert "google.com/tpu" in ev["message"]
+    assert ev["type"] == "Warning"
+    # Idempotent on re-reconcile: deterministic mirror names dedup.
+    reconcile(reconciler)
+    again = [
+        e for e in kube.list(EVENT, "user1")
+        if e["involvedObject"].get("kind") == "Notebook"
+        and e.get("reason") == "FailedScheduling"
+    ]
+    assert len(again) == 1
+
+
+def test_stale_events_from_before_creation_not_mirrored(kube, reconciler):
+    from kubeflow_tpu.platform.k8s.types import EVENT
+
+    ev = _pod_event(kube, "nb-0")
+    ev["firstTimestamp"] = ev["lastTimestamp"] = "2000-01-01T00:00:00Z"
+    kube.update(ev)
+    kube.create(make_notebook("nb"))
+    reconcile(reconciler)
+    assert not [
+        e for e in kube.list(EVENT, "user1")
+        if e["involvedObject"].get("kind") == "Notebook"
+        and e.get("reason") == "FailedScheduling"
+    ]
+
+
+def test_events_to_notebook_requests_mapper():
+    from kubeflow_tpu.platform.controllers.notebook import (
+        events_to_notebook_requests,
+    )
+
+    def ev(kind, name):
+        return {
+            "metadata": {"namespace": "user1"},
+            "involvedObject": {"kind": kind, "name": name},
+        }
+
+    assert events_to_notebook_requests(ev("Pod", "nb-12"))[0].name == "nb"
+    assert events_to_notebook_requests(ev("StatefulSet", "nb"))[0].name == "nb"
+    assert events_to_notebook_requests(ev("Notebook", "nb")) == []
+    assert events_to_notebook_requests(ev("Pod", "no-ordinal-x")) == []

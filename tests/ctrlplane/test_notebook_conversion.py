@@ -1,0 +1,195 @@
+"""Multi-version Notebook CRD conversion (hub/spoke through v1beta1).
+
+Role of reference notebook-controller/api/v1/notebook_conversion.go:25-60 —
+v1alpha1/v1 spokes convert through the v1beta1 hub; here the spokes carry
+the TPU request as chip limits + annotations, the hub as spec.tpu.
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+import pytest
+
+from kubeflow_tpu.platform.apis import notebook as nbapi
+
+
+def hub_notebook(tpu=True):
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "user1"},
+        "spec": {
+            "template": {
+                "spec": {"containers": [{"name": "nb", "image": "jupyter"}]}
+            },
+        },
+        "status": {
+            "readyReplicas": 1,
+            "containerState": {"running": {}},
+            "conditions": [{"type": "Ready", "status": "True"}],
+        },
+    }
+    if tpu:
+        nb["spec"]["tpu"] = {"accelerator": "v5e", "topology": "2x4"}
+    return nb
+
+
+def test_hub_to_v1_lowers_tpu_to_limits_and_annotations():
+    v1 = nbapi.convert(hub_notebook(), "v1")
+    assert v1["apiVersion"] == "kubeflow.org/v1"
+    assert "tpu" not in v1["spec"]
+    annotations = v1["metadata"]["annotations"]
+    assert annotations[nbapi.ANNOTATION_TPU_ACCELERATOR] == "v5e"
+    assert annotations[nbapi.ANNOTATION_TPU_TOPOLOGY] == "2x4"
+    limits = v1["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    # v5e 2x4 is single-host: all 8 chips on the one pod.
+    assert limits[nbapi.TPU_RESOURCE] == "8"
+
+
+def test_v1_roundtrip_is_lossless():
+    hub = hub_notebook()
+    back = nbapi.convert(nbapi.convert(hub, "v1"), "v1beta1")
+    assert back == hub
+
+
+def test_v1alpha1_drops_container_state_but_keeps_tpu():
+    a1 = nbapi.convert(hub_notebook(), "v1alpha1")
+    assert "containerState" not in a1["status"]
+    back = nbapi.convert(a1, "v1beta1")
+    assert back["spec"]["tpu"] == {"accelerator": "v5e", "topology": "2x4"}
+
+
+def test_no_tpu_roundtrip():
+    hub = hub_notebook(tpu=False)
+    v1 = nbapi.convert(hub, "v1")
+    assert "annotations" not in v1["metadata"]
+    assert nbapi.convert(v1, "v1beta1") == hub
+
+
+def test_convert_identity():
+    hub = hub_notebook()
+    assert nbapi.convert(hub, "v1beta1") == hub
+
+
+def test_convert_rejects_foreign_api_version():
+    nb = hub_notebook()
+    nb["apiVersion"] = "example.com/v1"
+    with pytest.raises(nbapi.ConversionError):
+        nbapi.convert(nb, "v1beta1")
+
+
+def test_conversion_review_success():
+    review = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "request": {
+            "uid": "u-1",
+            "desiredAPIVersion": "kubeflow.org/v1",
+            "objects": [hub_notebook(), hub_notebook(tpu=False)],
+        },
+    }
+    out = nbapi.convert_review(review)
+    assert out["kind"] == "ConversionReview"
+    assert out["response"]["uid"] == "u-1"
+    assert out["response"]["result"]["status"] == "Success"
+    objs = out["response"]["convertedObjects"]
+    assert len(objs) == 2
+    assert all(o["apiVersion"] == "kubeflow.org/v1" for o in objs)
+
+
+def test_conversion_review_failure_converts_nothing():
+    bad = hub_notebook()
+    bad["apiVersion"] = "example.com/v1"
+    review = {"request": {
+        "uid": "u-2",
+        "desiredAPIVersion": "kubeflow.org/v1beta1",
+        "objects": [hub_notebook(), bad],
+    }}
+    out = nbapi.convert_review(review)
+    assert out["response"]["result"]["status"] == "Failed"
+    assert out["response"]["convertedObjects"] == []
+
+
+def test_convert_endpoint_over_http():
+    import urllib.request
+
+    from kubeflow_tpu.platform.testing import FakeKube
+    from kubeflow_tpu.platform.webhook.server import WebhookServer
+
+    kube = FakeKube()
+    server = WebhookServer(kube, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        review = {"request": {
+            "uid": "u-3",
+            "desiredAPIVersion": "kubeflow.org/v1",
+            "objects": [hub_notebook()],
+        }}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/convert",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            out = json.loads(resp.read())
+        assert out["response"]["uid"] == "u-3"
+        converted = out["response"]["convertedObjects"][0]
+        assert converted["apiVersion"] == "kubeflow.org/v1"
+    finally:
+        server.stop()
+
+
+def test_crd_manifest_serves_all_versions_with_webhook_conversion():
+    crd = nbapi.crd_manifest()
+    versions = {v["name"]: v for v in crd["spec"]["versions"]}
+    assert set(versions) == set(nbapi.VERSIONS)
+    assert versions["v1beta1"]["storage"]
+    assert not versions["v1"]["storage"]
+    assert crd["spec"]["conversion"]["strategy"] == "Webhook"
+    assert crd["spec"]["conversion"]["webhook"]["clientConfig"]["service"][
+        "path"] == "/convert"
+
+
+def test_limit_only_tpu_request_preserved_without_annotation():
+    # GKE-idiomatic v1 shape: bare chip limit, no accelerator annotation.
+    # Conversion must not silently drop the limit (it stays in the template).
+    v1 = {
+        "apiVersion": "kubeflow.org/v1",
+        "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "user1"},
+        "spec": {"template": {"spec": {"containers": [{
+            "name": "nb", "image": "jupyter",
+            "resources": {"limits": {nbapi.TPU_RESOURCE: "8"}},
+        }]}}},
+    }
+    hub = nbapi.convert(v1, "v1beta1")
+    limits = hub["spec"]["template"]["spec"]["containers"][0]["resources"]["limits"]
+    assert limits[nbapi.TPU_RESOURCE] == "8"
+    assert "tpu" not in hub["spec"]
+
+
+def test_convert_endpoint_non_dict_body_returns_failed_review():
+    import urllib.request
+
+    from kubeflow_tpu.platform.testing import FakeKube
+    from kubeflow_tpu.platform.webhook.server import WebhookServer
+
+    kube = FakeKube()
+    server = WebhookServer(kube, host="127.0.0.1", port=0)
+    server.start()
+    try:
+        for body in (b"[]", b"null", b'{"request": {"objects": [42]}}'):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/convert",
+                data=body, headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out["kind"] == "ConversionReview"
+            # non-Notebook object → Failed; empty review → Success no-op
+            assert out["response"]["result"]["status"] in ("Success", "Failed")
+    finally:
+        server.stop()
