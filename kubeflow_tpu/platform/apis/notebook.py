@@ -97,6 +97,14 @@ def nb_prefix(namespace: str, name: str) -> str:
     return f"/notebook/{namespace}/{name}"
 
 
+def service_port_name(name: str) -> str:
+    """The per-notebook Service port name: http- prefix drives Istio
+    protocol selection (reference notebook_controller.go:438-465), capped at
+    the k8s 15-char port-name limit.  Shared by the Service generator and
+    the DEV-mode kubectl-proxy probe URL, which must agree."""
+    return f"http-{name}"[:15]
+
+
 # -- multi-version conversion (hub/spoke) ------------------------------------
 #
 # v1beta1 (hub):   spec.tpu: {accelerator, topology}
@@ -130,9 +138,10 @@ def _to_hub(notebook: Resource) -> Resource:
     accelerator = annotations.pop(ANNOTATION_TPU_ACCELERATOR, None)
     topology = annotations.pop(ANNOTATION_TPU_TOPOLOGY, None)
     containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
-    # Only lift the chip limit into spec.tpu when the accelerator annotation
-    # identifies the TPU generation; a bare google.com/tpu limit with no
-    # annotation stays as-is in the template rather than being dropped.
+    # Only strip the chip limit when the accelerator annotation identifies
+    # the TPU generation (the limit is then derivable from spec.tpu); a bare
+    # google.com/tpu limit with no annotation stays as-is in the template
+    # rather than being dropped.
     if accelerator and containers:
         resources = containers[0].get("resources") or {}
         limits = resources.get("limits") or {}
@@ -141,8 +150,13 @@ def _to_hub(notebook: Resource) -> Resource:
             resources.pop("limits", None)
         if not resources:
             containers[0].pop("resources", None)
-    if accelerator:
-        tpu = {"accelerator": accelerator}
+    # Partial annotations lift into a partial spec.tpu — the exact mirror of
+    # _from_hub lowering every spec.tpu field into annotations, so
+    # hub↔spoke conversion is lossless in both directions.
+    if accelerator or topology:
+        tpu = {}
+        if accelerator:
+            tpu["accelerator"] = accelerator
         if topology:
             tpu["topology"] = topology
         nb.setdefault("spec", {})["tpu"] = tpu
@@ -162,15 +176,21 @@ def _from_hub(notebook: Resource, version: str) -> Resource:
     if version == HUB_VERSION:
         return nb
     tpu = (nb.get("spec") or {}).pop("tpu", None)
-    if tpu and tpu.get("accelerator"):
+    if tpu and (tpu.get("accelerator") or tpu.get("topology")):
+        # Every spec.tpu field lowers into an annotation so hub→spoke→hub
+        # round-trips losslessly even for partial (topology-only) specs; the
+        # chip-limit lift additionally needs the accelerator to be known.
         annotations = nb.setdefault("metadata", {}).setdefault("annotations", {})
-        annotations[ANNOTATION_TPU_ACCELERATOR] = tpu["accelerator"]
+        if tpu.get("accelerator"):
+            annotations[ANNOTATION_TPU_ACCELERATOR] = tpu["accelerator"]
         if tpu.get("topology"):
             annotations[ANNOTATION_TPU_TOPOLOGY] = tpu["topology"]
-        try:
-            spec = slice_spec(tpu["accelerator"], tpu.get("topology"))
-        except ValueError:
-            spec = None
+        spec = None
+        if tpu.get("accelerator"):
+            try:
+                spec = slice_spec(tpu["accelerator"], tpu.get("topology"))
+            except ValueError:
+                spec = None
         containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
         if spec and containers:
             containers[0].setdefault("resources", {}).setdefault("limits", {})[
@@ -219,7 +239,14 @@ def crd_manifest() -> Resource:
     return {
         "apiVersion": "apiextensions.k8s.io/v1",
         "kind": "CustomResourceDefinition",
-        "metadata": {"name": "notebooks.kubeflow.org"},
+        "metadata": {
+            "name": "notebooks.kubeflow.org",
+            # cert-manager fills the conversion webhook caBundle in, same as
+            # manifests/crds/notebook.yaml — keep the two in sync.
+            "annotations": {
+                "cert-manager.io/inject-ca-from": "kubeflow/kubeflow-tpu-webhook",
+            },
+        },
         "spec": {
             "group": "kubeflow.org",
             "names": {

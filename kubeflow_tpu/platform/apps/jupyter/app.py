@@ -106,6 +106,15 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
             raise HttpError(404, f"no pods for notebook {name}")
         return success({"pod": pods[0]})
 
+    @app.route("/api/namespaces/<ns>/notebooks/<name>/pod/<pod>/logs")
+    def get_pod_logs(request: Request, ns: str, name: str, pod: str):
+        """Container logs for one worker pod (reference get.py:99-105); the
+        container is named after the notebook, as generate_statefulset
+        defaults it."""
+        user = current_user(request)
+        logs = backend.pod_logs(user, pod, ns, container=name)
+        return success({"logs": logs.split("\n")})
+
     @app.route("/api/namespaces/<ns>/notebooks/<name>/events")
     def get_notebook_events(request: Request, ns: str, name: str):
         user = current_user(request)

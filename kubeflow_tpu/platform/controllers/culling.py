@@ -72,16 +72,26 @@ class CullingReconciler(Reconciler):
             else config.env_float("IDLENESS_CHECK_PERIOD", 1.0)
         )
         self.cluster_domain = cluster_domain or config.env("CLUSTER_DOMAIN", "cluster.local")
+        self.dev = config.env_bool("DEV", False)
         self._now = now or (lambda: datetime.datetime.now(datetime.timezone.utc))
 
     # -- probe url -----------------------------------------------------------
 
     def kernels_url(self, namespace: str, name: str) -> str:
         # Through the per-notebook Service (port 80 → worker 0), under the
-        # NB_PREFIX base path the server runs with.
+        # NB_PREFIX base path the server runs with.  DEV mode reaches the
+        # Service through a local kubectl proxy instead of cluster DNS
+        # (reference culling_controller.go:211-216).
+        prefix = nbapi.nb_prefix(namespace, name)
+        if self.dev:
+            port_name = nbapi.service_port_name(name)
+            return (
+                f"http://localhost:8001/api/v1/namespaces/{namespace}"
+                f"/services/{name}:{port_name}/proxy{prefix}/api/kernels"
+            )
         return (
             f"http://{name}.{namespace}.svc.{self.cluster_domain}"
-            f"{nbapi.nb_prefix(namespace, name)}/api/kernels"
+            f"{prefix}/api/kernels"
         )
 
     # -- reconcile -----------------------------------------------------------

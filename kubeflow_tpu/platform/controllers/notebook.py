@@ -62,7 +62,8 @@ class NotebookReconciler(Reconciler):
     def __init__(self, client, *, use_istio: Optional[bool] = None,
                  istio_gateway: Optional[str] = None,
                  cluster_domain: Optional[str] = None,
-                 add_fsgroup: Optional[bool] = None):
+                 add_fsgroup: Optional[bool] = None,
+                 mirror_min_interval: Optional[float] = None):
         self.client = client
         self.recorder = EventRecorder(client, "notebook-controller")
         self.use_istio = (
@@ -75,6 +76,13 @@ class NotebookReconciler(Reconciler):
         self.add_fsgroup = (
             add_fsgroup if add_fsgroup is not None else config.env_bool("ADD_FSGROUP", True)
         )
+        # (ns, name) -> monotonic time of the last event-mirroring pass.
+        self._mirror_last: Dict[tuple, float] = {}
+        self.mirror_min_interval = (
+            mirror_min_interval
+            if mirror_min_interval is not None
+            else self.MIRROR_MIN_INTERVAL_SECONDS
+        )
 
     # -- reconcile -----------------------------------------------------------
 
@@ -85,6 +93,7 @@ class NotebookReconciler(Reconciler):
             # ownerReference GC tears down children; refresh the gauges so a
             # deleted notebook's chips don't linger in the metrics.
             self._update_namespace_gauges(req.namespace)
+            self._mirror_last.pop((req.namespace, req.name), None)
             return None
 
         # Invalid specs (bad TPU topology etc.) are terminal user errors:
@@ -270,9 +279,7 @@ class NotebookReconciler(Reconciler):
                 "type": "ClusterIP",
                 "selector": selector,
                 "ports": [{
-                    # http- prefix drives Istio protocol selection (the
-                    # reference relies on the same convention, :438-465).
-                    "name": f"http-{name}"[:15],
+                    "name": nbapi.service_port_name(name),
                     "port": 80,
                     "targetPort": port,
                     "protocol": "TCP",
@@ -381,6 +388,12 @@ class NotebookReconciler(Reconciler):
     # -- event mirroring -----------------------------------------------------
 
     MIRROR_ANNOTATION = "notebooks.kubeflow.org/mirrored-from"
+    # Event mirroring lists every Event in the namespace; during the event
+    # storms it exists to surface (FailedScheduling on exhausted TPU
+    # capacity) each event also triggers a reconcile, which would make the
+    # listing O(events²) across the storm.  Bound it: at most one mirroring
+    # pass per notebook per window.
+    MIRROR_MIN_INTERVAL_SECONDS = 10.0
 
     def _mirror_events(self, notebook: Resource) -> None:
         """Re-emit Pod/StatefulSet Events onto the Notebook CR so users see
@@ -390,6 +403,11 @@ class NotebookReconciler(Reconciler):
         :608-644).  Idempotent: the mirror's deterministic name encodes the
         source event uid + count, so re-reconciles hit AlreadyExists."""
         ns, name = meta(notebook)["namespace"], name_of(notebook)
+        now = time.monotonic()
+        last = self._mirror_last.get((ns, name))
+        if last is not None and now - last < self.mirror_min_interval:
+            return  # the periodic resync guarantees a later pass
+        self._mirror_last[(ns, name)] = now
         created_ts = deep_get(notebook, "metadata", "creationTimestamp")
         try:
             events = self.client.list(EVENT, ns)
@@ -399,7 +417,7 @@ class NotebookReconciler(Reconciler):
         # involve the Notebook) — dedup locally instead of a guaranteed-409
         # create per mirrored event on every reconcile.
         existing = {
-            name_of(e)
+            name_of(e): e
             for e in events
             if (e.get("involvedObject") or {}).get("kind") == NOTEBOOK.kind
         }
@@ -423,8 +441,22 @@ class NotebookReconciler(Reconciler):
             src_uid = deep_get(ev, "metadata", "uid") or _content_hash(
                 [ev.get("reason"), ev.get("message"), last_ts]
             )
-            mirror_name = f"{name}.{src_uid[:10]}.{ev.get('count', 1)}"
-            if mirror_name in existing:
+            # One mirror per source event; count bumps on a recurring source
+            # (FailedScheduling retries) update the mirror in place instead
+            # of minting a new Event per bump.
+            mirror_name = f"{name}.{src_uid[:10]}"
+            prior = existing.get(mirror_name)
+            if prior is not None:
+                if (prior.get("count", 1), prior.get("lastTimestamp")) != (
+                    ev.get("count", 1), last_ts,
+                ):
+                    prior = copy.deepcopy(prior)
+                    prior["count"] = ev.get("count", 1)
+                    prior["lastTimestamp"] = last_ts
+                    try:
+                        self.client.update(prior)
+                    except errors.ApiError:
+                        pass
                 continue
             mirror = {
                 "apiVersion": "v1",

@@ -36,6 +36,7 @@ from kubeflow_tpu.platform.k8s.types import (
     set_owner,
 )
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
+from kubeflow_tpu.platform.runtime import metrics
 
 OWNER_ANNOTATION = "owner"
 FINALIZER = "profile-finalizer"
@@ -139,6 +140,7 @@ class ProfileReconciler(Reconciler):
         userid_header: Optional[str] = None,
         userid_prefix: Optional[str] = None,
         default_namespace_labels: Optional[Dict[str, str]] = None,
+        default_namespace_labels_path: Optional[str] = None,
         plugins: Optional[List[ProfilePlugin]] = None,
         notebook_controller_sa: str = "system:serviceaccount:kubeflow:notebook-controller-service-account",
     ):
@@ -148,6 +150,7 @@ class ProfileReconciler(Reconciler):
         self.userid_prefix = (
             userid_prefix if userid_prefix is not None else config.env("USERID_PREFIX", "")
         )
+        self.labels_path = default_namespace_labels_path
         self.default_labels = default_namespace_labels or {
             "istio-injection": "enabled",
             "app.kubernetes.io/part-of": "kubeflow-profile",
@@ -176,21 +179,54 @@ class ProfileReconciler(Reconciler):
             meta(profile).setdefault("finalizers", []).append(FINALIZER)
             profile = self.client.update(profile)
 
-        if not self._reconcile_namespace(profile):
+        if not self._counted("namespace", self._reconcile_namespace, profile):
             return None  # ownership conflict surfaced on status
-        self._reconcile_service_accounts(profile)
-        self._reconcile_role_bindings(profile)
-        self._reconcile_authorization_policy(profile)
-        self._reconcile_resource_quota(profile)
-        self._apply_plugins(profile)
+        self._counted("serviceaccount", self._reconcile_service_accounts, profile)
+        self._counted("rolebinding", self._reconcile_role_bindings, profile)
+        self._counted("authorizationpolicy", self._reconcile_authorization_policy, profile)
+        self._counted("resourcequota", self._reconcile_resource_quota, profile)
+        self._counted("plugin", self._apply_plugins, profile)
         self._set_ready(profile)
         return None
 
+    def _counted(self, kind: str, fn, *args):
+        """Per-kind request/failure counters around each reconcile step
+        (reference monitoring.go:28-44 IncRequestCounter pattern)."""
+        try:
+            result = fn(*args)
+        except Exception:
+            metrics.request_kf_failure.labels(
+                component="profile", kind=kind, severity=metrics.SEVERITY_MAJOR
+            ).inc()
+            raise
+        metrics.request_kf.labels(component="profile", kind=kind).inc()
+        return result
+
     # -- namespace -----------------------------------------------------------
+
+    def _current_default_labels(self) -> Dict[str, str]:
+        """Default namespace labels, re-read from the mounted file on every
+        reconcile when a path is configured — paired with the mtime watcher
+        in make_controller this gives the reference's hot-reload semantics
+        (reference profile_controller.go:368-399, :762-777)."""
+        if self.labels_path:
+            import yaml
+
+            try:
+                with open(self.labels_path) as f:
+                    data = yaml.safe_load(f) or {}
+            except (OSError, yaml.YAMLError):
+                # A bad config edit must not wedge every Profile reconcile;
+                # fall back to the static defaults until the file is fixed.
+                return dict(self.default_labels)
+            if isinstance(data, dict):
+                return {str(k): str(v) for k, v in data.items()}
+        return dict(self.default_labels)
 
     def _reconcile_namespace(self, profile: Resource) -> bool:
         name = name_of(profile)
         owner = deep_get(profile, "spec", "owner", "name", default="")
+        default_labels = self._current_default_labels()
         try:
             ns = self.client.get(NAMESPACE, name)
         except errors.NotFound:
@@ -200,7 +236,7 @@ class ProfileReconciler(Reconciler):
                 "metadata": {
                     "name": name,
                     "annotations": {OWNER_ANNOTATION: owner},
-                    "labels": dict(self.default_labels),
+                    "labels": dict(default_labels),
                 },
             }
             set_owner(ns, profile)
@@ -223,7 +259,7 @@ class ProfileReconciler(Reconciler):
             return False
         changed = False
         labels = meta(ns).setdefault("labels", {})
-        for k, v in self.default_labels.items():
+        for k, v in default_labels.items():
             if labels.get(k) != v:
                 labels[k] = v
                 changed = True
@@ -395,12 +431,51 @@ class ProfileReconciler(Reconciler):
             self.client.update_status(profile)
 
 
-def make_controller(client, **kwargs):
+def labels_file_watcher(path: str, *, poll_seconds: float = 1.0):
+    """Controller runnable: poll the namespace-labels file's mtime and
+    trigger a reconcile of every Profile when it changes — the fsnotify
+    watch + reconcile-all of the reference (profile_controller.go:368-399).
+    mtime polling also covers the ConfigMap symlink-swap dance the
+    reference handles via Remove+re-Add."""
+    import os
+
+    def run(controller) -> None:
+        from kubeflow_tpu.platform.runtime import Request as Req
+
+        def stat():
+            try:
+                st = os.stat(path)
+                return (st.st_mtime_ns, st.st_ino)
+            except OSError:
+                return None
+
+        last = stat()
+        while not controller._stop.wait(poll_seconds):
+            now = stat()
+            if now != last:
+                last = now
+                try:
+                    for p in controller.reconciler.client.list(PROFILE):
+                        controller.queue.add(Req("", name_of(p)))
+                except Exception:
+                    pass  # transient list failure; next change retries
+
+    return run
+
+
+def make_controller(client, *, heartbeat: bool = False, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
 
+    reconciler = ProfileReconciler(client, **kwargs)
+    runnables = []
+    if reconciler.labels_path:
+        runnables.append(labels_file_watcher(reconciler.labels_path))
+    if heartbeat:
+        metrics.start_heartbeat("profile")
     return Controller(
         "profile-controller",
-        ProfileReconciler(client, **kwargs),
+        reconciler,
         primary=PROFILE,
         resync_period=300.0,
+        runnables=runnables,
     )

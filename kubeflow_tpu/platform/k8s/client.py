@@ -82,6 +82,10 @@ class KubeClient(Protocol):
         subresource: str = "",
     ) -> bool: ...
 
+    def pod_logs(
+        self, name: str, namespace: str, *, container: Optional[str] = None
+    ) -> str: ...
+
 
 def _selector_string(label_selector: Optional[Dict[str, str]]) -> Optional[str]:
     if not label_selector:
@@ -266,6 +270,13 @@ class RestKubeClient:
                 yield evt.get("type", ""), evt.get("object", {})
         finally:
             resp.close()
+
+    def pod_logs(self, name, namespace, *, container=None) -> str:
+        """GET .../pods/<name>/log — the reference JWA logs endpoint's
+        backing call (reference crud_backend/api/pod.py:11-15)."""
+        params = {"container": container} if container else None
+        path = f"/api/v1/namespaces/{namespace}/pods/{name}/log"
+        return self._request("GET", path, params=params).text
 
     def can_i(self, user, verb, gvk, namespace=None, *, groups=None, subresource="") -> bool:
         review = {

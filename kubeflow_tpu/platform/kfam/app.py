@@ -21,11 +21,31 @@ from kubeflow_tpu.platform.web.crud_backend import (
 from kubeflow_tpu.platform.web.framework import App, HttpError, success
 
 
-def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
+               heartbeat: bool = False) -> App:
+    from kubeflow_tpu.platform.runtime import metrics
+
     app = App("kfam")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
     manager = BindingManager(client)
+    if heartbeat:
+        metrics.start_heartbeat("kfam")
+
+    def counted(kind: str, fn, *args):
+        """request_kf/request_kf_failure around each mutation, same
+        monitoring surface as the reference (kfam/monitoring.go)."""
+        try:
+            result = fn(*args)
+        except HttpError:
+            raise  # client errors aren't service failures
+        except Exception:
+            metrics.request_kf_failure.labels(
+                component="kfam", kind=kind, severity=metrics.SEVERITY_MAJOR
+            ).inc()
+            raise
+        metrics.request_kf.labels(component="kfam", kind=kind).inc()
+        return result
 
     def _require_admin(user: str, namespace: str) -> None:
         if manager.is_owner(user, namespace) or manager.is_cluster_admin(user):
@@ -56,10 +76,16 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
             request.get_json(force=True, silent=True) or {}
         )
         _require_admin(caller, namespace)
-        try:
-            manager.create_binding(user, namespace, role)
-        except ValueError as e:
-            raise HttpError(400, str(e)) from None
+
+        def create():
+            # ValueError is a client error (bad role) → 400 before counted()
+            # can misclassify it as a service failure.
+            try:
+                manager.create_binding(user, namespace, role)
+            except ValueError as e:
+                raise HttpError(400, str(e)) from None
+
+        counted("binding", create)
         return success()
 
     @app.route("/kfam/v1/bindings", methods=["DELETE"])
@@ -69,7 +95,7 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
             request.get_json(force=True, silent=True) or {}
         )
         _require_admin(caller, namespace)
-        manager.delete_binding(user, namespace, role)
+        counted("binding", manager.delete_binding, user, namespace, role)
         return success()
 
     @app.route("/kfam/v1/profiles", methods=["POST"])
@@ -87,14 +113,14 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
             raise HttpError(
                 403, "only cluster admins may create profiles for other users"
             )
-        manager.create_profile(name, owner or caller)
+        counted("profile", manager.create_profile, name, owner or caller)
         return success()
 
     @app.route("/kfam/v1/profiles/<name>", methods=["DELETE"])
     def delete_profile(request: Request, name: str):
         caller = current_user(request)
         _require_admin(caller, name)
-        manager.delete_profile(name)
+        counted("profile", manager.delete_profile, name)
         return success()
 
     @app.route("/kfam/v1/role/clusteradmin")

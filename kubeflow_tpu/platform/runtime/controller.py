@@ -138,6 +138,7 @@ class Controller:
         namespace: Optional[str] = None,
         resync_period: Optional[float] = None,
         workers: int = 1,
+        runnables: Optional[List[Callable[["Controller"], None]]] = None,
     ):
         self.name = name
         self.reconciler = reconciler
@@ -147,6 +148,11 @@ class Controller:
         self.namespace = namespace
         self.resync_period = resync_period
         self.workers = workers
+        # Extra daemon loops sharing the controller's lifecycle (the
+        # controller-runtime Runnable idea) — e.g. config-file watchers that
+        # enqueue reconciles.  Each receives the controller and should exit
+        # when controller._stop is set.
+        self.runnables = runnables or []
         self.queue = make_workqueue()
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
@@ -234,6 +240,13 @@ class Controller:
         for i in range(self.workers):
             t = threading.Thread(
                 target=self._worker, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        for i, fn in enumerate(self.runnables):
+            t = threading.Thread(
+                target=fn, args=(self,),
+                name=f"{self.name}-runnable-{i}", daemon=True,
             )
             t.start()
             self._threads.append(t)

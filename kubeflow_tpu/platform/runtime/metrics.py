@@ -50,6 +50,57 @@ reconcile_errors_total = Counter(
     registry=registry,
 )
 
+# Profile-controller/KFAM monitoring pattern (reference
+# profile-controller/controllers/monitoring.go:28-60, kfam/monitoring.go):
+# per-kind request counters, severity-labelled failure counters, and a
+# liveness heartbeat incremented on a fixed cadence.
+SEVERITY_MINOR = "minor"
+SEVERITY_MAJOR = "major"
+SEVERITY_CRITICAL = "critical"
+
+request_kf = Counter(
+    "request_kf",
+    "Requests handled, by component and resource kind",
+    ["component", "kind"],
+    registry=registry,
+)
+request_kf_failure = Counter(
+    "request_kf_failure",
+    "Failed requests, by component, resource kind, and severity",
+    ["component", "kind", "severity"],
+    registry=registry,
+)
+service_heartbeat = Counter(
+    "service_heartbeat",
+    "Heartbeat signal on a fixed cadence indicating the service is alive",
+    ["component", "severity"],
+    registry=registry,
+)
+
+_heartbeats = {}
+
+
+def start_heartbeat(component: str, *, interval: float = 10.0):
+    """Tick service_heartbeat{component} every ``interval`` seconds from a
+    daemon thread (reference monitoring.go:47-60).  Idempotent per
+    component; returns the stop Event."""
+    import threading
+
+    if component in _heartbeats:
+        return _heartbeats[component]
+    stop = threading.Event()
+
+    def tick():
+        counter = service_heartbeat.labels(
+            component=component, severity=SEVERITY_CRITICAL
+        )
+        while not stop.wait(interval):
+            counter.inc()
+
+    threading.Thread(target=tick, name=f"heartbeat-{component}", daemon=True).start()
+    _heartbeats[component] = stop
+    return stop
+
 
 def render() -> bytes:
     return generate_latest(registry)

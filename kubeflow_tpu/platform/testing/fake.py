@@ -60,6 +60,8 @@ class FakeKube:
         self._now = now or time.time
         # SubjectAccessReview policy: (user, verb, gvk, namespace) -> bool.
         self.authz_policy: Optional[Callable[..., bool]] = None
+        # (namespace, pod, container|None) -> log text (see set_pod_logs).
+        self._pod_logs: Dict[Tuple[str, str, Optional[str]], str] = {}
 
     # -- helpers -------------------------------------------------------------
 
@@ -267,6 +269,11 @@ class FakeKube:
             groups=groups or [], subresource=subresource,
         )
 
+    def pod_logs(self, name, namespace, *, container=None) -> str:
+        self._get_ref(POD, name, namespace)  # NotFound if the pod is gone
+        return self._pod_logs.get((namespace, name, container)) or \
+            self._pod_logs.get((namespace, name, None), "")
+
     # -- internals -----------------------------------------------------------
 
     def _check_rv(self, incoming: Resource, current: Resource) -> None:
@@ -310,6 +317,12 @@ class FakeKube:
                 "allocatable": {"google.com/tpu": str(chips)},
             },
         })
+
+    def set_pod_logs(self, namespace: str, name: str, logs: str,
+                     *, container: Optional[str] = None) -> None:
+        """Stub the kubelet log endpoint for a pod (container=None is the
+        default-container fallback)."""
+        self._pod_logs[(namespace, name, container)] = logs
 
     def set_pod_phase(self, namespace: str, name: str, phase: str, *,
                       ready: Optional[bool] = None,

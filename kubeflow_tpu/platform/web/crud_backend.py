@@ -61,13 +61,15 @@ class CrudBackend:
 
     # -- authz gate ----------------------------------------------------------
 
-    def ensure(self, user: str, verb: str, gvk: GVK, namespace: Optional[str] = None):
+    def ensure(self, user: str, verb: str, gvk: GVK, namespace: Optional[str] = None,
+               subresource: str = ""):
         if self.auth.disable_auth:
             return
-        if not self.client.can_i(user, verb, gvk, namespace):
+        if not self.client.can_i(user, verb, gvk, namespace, subresource=subresource):
             raise HttpError(
                 403,
                 f"user {user!r} cannot {verb} {gvk.plural}"
+                + (f"/{subresource}" if subresource else "")
                 + (f" in namespace {namespace}" if namespace else ""),
             )
 
@@ -94,6 +96,14 @@ class CrudBackend:
     def delete_resource(self, user, gvk, name, namespace=None):
         self.ensure(user, "delete", gvk, namespace)
         return self.client.delete(gvk, name, namespace)
+
+    def pod_logs(self, user, name, namespace, *, container=None) -> str:
+        """Authz on the pods/log subresource, exactly like the reference
+        (reference crud_backend/api/pod.py:11-15)."""
+        from kubeflow_tpu.platform.k8s.types import POD
+
+        self.ensure(user, "get", POD, namespace, subresource="log")
+        return self.client.pod_logs(name, namespace, container=container)
 
 
 CSRF_COOKIE = "XSRF-TOKEN"

@@ -93,3 +93,18 @@ def test_probe_url_targets_worker0_service():
     assert r.kernels_url("user1", "nb") == (
         "http://nb.user1.svc.cluster.local/notebook/user1/nb/api/kernels"
     )
+
+
+def test_probe_url_dev_mode_uses_kubectl_proxy(monkeypatch):
+    # DEV != "false" probes through a local kubectl proxy instead of cluster
+    # DNS (reference culling_controller.go:211-216).
+    monkeypatch.setenv("DEV", "true")
+    r = CullingReconciler(FakeKube(), prober=lambda url: [])
+    url = r.kernels_url("user1", "nb")
+    assert url == (
+        "http://localhost:8001/api/v1/namespaces/user1/services/nb:http-nb"
+        "/proxy/notebook/user1/nb/api/kernels"
+    )
+    monkeypatch.delenv("DEV")
+    r = CullingReconciler(FakeKube(), prober=lambda url: [])
+    assert url != r.kernels_url("user1", "nb")

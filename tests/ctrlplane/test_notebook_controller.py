@@ -50,7 +50,10 @@ def kube():
 
 @pytest.fixture
 def reconciler(kube):
-    return NotebookReconciler(kube, use_istio=True, add_fsgroup=True)
+    # mirror_min_interval=0: tests reconcile back-to-back and assert on
+    # mirrors immediately; the storm throttle is covered separately.
+    return NotebookReconciler(kube, use_istio=True, add_fsgroup=True,
+                              mirror_min_interval=0)
 
 
 def reconcile(reconciler, name="nb", ns="user1"):
@@ -301,3 +304,57 @@ def test_events_to_notebook_requests_mapper():
     assert events_to_notebook_requests(ev("StatefulSet", "nb"))[0].name == "nb"
     assert events_to_notebook_requests(ev("Notebook", "nb")) == []
     assert events_to_notebook_requests(ev("Pod", "no-ordinal-x")) == []
+
+
+def test_event_mirroring_throttled_between_reconciles(kube):
+    # During an event storm every Event triggers a reconcile; the mirror
+    # pass must not re-list the namespace each time (O(events^2)).
+    r = NotebookReconciler(kube, use_istio=True, mirror_min_interval=3600)
+    kube.create(make_notebook("nb"))
+    reconcile(r)
+    _pod_event(kube, "nb-0")
+    reconcile(r)  # within the window: no mirroring pass
+    from kubeflow_tpu.platform.k8s.types import EVENT
+    mirrored = [e for e in kube.list(EVENT, "user1")
+                if e["involvedObject"].get("kind") == "Notebook"
+                and e.get("reason") == "FailedScheduling"]
+    assert mirrored == []
+    r.mirror_min_interval = 0
+    reconcile(r)
+    mirrored = [e for e in kube.list(EVENT, "user1")
+                if e["involvedObject"].get("kind") == "Notebook"
+                and e.get("reason") == "FailedScheduling"]
+    assert len(mirrored) == 1
+
+
+def test_recurring_event_count_updates_mirror_in_place(kube, reconciler):
+    # A FailedScheduling retry bumps count on the source event; the mirror
+    # updates in place instead of minting a new Event per bump.
+    from kubeflow_tpu.platform.k8s.types import EVENT
+
+    kube.create(make_notebook("nb"))
+    reconcile(reconciler)
+    src = _pod_event(kube, "nb-0")
+    reconcile(reconciler)
+    src["count"] = 7
+    src["lastTimestamp"] = "2099-01-01T00:05:00Z"
+    kube.update(src)
+    reconcile(reconciler)
+    mirrored = [e for e in kube.list(EVENT, "user1")
+                if e["involvedObject"].get("kind") == "Notebook"
+                and e.get("reason") == "FailedScheduling"]
+    assert len(mirrored) == 1
+    assert mirrored[0]["count"] == 7
+    assert mirrored[0]["lastTimestamp"] == "2099-01-01T00:05:00Z"
+
+
+def test_topology_only_conversion_roundtrip():
+    # Partial spec.tpu survives hub->spoke->hub (lossless both ways).
+    from kubeflow_tpu.platform.apis import notebook as nbapi
+
+    hub = make_notebook("nb", tpu={"topology": "2x4"})
+    spoke = nbapi.convert(hub, "v1")
+    assert spoke["metadata"]["annotations"][
+        "notebooks.kubeflow.org/tpu-topology"] == "2x4"
+    back = nbapi.convert(spoke, "v1beta1")
+    assert back["spec"]["tpu"] == {"topology": "2x4"}

@@ -125,3 +125,76 @@ def test_idempotent(kube):
     reconcile(kube)
     rv2 = kube.get(RESOURCEQUOTA, QUOTA_NAME, "alice")["metadata"]["resourceVersion"]
     assert rv1 == rv2
+
+
+def test_namespace_labels_hot_reload(kube, tmp_path):
+    # Labels come from the mounted file, re-read each reconcile (reference
+    # profile_controller.go:368-399 fsnotify + :762-777 file loader).
+    labels = tmp_path / "namespace-labels.yaml"
+    labels.write_text("istio-injection: enabled\n")
+    kube.create(make_profile())
+    r = ProfileReconciler(kube, default_namespace_labels_path=str(labels))
+    r.reconcile(Request("", "alice"))
+    ns = kube.get(NAMESPACE, "alice")
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+    assert "team" not in ns["metadata"]["labels"]
+
+    labels.write_text("istio-injection: enabled\nteam: ml\n")
+    r.reconcile(Request("", "alice"))
+    ns = kube.get(NAMESPACE, "alice")
+    assert ns["metadata"]["labels"]["team"] == "ml"
+
+
+def test_labels_file_watcher_triggers_reconcile_all(kube, tmp_path):
+    import time
+
+    from kubeflow_tpu.platform.controllers.profile import make_controller
+
+    labels = tmp_path / "namespace-labels.yaml"
+    labels.write_text("a: b\n")
+    kube.create(make_profile())
+    c = make_controller(
+        kube, default_namespace_labels_path=str(labels)
+    )
+    # Shrink the poll for the test.
+    c.runnables = [
+        __import__("kubeflow_tpu.platform.controllers.profile", fromlist=["x"])
+        .labels_file_watcher(str(labels), poll_seconds=0.05)
+    ]
+    c.start(kube)
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                ns = kube.get(NAMESPACE, "alice")
+                break
+            except errors.NotFound:
+                time.sleep(0.02)
+        ns = kube.get(NAMESPACE, "alice")
+        assert ns["metadata"]["labels"].get("a") == "b"
+        # Change the file; the watcher must re-reconcile and apply new labels.
+        time.sleep(0.1)
+        labels.write_text("a: b\nc: d\n")
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            ns = kube.get(NAMESPACE, "alice")
+            if ns["metadata"]["labels"].get("c") == "d":
+                break
+            time.sleep(0.02)
+        assert ns["metadata"]["labels"].get("c") == "d"
+    finally:
+        c.stop()
+
+
+def test_request_counters_incremented(kube):
+    from kubeflow_tpu.platform.runtime import metrics
+
+    before = metrics.request_kf.labels(
+        component="profile", kind="resourcequota"
+    )._value.get()
+    kube.create(make_profile(quota={"hard": {"google.com/tpu": "16"}}))
+    reconcile(kube)
+    after = metrics.request_kf.labels(
+        component="profile", kind="resourcequota"
+    )._value.get()
+    assert after == before + 1

@@ -292,3 +292,27 @@ def test_duplicate_spawn_is_409(jwa):
     )
     assert r.status_code == 409
     assert "already exists" in r.json()["log"]
+
+
+def test_jwa_pod_logs(jwa, kube):
+    # Reference get.py:99-105 — logs of one worker pod, split into lines,
+    # container named after the notebook; authz on the pods/log subresource.
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "mynb-0", "namespace": "user1",
+                     "labels": {"notebook-name": "mynb"}},
+        "spec": {"containers": [{"name": "mynb"}]},
+    })
+    kube.set_pod_logs("user1", "mynb-0", "line1\nline2", container="mynb")
+    r = http.get(
+        f"{jwa}/api/namespaces/user1/notebooks/mynb/pod/mynb-0/logs",
+        headers=USER_HEADER,
+    )
+    assert r.status_code == 200
+    assert r.json()["logs"] == ["line1", "line2"]
+    # Missing pod -> k8s NotFound surfaces as 404.
+    r = http.get(
+        f"{jwa}/api/namespaces/user1/notebooks/mynb/pod/ghost-0/logs",
+        headers=USER_HEADER,
+    )
+    assert r.status_code == 404
