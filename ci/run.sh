@@ -28,6 +28,9 @@ echo "=== driver contract: multi-chip dryrun ==="
 XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "=== conformance suite ==="
+python conformance/run.py
+
 echo "=== spawn benchmark ==="
 python bench_spawn.py
 

@@ -1,0 +1,274 @@
+#!/usr/bin/env python3
+"""Platform conformance suite.
+
+The reference ships a conformance program that runs each component's
+conformance job in-cluster and collects pass/fail reports (reference
+conformance/1.5/README.md:1-27, kfp-conformance.yaml).  This is the same
+contract for the TPU-native platform: a fixed list of named checks, each
+asserting an end-user-visible behavior contract (not an implementation
+detail), producing a machine-readable report.
+
+Run:  python conformance/run.py [--report PATH]
+Exit: 0 iff every check passed; report JSON always written.
+
+The suite drives the real control plane (controllers with live watch
+threads, the admission webhook over HTTP, the web apps over WSGI) against
+the in-memory API server, so it runs hermetically in CI; pointing it at a
+real cluster only requires swapping the client factory.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHECKS = []
+
+
+def check(name):
+    def wrap(fn):
+        CHECKS.append((name, fn))
+        return fn
+    return wrap
+
+
+def _e2e(**kwargs):
+    from ci.e2e import E2E
+
+    return E2E(**kwargs)
+
+
+@check("notebook-spawn-lifecycle")
+def spawn_lifecycle():
+    """Register → spawn → Ready → stop/start → delete (SURVEY §3.1)."""
+    e2e = _e2e()
+    try:
+        ns = e2e.register()
+        e2e.spawn(ns)
+        e2e.stop_start(ns)
+        e2e.delete(ns)
+    finally:
+        e2e.close()
+
+
+@check("multi-host-slice")
+def multi_host_slice():
+    """A multi-host topology spawns hosts(topology) workers with stable DNS
+    and per-worker TPU env — the platform's defining TPU capability."""
+    from kubeflow_tpu.platform.k8s.types import SERVICE, STATEFULSET, deep_get
+
+    e2e = _e2e()
+    try:
+        e2e.kube.add_tpu_node("tpu-multi-1", topology="4x4")
+        ns = e2e.register()
+        resp = e2e.jupyter.post(
+            f"/api/namespaces/{ns}/notebooks",
+            json={"name": "slice-nb",
+                  "tpus": {"accelerator": "v5e", "topology": "4x4"}},
+            headers=e2e.user,
+        )
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        sts = e2e._wait(
+            lambda: e2e._get(STATEFULSET, "slice-nb", ns), "statefulset"
+        )
+        replicas = deep_get(sts, "spec", "replicas")
+        assert replicas == 2, f"v5e 4x4 = 16 chips / 8 per host: {replicas}"
+        env = {e.get("name"): e for e in deep_get(
+            sts, "spec", "template", "spec", "containers",
+            default=[{}])[0].get("env", [])}
+        for key in ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "TPU_TOPOLOGY"):
+            assert key in env, f"missing {key}"
+        headless = e2e._wait(
+            lambda: e2e._get(SERVICE, "slice-nb-workers", ns), "headless svc"
+        )
+        assert deep_get(headless, "spec", "clusterIP") == "None"
+        assert deep_get(headless, "spec", "publishNotReadyAddresses") is True
+    finally:
+        e2e.close()
+
+
+@check("webhook-merge-semantics")
+def webhook_merge():
+    """PodDefault merge: identical-or-error on name collisions, conflict
+    rejected, provenance annotation stamped (reference main.go:97-148)."""
+    from kubeflow_tpu.platform.webhook.mutate import (
+        MergeConflict,
+        apply_pod_defaults,
+        safe_to_apply,
+    )
+
+    pod = {"metadata": {"labels": {"tpu": "true"}},
+           "spec": {"containers": [{"name": "nb", "env": [
+               {"name": "A", "value": "1"}]}]}}
+    pd = {"metadata": {"name": "tpu-env", "resourceVersion": "5"},
+          "spec": {"selector": {"matchLabels": {"tpu": "true"}},
+                   "env": [{"name": "TPU_TOPOLOGY", "value": "2x4"}]}}
+    out = apply_pod_defaults(pod, [pd])
+    env = {e["name"]: e["value"] for e in out["spec"]["containers"][0]["env"]}
+    assert env == {"A": "1", "TPU_TOPOLOGY": "2x4"}
+    anns = out["metadata"]["annotations"]
+    assert any("poddefault-tpu-env" in k for k in anns), anns
+
+    conflict = {"metadata": {"name": "other", "resourceVersion": "6"},
+                "spec": {"selector": {"matchLabels": {"tpu": "true"}},
+                         "env": [{"name": "A", "value": "2"}]}}
+    assert safe_to_apply(pod, [conflict]) is not None
+    try:
+        apply_pod_defaults(pod, [conflict])
+    except MergeConflict:
+        pass
+    else:
+        raise AssertionError("conflicting env merged silently")
+
+
+@check("profile-workspace-rbac-quota")
+def profile_rbac_quota():
+    """A Profile materializes namespace + RBAC + TPU chip quota."""
+    from kubeflow_tpu.platform.controllers.profile import ProfileReconciler
+    from kubeflow_tpu.platform.k8s.types import (
+        NAMESPACE, RESOURCEQUOTA, ROLEBINDING, SERVICEACCOUNT, deep_get,
+    )
+    from kubeflow_tpu.platform.runtime import Request
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.add_namespace("default")
+    kube.create({
+        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
+        "metadata": {"name": "conf-user"},
+        "spec": {"owner": {"kind": "User", "name": "conf@x.org"},
+                 "resourceQuotaSpec": {"hard": {"google.com/tpu": "32"}}},
+    })
+    ProfileReconciler(kube).reconcile(Request("", "conf-user"))
+    kube.get(NAMESPACE, "conf-user")
+    kube.get(SERVICEACCOUNT, "default-editor", "conf-user")
+    kube.get(ROLEBINDING, "namespaceAdmin", "conf-user")
+    rq = kube.get(RESOURCEQUOTA, "kf-resource-quota", "conf-user")
+    assert deep_get(rq, "spec", "hard", "google.com/tpu") == "32"
+
+
+@check("crd-version-conversion")
+def crd_conversion():
+    """Notebooks round-trip across every served version pair losslessly
+    enough to preserve the TPU request."""
+    from kubeflow_tpu.platform.apis import notebook as nbapi
+
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "c", "namespace": "x"},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4"},
+                 "template": {"spec": {"containers": [{"name": "c"}]}}},
+    }
+    for version in nbapi.VERSIONS:
+        there = nbapi.convert(nb, version)
+        back = nbapi.convert(there, "v1beta1")
+        assert back["spec"].get("tpu", {}).get("topology") == "2x4", (
+            version, back)
+
+
+@check("culling-idle-stop")
+def culling_idle():
+    """All-idle kernels past the window set the stop annotation; the
+    reconciler then scales the slice to zero."""
+    import datetime
+
+    from kubeflow_tpu.platform.apis import notebook as nbapi
+    from kubeflow_tpu.platform.controllers.culling import CullingReconciler
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+    from kubeflow_tpu.platform.runtime import Request
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.add_namespace("u")
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "idle-nb", "namespace": "u"},
+        "spec": {"template": {"spec": {"containers": [{"name": "idle-nb"}]}}},
+    })
+    now = datetime.datetime(2026, 1, 1, 12, 0,
+                            tzinfo=datetime.timezone.utc)
+    r = CullingReconciler(
+        kube,
+        prober=lambda url: [{"execution_state": "idle",
+                             "last_activity": "2026-01-01T10:00:00Z"}],
+        idle_minutes=60, now=lambda: now,
+    )
+    r.reconcile(Request("u", "idle-nb"))
+    nb = kube.get(NOTEBOOK, "idle-nb", "u")
+    assert nbapi.is_stopped(nb), "idle notebook was not stopped"
+
+
+@check("api-authn-authz")
+def api_authn_authz():
+    """Identity comes from the trusted header; requests without it are 401
+    and SubjectAccessReview denials are 403 (reference authn.py/authz.py)."""
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.add_namespace("u")
+    app = create_app(kube, secure_cookies=False)
+    c = Client(app)
+    assert c.get("/api/config").status_code == 401
+    kube.authz_policy = lambda **kw: False
+    resp = c.get("/api/namespaces/u/notebooks",
+                 headers={"kubeflow-userid": "eve@x.org"})
+    assert resp.status_code == 403
+    kube.authz_policy = None
+    assert c.get("/api/namespaces/u/notebooks",
+                 headers={"kubeflow-userid": "eve@x.org"}).status_code == 200
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--report", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "report.json"))
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset of checks to run")
+    args = ap.parse_args(argv)
+
+    only = {n for n in args.only.split(",") if n}
+    unknown = only - {n for n, _ in CHECKS}
+    if unknown:
+        print(f"unknown checks: {sorted(unknown)}", file=sys.stderr)
+        return 2
+    selected = [(n, f) for n, f in CHECKS if not only or n in only]
+
+    results = []
+    for name, fn in selected:
+        t0 = time.perf_counter()
+        try:
+            fn()
+            results.append({"check": name, "passed": True,
+                            "seconds": round(time.perf_counter() - t0, 3)})
+            print(f"PASS {name}")
+        except Exception:
+            results.append({
+                "check": name, "passed": False,
+                "seconds": round(time.perf_counter() - t0, 3),
+                "error": traceback.format_exc(limit=5),
+            })
+            print(f"FAIL {name}")
+            traceback.print_exc(limit=5)
+    report = {
+        "suite": "kubeflow-tpu-conformance",
+        "passed": all(r["passed"] for r in results),
+        "checks": results,
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"{'PASS' if report['passed'] else 'FAIL'}: "
+          f"{sum(r['passed'] for r in results)}/{len(results)} checks "
+          f"(report: {args.report})")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -446,6 +446,13 @@ class NotebookReconciler(Reconciler):
             # of minting a new Event per bump.
             mirror_name = f"{name}.{src_uid[:10]}"
             prior = existing.get(mirror_name)
+            if prior is None and any(
+                k.startswith(mirror_name + ".") for k in existing
+            ):
+                # A mirror created under the legacy <name>.<uid>.<count>
+                # naming already covers this source event; don't duplicate
+                # it — it ages out of etcd on its own.
+                continue
             if prior is not None:
                 if (prior.get("count", 1), prior.get("lastTimestamp")) != (
                     ev.get("count", 1), last_ts,

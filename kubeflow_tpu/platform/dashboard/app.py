@@ -43,7 +43,8 @@ SETTINGS_NAMESPACE = "kubeflow"
 ROLE_MAP = {"admin": "owner", "edit": "contributor", "view": "viewer"}
 
 
-def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
+               metrics_service=None) -> App:
     app = App("centraldashboard")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
@@ -88,6 +89,30 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
         return success({"settings": _settings(client).get("settings", {
             "DASHBOARD_FORCE_IFRAME": True,
         })})
+
+    @app.route("/api/metrics/<mtype>")
+    def get_metrics(request: Request, mtype: str):
+        """Utilization time-series (reference api.ts:29-58): 405 when no
+        metrics service is wired, ?interval=Last15m windows otherwise."""
+        from kubeflow_tpu.platform.dashboard.metrics_service import Interval
+
+        if metrics_service is None:
+            raise HttpError(405, "metrics service not configured")
+        interval = Interval.parse(request.args.get("interval"))
+        fetchers = {
+            "node": metrics_service.node_cpu_utilization,
+            "podcpu": metrics_service.pod_cpu_utilization,
+            "podmem": metrics_service.pod_memory_usage,
+            "tpu": metrics_service.tpu_duty_cycle,
+        }
+        fn = fetchers.get(mtype)
+        if fn is None:
+            raise HttpError(404, f"unknown metrics type {mtype!r}")
+        try:
+            points = fn(interval)
+        except NotImplementedError:
+            raise HttpError(405, f"metrics type {mtype!r} not supported") from None
+        return success({"points": [p.to_dict() for p in points]})
 
     @app.route("/api/tpu-overview")
     def tpu_overview(request: Request):

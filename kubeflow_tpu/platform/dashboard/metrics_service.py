@@ -1,0 +1,148 @@
+"""Dashboard metrics service: cluster utilization time-series.
+
+The reference defines a MetricsService interface with exactly three
+time-series queries (node CPU, pod CPU, pod memory) and ships only a
+Stackdriver implementation, making the dashboard's metrics panel GCP-only
+(reference centraldashboard/app/metrics_service.ts:20-42,
+stackdriver_metrics_service.ts).  Here the interface is kept but the
+bundled implementation targets a Prometheus endpoint — the scrape stack the
+platform already exports to (runtime/metrics.py) — so the panel works on
+any cluster; a TPU duty-cycle series is added since chips, not CPUs, are
+the scarce resource on this platform.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Callable, List, Optional
+
+
+class Interval(enum.Enum):
+    """Time-series window (reference metrics_service.ts:2-8)."""
+
+    Last5m = 5
+    Last15m = 15
+    Last30m = 30
+    Last60m = 60
+    Last180m = 180
+
+    @property
+    def minutes(self) -> int:
+        return self.value
+
+    @classmethod
+    def parse(cls, raw: Optional[str], default: "Interval" = None) -> "Interval":
+        default = default or cls.Last15m
+        if not raw:
+            return default
+        try:
+            return cls[raw]
+        except KeyError:
+            return default
+
+
+@dataclasses.dataclass
+class TimeSeriesPoint:
+    timestamp: float  # unix seconds
+    label: str        # node / pod the sample belongs to
+    value: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class MetricsService:
+    """Interface (reference metrics_service.ts:20-42).  Implementations
+    return points sorted by timestamp; label identifies the series."""
+
+    def node_cpu_utilization(self, interval: Interval) -> List[TimeSeriesPoint]:
+        raise NotImplementedError
+
+    def pod_cpu_utilization(self, interval: Interval) -> List[TimeSeriesPoint]:
+        raise NotImplementedError
+
+    def pod_memory_usage(self, interval: Interval) -> List[TimeSeriesPoint]:
+        raise NotImplementedError
+
+    def tpu_duty_cycle(self, interval: Interval) -> List[TimeSeriesPoint]:
+        """TPU-native extension; optional for implementations."""
+        raise NotImplementedError
+
+
+# PromQL for each series.  Rates over 5m windows, aggregated per node/pod —
+# the same shapes the Stackdriver impl queried from GCP monitoring.
+QUERIES = {
+    "node": 'sum by (instance) (rate(node_cpu_seconds_total{mode!="idle"}[5m]))',
+    "podcpu": "sum by (pod) (rate(container_cpu_usage_seconds_total[5m]))",
+    "podmem": "sum by (pod) (container_memory_working_set_bytes)",
+    "tpu": "avg by (pod) (tpu_duty_cycle_percent)",
+}
+
+LABEL_KEYS = ("instance", "pod", "node")
+
+Fetch = Callable[[str, dict], dict]  # (url, params) -> parsed JSON
+
+
+def _default_fetch(url: str, params: dict) -> dict:
+    import requests
+
+    resp = requests.get(url, params=params, timeout=30)
+    resp.raise_for_status()
+    return resp.json()
+
+
+class PrometheusMetricsService(MetricsService):
+    """MetricsService over the Prometheus HTTP API (query_range).
+
+    ``fetch`` is injectable for tests; production uses requests.  Failures
+    surface as empty series rather than exceptions — the dashboard panel
+    degrades to "no data", matching how the reference's frontend treats a
+    metrics error.
+    """
+
+    def __init__(self, base_url: str, *, fetch: Fetch = None,
+                 step_seconds: int = 60,
+                 now: Callable[[], float] = time.time):
+        self.base_url = base_url.rstrip("/")
+        self.fetch = fetch or _default_fetch
+        self.step = step_seconds
+        self._now = now
+
+    def _query_range(self, promql: str, interval: Interval) -> List[TimeSeriesPoint]:
+        end = self._now()
+        start = end - interval.minutes * 60
+        try:
+            data = self.fetch(
+                f"{self.base_url}/api/v1/query_range",
+                {"query": promql, "start": start, "end": end, "step": self.step},
+            )
+        except Exception:
+            return []
+        if not isinstance(data, dict) or data.get("status") != "success":
+            return []
+        points: List[TimeSeriesPoint] = []
+        for series in (data.get("data") or {}).get("result") or []:
+            metric = series.get("metric") or {}
+            label = next(
+                (metric[k] for k in LABEL_KEYS if metric.get(k)), ""
+            )
+            for ts, value in series.get("values") or []:
+                try:
+                    points.append(TimeSeriesPoint(float(ts), label, float(value)))
+                except (TypeError, ValueError):
+                    continue
+        points.sort(key=lambda p: p.timestamp)
+        return points
+
+    def node_cpu_utilization(self, interval: Interval) -> List[TimeSeriesPoint]:
+        return self._query_range(QUERIES["node"], interval)
+
+    def pod_cpu_utilization(self, interval: Interval) -> List[TimeSeriesPoint]:
+        return self._query_range(QUERIES["podcpu"], interval)
+
+    def pod_memory_usage(self, interval: Interval) -> List[TimeSeriesPoint]:
+        return self._query_range(QUERIES["podmem"], interval)
+
+    def tpu_duty_cycle(self, interval: Interval) -> List[TimeSeriesPoint]:
+        return self._query_range(QUERIES["tpu"], interval)

@@ -63,7 +63,13 @@ def run_controllers(args) -> int:
     client = _client()
     mgr = Manager(client)
     mgr.add(make_controller(client, use_istio=config.env_bool("USE_ISTIO", True)))
-    mgr.add(profile.make_controller(client))
+    mgr.add(profile.make_controller(
+        client,
+        heartbeat=True,
+        default_namespace_labels_path=(
+            config.env("NAMESPACE_LABELS_PATH", "") or None
+        ),
+    ))
     mgr.add(tensorboard.make_controller(client))
     if config.env_bool("ENABLE_CULLING", False):
         mgr.add(culling.make_controller(client))
@@ -104,7 +110,20 @@ def run_web_app(name: str, args) -> int:
     import importlib
 
     module = importlib.import_module(factories[name])
-    app = module.create_app(_client())
+    kwargs = {}
+    if name == "dashboard":
+        # Optional utilization panel: point PROMETHEUS_URL at any Prometheus
+        # (the reference's equivalent is GCP-only Stackdriver).
+        prom = config.env("PROMETHEUS_URL", "")
+        if prom:
+            from kubeflow_tpu.platform.dashboard.metrics_service import (
+                PrometheusMetricsService,
+            )
+
+            kwargs["metrics_service"] = PrometheusMetricsService(prom)
+    if name == "kfam":
+        kwargs["heartbeat"] = True
+    app = module.create_app(_client(), **kwargs)
     from werkzeug.serving import make_server as wz_make_server
 
     server = wz_make_server("0.0.0.0", args.port, app, threaded=True)

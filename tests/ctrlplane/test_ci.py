@@ -1,0 +1,70 @@
+"""CI workflow dispatch + conformance suite registration tests
+(reference prow_config.yaml:8-40 dispatch, conformance/1.5)."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from ci.workflows import WORKFLOWS, select  # noqa: E402
+
+
+def test_dispatch_table_selects_by_changed_path():
+    assert select(["kubeflow_tpu/platform/webhook/mutate.py"]) == [
+        "admission-webhook"
+    ]
+    got = select(["kubeflow_tpu/platform/controllers/notebook.py"])
+    assert "notebook-controller" in got
+    assert select(["docs/irrelevant.md"]) == []
+    # releasing/* triggers everything presubmit (the reference's
+    # releasing/version/* entries).
+    everything = select(["releasing/VERSION"])
+    assert set(everything) == {
+        n for n, wf in WORKFLOWS.items() if "presubmit" in wf.job_types
+    }
+
+
+def test_conformance_is_postsubmit_only():
+    assert "conformance" not in select(["kubeflow_tpu/models/llama.py"])
+    assert "conformance" in select(
+        ["kubeflow_tpu/models/llama.py"], job_type="postsubmit"
+    )
+
+
+def test_argo_manifest_shape():
+    wf = WORKFLOWS["notebook-controller"]
+    manifest = wf.to_argo()
+    assert manifest["kind"] == "Workflow"
+    dag = manifest["spec"]["templates"][0]["dag"]["tasks"]
+    assert [t["name"] for t in dag] == ["unit", "e2e"]
+    assert dag[1]["depends"] == "unit"
+    # Every task's command is JSON so the runner template can exec it.
+    for t in dag:
+        cmd = json.loads(t["arguments"]["parameters"][0]["value"])
+        assert isinstance(cmd, list) and cmd
+
+
+def test_conformance_report_contract(tmp_path):
+    # Runs only the two fastest checks to pin the CLI + report contract;
+    # ci/run.sh runs the full suite as its own step (not duplicated here).
+    report = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "conformance", "run.py"),
+         "--report", str(report),
+         "--only", "webhook-merge-semantics,crd-version-conversion"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    data = json.loads(report.read_text())
+    assert data["passed"] is True
+    assert [c["check"] for c in data["checks"]] == [
+        "webhook-merge-semantics", "crd-version-conversion"
+    ]
+    # The full check list is registered even when filtered.
+    from conformance.run import CHECKS
+
+    names = {n for n, _ in CHECKS}
+    assert {"notebook-spawn-lifecycle", "multi-host-slice",
+            "webhook-merge-semantics", "api-authn-authz"} <= names

@@ -316,3 +316,62 @@ def test_jwa_pod_logs(jwa, kube):
         headers=USER_HEADER,
     )
     assert r.status_code == 404
+
+
+def test_dashboard_metrics_service(kube):
+    # Reference api.ts:29-58 — /api/metrics/<node|podcpu|podmem> backed by a
+    # MetricsService; 405 when none is configured.
+    from kubeflow_tpu.platform.dashboard.app import create_app
+    from kubeflow_tpu.platform.dashboard.metrics_service import (
+        Interval,
+        PrometheusMetricsService,
+    )
+
+    # No service wired -> 405 (matches the reference's operation_not_supported).
+    bare = serve(create_app(kube, auth=auth()))
+    r = http.get(f"{bare}/api/metrics/node", headers=USER_HEADER)
+    assert r.status_code == 405
+
+    calls = []
+
+    def fake_fetch(url, params):
+        calls.append((url, params))
+        return {
+            "status": "success",
+            "data": {"result": [
+                {"metric": {"instance": "node-a"},
+                 "values": [[1000, "0.5"], [1060, "0.75"]]},
+            ]},
+        }
+
+    svc = PrometheusMetricsService(
+        "http://prom:9090", fetch=fake_fetch, now=lambda: 2000.0
+    )
+    base = serve(create_app(kube, auth=auth(), metrics_service=svc))
+    r = http.get(
+        f"{base}/api/metrics/node?interval=Last5m", headers=USER_HEADER
+    )
+    assert r.status_code == 200
+    pts = r.json()["points"]
+    assert [p["value"] for p in pts] == [0.5, 0.75]
+    assert pts[0]["label"] == "node-a"
+    url, params = calls[0]
+    assert url == "http://prom:9090/api/v1/query_range"
+    assert params["end"] - params["start"] == Interval.Last5m.minutes * 60
+
+    for mtype in ("podcpu", "podmem", "tpu"):
+        assert http.get(
+            f"{base}/api/metrics/{mtype}", headers=USER_HEADER
+        ).status_code == 200
+    assert http.get(
+        f"{base}/api/metrics/bogus", headers=USER_HEADER
+    ).status_code == 404
+
+    # A failing backend degrades to an empty series, not an error.
+    def broken(url, params):
+        raise RuntimeError("prometheus down")
+
+    svc_broken = PrometheusMetricsService("http://prom:9090", fetch=broken)
+    base2 = serve(create_app(kube, auth=auth(), metrics_service=svc_broken))
+    r = http.get(f"{base2}/api/metrics/podcpu", headers=USER_HEADER)
+    assert r.status_code == 200 and r.json()["points"] == []
