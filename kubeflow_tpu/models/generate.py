@@ -1,0 +1,142 @@
+"""Autoregressive generation with a KV cache, jit end-to-end.
+
+The reference platform ships no inference code (SURVEY.md §2.13); for this
+stack the decode path is part of the model zoo so a spawned notebook can
+serve/sample its trained models.  TPU-first mechanics:
+
+* **Prefill** runs the whole (padded) prompt in one batched pass — MXU
+  work — writing the KV cache (models/layers.py Attention._update_cache).
+* **Decode** is a ``lax.scan`` over single-token steps with the cache as
+  carry: static shapes, one compiled step body, no Python loop per token.
+* Right-padded prompts are handled with position + cache-slot masks, so
+  one compiled function serves any prompt length ≤ the bucket — no
+  per-length recompiles.
+* Sampling (greedy / temperature / top-k) is functional over
+  ``jax.random`` keys.
+
+Under pjit, shard the cache pytree like the activations (batch on dp, kv
+heads on tp); the scan body then runs fully SPMD.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits: jax.Array, rng: jax.Array, *,
+                  temperature: float = 1.0,
+                  top_k: Optional[int] = None) -> jax.Array:
+    """Sample token ids from [batch, vocab] logits.  temperature == 0 is
+    greedy; top_k restricts to the k highest-probability tokens."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k is not None and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [b, 1]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "eos_token"),
+)
+def generate(model, params, prompt: jax.Array, *,
+             rng: Optional[jax.Array] = None,
+             prompt_mask: Optional[jax.Array] = None,
+             max_new_tokens: int = 32,
+             temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             eos_token: Optional[int] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations for a [batch, prompt_len]
+    right-padded prompt (``prompt_mask`` True on real tokens).  Returns
+    [batch, max_new_tokens] token ids; after an EOS the row pads with EOS.
+
+    ``model`` must be a Llama-style module whose ``__call__`` supports
+    ``decode=True`` with a "cache" collection; its ``max_seq_len`` must
+    bound prompt_len + max_new_tokens.
+
+    MoE caveat: capacity-truncated routing is sequence-length dependent by
+    construction (per-step decode has fresh capacity; a full re-forward
+    shares capacity across the whole sequence), so for ``n_experts > 0``
+    cached decode equals the re-forward oracle only while no token is
+    dropped — the standard Switch/GShard decode behavior.
+    """
+    b, prompt_len = prompt.shape
+    # The cache is bucketed to exactly the tokens this call can produce —
+    # decode attends over cache_len keys, not the model's full max_seq_len
+    # (an 8-token prompt + 32 new tokens on a 32k-context config would
+    # otherwise pay ~800x the attention work per step).
+    cache_len = prompt_len + max_new_tokens
+    if cache_len > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"= {cache_len} exceeds max_seq_len {model.cfg.max_seq_len}"
+        )
+    if rng is None:
+        rng = jax.random.key(0)
+    if prompt_mask is None:
+        prompt_mask = jnp.ones((b, prompt_len), dtype=bool)
+    prompt_mask = prompt_mask.astype(bool)
+    positions = jnp.cumsum(prompt_mask.astype(jnp.int32), axis=-1) - 1
+    positions = jnp.maximum(positions, 0)
+    lengths = prompt_mask.sum(axis=-1).astype(jnp.int32)  # [b]
+
+    # Padding slots hold garbage k/v after prefill (the cache is written by
+    # slot, not by logical position); hide them from every later query.
+    # Decode tokens land at slots >= prompt_len, which stay visible.
+    slot_valid = jnp.concatenate(
+        [prompt_mask,
+         jnp.ones((b, cache_len - prompt_len), dtype=bool)], axis=-1
+    )
+    pad_bias = jnp.where(slot_valid, 0.0, -1e30)[:, None, None, :]
+
+    # Prefill: one pass over the padded prompt fills the cache and yields
+    # logits; each row samples its first token from its last valid slot.
+    # token_mask keeps padding out of MoE expert routing.
+    logits, state = model.apply(
+        {"params": params}, prompt, positions=positions, decode=True,
+        mask_bias=pad_bias, token_mask=prompt_mask, cache_len=cache_len,
+        mutable=["cache"],
+    )
+    cache = state["cache"]
+    idx = jnp.broadcast_to(
+        (lengths - 1)[:, None, None], (b, 1, logits.shape[-1])
+    )
+    last_logits = jnp.take_along_axis(logits, idx, axis=1)[:, 0]  # [b, vocab]
+
+    rng, sub = jax.random.split(rng)
+    first = sample_logits(last_logits, sub, temperature=temperature,
+                          top_k=top_k)
+
+    def step(carry, _):
+        cache, token, pos, rng, done = carry
+        rng, sub = jax.random.split(rng)
+        logits, state = model.apply(
+            {"params": params, "cache": cache},
+            token[:, None],
+            positions=pos[:, None],
+            decode=True,
+            mask_bias=pad_bias,
+            cache_len=cache_len,
+            mutable=["cache"],
+        )
+        nxt = sample_logits(logits[:, -1], sub, temperature=temperature,
+                            top_k=top_k)
+        if eos_token is not None:
+            nxt = jnp.where(done, eos_token, nxt)
+            done = done | (nxt == eos_token)
+        return (state["cache"], nxt, pos + 1, rng, done), nxt
+
+    done0 = jnp.zeros((b,), dtype=bool)
+    if eos_token is not None:
+        done0 = first == eos_token
+    if max_new_tokens == 1:
+        return first[:, None]
+    carry = (cache, first, lengths, rng, done0)
+    _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -47,7 +47,8 @@ class Attention(nn.Module):
     attn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, *, positions=None, segment_ids=None, mask_bias=None):
+    def __call__(self, x, *, positions=None, segment_ids=None, mask_bias=None,
+                 decode=False, max_decode_len=None):
         b, s, dim = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or dim // self.num_heads
@@ -62,19 +63,66 @@ class Attention(nn.Module):
                 positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
             q = apply_rope(q, positions, theta=self.rope_theta)
             k = apply_rope(k, positions, theta=self.rope_theta)
-        out = ops.dot_product_attention(
-            q,
-            k,
-            v,
-            causal=self.causal,
-            segment_ids=segment_ids,
-            bias=mask_bias,
-            impl=self.attn_impl,
-        )
+        if decode:
+            if segment_ids is not None:
+                raise ValueError(
+                    "decode=True does not support packed sequences "
+                    "(segment_ids); the cache is one sequence per batch row"
+                )
+            k, v, bias = self._update_cache(k, v, max_decode_len)
+            if mask_bias is not None:
+                bias = bias + mask_bias
+            out = ops.dot_product_attention(
+                q, k, v, causal=False, bias=bias, impl="xla"
+            )
+        else:
+            out = ops.dot_product_attention(
+                q,
+                k,
+                v,
+                causal=self.causal,
+                segment_ids=segment_ids,
+                bias=mask_bias,
+                impl=self.attn_impl,
+            )
         out = nn.DenseGeneral(
             dim, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="o_proj"
         )(out)
         return out
+
+    def _update_cache(self, k, v, max_decode_len):
+        """Autoregressive KV cache (flax "cache" collection): write the new
+        k/v at the running index with a static-shape dynamic_update_slice,
+        return the full cache plus the mask bias hiding future/unwritten
+        slots.  Works for prefill (s>1 at index 0) and single-token decode
+        (s=1) under one jit trace each — no data-dependent Python control
+        flow (SURVEY-mandated XLA semantics)."""
+        b, s, kv_heads, head_dim = k.shape
+        if max_decode_len is None:
+            raise ValueError("decode=True requires max_decode_len")
+        cached_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, max_decode_len, kv_heads, head_dim), k.dtype),
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, max_decode_len, kv_heads, head_dim), v.dtype),
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+        cached_k.value = k_all
+        cached_v.value = v_all
+        cache_index.value = idx + s
+        # Query at global position idx+i sees keys at positions <= idx+i.
+        q_pos = idx + jnp.arange(s)
+        k_pos = jnp.arange(max_decode_len)
+        allowed = k_pos[None, :] <= q_pos[:, None]            # [s, max_len]
+        bias = jnp.where(allowed, 0.0, -1e30)[None, None]      # [1,1,s,max_len]
+        return k_all, v_all, bias
 
 
 class SwiGLU(nn.Module):

@@ -82,7 +82,8 @@ class LlamaBlock(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids):
+    def __call__(self, x, positions, segment_ids, decode=False,
+                 mask_bias=None, token_mask=None, cache_len=None):
         cfg = self.cfg
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(x)
         h = Attention(
@@ -95,7 +96,8 @@ class LlamaBlock(nn.Module):
             dtype=cfg.dtype,
             attn_impl=cfg.attn_impl,
             name="attn",
-        )(h, positions=positions, segment_ids=segment_ids)
+        )(h, positions=positions, segment_ids=segment_ids, decode=decode,
+          max_decode_len=cache_len or cfg.max_seq_len, mask_bias=mask_bias)
         x = x + h
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
         if cfg.n_experts > 0:
@@ -108,7 +110,7 @@ class LlamaBlock(nn.Module):
                 capacity_factor=cfg.capacity_factor,
                 dtype=cfg.dtype,
                 name="mlp",
-            )(h)
+            )(h, token_mask=token_mask)
         else:
             h = SwiGLU(hidden_dim=cfg.ffn_dim, dtype=cfg.dtype, name="mlp")(h)
         return x + h
@@ -118,9 +120,15 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, *, positions=None, segment_ids=None):
+    def __call__(self, tokens, *, positions=None, segment_ids=None,
+                 decode=False, mask_bias=None, token_mask=None,
+                 cache_len=None):
         cfg = self.cfg
         b, s = tokens.shape
+        if cache_len is not None and cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"cache_len {cache_len} exceeds max_seq_len {cfg.max_seq_len}"
+            )
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
         x = nn.Embed(
@@ -128,9 +136,13 @@ class Llama(nn.Module):
         )(tokens)
         block = LlamaBlock
         if cfg.remat:
-            block = nn.remat(LlamaBlock, static_argnums=())
+            # static: decode flag (4) and cache bucket size (7).
+            block = nn.remat(LlamaBlock, static_argnums=(4, 7))
         for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(x, positions, segment_ids)
+            x = block(cfg, name=f"layer_{i}")(
+                x, positions, segment_ids, decode, mask_bias, token_mask,
+                cache_len,
+            )
         x = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"

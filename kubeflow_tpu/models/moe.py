@@ -53,7 +53,14 @@ class MoeMlp(nn.Module):
     dtype: Any = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 token_mask: jax.Array = None) -> jax.Array:
+        """``token_mask`` [b, s] (True = real token) excludes padding from
+        routing: pad tokens consume no expert capacity and contribute
+        nothing to the aux loss, so a right-padded batch routes its real
+        tokens the same way regardless of padding (exactly equal when
+        capacity truncation doesn't bite — capacity itself is static in the
+        padded length)."""
         b, s, d = x.shape
         e, k, f = self.n_experts, self.top_k, self.hidden_dim
         # Per-group capacity: each batch row is a routing group, so capacity
@@ -67,6 +74,8 @@ class MoeMlp(nn.Module):
         # Top-k expert choice per token, k one-hot masks [b, s, e].
         _, topk_idx = jax.lax.top_k(probs, k)  # [b, s, k]
         onehot = jax.nn.one_hot(topk_idx, e, dtype=jnp.float32)  # [b, s, k, e]
+        if token_mask is not None:
+            onehot = onehot * token_mask.astype(jnp.float32)[:, :, None, None]
 
         # Position of each (token, choice) in its expert's buffer, counted in
         # routing order along the sequence; beyond-capacity slots are dropped.
@@ -83,9 +92,16 @@ class MoeMlp(nn.Module):
         gates = jnp.einsum("bse,bske->bsk", probs, keep)
         combine = jnp.einsum("bsk,bske,bskec->bsec", gates, keep, pos_oh)
 
-        # Aux load-balancing loss (Switch eq. 4): e * Σ_e f_e · p̄_e.
-        token_frac = jnp.mean(onehot.sum(2), axis=(0, 1))  # [e]
-        prob_frac = jnp.mean(probs, axis=(0, 1))  # [e]
+        # Aux load-balancing loss (Switch eq. 4): e * Σ_e f_e · p̄_e,
+        # averaged over real tokens only.
+        if token_mask is not None:
+            w = token_mask.astype(jnp.float32)[:, :, None]  # [b, s, 1]
+            denom = jnp.maximum(w.sum(), 1.0)
+            token_frac = (onehot.sum(2) * w).sum(axis=(0, 1)) / denom
+            prob_frac = (probs * w).sum(axis=(0, 1)) / denom
+        else:
+            token_frac = jnp.mean(onehot.sum(2), axis=(0, 1))  # [e]
+            prob_frac = jnp.mean(probs, axis=(0, 1))  # [e]
         aux = e * jnp.sum(token_frac * prob_frac) / k
         self.sow("losses", "moe_aux_loss", aux)
 
