@@ -45,10 +45,15 @@ class Attention(nn.Module):
     causal: bool = False
     dtype: Any = jnp.bfloat16
     attn_impl: str = "auto"
+    # None -> 1/sqrt(head_dim); T5 passes 1.0 (scale folded into init).
+    softmax_scale: Optional[float] = None
 
     @nn.compact
-    def __call__(self, x, *, positions=None, segment_ids=None, mask_bias=None,
-                 decode=False, max_decode_len=None):
+    def __call__(self, x, *, kv=None, positions=None, segment_ids=None,
+                 mask_bias=None, decode=False, max_decode_len=None):
+        """``kv`` switches to cross-attention: keys/values project from the
+        encoder sequence instead of ``x`` (RoPE/cache apply to
+        self-attention only)."""
         b, s, dim = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or dim // self.num_heads
@@ -56,9 +61,10 @@ class Attention(nn.Module):
             feats, axis=-1, use_bias=False, dtype=self.dtype, name=name
         )
         q = dense((self.num_heads, head_dim), "q_proj")(x)
-        k = dense((kv_heads, head_dim), "k_proj")(x)
-        v = dense((kv_heads, head_dim), "v_proj")(x)
-        if self.rope:
+        src = x if kv is None else kv
+        k = dense((kv_heads, head_dim), "k_proj")(src)
+        v = dense((kv_heads, head_dim), "v_proj")(src)
+        if self.rope and kv is None:
             if positions is None:
                 positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
             q = apply_rope(q, positions, theta=self.rope_theta)
@@ -69,11 +75,17 @@ class Attention(nn.Module):
                     "decode=True does not support packed sequences "
                     "(segment_ids); the cache is one sequence per batch row"
                 )
+            if kv is not None:
+                raise ValueError(
+                    "decode=True caches self-attention only; cross-attention "
+                    "k/v are static per call — compute them outside the loop"
+                )
             k, v, bias = self._update_cache(k, v, max_decode_len)
             if mask_bias is not None:
                 bias = bias + mask_bias
             out = ops.dot_product_attention(
-                q, k, v, causal=False, bias=bias, impl="xla"
+                q, k, v, causal=False, bias=bias, impl="xla",
+                softmax_scale=self.softmax_scale,
             )
         else:
             out = ops.dot_product_attention(
@@ -84,6 +96,7 @@ class Attention(nn.Module):
                 segment_ids=segment_ids,
                 bias=mask_bias,
                 impl=self.attn_impl,
+                softmax_scale=self.softmax_scale,
             )
         out = nn.DenseGeneral(
             dim, axis=(-2, -1), use_bias=False, dtype=self.dtype, name="o_proj"

@@ -21,7 +21,7 @@ def register_model(name: str):
 def create_model(name: str, **kwargs) -> Any:
     """Instantiate a registered model (a ``flax.linen.Module``)."""
     # Import for registration side effects on first use.
-    from kubeflow_tpu.models import bert, llama, resnet, vit  # noqa: F401
+    from kubeflow_tpu.models import bert, llama, resnet, t5, vit  # noqa: F401
 
     if name not in _REGISTRY:
         raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
@@ -29,6 +29,6 @@ def create_model(name: str, **kwargs) -> Any:
 
 
 def list_models() -> list[str]:
-    from kubeflow_tpu.models import bert, llama, resnet, vit  # noqa: F401
+    from kubeflow_tpu.models import bert, llama, resnet, t5, vit  # noqa: F401
 
     return sorted(_REGISTRY)

@@ -1,0 +1,211 @@
+"""T5-style encoder-decoder in Flax — the seq2seq member of the model zoo.
+
+The reference platform ships no model code (SURVEY.md §2.13); this module
+completes the family coverage (CNN / ViT / encoder / decoder / MoE /
+**encoder-decoder**) for spawned notebooks.  T5 1.1 shape: RMSNorm
+pre-norm, relative-position-bucket attention bias (no absolute position
+embeddings), gated-GELU feed-forward, untied LM head.
+
+TPU-first notes: the relative bias is computed once per stack from a
+static [q_len, k_len] bucket table and shared by every layer (T5's own
+scheme — one embedding lookup, reused), so each block stays a pure
+matmul+bias pipeline XLA fuses cleanly; all shapes static, encoder padding
+handled by additive mask bias.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.layers import Attention, RMSNorm
+from kubeflow_tpu.models.registry import register_model
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    dim: int = 512
+    n_encoder_layers: int = 6
+    n_decoder_layers: int = 6
+    n_heads: int = 8
+    head_dim: int = 64
+    ffn_dim: int = 1024
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+
+CONFIGS = {
+    "t5_debug": T5Config(vocab_size=128, dim=32, n_encoder_layers=2,
+                         n_decoder_layers=2, n_heads=2, head_dim=16,
+                         ffn_dim=64, dtype=jnp.float32),
+    "t5_small": T5Config(),
+    "t5_base": T5Config(dim=768, n_encoder_layers=12, n_decoder_layers=12,
+                        n_heads=12, ffn_dim=2048),
+    "t5_large": T5Config(dim=1024, n_encoder_layers=24, n_decoder_layers=24,
+                         n_heads=16, ffn_dim=2816),
+}
+
+
+def relative_position_bucket(relative_position: np.ndarray, *,
+                             bidirectional: bool, num_buckets: int,
+                             max_distance: int) -> np.ndarray:
+    """T5 bucket scheme: half the buckets exact, half log-spaced out to
+    max_distance.  Static numpy — the table is built at trace time."""
+    ret = np.zeros_like(relative_position)
+    n = -relative_position
+    if bidirectional:
+        num_buckets //= 2
+        ret += (n < 0).astype(np.int32) * num_buckets
+        n = np.abs(n)
+    else:
+        n = np.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    log_ratio = np.log(np.maximum(n, 1) / max_exact) / np.log(
+        max_distance / max_exact
+    )
+    large = max_exact + (log_ratio * (num_buckets - max_exact)).astype(np.int32)
+    large = np.minimum(large, num_buckets - 1)
+    ret += np.where(is_small, n, large)
+    return ret
+
+
+class RelativeBias(nn.Module):
+    """Learned [buckets, heads] embedding → [1, heads, q, k] additive bias."""
+
+    cfg: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_len: int, k_len: int):
+        cfg = self.cfg
+        ctx = np.arange(q_len)[:, None] - np.arange(k_len)[None, :]
+        buckets = relative_position_bucket(
+            -ctx, bidirectional=self.bidirectional,
+            num_buckets=cfg.rel_buckets, max_distance=cfg.rel_max_distance,
+        )  # [q, k] static
+        table = self.param(
+            "rel_embedding",
+            nn.initializers.normal(stddev=1.0 / np.sqrt(cfg.dim)),
+            (cfg.rel_buckets, cfg.n_heads),
+        )
+        bias = table[jnp.asarray(buckets)]            # [q, k, heads]
+        return jnp.transpose(bias, (2, 0, 1))[None]   # [1, heads, q, k]
+
+
+class GatedGelu(nn.Module):
+    hidden_dim: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        dim = x.shape[-1]
+        g = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype,
+                     name="wi_0")(x)
+        u = nn.Dense(self.hidden_dim, use_bias=False, dtype=self.dtype,
+                     name="wi_1")(x)
+        return nn.Dense(dim, use_bias=False, dtype=self.dtype,
+                        name="wo")(nn.gelu(g) * u)
+
+
+class T5EncoderBlock(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.cfg
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(x)
+        h = Attention(
+            num_heads=cfg.n_heads, head_dim=cfg.head_dim, dtype=cfg.dtype,
+            # T5 attention is unscaled (the scale is folded into init).
+            softmax_scale=1.0, name="attn",
+        )(h, mask_bias=bias)
+        x = x + h
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
+        return x + GatedGelu(cfg.ffn_dim, cfg.dtype, name="mlp")(h)
+
+
+class T5DecoderBlock(nn.Module):
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, x, encoded, self_bias, cross_bias):
+        cfg = self.cfg
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="self_attn_norm")(x)
+        h = Attention(
+            num_heads=cfg.n_heads, head_dim=cfg.head_dim, causal=True,
+            dtype=cfg.dtype, softmax_scale=1.0, name="self_attn",
+        )(h, mask_bias=self_bias)
+        x = x + h
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="cross_attn_norm")(x)
+        h = Attention(
+            num_heads=cfg.n_heads, head_dim=cfg.head_dim, dtype=cfg.dtype,
+            softmax_scale=1.0, name="cross_attn",
+        )(h, kv=encoded, mask_bias=cross_bias)
+        x = x + h
+        h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
+        return x + GatedGelu(cfg.ffn_dim, cfg.dtype, name="mlp")(h)
+
+
+class T5(nn.Module):
+    """Returns [batch, target_len, vocab] logits for (source, target) token
+    pairs; ``source_mask`` (True = real token) masks encoder padding out of
+    both encoder self-attention and decoder cross-attention."""
+
+    cfg: T5Config
+
+    @nn.compact
+    def __call__(self, source, target, *,
+                 source_mask: Optional[jnp.ndarray] = None):
+        cfg = self.cfg
+        b, src_len = source.shape
+        tgt_len = target.shape[1]
+        if source_mask is None:
+            source_mask = jnp.ones((b, src_len), dtype=bool)
+        pad = jnp.where(source_mask, 0.0, -1e30)[:, None, None, :]
+
+        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
+                         name="embed")
+
+        # Encoder: bidirectional relative bias, shared across layers.
+        x = embed(source)
+        enc_bias = RelativeBias(cfg, bidirectional=True,
+                                name="encoder_rel_bias")(src_len, src_len)
+        enc_bias = enc_bias + pad
+        for i in range(cfg.n_encoder_layers):
+            x = T5EncoderBlock(cfg, name=f"encoder_{i}")(x, enc_bias)
+        encoded = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype,
+                          name="encoder_norm")(x)
+
+        # Decoder: causal relative bias for self-attention, encoder padding
+        # bias for cross-attention (cross gets no relative bias, per T5).
+        y = embed(target)
+        dec_bias = RelativeBias(cfg, bidirectional=False,
+                                name="decoder_rel_bias")(tgt_len, tgt_len)
+        for i in range(cfg.n_decoder_layers):
+            y = T5DecoderBlock(cfg, name=f"decoder_{i}")(
+                y, encoded, dec_bias, pad
+            )
+        y = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="decoder_norm")(y)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(y)
+
+
+def _factory(name):
+    @register_model(name)
+    def make(**overrides):
+        cfg = dataclasses.replace(CONFIGS[name], **overrides)
+        return T5(cfg)
+
+    make.__name__ = name
+    return make
+
+
+for _n in CONFIGS:
+    _factory(_n)

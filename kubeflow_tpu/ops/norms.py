@@ -5,12 +5,30 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, scale: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+def rms_norm(x: jax.Array, scale: jax.Array, *, eps: float = 1e-6,
+             impl: str = "auto") -> jax.Array:
     """RMSNorm with f32 accumulation, output in x.dtype.
 
-    XLA fuses this into neighbouring ops on TPU; a Pallas version exists in
-    ops/pallas for the cases where it doesn't (measured, not assumed).
+    impl: "auto" | "xla" | "pallas".  XLA fuses this into neighbouring ops
+    on TPU, so "auto" stays on XLA; "pallas" selects the single-pass VMEM
+    kernel (ops/pallas/rms_norm.py) for the cases where the fusion breaks —
+    choose by measuring, not assuming.
     """
+    if impl not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown impl {impl!r}")
+    if impl == "pallas":
+        from kubeflow_tpu.ops.pallas import rms_norm as pallas_rms
+
+        if pallas_rms.pltpu is None:
+            raise ValueError(
+                "pallas rms_norm unavailable: jax.experimental.pallas.tpu "
+                "is not importable in this JAX build"
+            )
+        if not pallas_rms.supported(x):
+            raise ValueError(
+                f"pallas rms_norm needs a %128 last dim, got {x.shape}"
+            )
+        return pallas_rms.rms_norm(x, scale, eps=eps)
     orig_dtype = x.dtype
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)

@@ -1,0 +1,111 @@
+"""Pallas TPU kernel for RMSNorm (every Llama layer runs it twice).
+
+Forward: rows are tiled into (block_rows, d) VMEM blocks; the f32
+mean-square, rsqrt, and scale all happen in one VPU pass per tile, so x is
+read from HBM exactly once and y written once — the op is bandwidth-bound,
+and this is its bandwidth floor.  XLA usually fuses the surrounding
+elementwise chain to the same effect (ops/norms.py keeps XLA as the
+default); the kernel exists for the residual cases where the fusion breaks
+(measured via ops.norms.rms_norm(impl=...), not assumed).
+
+Backward: analytic VJP in plain XLA (two reductions) — a Pallas backward
+would only re-derive the same bandwidth floor.
+
+On non-TPU backends the kernel runs in interpret mode (CPU test suite);
+``supported`` gates shapes: last dim must be lane-aligned (%128).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific pallas extras are unavailable on pure-CPU builds.
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# Target ~1 MiB of f32 per input tile; sublane-aligned (multiple of 8).
+_TARGET_TILE_BYTES = 1 << 20
+
+
+def _platform() -> str:
+    return jax.devices()[0].platform
+
+
+def supported(x: jax.Array) -> bool:
+    if pltpu is None:
+        return False
+    d = x.shape[-1]
+    return d % 128 == 0 and x.size // d >= 1
+
+
+def _block_rows(n_rows: int, d: int) -> int:
+    rows = max(8, _TARGET_TILE_BYTES // (4 * d))
+    rows = (rows // 8) * 8
+    # Blocks stay sublane-aligned (multiple of 8) even when n_rows is
+    # small/odd; _forward pads the rows up to the block multiple.
+    return min(rows, ((n_rows + 7) // 8) * 8)
+
+
+def _kernel(x_ref, scale_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    y = x * jax.lax.rsqrt(var + eps)
+    o_ref[...] = (y * scale_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _rms_norm_2d(x, scale, eps):
+    return _forward(x, scale, eps)
+
+
+def _forward(x, scale, eps):
+    n, d = x.shape
+    block = _block_rows(n, d)
+    pad = (-n) % block
+    if pad:
+        x_in = jnp.pad(x, ((0, pad), (0, 0)))
+    else:
+        x_in = x
+    out = pl.pallas_call(
+        functools.partial(_kernel, eps=eps),
+        grid=((n + pad) // block,),
+        in_specs=[
+            pl.BlockSpec((block, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x_in.shape, x.dtype),
+        interpret=_platform() != "tpu",
+    )(x_in, scale)
+    return out[:n] if pad else out
+
+
+def _fwd(x, scale, eps):
+    return _forward(x, scale, eps), (x, scale)
+
+
+def _bwd(eps, res, g):
+    x, scale = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    s32 = scale.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    gs = g32 * s32
+    dx = r * gs - x32 * (r**3) * jnp.mean(gs * x32, axis=-1, keepdims=True)
+    dscale = jnp.sum(g32 * x32 * r, axis=0)
+    return dx.astype(x.dtype), dscale.astype(scale.dtype)
+
+
+_rms_norm_2d.defvjp(_fwd, _bwd)
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    """Pallas RMSNorm over the last axis; leading axes are flattened into
+    rows.  Differentiable (custom VJP)."""
+    d = x.shape[-1]
+    y = _rms_norm_2d(x.reshape(-1, d), scale, eps)
+    return y.reshape(x.shape)

@@ -55,3 +55,67 @@ def test_bad_impl_raises():
     q, k, v = _qkv(s=32)
     with pytest.raises(ValueError):
         dot_product_attention(q, k, v, impl="cuda")
+
+
+# -- pallas rms_norm (ops/pallas/rms_norm.py, interpret mode on CPU) ----------
+
+
+def test_pallas_rms_norm_matches_xla():
+    import numpy as np
+
+    from kubeflow_tpu import ops
+
+    rng = jax.random.key(0)
+    x = jax.random.normal(rng, (4, 96, 256), jnp.float32)
+    scale = jax.random.normal(jax.random.key(1), (256,)) + 1.0
+    want = ops.rms_norm(x, scale, impl="xla")
+    got = ops.rms_norm(x, scale, impl="pallas")
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_pallas_rms_norm_grads_match():
+    import numpy as np
+
+    from kubeflow_tpu import ops
+
+    x = jax.random.normal(jax.random.key(2), (8, 384), jnp.float32)
+    scale = jax.random.normal(jax.random.key(3), (384,)) + 1.0
+
+    def loss(impl):
+        def fn(x, scale):
+            y = ops.rms_norm(x, scale, impl=impl)
+            return (y * jnp.sin(y)).sum()
+        return fn
+
+    gx_w, gs_w = jax.grad(loss("xla"), argnums=(0, 1))(x, scale)
+    gx_g, gs_g = jax.grad(loss("pallas"), argnums=(0, 1))(x, scale)
+    np.testing.assert_allclose(np.asarray(gx_w), np.asarray(gx_g),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gs_w), np.asarray(gs_g),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_pallas_rms_norm_odd_rows_and_bf16():
+    import numpy as np
+
+    from kubeflow_tpu import ops
+
+    # 13 rows forces tile padding; bf16 exercises the dtype round-trip.
+    x = jax.random.normal(jax.random.key(4), (13, 128), jnp.bfloat16)
+    scale = jnp.ones((128,))
+    want = ops.rms_norm(x, scale, impl="xla")
+    got = ops.rms_norm(x, scale, impl="pallas")
+    np.testing.assert_allclose(
+        np.asarray(want, np.float32), np.asarray(got, np.float32),
+        atol=1e-2, rtol=1e-2,
+    )
+
+
+def test_pallas_rms_norm_rejects_unaligned():
+    import pytest as _pytest
+
+    from kubeflow_tpu import ops
+
+    with _pytest.raises(ValueError, match="128"):
+        ops.rms_norm(jnp.ones((4, 100)), jnp.ones((100,)), impl="pallas")
