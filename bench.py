@@ -36,6 +36,9 @@ def main() -> int:
     from kubeflow_tpu.models import create_model
     from kubeflow_tpu.train import create_train_state, make_classification_train_step
 
+    # Classic stem: the MLPerf space-to-depth conv0 rewrite measured
+    # *slower* here (BASELINE.md optimization log), so the benchmark stays
+    # on the standard network.
     model = create_model("resnet50", num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.key(0)
     images = jax.random.normal(rng, (BATCH, IMAGE, IMAGE, 3), jnp.float32)

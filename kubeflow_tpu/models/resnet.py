@@ -81,15 +81,36 @@ class BasicBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: [N,H,W,C] -> [N,H/b,W/b,C*b*b] (pure reshape /
+    transpose — free on TPU, it's a layout change)."""
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            f"space_to_depth needs H and W divisible by {block}, got "
+            f"{h}x{w}"
+        )
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, c * block * block)
+
+
 class ResNet(nn.Module):
-    """ResNet v1.5.  ``stem='cifar'`` swaps the 7x7/maxpool stem for a 3x3."""
+    """ResNet v1.5.  ``stem='cifar'`` swaps the 7x7/maxpool stem for a 3x3;
+    ``stem='space_to_depth'`` is the MLPerf conv0 rewrite — input 2x2
+    space-to-depth (3->12 channels) + a 4x4 stride-1 conv over the 112x112
+    s2d grid (the 7x7/s2's receptive field, zero-padded to 8x8, folded into
+    4x4x12), keeping the 3x3/s2 maxpool.  Output shapes and layer count
+    match the classic stem exactly; the win is purely that a 3-channel conv
+    wastes the MXU's 128-wide channel lanes on padding while 12 channels
+    over a quarter of the positions packs them 4x better."""
 
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
-    stem: str = "imagenet"  # or "cifar"
+    stem: str = "imagenet"  # "imagenet" | "space_to_depth" | "cifar"
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
@@ -110,10 +131,24 @@ class ResNet(nn.Module):
             x = norm(name="norm_init")(x)
             x = act(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
-        else:  # cifar
+        elif self.stem == "space_to_depth":
+            x = space_to_depth(x, 2)  # [N,112,112,12] for 224 input
+            # Stride 1: stride 2 in pixel space is absorbed by the s2d
+            # block; output [N,112,112,64], identical to the 7x7/s2 path.
+            x = conv(self.num_filters, (4, 4), (1, 1), padding="SAME",
+                     name="conv_init")(x)
+            x = norm(name="norm_init")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        elif self.stem == "cifar":
             x = conv(self.num_filters, (3, 3), name="conv_init")(x)
             x = norm(name="norm_init")(x)
             x = act(x)
+        else:
+            raise ValueError(
+                f"unknown stem {self.stem!r}; expected 'imagenet', "
+                "'space_to_depth', or 'cifar'"
+            )
 
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):

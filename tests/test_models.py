@@ -75,3 +75,45 @@ def test_bert_debug_forward_with_mask():
 def test_unknown_model_raises():
     with pytest.raises(KeyError):
         create_model("resnet9000")
+
+
+def test_space_to_depth_transform():
+    import numpy as np
+
+    from kubeflow_tpu.models.resnet import space_to_depth
+
+    x = jnp.arange(2 * 4 * 4 * 3).reshape(2, 4, 4, 3).astype(jnp.float32)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 2, 2, 12)
+    # Block (0,0) packs pixels (0,0),(0,1),(1,0),(1,1) in row-major order.
+    np.testing.assert_array_equal(
+        np.asarray(y[0, 0, 0]),
+        np.concatenate([np.asarray(x[0, 0, 0]), np.asarray(x[0, 0, 1]),
+                        np.asarray(x[0, 1, 0]), np.asarray(x[0, 1, 1])]),
+    )
+
+
+def test_space_to_depth_stem_trains():
+    import optax
+
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.train import (
+        create_train_state,
+        make_classification_train_step,
+    )
+
+    model = create_model(
+        "resnet_tiny", stem="space_to_depth", num_classes=10
+    )
+    rng = jax.random.key(0)
+    images = jax.random.normal(rng, (4, 16, 16, 3), jnp.float32)
+    labels = jnp.array([0, 1, 2, 3])
+    state = create_train_state(
+        rng, model, images, optax.sgd(0.05), init_kwargs={"train": False}
+    )
+    step = jax.jit(make_classification_train_step(has_batch_stats=True))
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, (images, labels))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
