@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Protocol, Tuple
 
 from kubeflow_tpu.platform.k8s import errors
@@ -34,6 +35,7 @@ class KubeClient(Protocol):
         namespace: Optional[str] = None,
         *,
         label_selector: Optional[Dict[str, str]] = None,
+        field_selector: Optional[Dict[str, str]] = None,
     ) -> List[Resource]: ...
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource: ...
@@ -93,11 +95,42 @@ def _selector_string(label_selector: Optional[Dict[str, str]]) -> Optional[str]:
     return ",".join(f"{k}={v}" for k, v in sorted(label_selector.items()))
 
 
+class TokenBucket:
+    """QPS/burst rate limiter for API-server traffic (the reference exposes
+    the same pair as manager flags, notebook-controller main.go:64-76).
+    Thread-safe; acquire() blocks until a token is available."""
+
+    def __init__(self, qps: float, burst: int):
+        self.qps = float(qps)
+        self.burst = float(max(burst, 1))
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        while True:
+            with self._lock:
+                now = time.monotonic()
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._last) * self.qps
+                )
+                self._last = now
+                if self._tokens >= 1.0:
+                    self._tokens -= 1.0
+                    return
+                wait = (1.0 - self._tokens) / self.qps
+            time.sleep(wait)
+
+
 class RestKubeClient:
     """KubeClient over the real API server.
 
     Config resolution: explicit args → in-cluster service account →
     $KUBECONFIG/~/.kube/config (current-context, token or client-cert auth).
+
+    ``qps``/``burst`` bound request rate (env ``K8S_CLIENT_QPS`` /
+    ``K8S_CLIENT_BURST``; watch long-polls are exempt — they hold a
+    connection, they don't spam requests).
     """
 
     def __init__(
@@ -109,6 +142,8 @@ class RestKubeClient:
         client_cert: Optional[Tuple[str, str]] = None,
         verify: Optional[bool] = None,
         timeout: float = 30.0,
+        qps: Optional[float] = None,
+        burst: Optional[int] = None,
     ):
         import requests
 
@@ -116,6 +151,11 @@ class RestKubeClient:
             base_url, token, ca_cert, client_cert = self._resolve_config()
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        if qps is None:
+            qps = float(os.environ.get("K8S_CLIENT_QPS", "50"))
+        if burst is None:
+            burst = int(os.environ.get("K8S_CLIENT_BURST", "100"))
+        self._limiter = TokenBucket(qps, burst) if qps > 0 else None
         self._session = requests.Session()
         if token:
             self._session.headers["Authorization"] = f"Bearer {token}"
@@ -160,6 +200,8 @@ class RestKubeClient:
 
     def _request(self, method: str, path: str, *, params: Optional[dict] = None,
                  body: Optional[Any] = None, stream: bool = False):
+        if self._limiter is not None:
+            self._limiter.acquire()
         headers = {}
         if method == "PATCH":
             ptype = (params or {}).pop("_patch_type", "merge")
@@ -192,11 +234,19 @@ class RestKubeClient:
     def get(self, gvk: GVK, name: str, namespace: Optional[str] = None) -> Resource:
         return self._request("GET", gvk.path(namespace, name)).json()
 
-    def list(self, gvk, namespace=None, *, label_selector=None) -> List[Resource]:
+    def list(self, gvk, namespace=None, *, label_selector=None,
+             field_selector=None) -> List[Resource]:
+        """``field_selector`` is a dict of dotted field path → exact value
+        (e.g. ``{"involvedObject.name": "nb"}``), serialized to the API
+        server's fieldSelector syntax — only fields the server indexes for
+        the kind are accepted (events, pods.spec.nodeName, metadata.*)."""
         params = {}
         sel = _selector_string(label_selector)
         if sel:
             params["labelSelector"] = sel
+        fsel = _selector_string(field_selector)
+        if fsel:
+            params["fieldSelector"] = fsel
         data = self._request("GET", gvk.path(namespace), params=params).json()
         return data.get("items", [])
 

@@ -60,6 +60,8 @@ ROLEBINDING = GVK("rbac.authorization.k8s.io", "v1", "RoleBinding", "rolebinding
 CLUSTERROLE = GVK("rbac.authorization.k8s.io", "v1", "ClusterRole", "clusterroles", namespaced=False)
 STORAGECLASS = GVK("storage.k8s.io", "v1", "StorageClass", "storageclasses", namespaced=False)
 
+LEASE = GVK("coordination.k8s.io", "v1", "Lease", "leases")
+
 VIRTUALSERVICE = GVK("networking.istio.io", "v1beta1", "VirtualService", "virtualservices")
 AUTHORIZATIONPOLICY = GVK("security.istio.io", "v1beta1", "AuthorizationPolicy", "authorizationpolicies")
 

@@ -22,13 +22,22 @@ from kubeflow_tpu.platform.web.framework import App, HttpError, success
 
 
 def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
-               heartbeat: bool = False) -> App:
+               heartbeat: bool = False, use_informer: bool = False) -> App:
     from kubeflow_tpu.platform.runtime import metrics
 
     app = App("kfam")
     backend = CrudBackend(client, auth)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
-    manager = BindingManager(client)
+    cache = None
+    if use_informer:
+        from kubeflow_tpu.platform.k8s.types import ROLEBINDING
+        from kubeflow_tpu.platform.runtime.informer import Informer
+
+        # 60-min resync, matching the reference's informer cache
+        # (api_default.go:94-103).
+        cache = Informer(client, ROLEBINDING, resync_period=3600.0).start()
+        cache.wait_for_sync(10.0)
+    manager = BindingManager(client, cache=cache)
     if heartbeat:
         metrics.start_heartbeat("kfam")
 

@@ -36,8 +36,12 @@ def binding_name(user: str, role: str) -> str:
 
 class BindingManager:
     def __init__(self, client, *, userid_header: Optional[str] = None,
-                 userid_prefix: Optional[str] = None):
+                 userid_prefix: Optional[str] = None, cache=None):
+        """``cache`` is an optional started Informer over RoleBindings
+        (reference KFAM reads through a 60-min-resync informer,
+        api_default.go:94-103); queries fall back to live lists without it."""
         self.client = client
+        self.cache = cache
         self.userid_header = userid_header or config.env("USERID_HEADER", "kubeflow-userid")
         self.userid_prefix = (
             userid_prefix if userid_prefix is not None else config.env("USERID_PREFIX", "")
@@ -45,10 +49,17 @@ class BindingManager:
 
     # -- queries -------------------------------------------------------------
 
+    def _role_bindings(self, namespace: Optional[str]) -> List[Resource]:
+        # An unsynced cache would serve "no bindings" as authoritative —
+        # fall back to a live list until the initial LIST has landed.
+        if self.cache is not None and getattr(self.cache, "has_synced", True):
+            return self.cache.list(namespace)
+        return self.client.list(ROLEBINDING, namespace)
+
     def list_bindings(self, namespace: Optional[str] = None,
                       user: Optional[str] = None) -> List[dict]:
         out = []
-        for rb in self.client.list(ROLEBINDING, namespace):
+        for rb in self._role_bindings(namespace):
             annotations = deep_get(rb, "metadata", "annotations", default={}) or {}
             role = annotations.get("role")
             bound_user = annotations.get("user")

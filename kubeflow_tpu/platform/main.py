@@ -61,7 +61,12 @@ def run_controllers(args) -> int:
     from kubeflow_tpu.platform.runtime import Manager
 
     client = _client()
-    mgr = Manager(client)
+    mgr = Manager(
+        client,
+        # Same knob as the reference's --leader-elect flag (main.go:64-76).
+        leader_election=config.env_bool("LEADER_ELECT", False),
+        lease_namespace=config.env("POD_NAMESPACE", "kubeflow"),
+    )
     mgr.add(make_controller(client, use_istio=config.env_bool("USE_ISTIO", True)))
     mgr.add(profile.make_controller(
         client,
@@ -123,6 +128,7 @@ def run_web_app(name: str, args) -> int:
             kwargs["metrics_service"] = PrometheusMetricsService(prom)
     if name == "kfam":
         kwargs["heartbeat"] = True
+        kwargs["use_informer"] = True
     app = module.create_app(_client(), **kwargs)
     from werkzeug.serving import make_server as wz_make_server
 

@@ -265,15 +265,34 @@ class Controller:
 class Manager:
     """Holds the client and a set of controllers; start/stop together.
 
-    The reference manager adds leader election + health endpoints
-    (notebook-controller main.go:57-147); here leadership is delegated to
-    the Deployment's single replica and health is exposed by serve_health().
+    With ``leader_election=True`` the manager contends for a
+    coordination.k8s.io Lease (reference notebook-controller main.go:90-92)
+    and only starts its controllers while leading.  Like controller-runtime,
+    lost leadership is terminal for this manager: controllers stop and
+    ``healthy()`` turns false so the liveness probe restarts the pod — a
+    single-writer guarantee is worth a restart.
     """
 
-    def __init__(self, client):
+    def __init__(self, client, *, leader_election: bool = False,
+                 lease_name: str = "kubeflow-tpu-controller-leader",
+                 lease_namespace: str = "kubeflow",
+                 identity: Optional[str] = None):
         self.client = client
         self.controllers: List[Controller] = []
         self._started = False
+        self._lost_leadership = False
+        self.elector = None
+        if leader_election:
+            from kubeflow_tpu.platform.runtime.leader import LeaderElector
+
+            self.elector = LeaderElector(
+                client,
+                name=lease_name,
+                namespace=lease_namespace,
+                identity=identity,
+                on_started_leading=self._start_controllers,
+                on_stopped_leading=self._on_lost_leadership,
+            )
         # Eagerly load/build libkfnative so the first watch event doesn't
         # pay for it (see native.preload()).
         from kubeflow_tpu.platform import native
@@ -286,14 +305,42 @@ class Manager:
             controller.start(self.client)
         return controller
 
-    def start(self) -> None:
+    def _start_controllers(self) -> None:
         self._started = True
         for c in self.controllers:
             c.start(self.client)
 
+    def _on_lost_leadership(self) -> None:
+        # Terminal, like controller-runtime: stopped controllers cannot be
+        # restarted (their queues are shut down), so stop contending too —
+        # re-acquiring the lease here would hold it while reconciling
+        # nothing.  healthy() goes false; the liveness probe restarts us.
+        self._lost_leadership = True
+        if self.elector is not None:
+            self.elector._stop.set()  # signal only; joining self deadlocks
+        for c in self.controllers:
+            c.stop()
+
+    def start(self) -> None:
+        if self.elector is not None:
+            self.elector.start()  # controllers start when the lease lands
+        else:
+            self._start_controllers()
+
     def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()
         for c in self.controllers:
             c.stop()
 
     def healthy(self) -> bool:
+        if self._lost_leadership:
+            return False
+        if self.elector is not None:
+            # Standby replicas are healthy — they're waiting, not broken.
+            return True
         return self._started
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.is_leader if self.elector else self._started

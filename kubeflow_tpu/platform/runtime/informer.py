@@ -1,0 +1,166 @@
+"""Shared informer: a list+watch cache with handlers and periodic resync.
+
+The reference's Go services read through client-go informer caches instead
+of hitting the API server per request — KFAM keeps a RoleBinding informer
+with a 60-minute resync (reference access-management/kfam/
+api_default.go:94-103).  This is the same machinery for this platform's
+client interface: one initial LIST seeds a thread-safe store, a WATCH
+thread applies deltas, watch failures trigger a relist (the store is
+rebuilt, never served half-empty), and a resync timer guards against
+missed deltas on bounded watch windows.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from kubeflow_tpu.platform.k8s.types import (
+    GVK,
+    Resource,
+    deep_get,
+    meta,
+    name_of,
+    namespace_of,
+)
+
+log = logging.getLogger("kubeflow_tpu.runtime.informer")
+
+Handler = Callable[[str, Resource], None]  # (event_type, object)
+
+
+class Informer:
+    def __init__(self, client, gvk: GVK, *, namespace: Optional[str] = None,
+                 resync_period: float = 3600.0):
+        self.client = client
+        self.gvk = gvk
+        self.namespace = namespace
+        self.resync_period = resync_period
+        self._store: Dict[Tuple[str, str], Resource] = {}
+        self._lock = threading.RLock()
+        self._synced = threading.Event()
+        self._stop = threading.Event()
+        self._handlers: List[Handler] = []
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "Informer":
+        self._thread = threading.Thread(
+            target=self._run, name=f"informer-{self.gvk.kind}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_for_sync(self, timeout: float = 30.0) -> bool:
+        return self._synced.wait(timeout)
+
+    @property
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    def add_handler(self, handler: Handler) -> None:
+        """Register for deltas.  Objects already in the store are replayed
+        as ADDED so late subscribers see a complete stream."""
+        with self._lock:
+            self._handlers.append(handler)
+            existing = list(self._store.values())
+        for obj in existing:
+            handler("ADDED", obj)
+
+    # -- read API ------------------------------------------------------------
+
+    def get(self, name: str, namespace: Optional[str] = None) -> Optional[Resource]:
+        import copy
+
+        with self._lock:
+            obj = self._store.get((namespace or "", name))
+        # Deep-copy like every KubeClient.list/get: a caller mutating a
+        # result must not corrupt the shared cache.
+        return copy.deepcopy(obj) if obj is not None else None
+
+    def list(self, namespace: Optional[str] = None, *,
+             label_selector: Optional[Dict[str, str]] = None) -> List[Resource]:
+        import copy
+
+        with self._lock:
+            items = [copy.deepcopy(o) for o in self._store.values()]
+        if namespace is not None:
+            items = [o for o in items if namespace_of(o) == namespace]
+        if label_selector:
+            def matches(o):
+                labels = deep_get(o, "metadata", "labels", default={}) or {}
+                return all(labels.get(k) == v for k, v in label_selector.items())
+
+            items = [o for o in items if matches(o)]
+        return items
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._store)
+
+    # -- internals -----------------------------------------------------------
+
+    def _key(self, obj: Resource) -> Tuple[str, str]:
+        return (namespace_of(obj) or "", name_of(obj))
+
+    def _relist(self) -> None:
+        items = self.client.list(self.gvk, self.namespace)
+        fresh = {self._key(o): o for o in items}
+        with self._lock:
+            old = self._store
+            self._store = fresh
+            handlers = list(self._handlers)
+        for key, obj in fresh.items():
+            prior = old.get(key)
+            if prior is None:
+                self._notify(handlers, "ADDED", obj)
+            elif meta(prior).get("resourceVersion") != meta(obj).get("resourceVersion"):
+                self._notify(handlers, "MODIFIED", obj)
+        for key, obj in old.items():
+            if key not in fresh:
+                self._notify(handlers, "DELETED", obj)
+
+    @staticmethod
+    def _notify(handlers, etype: str, obj: Resource) -> None:
+        for h in handlers:
+            try:
+                h(etype, obj)
+            except Exception:
+                log.exception("informer handler failed")
+
+    def _apply(self, etype: str, obj: Resource) -> None:
+        with self._lock:
+            handlers = list(self._handlers)
+            if etype == "DELETED":
+                self._store.pop(self._key(obj), None)
+            elif etype in ("ADDED", "MODIFIED"):
+                self._store[self._key(obj)] = obj
+            else:
+                return  # BOOKMARK etc.
+        self._notify(handlers, etype, obj)
+
+    def _run(self) -> None:
+        import time as _time
+
+        while not self._stop.is_set():
+            try:
+                self._relist()
+                self._synced.set()
+                deadline = _time.monotonic() + self.resync_period
+                for etype, obj in self.client.watch(
+                    self.gvk, self.namespace, stop=self._stop
+                ):
+                    self._apply(etype, obj)
+                    if _time.monotonic() >= deadline:
+                        break  # fall through to relist
+            except Exception:
+                if not self._stop.is_set():
+                    log.warning(
+                        "informer %s: watch failed, relisting", self.gvk.kind,
+                        exc_info=True,
+                    )
+                    self._stop.wait(1.0)

@@ -94,7 +94,8 @@ class FakeKube:
         with self._lock:
             return copy.deepcopy(self._get_ref(gvk, name, namespace))
 
-    def list(self, gvk, namespace=None, *, label_selector=None) -> List[Resource]:
+    def list(self, gvk, namespace=None, *, label_selector=None,
+             field_selector=None) -> List[Resource]:
         with self._lock:
             out = []
             for (av, kind, ns, _), obj in self._objects.items():
@@ -103,6 +104,8 @@ class FakeKube:
                 if gvk.namespaced and namespace and ns != namespace:
                     continue
                 if label_selector and not match_labels(obj, label_selector):
+                    continue
+                if field_selector and not _match_fields(obj, field_selector):
                     continue
                 out.append(copy.deepcopy(obj))
             return out
@@ -352,3 +355,17 @@ def _merge_patch(target: Resource, patch: Any) -> None:
             _merge_patch(target[k], v)
         else:
             target[k] = copy.deepcopy(v)
+
+
+def _match_fields(obj: Resource, field_selector: Dict[str, str]) -> bool:
+    """Dotted-path equality, the fieldSelector subset real servers support."""
+    for path, want in field_selector.items():
+        value = obj
+        for part in path.split("."):
+            if not isinstance(value, dict):
+                value = None
+                break
+            value = value.get(part)
+        if value is None or str(value) != str(want):
+            return False
+    return True

@@ -1,0 +1,127 @@
+"""Shared informer cache + field selectors (reference KFAM informer,
+api_default.go:94-103)."""
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.k8s.types import EVENT, ROLEBINDING
+from kubeflow_tpu.platform.runtime.informer import Informer
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def rb(name, ns, user="alice@x.org"):
+    return {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": {"role": "edit", "user": user}},
+        "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": "kubeflow-edit"},
+    }
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("ns1")
+    k.add_namespace("ns2")
+    return k
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_informer_seeds_then_tracks(kube):
+    kube.create(rb("b1", "ns1"))
+    inf = Informer(kube, ROLEBINDING).start()
+    assert inf.wait_for_sync(5)
+    assert len(inf) == 1 and inf.get("b1", "ns1") is not None
+
+    kube.create(rb("b2", "ns2"))
+    assert _wait(lambda: inf.get("b2", "ns2") is not None)
+    kube.delete(ROLEBINDING, "b1", "ns1")
+    assert _wait(lambda: inf.get("b1", "ns1") is None)
+    assert [r["metadata"]["name"] for r in inf.list("ns2")] == ["b2"]
+    inf.stop()
+
+
+def test_informer_handlers_replay_and_stream(kube):
+    kube.create(rb("b1", "ns1"))
+    inf = Informer(kube, ROLEBINDING).start()
+    inf.wait_for_sync(5)
+    seen = []
+    inf.add_handler(lambda et, obj: seen.append((et, obj["metadata"]["name"])))
+    assert seen == [("ADDED", "b1")]  # replay of the existing store
+    kube.create(rb("b2", "ns1"))
+    assert _wait(lambda: ("ADDED", "b2") in seen)
+    inf.stop()
+
+
+def test_kfam_reads_through_cache(kube):
+    from kubeflow_tpu.platform.kfam.bindings import BindingManager
+
+    kube.create(rb("user-alice-clusterrole-edit", "ns1"))
+    inf = Informer(kube, ROLEBINDING).start()
+    inf.wait_for_sync(5)
+    mgr = BindingManager(kube, cache=inf)
+    out = mgr.list_bindings("ns1")
+    assert len(out) == 1 and out[0]["referredNamespace"] == "ns1"
+
+    # The cache serves reads even if the live client starts failing.
+    class Broken:
+        def list(self, *a, **k):
+            raise RuntimeError("api server down")
+
+    mgr_broken = BindingManager(Broken(), cache=inf)
+    assert len(mgr_broken.list_bindings("ns1")) == 1
+    inf.stop()
+
+
+def test_field_selector_fake_and_rest_param(kube):
+    kube.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "e1", "namespace": "ns1"},
+        "involvedObject": {"kind": "Pod", "name": "nb-0"},
+        "reason": "FailedScheduling",
+    })
+    kube.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "e2", "namespace": "ns1"},
+        "involvedObject": {"kind": "StatefulSet", "name": "nb"},
+        "reason": "Created",
+    })
+    got = kube.list(EVENT, "ns1",
+                    field_selector={"involvedObject.kind": "Pod"})
+    assert [e["metadata"]["name"] for e in got] == ["e1"]
+    got = kube.list(EVENT, "ns1", field_selector={
+        "involvedObject.kind": "StatefulSet", "involvedObject.name": "nb",
+    })
+    assert [e["metadata"]["name"] for e in got] == ["e2"]
+    assert kube.list(EVENT, "ns1",
+                     field_selector={"involvedObject.kind": "Job"}) == []
+
+
+def test_informer_survives_watch_failure(kube):
+    # A watch that raises must trigger a relist, not kill the informer.
+    calls = {"n": 0}
+    real_watch = kube.watch
+
+    def flaky_watch(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("watch broke")
+        return real_watch(*args, **kwargs)
+
+    kube.watch = flaky_watch
+    kube.create(rb("b1", "ns1"))
+    inf = Informer(kube, ROLEBINDING).start()
+    assert inf.wait_for_sync(5)
+    kube.create(rb("b2", "ns1"))
+    assert _wait(lambda: inf.get("b2", "ns1") is not None)
+    inf.stop()

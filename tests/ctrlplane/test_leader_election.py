@@ -1,0 +1,179 @@
+"""Lease-based leader election (reference main.go:90-92) + client QPS."""
+import datetime
+import time
+
+from kubeflow_tpu.platform.runtime.leader import LeaderElector
+from kubeflow_tpu.platform.testing import FakeKube
+
+T0 = datetime.datetime(2026, 7, 30, 12, 0, 0, tzinfo=datetime.timezone.utc)
+
+
+class Clock:
+    def __init__(self):
+        self.now = T0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, seconds):
+        self.now += datetime.timedelta(seconds=seconds)
+
+
+def elector(kube, ident, clock, **kw):
+    return LeaderElector(
+        kube, name="test-lease", namespace="kubeflow", identity=ident,
+        lease_seconds=15, now=clock, **kw,
+    )
+
+
+def test_exactly_one_acquires():
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    clock = Clock()
+    a, b = elector(kube, "a", clock), elector(kube, "b", clock)
+    assert a.try_acquire_or_renew() == "leading"
+    assert b.try_acquire_or_renew() == "lost"
+    # a renews fine; b still locked out.
+    clock.advance(5)
+    assert a.try_acquire_or_renew() == "leading"
+    assert b.try_acquire_or_renew() == "lost"
+
+
+def test_expiry_failover():
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    clock = Clock()
+    a, b = elector(kube, "a", clock), elector(kube, "b", clock)
+    assert a.try_acquire_or_renew() == "leading"
+    clock.advance(16)  # past leaseDurationSeconds without renewal
+    assert b.try_acquire_or_renew() == "leading"
+    # a's next renew must fail: the lease moved.
+    assert a.try_acquire_or_renew() == "lost"
+
+
+def test_release_hands_over_immediately():
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    clock = Clock()
+    a, b = elector(kube, "a", clock), elector(kube, "b", clock)
+    assert a.try_acquire_or_renew() == "leading"
+    a.release()
+    assert b.try_acquire_or_renew() == "leading"
+
+
+def test_manager_leader_election_single_writer():
+    from kubeflow_tpu.platform.runtime import Manager
+
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    m1 = Manager(kube, leader_election=True, identity="m1")
+    m2 = Manager(kube, leader_election=True, identity="m2")
+    # Speed the loops up for the test.
+    for m in (m1, m2):
+        m.elector.lease_seconds = 1.0
+        m.elector.renew_seconds = 0.05
+        m.elector.retry_seconds = 0.05
+    m1.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not m1.is_leader:
+        time.sleep(0.01)
+    assert m1.is_leader
+    m2.start()
+    time.sleep(0.2)
+    assert not m2.is_leader
+    assert m2.healthy()  # standby is healthy, just not leading
+    # m1 shuts down -> lease released -> m2 takes over.
+    m1.stop()
+    deadline = time.time() + 5
+    while time.time() < deadline and not m2.is_leader:
+        time.sleep(0.01)
+    assert m2.is_leader
+    m2.stop()
+
+
+def test_token_bucket_limits_rate():
+    from kubeflow_tpu.platform.k8s.client import TokenBucket
+
+    tb = TokenBucket(qps=200, burst=3)
+    t0 = time.monotonic()
+    for _ in range(3):
+        tb.acquire()  # burst: immediate
+    burst_t = time.monotonic() - t0
+    assert burst_t < 0.05
+    for _ in range(4):
+        tb.acquire()  # must wait ~5ms each at 200 qps
+    assert time.monotonic() - t0 >= 4 / 200
+
+
+def test_transient_api_error_does_not_drop_leadership():
+    # client-go semantics: a failed renew only demotes once the lease
+    # duration has elapsed without a successful renewal.
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+
+    class Flaky:
+        """Proxy that can be told to fail the next N API calls."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.fail = 0
+
+        def __getattr__(self, name):
+            fn = getattr(self._inner, name)
+
+            def wrapped(*a, **k):
+                if self.fail > 0 and name in ("get", "update", "create"):
+                    self.fail -= 1
+                    raise RuntimeError("apiserver blip")
+                return fn(*a, **k)
+
+            return wrapped
+
+    flaky = Flaky(kube)
+    el = LeaderElector(
+        kube, name="t", namespace="kubeflow", identity="a",
+        lease_seconds=2.0, renew_seconds=0.05, retry_seconds=0.05,
+    )
+    el.client = flaky
+    became, lost = [], []
+    el.on_started_leading = lambda: became.append(1)
+    el.on_stopped_leading = lambda: lost.append(1)
+    el.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not el.is_leader:
+        time.sleep(0.01)
+    assert el.is_leader
+    flaky.fail = 3  # a few blips, well inside the 2s lease window
+    time.sleep(0.5)
+    assert el.is_leader and not lost
+    el.stop()
+
+
+def test_lost_leadership_is_terminal_for_manager():
+    from kubeflow_tpu.platform.runtime import Manager
+
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    m = Manager(kube, leader_election=True, identity="m")
+    m.elector.lease_seconds = 0.4
+    m.elector.renew_seconds = 0.05
+    m.elector.retry_seconds = 0.05
+    m.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not m.is_leader:
+        time.sleep(0.01)
+    assert m.is_leader
+    # Steal the lease: m sees a live foreign holder -> definitive loss.
+    from kubeflow_tpu.platform.k8s.types import LEASE
+
+    lease = kube.get(LEASE, "kubeflow-tpu-controller-leader", "kubeflow")
+    lease["spec"]["holderIdentity"] = "other"
+    lease["spec"]["renewTime"] = "2199-01-01T00:00:00.000000Z"
+    kube.update(lease)
+    deadline = time.time() + 5
+    while time.time() < deadline and m.healthy():
+        time.sleep(0.01)
+    assert not m.healthy()          # terminal: liveness probe restarts us
+    time.sleep(0.2)
+    assert not m.is_leader          # and we never re-contend
+    m.stop()
