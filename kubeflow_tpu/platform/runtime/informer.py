@@ -135,32 +135,74 @@ class Informer:
     def _apply(self, etype: str, obj: Resource) -> None:
         with self._lock:
             handlers = list(self._handlers)
+            key = self._key(obj)
             if etype == "DELETED":
-                self._store.pop(self._key(obj), None)
+                if self._store.pop(key, None) is None:
+                    return  # already gone; don't replay the delete
             elif etype in ("ADDED", "MODIFIED"):
-                self._store[self._key(obj)] = obj
+                prior = self._store.get(key)
+                if prior is not None and meta(prior).get(
+                    "resourceVersion"
+                ) == meta(obj).get("resourceVersion"):
+                    # Watch replay of an object the store already holds at
+                    # this exact version (re-established watches without a
+                    # resume RV re-deliver the backlog as ADDED) — handlers
+                    # must not see duplicates.
+                    return
+                self._store[key] = obj
             else:
                 return  # BOOKMARK etc.
         self._notify(handlers, etype, obj)
 
+    def _max_rv(self) -> Optional[str]:
+        """Best-effort watch resume point: the max object resourceVersion in
+        the store.  RVs are opaque strings, but both this repo's fake and
+        etcd-backed servers use monotonically increasing integers; anything
+        unparsable disables resume (full replay, deduped by _apply)."""
+        with self._lock:
+            rvs = []
+            for obj in self._store.values():
+                try:
+                    rvs.append(int(meta(obj).get("resourceVersion", "")))
+                except (TypeError, ValueError):
+                    return None
+            return str(max(rvs)) if rvs else None
+
     def _run(self) -> None:
         import time as _time
 
+        deadline = 0.0
+        rv: Optional[str] = None
         while not self._stop.is_set():
             try:
-                self._relist()
-                self._synced.set()
-                deadline = _time.monotonic() + self.resync_period
+                if rv is None or _time.monotonic() >= deadline:
+                    # Initial sync or scheduled resync: full relist (the
+                    # store diff suppresses no-op handler calls).  Between
+                    # resyncs, watch re-establishments resume from the last
+                    # seen RV instead of relisting — a bounded watch window
+                    # (RestKubeClient closes at 300s) must not turn the
+                    # 3600s resync into a 300s one.
+                    self._relist()
+                    self._synced.set()
+                    deadline = _time.monotonic() + self.resync_period
+                    rv = self._max_rv()
                 for etype, obj in self.client.watch(
-                    self.gvk, self.namespace, stop=self._stop
+                    self.gvk, self.namespace, resource_version=rv,
+                    stop=self._stop,
                 ):
                     self._apply(etype, obj)
+                    if rv is not None:
+                        new_rv = meta(obj).get("resourceVersion")
+                        if new_rv is not None:
+                            rv = new_rv
                     if _time.monotonic() >= deadline:
-                        break  # fall through to relist
+                        rv = None  # fall through to relist
+                        break
             except Exception:
                 if not self._stop.is_set():
                     log.warning(
                         "informer %s: watch failed, relisting", self.gvk.kind,
                         exc_info=True,
                     )
+                    rv = None  # stale-RV or transport error: start clean
                     self._stop.wait(1.0)

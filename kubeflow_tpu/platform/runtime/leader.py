@@ -148,19 +148,19 @@ class LeaderElector:
         }
 
     def release(self) -> None:
-        """Give the lease up so a standby can take over immediately."""
+        """Give the lease up so a standby can take over immediately.
+        Best-effort: any failure (API or transport — shutdown often races
+        an unreachable API server) must not abort the caller's shutdown;
+        the lease then simply expires on its own."""
         try:
             lease = self.client.get(LEASE, self.name, self.namespace)
-        except errors.ApiError:
-            return
-        if deep_get(lease, "spec", "holderIdentity") != self.identity:
-            return
-        lease = copy.deepcopy(lease)
-        lease["spec"]["holderIdentity"] = ""
-        lease["spec"]["renewTime"] = None
-        try:
+            if deep_get(lease, "spec", "holderIdentity") != self.identity:
+                return
+            lease = copy.deepcopy(lease)
+            lease["spec"]["holderIdentity"] = ""
+            lease["spec"]["renewTime"] = None
             self.client.update(lease)
-        except errors.ApiError:
+        except Exception:
             pass
 
     # -- loop ----------------------------------------------------------------

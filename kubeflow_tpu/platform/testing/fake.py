@@ -359,13 +359,10 @@ def _merge_patch(target: Resource, patch: Any) -> None:
 
 def _match_fields(obj: Resource, field_selector: Dict[str, str]) -> bool:
     """Dotted-path equality, the fieldSelector subset real servers support."""
+    from kubeflow_tpu.platform.k8s.types import deep_get
+
     for path, want in field_selector.items():
-        value = obj
-        for part in path.split("."):
-            if not isinstance(value, dict):
-                value = None
-                break
-            value = value.get(part)
+        value = deep_get(obj, *path.split("."))
         if value is None or str(value) != str(want):
             return False
     return True
