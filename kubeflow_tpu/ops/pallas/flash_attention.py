@@ -153,7 +153,7 @@ def _compiler_params(interpret, semantics):
 def _scratch(shape, dtype=jnp.float32):
     if pltpu is not None:
         return pltpu.VMEM(shape, dtype)
-    return pl.pallas_core.MemoryRef(shape, dtype)  # pragma: no cover
+    return pl.MemoryRef(shape, dtype)  # pragma: no cover
 
 
 def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
@@ -230,7 +230,36 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
     return out.transpose(0, 2, 1, 3)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *,
+              causal, scale, q_start, k_start, block_q, block_k):
+    """Shared backward tile math: (p, ds, do) for one (q, k) block pair.
+    delta = rowsum(dO ∘ O) is recomputed here from the residuals instead of
+    being materialized lane-replicated in HBM (it is one scalar per row; a
+    (bq, d) elementwise pass in VMEM is cheaper than 128x HBM traffic).
+    The mask convention must stay identical to _fwd_kernel's."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    o = o_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, 0:1]  # (bq, 1), lane-replicated source
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where((q_start + rows) >= (k_start + cols), s, _NEG_INF)
+    p = jnp.exp(s - lse)  # (bq, bk)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = p * (dp - delta) * scale  # (bq, bk)
+    return q, k, p, ds, do
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                acc_ref, *, causal, scale, block_q, block_k, num_k):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -247,24 +276,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, 0:1]      # (bq, 1), lane-replicated source
-        delta = delta_ref[0, 0][:, 0:1]  # (bq, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where((q_start + rows) >= (k_start + cols), s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        _, k, _, ds, _ = _bwd_tile(
+            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+            causal=causal, scale=scale, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_k=block_k,
         )
-        ds = p * (dp - delta) * scale
         acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -274,7 +290,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *,
                 causal, scale, block_q, block_k, num_q):
     ki = pl.program_id(2)
@@ -293,27 +309,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, 0:1]
-        delta = delta_ref[0, 0][:, 0:1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where((q_start + rows) >= (k_start + cols), s, _NEG_INF)
-        p = jnp.exp(s - lse)  # (bq, bk)
+        q, _, p, ds, do = _bwd_tile(
+            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+            causal=causal, scale=scale, q_start=q_start, k_start=k_start,
+            block_q=block_q, block_k=block_k,
+        )
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta) * scale  # (bq, bk)
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -340,13 +343,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
+    ot = out.transpose(0, 2, 1, 3)
     dot = g.transpose(0, 2, 1, 3)
-    # delta = rowsum(dO * O), lane-replicated like lse.
-    delta = jnp.sum(
-        dot.astype(jnp.float32) * out.transpose(0, 2, 1, 3).astype(jnp.float32),
-        axis=-1, keepdims=True,
-    )
-    delta = jnp.broadcast_to(delta, (b, hq, sq, 128))
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
@@ -362,7 +360,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
             block_q=bq, block_k=bk, num_k=num_k,
         ),
         grid=(b, hq, num_q, num_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lse_spec, lse_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         scratch_shapes=[_scratch((bq, d))],
@@ -370,7 +368,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, ot, dot, lse)
 
     # dk/dv: grid ordered (k, q) so the q axis is the sequential one.
     q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
@@ -390,7 +388,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
             block_q=bq, block_k=bk, num_q=num_q,
         ),
         grid=(b, hq, num_k, num_q),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, lse_spec2, lse_spec2],
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
         out_specs=[dkv_out_spec, dkv_out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sk, d), k.dtype),
@@ -401,7 +399,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt, dot, lse, delta)
+    )(qt, kt, vt, ot, dot, lse)
 
     if n_rep > 1:
         dk = dk.reshape(b, hk, n_rep, sk, d).sum(axis=2)

@@ -250,6 +250,14 @@ class RestKubeClient:
         data = self._request("GET", gvk.path(namespace), params=params).json()
         return data.get("items", [])
 
+    def list_with_rv(self, gvk, namespace=None):
+        """List plus the collection resourceVersion — the correct point to
+        resume a watch from (object RVs miss deletions; informers need the
+        snapshot RV)."""
+        data = self._request("GET", gvk.path(namespace)).json()
+        rv = ((data.get("metadata") or {}).get("resourceVersion"))
+        return data.get("items", []), rv
+
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
         gvk = gvk_of(obj)
         params = {"dryRun": "All"} if dry_run else None

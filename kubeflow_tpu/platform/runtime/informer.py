@@ -107,8 +107,14 @@ class Informer:
     def _key(self, obj: Resource) -> Tuple[str, str]:
         return (namespace_of(obj) or "", name_of(obj))
 
-    def _relist(self) -> None:
-        items = self.client.list(self.gvk, self.namespace)
+    def _relist(self) -> Optional[str]:
+        """Rebuild the store from a full LIST; returns the collection
+        resourceVersion to resume the watch from (None when the client
+        can't provide one — the watch then replays, deduped by _apply)."""
+        if hasattr(self.client, "list_with_rv"):
+            items, rv = self.client.list_with_rv(self.gvk, self.namespace)
+        else:
+            items, rv = self.client.list(self.gvk, self.namespace), None
         fresh = {self._key(o): o for o in items}
         with self._lock:
             old = self._store
@@ -123,6 +129,7 @@ class Informer:
         for key, obj in old.items():
             if key not in fresh:
                 self._notify(handlers, "DELETED", obj)
+        return rv
 
     @staticmethod
     def _notify(handlers, etype: str, obj: Resource) -> None:
@@ -154,20 +161,6 @@ class Informer:
                 return  # BOOKMARK etc.
         self._notify(handlers, etype, obj)
 
-    def _max_rv(self) -> Optional[str]:
-        """Best-effort watch resume point: the max object resourceVersion in
-        the store.  RVs are opaque strings, but both this repo's fake and
-        etcd-backed servers use monotonically increasing integers; anything
-        unparsable disables resume (full replay, deduped by _apply)."""
-        with self._lock:
-            rvs = []
-            for obj in self._store.values():
-                try:
-                    rvs.append(int(meta(obj).get("resourceVersion", "")))
-                except (TypeError, ValueError):
-                    return None
-            return str(max(rvs)) if rvs else None
-
     def _run(self) -> None:
         import time as _time
 
@@ -178,23 +171,27 @@ class Informer:
                 if rv is None or _time.monotonic() >= deadline:
                     # Initial sync or scheduled resync: full relist (the
                     # store diff suppresses no-op handler calls).  Between
-                    # resyncs, watch re-establishments resume from the last
-                    # seen RV instead of relisting — a bounded watch window
-                    # (RestKubeClient closes at 300s) must not turn the
-                    # 3600s resync into a 300s one.
-                    self._relist()
+                    # resyncs, watch re-establishments resume from the
+                    # list's collection RV / the last event's RV instead of
+                    # relisting — a bounded watch window (RestKubeClient
+                    # closes at 300s) must not turn the 3600s resync into a
+                    # 300s one.
+                    rv = self._relist()
                     self._synced.set()
                     deadline = _time.monotonic() + self.resync_period
-                    rv = self._max_rv()
                 for etype, obj in self.client.watch(
                     self.gvk, self.namespace, resource_version=rv,
                     stop=self._stop,
                 ):
+                    if etype == "ERROR":
+                        # Typically 410 Gone: the resume RV was compacted.
+                        # Relist instead of re-issuing a doomed watch.
+                        rv = None
+                        break
                     self._apply(etype, obj)
-                    if rv is not None:
-                        new_rv = meta(obj).get("resourceVersion")
-                        if new_rv is not None:
-                            rv = new_rv
+                    new_rv = meta(obj).get("resourceVersion")
+                    if new_rv is not None:
+                        rv = new_rv
                     if _time.monotonic() >= deadline:
                         rv = None  # fall through to relist
                         break

@@ -34,6 +34,7 @@ from kubeflow_tpu.platform.k8s.types import (
     NODE,
     POD,
     Resource,
+    deep_get,
     gvk_of,
     match_labels,
     meta,
@@ -58,6 +59,7 @@ class FakeKube:
         self._uid = itertools.count(1)
         self._watchers: List[Tuple[GVK, Optional[str], Optional[dict], queue.Queue]] = []
         self._now = now or time.time
+        self._latest_rv = "0"  # collection resourceVersion (see list_with_rv)
         # SubjectAccessReview policy: (user, verb, gvk, namespace) -> bool.
         self.authz_policy: Optional[Callable[..., bool]] = None
         # (namespace, pod, container|None) -> log text (see set_pod_logs).
@@ -66,7 +68,8 @@ class FakeKube:
     # -- helpers -------------------------------------------------------------
 
     def _bump(self, obj: Resource) -> None:
-        meta(obj)["resourceVersion"] = str(next(self._rv))
+        self._latest_rv = str(next(self._rv))
+        meta(obj)["resourceVersion"] = self._latest_rv
 
     def _emit(self, event_type: str, obj: Resource) -> None:
         gvk = gvk_of(obj)
@@ -109,6 +112,12 @@ class FakeKube:
                     continue
                 out.append(copy.deepcopy(obj))
             return out
+
+    def list_with_rv(self, gvk, namespace=None):
+        """List plus the collection resourceVersion, like the real server's
+        listMeta.resourceVersion."""
+        with self._lock:
+            return self.list(gvk, namespace), self._latest_rv
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
         with self._lock:
@@ -359,8 +368,6 @@ def _merge_patch(target: Resource, patch: Any) -> None:
 
 def _match_fields(obj: Resource, field_selector: Dict[str, str]) -> bool:
     """Dotted-path equality, the fieldSelector subset real servers support."""
-    from kubeflow_tpu.platform.k8s.types import deep_get
-
     for path, want in field_selector.items():
         value = deep_get(obj, *path.split("."))
         if value is None or str(value) != str(want):

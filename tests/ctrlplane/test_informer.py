@@ -147,11 +147,51 @@ def test_informer_dedups_watch_replay(kube):
     inf.stop()
 
 
-def test_informer_resumes_from_resource_version(kube):
-    # Between resyncs the watch must resume from the last seen RV rather
-    # than relisting on every re-establishment.
+def test_informer_resumes_from_collection_rv(kube):
+    # Between resyncs the watch must resume from the list's COLLECTION
+    # resourceVersion (object RVs miss deletions: an object created and
+    # deleted between the max object RV and the snapshot would otherwise be
+    # replayed into the store as a spurious ADDED).
     kube.create(rb("b1", "ns1"))
+    created = kube.create(rb("doomed", "ns1"))
+    kube.delete(ROLEBINDING, "doomed", "ns1")
     inf = Informer(kube, ROLEBINDING)
-    inf._relist()
-    assert inf._max_rv() is not None
-    assert int(inf._max_rv()) >= 1
+    rv = inf._relist()
+    assert rv is not None
+    # The collection RV is at least as new as the deleted object's RV.
+    assert int(rv) >= int(created["metadata"]["resourceVersion"])
+
+
+def test_informer_error_event_forces_relist(kube):
+    # A watch ERROR (410 Gone on a compacted RV) must trigger a relist, not
+    # a tight reconnect loop with the same stale RV.
+    import queue as _q
+
+    kube.create(rb("b1", "ns1"))
+    relists = []
+    orig = kube.list_with_rv
+
+    def counting_list_with_rv(*a, **k):
+        relists.append(1)
+        return orig(*a, **k)
+
+    kube.list_with_rv = counting_list_with_rv
+    events = _q.Queue()
+    events.put(("ERROR", {"kind": "Status", "code": 410}))
+
+    real_watch = kube.watch
+
+    def watch_with_error(*args, stop=None, **kwargs):
+        try:
+            yield events.get_nowait()
+        except _q.Empty:
+            yield from real_watch(*args, stop=stop, **kwargs)
+
+    kube.watch = watch_with_error
+    inf = Informer(kube, ROLEBINDING).start()
+    assert inf.wait_for_sync(5)
+    # ERROR consumed -> second relist happens and the informer still tracks.
+    assert _wait(lambda: len(relists) >= 2)
+    kube.create(rb("b2", "ns1"))
+    assert _wait(lambda: inf.get("b2", "ns1") is not None)
+    inf.stop()

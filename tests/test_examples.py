@@ -70,3 +70,16 @@ def test_pytorch_xla_notebook_structure():
     src = _code("03_bert_finetune_pytorch_xla.ipynb")
     for needle in ("torch_xla", "xla_device", "AdamW", "mark_step"):
         assert needle in src
+
+
+def test_generation_t5_notebook_runs_tiny(devices8):
+    src = _code("06_generation_t5.ipynb")
+    src = src.replace('CFG = "llama_125m"', 'CFG = "llama_debug"')
+    src = src.replace("MAX_SEQ = 512", "MAX_SEQ = 64")
+    src = src.replace("NEW_TOKENS = 32", "NEW_TOKENS = 4")
+    src = src.replace('T5_CONFIGS["t5_small"]', 'T5_CONFIGS["t5_debug"]')
+    src = src.replace("SRC_LEN, TGT_LEN, BATCH = 64, 32, 8",
+                      "SRC_LEN, TGT_LEN, BATCH = 16, 8, 2")
+    src = src.replace("0, 32000)", "0, 128)")
+    src = src.replace("STEPS = 3", "STEPS = 1")
+    exec(compile(src, "nb06", "exec"), {})
