@@ -40,6 +40,12 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     attn_impl: str = "auto"
+    # Stack the identical blocks into one lax.scan (nn.scan): one compiled
+    # block body instead of n_layers inlined copies — compile time drops
+    # near-linearly with depth, the standard TPU idiom for 32+ layer models.
+    # Params gain a leading layer axis; parallel.sharding prepends None to
+    # the matched spec for paths under "layers_scan".
+    scan_layers: bool = False
     # MoE (Mixtral-style): n_experts == 0 means a dense SwiGLU MLP.
     n_experts: int = 0
     top_k: int = 2
@@ -116,6 +122,24 @@ class LlamaBlock(nn.Module):
         return x + h
 
 
+class LlamaScanBody(nn.Module):
+    """nn.scan body: carry = activations, no per-layer outputs."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids, decode, mask_bias,
+                 token_mask, cache_len):
+        block = LlamaBlock
+        if self.cfg.remat:
+            block = nn.remat(LlamaBlock, static_argnums=(4, 7))
+        x = block(self.cfg, name="block")(
+            x, positions, segment_ids, decode, mask_bias, token_mask,
+            cache_len,
+        )
+        return x, None
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
 
@@ -134,15 +158,28 @@ class Llama(nn.Module):
         x = nn.Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="embed"
         )(tokens)
-        block = LlamaBlock
-        if cfg.remat:
-            # static: decode flag (4) and cache bucket size (7).
-            block = nn.remat(LlamaBlock, static_argnums=(4, 7))
-        for i in range(cfg.n_layers):
-            x = block(cfg, name=f"layer_{i}")(
+        if cfg.scan_layers:
+            scan = nn.scan(
+                LlamaScanBody,
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,) * 6,
+                length=cfg.n_layers,
+            )
+            x, _ = scan(cfg, name="layers_scan")(
                 x, positions, segment_ids, decode, mask_bias, token_mask,
                 cache_len,
             )
+        else:
+            block = LlamaBlock
+            if cfg.remat:
+                # static: decode flag (4) and cache bucket size (7).
+                block = nn.remat(LlamaBlock, static_argnums=(4, 7))
+            for i in range(cfg.n_layers):
+                x = block(cfg, name=f"layer_{i}")(
+                    x, positions, segment_ids, decode, mask_bias, token_mask,
+                    cache_len,
+                )
         x = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
         logits = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"

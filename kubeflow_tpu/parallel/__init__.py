@@ -16,17 +16,23 @@ In the TPU rebuild the compute-side story is explicit and first-class:
 """
 
 from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
+from kubeflow_tpu.parallel.mesh import default_mesh_config
 from kubeflow_tpu.parallel.sharding import (
     batch_sharding,
     infer_state_shardings,
     llama_rules,
+    resnet_rules,
     shard_params,
+    vit_rules,
 )
 from kubeflow_tpu.parallel.train import make_sharded_train_step
 
 __all__ = [
     "MeshConfig",
     "make_mesh",
+    "default_mesh_config",
+    "resnet_rules",
+    "vit_rules",
     "batch_sharding",
     "infer_state_shardings",
     "llama_rules",

@@ -97,12 +97,25 @@ def _clamp_spec(spec: P, ndim: int) -> P:
     return P(*parts)
 
 
+# Path marker of nn.scan-stacked layer params (models/llama.py
+# LlamaConfig.scan_layers): leaves gain a leading layer axis, so the
+# matched spec shifts right by one (layer axis replicated — it is the
+# scan's sequential axis, never a mesh axis).
+SCAN_MARKER = "layers_scan"
+
+
+def spec_for_leaf(path_string: str, rules: Rules, ndim: int) -> P:
+    spec = spec_for_path(path_string, rules)
+    if SCAN_MARKER in path_string and len(spec) > 0:
+        spec = P(None, *spec)
+    return _clamp_spec(spec, ndim)
+
+
 def tree_specs(tree: Any, rules: Rules) -> Any:
     """PartitionSpec pytree matching ``tree`` by path rules."""
 
     def one(path, leaf):
-        spec = spec_for_path(_path_str(path), rules)
-        return _clamp_spec(spec, getattr(leaf, "ndim", 0))
+        return spec_for_leaf(_path_str(path), rules, getattr(leaf, "ndim", 0))
 
     return jax.tree_util.tree_map_with_path(one, tree)
 
@@ -148,8 +161,9 @@ def infer_state_shardings(state: Any, mesh: Mesh, rules: Rules) -> Any:
         # Optax state leaves that mirror a param keep its sharding; scalar
         # counters replicate.  Matching by shape: mirrors have ndim>0 and the
         # same path tail inside the state pytree.
-        spec = spec_for_path(_path_str(leaf_path), rules)
-        spec = _clamp_spec(spec, getattr(leaf, "ndim", 0))
+        spec = spec_for_leaf(
+            _path_str(leaf_path), rules, getattr(leaf, "ndim", 0)
+        )
         return NamedSharding(mesh, spec)
 
     return TrainState(

@@ -185,8 +185,12 @@ class Informer:
                 ):
                     if etype == "ERROR":
                         # Typically 410 Gone: the resume RV was compacted.
-                        # Relist instead of re-issuing a doomed watch.
+                        # Relist instead of re-issuing a doomed watch — after
+                        # the same backoff as the transport-error path, so a
+                        # persistently erroring server isn't hot-looped with
+                        # full LISTs.
                         rv = None
+                        self._stop.wait(1.0)
                         break
                     self._apply(etype, obj)
                     new_rv = meta(obj).get("resourceVersion")

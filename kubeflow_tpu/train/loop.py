@@ -1,0 +1,140 @@
+"""Reusable training loop: log, checkpoint, resume, eval.
+
+The reference platform leaves every training concern to user notebooks
+(SURVEY.md §2.13); this loop is the batteries the bundled images ship so a
+notebook is three lines: build state, build step, ``train_loop(...)``.
+Design points:
+
+* **Resume-or-init**: pointing ``checkpoint_dir`` at an existing run
+  restores the latest step into the state's shardings and continues —
+  the platform's stop/start (culling) then composes with training: a
+  culled-and-restarted notebook picks up where it left off.
+* **Async metric fetch**: metrics are fetched (device→host) only on log
+  steps, keeping the step stream free of host syncs — and the fetch is a
+  scalar ``float()``, which on async/tunneled backends is the only
+  reliable completion barrier (BASELINE.md measurement note).
+* Pure orchestration: no jit/sharding in here — ``step_fn`` arrives
+  already compiled (see parallel.train.make_sharded_train_step).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+log = logging.getLogger("kubeflow_tpu.train")
+
+
+@dataclasses.dataclass
+class LoopConfig:
+    total_steps: int
+    log_every: int = 10
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 100
+    max_to_keep: int = 3
+    eval_every: int = 0          # 0 disables
+    eval_steps: int = 10
+
+
+def train_loop(
+    state,
+    step_fn: Callable,
+    batches: Iterable,
+    cfg: LoopConfig,
+    *,
+    eval_fn: Optional[Callable] = None,
+    eval_batches: Optional[Callable[[], Iterable]] = None,
+    on_log: Optional[Callable[[int, Dict[str, float]], None]] = None,
+):
+    """Run ``step_fn(state, batch) -> (state, metrics)`` for
+    ``cfg.total_steps`` optimizer steps (counted from the restored step
+    when resuming).  Returns ``(state, history)`` where history is a list
+    of ``{"step": n, **metrics}`` dicts from log/eval points.
+    """
+    manager = None
+    start_step = 0
+    if cfg.checkpoint_dir:
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(
+            cfg.checkpoint_dir,
+            max_to_keep=cfg.max_to_keep,
+            save_interval_steps=cfg.checkpoint_every,
+        )
+        restored = manager.restore(state)
+        if restored is not None:
+            state = restored
+            start_step = int(state.step)
+            log.info("resumed from checkpoint at step %d", start_step)
+
+    history: List[Dict[str, Any]] = []
+    it = iter(batches)
+    last_metrics = None
+    t0 = time.perf_counter()
+    window_started_at = start_step
+    step = start_step
+
+    def fetch(metrics) -> Dict[str, float]:
+        return {k: float(v) for k, v in metrics.items()}
+
+    try:
+        for step in range(start_step, cfg.total_steps):
+            try:
+                batch = next(it)
+            except StopIteration:
+                log.info("data exhausted at step %d", step)
+                break
+            state, last_metrics = step_fn(state, batch)
+            now = step + 1
+            if cfg.log_every and now % cfg.log_every == 0:
+                vals = fetch(last_metrics)  # completion barrier
+                dt = time.perf_counter() - t0
+                vals["steps_per_sec"] = (now - window_started_at) / max(dt, 1e-9)
+                entry = {"step": now, **vals}
+                history.append(entry)
+                (on_log or _default_log)(now, vals)
+                t0 = time.perf_counter()
+                window_started_at = now
+            if manager is not None:
+                manager.save(now, state)
+            if (
+                cfg.eval_every
+                and eval_fn is not None
+                and now % cfg.eval_every == 0
+            ):
+                vals = _run_eval(eval_fn, state, eval_batches, cfg.eval_steps)
+                entry = {"step": now, **{f"eval_{k}": v for k, v in vals.items()}}
+                history.append(entry)
+                (on_log or _default_log)(now, entry)
+    finally:
+        if manager is not None:
+            final = step + 1
+            if manager.latest_step() != final:
+                # Final save unless the interval save already covered it.
+                manager.save(final, state, force=True)
+            manager.wait()
+            manager.close()
+    return state, history
+
+
+def _run_eval(eval_fn, state, eval_batches, eval_steps) -> Dict[str, float]:
+    sums: Dict[str, float] = {}
+    n = 0
+    source = eval_batches() if eval_batches is not None else []
+    for i, batch in enumerate(source):
+        if i >= eval_steps:
+            break
+        metrics = eval_fn(state, batch)
+        for k, v in metrics.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        n += 1
+    return {k: v / max(n, 1) for k, v in sums.items()}
+
+
+def _default_log(step: int, vals: Dict[str, float]) -> None:
+    parts = " ".join(
+        f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
+        for k, v in vals.items() if k != "step"
+    )
+    print(f"step {step}: {parts}", flush=True)

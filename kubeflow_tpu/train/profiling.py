@@ -1,0 +1,77 @@
+"""JAX profiler capture for notebook workloads.
+
+SURVEY.md §5 notes the reference has no tracing story at all; here the
+compute stack exposes one that plugs into the platform: traces land in a
+logdir a Tensorboard CR can point at (``pvc://.../profile``), so "profile
+my training loop" is ``with profile_trace(logdir): run_steps()`` followed
+by opening the TensorBoard the tensorboards web app already serves.
+
+Two entry points:
+
+* ``profile_trace(logdir)`` — context manager around a region; captures
+  XLA device traces (TPU timeline, HLO op breakdown in TensorBoard's
+  profile plugin).
+* ``profile_steps(logdir, step_fn, *args, warmup, steps)`` — the common
+  notebook move: warm up (compile excluded), then trace N steps.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Callable, Tuple
+
+import jax
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Capture a JAX profiler trace for the enclosed region."""
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def profile_steps(
+    logdir: str,
+    step_fn: Callable,
+    *args: Any,
+    warmup: int = 2,
+    steps: int = 5,
+) -> Tuple[Any, str]:
+    """Trace ``steps`` invocations of ``step_fn(*args)`` after ``warmup``
+    untraced ones (compile + autotuning excluded from the trace).  The
+    step's first argument is treated as loop-carried state when the step
+    returns ``(state, metrics)``; otherwise outputs are discarded and the
+    same args repeat.  Returns (last output, trace directory)."""
+    out = None
+
+    def once(current_args):
+        result = step_fn(*current_args)
+        if (
+            isinstance(result, tuple)
+            and len(result) == 2
+            and current_args
+            and jax.tree_util.tree_structure(result[0])
+            == jax.tree_util.tree_structure(current_args[0])
+        ):
+            return result, (result[0], *current_args[1:])
+        return result, current_args
+
+    current = tuple(args)
+    for _ in range(warmup):
+        out, current = once(current)
+    _block(out)
+    with profile_trace(logdir):
+        for _ in range(steps):
+            out, current = once(current)
+        _block(out)
+    return out, logdir
+
+
+def _block(out: Any) -> None:
+    for leaf in jax.tree_util.tree_leaves(out):
+        if isinstance(leaf, jax.Array):
+            leaf.block_until_ready()

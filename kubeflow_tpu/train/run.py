@@ -1,0 +1,163 @@
+"""Trainer CLI: spawn-and-train in one command, SPMD over any mesh.
+
+    python -m kubeflow_tpu.train.run --model llama_debug --task lm \\
+        --steps 100 --batch 32 --seq 256 --mesh dp=2,fsdp=2,tp=2 \\
+        --checkpoint-dir /workspace/ckpt
+
+Reads TPU worker env injected by the platform (TPU_WORKER_ID etc. — see
+parallel/dist.py) for multi-host bring-up, builds the mesh, shards the
+train state by the model family's partition rules, and runs the shared
+train loop with checkpoint/resume.  ``--mesh auto`` factorizes the device
+count via ``default_mesh_config``.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+import jax
+
+
+def parse_mesh(spec: str, n_devices: int):
+    from kubeflow_tpu.parallel import default_mesh_config, make_mesh
+    from kubeflow_tpu.parallel.mesh import MeshConfig
+
+    if spec == "auto":
+        return make_mesh(default_mesh_config(n_devices))
+    axes = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        key, _, value = part.partition("=")
+        if key not in MeshConfig.__dataclass_fields__:
+            raise SystemExit(f"unknown mesh axis {key!r}")
+        axes[key] = int(value)
+    return make_mesh(**axes)
+
+
+def build_lm(args, mesh):
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.data.loader import ShardedLoader, synthetic_lm_batches
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.parallel import llama_rules
+    from kubeflow_tpu.parallel.train import (
+        make_sharded_train_step,
+        shard_train_state,
+    )
+    from kubeflow_tpu.train import create_train_state, make_lm_train_step
+
+    model = create_model(args.model, max_seq_len=args.seq)
+    vocab = model.cfg.vocab_size
+    import optax
+
+    tokens = jnp.ones((args.batch, args.seq), jnp.int32)
+    state = create_train_state(
+        jax.random.key(args.seed), model, tokens, optax.adamw(args.lr)
+    )
+    state = shard_train_state(state, mesh, llama_rules())
+    step, data_sharding = make_sharded_train_step(
+        make_lm_train_step(), state, mesh, llama_rules()
+    )
+    batches = ShardedLoader(
+        synthetic_lm_batches(
+            global_batch=args.batch, seq_len=args.seq, vocab_size=vocab,
+            seed=args.seed,
+        ),
+        data_sharding,
+    )
+    return state, step, batches
+
+
+def build_image(args, mesh):
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.data.loader import ShardedLoader, synthetic_image_batches
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.parallel import resnet_rules
+    from kubeflow_tpu.parallel.train import (
+        make_sharded_train_step,
+        shard_train_state,
+    )
+    from kubeflow_tpu.train import (
+        create_train_state,
+        make_classification_train_step,
+    )
+
+    model = create_model(args.model, num_classes=args.num_classes)
+    images = jnp.ones((args.batch, args.image_size, args.image_size, 3),
+                      jnp.float32)
+    state = create_train_state(
+        jax.random.key(args.seed), model, images,
+        optax.sgd(args.lr, momentum=0.9), init_kwargs={"train": False},
+    )
+    state = shard_train_state(state, mesh, resnet_rules())
+    step, data_sharding = make_sharded_train_step(
+        make_classification_train_step(has_batch_stats=True),
+        state, mesh, resnet_rules(),
+    )
+    batches = ShardedLoader(
+        synthetic_image_batches(
+            global_batch=args.batch, image_size=args.image_size,
+            num_classes=args.num_classes, seed=args.seed,
+        ),
+        data_sharding,
+    )
+    return state, step, batches
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="llama_debug")
+    ap.add_argument("--task", choices=["lm", "image"], default="lm")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mesh", default="auto")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--checkpoint-every", type=int, default=100)
+    ap.add_argument("--log-every", type=int, default=10)
+    ap.add_argument("--distributed", action="store_true",
+                    help="jax.distributed.initialize from platform-injected env")
+    args = ap.parse_args(argv)
+
+    if args.distributed:
+        from kubeflow_tpu.parallel.dist import initialize_from_env
+
+        initialize_from_env()
+
+    from kubeflow_tpu.parallel.context import global_mesh
+    from kubeflow_tpu.train.loop import LoopConfig, train_loop
+
+    mesh = parse_mesh(args.mesh, len(jax.devices()))
+    print(f"devices={len(jax.devices())} mesh={dict(mesh.shape)}", flush=True)
+
+    build = build_lm if args.task == "lm" else build_image
+    with global_mesh(mesh):
+        state, step, batches = build(args, mesh)
+        state, history = train_loop(
+            state, step, batches,
+            LoopConfig(
+                total_steps=args.steps,
+                log_every=args.log_every,
+                checkpoint_dir=args.checkpoint_dir,
+                checkpoint_every=args.checkpoint_every,
+            ),
+        )
+    if history:
+        last = history[-1]
+        print(f"done: step {last['step']} "
+              + " ".join(f"{k}={v:.4g}" for k, v in last.items()
+                         if k != "step" and isinstance(v, float)),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,7 +116,14 @@ def make_lm_train_step(*, aux_loss_weight: float = 0.0):
                     {"params": params}, tokens, mutable=["losses"], **kwargs
                 )
                 sowed = jax.tree.leaves(cols.get("losses", {}))
-                aux = sum(sowed) / max(1, len(sowed)) if sowed else 0.0
+                # Mean per leaf, then mean over leaves: a python-loop model
+                # sows n_layers scalar leaves; under scan_layers they arrive
+                # as ONE stacked (n_layers,) leaf — both reduce to the same
+                # scalar mean-over-layers.
+                aux = (
+                    sum(jnp.mean(x) for x in sowed) / max(1, len(sowed))
+                    if sowed else 0.0
+                )
             else:
                 logits = state.apply_fn({"params": params}, tokens, **kwargs)
                 aux = 0.0

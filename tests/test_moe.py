@@ -82,3 +82,29 @@ def test_expert_parallel_step_matches_single_device(devices8):
         _, metrics = step(state2, batch)
         assert abs(float(metrics["loss"]) - float(ref_metrics["loss"])) < 1e-4
         assert float(metrics["moe_aux_loss"]) >= 1.0 - 1e-5
+
+
+def test_moe_aux_loss_with_scan_layers(devices8):
+    # Under scan_layers the sowed per-layer aux losses arrive as ONE
+    # stacked (n_layers,) leaf; the lm step must still produce a scalar
+    # loss (regression: value_and_grad raised on a vector loss).
+    import dataclasses
+
+    import optax
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.train import create_train_state, make_lm_train_step
+
+    cfg = dataclasses.replace(
+        CONFIGS["mixtral_debug"], max_seq_len=32, scan_layers=True
+    )
+    model = Llama(cfg)
+    tokens = jnp.ones((2, 16), jnp.int32)
+    state = create_train_state(
+        jax.random.key(0), model, tokens, optax.adamw(1e-3)
+    )
+    step = jax.jit(make_lm_train_step(aux_loss_weight=0.01))
+    state, metrics = step(state, tokens)
+    assert metrics["loss"].shape == ()
+    assert metrics["moe_aux_loss"].shape == ()
+    assert jnp.isfinite(metrics["moe_aux_loss"])

@@ -1,0 +1,137 @@
+"""Train loop: logging, eval hook, checkpoint/resume, trainer CLI."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from kubeflow_tpu.train import create_train_state, make_lm_train_step
+from kubeflow_tpu.train.loop import LoopConfig, train_loop
+
+
+def tiny_state(seed=0):
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=32)
+    model = Llama(cfg)
+    tokens = jnp.ones((4, 32), jnp.int32)
+    return create_train_state(
+        jax.random.key(seed), model, tokens, optax.adamw(1e-3)
+    ), cfg
+
+
+def batches(n=10_000, seed=0):
+    rng = jax.random.key(seed)
+    i = 0
+    while i < n:
+        yield jax.random.randint(jax.random.fold_in(rng, i), (4, 32), 0, 256)
+        i += 1
+
+
+def test_loop_runs_and_logs():
+    state, _ = tiny_state()
+    step = jax.jit(make_lm_train_step())
+    logged = []
+    state, history = train_loop(
+        state, step, batches(), LoopConfig(total_steps=6, log_every=2),
+        on_log=lambda s, vals: logged.append(s),
+    )
+    assert int(state.step) == 6
+    assert logged == [2, 4, 6]
+    assert all("loss" in h and "steps_per_sec" in h for h in history)
+    # Loss must actually move (the step is real, not a no-op).
+    assert history[-1]["loss"] != history[0]["loss"]
+
+
+def test_loop_checkpoint_resume(tmp_path):
+    ckpt = str(tmp_path / "run1")
+    step = jax.jit(make_lm_train_step())
+
+    state, _ = tiny_state()
+    cfg = LoopConfig(total_steps=5, log_every=0, checkpoint_dir=ckpt,
+                     checkpoint_every=2)
+    state, _ = train_loop(state, step, batches(), cfg)
+    assert int(state.step) == 5
+    trained_params = state.params
+
+    # "Restart the pod": fresh state, same checkpoint dir, higher target.
+    state2, _ = tiny_state(seed=123)  # different init — must be overwritten
+    cfg2 = dataclasses.replace(cfg, total_steps=8)
+    state2, history = train_loop(state2, step, batches(), cfg2)
+    assert int(state2.step) == 8
+    # The resumed run continued from the trained params, not seed 123's.
+    restored_leaf = jax.tree_util.tree_leaves(trained_params)[0]
+    fresh_leaf = jax.tree_util.tree_leaves(tiny_state(seed=123)[0].params)[0]
+    assert not jnp.allclose(restored_leaf, fresh_leaf, atol=1e-6)
+
+
+def test_loop_eval_hook():
+    state, _ = tiny_state()
+    step = jax.jit(make_lm_train_step())
+
+    def eval_fn(state, batch):
+        return {"loss": 1.25}
+
+    state, history = train_loop(
+        state, step, batches(),
+        LoopConfig(total_steps=4, log_every=0, eval_every=2, eval_steps=3),
+        eval_fn=eval_fn, eval_batches=lambda: batches(seed=9),
+    )
+    evals = [h for h in history if "eval_loss" in h]
+    assert [h["step"] for h in evals] == [2, 4]
+    assert all(h["eval_loss"] == pytest.approx(1.25) for h in evals)
+
+
+def test_loop_stops_on_data_exhaustion():
+    state, _ = tiny_state()
+    step = jax.jit(make_lm_train_step())
+    state, _ = train_loop(
+        state, step, batches(n=3), LoopConfig(total_steps=100, log_every=0)
+    )
+    assert int(state.step) == 3
+
+
+def test_trainer_cli_smoke(devices8, tmp_path):
+    from kubeflow_tpu.train import run as trainer
+
+    rc = trainer.main([
+        "--model", "llama_debug", "--task", "lm", "--steps", "4",
+        "--batch", "8", "--seq", "32", "--mesh", "dp=2,fsdp=2,tp=2",
+        "--log-every", "2", "--checkpoint-dir", str(tmp_path / "cli"),
+        "--checkpoint-every", "2",
+    ])
+    assert rc == 0
+    # Resume path: second invocation continues past step 4.
+    rc = trainer.main([
+        "--model", "llama_debug", "--task", "lm", "--steps", "6",
+        "--batch", "8", "--seq", "32", "--mesh", "dp=2,fsdp=2,tp=2",
+        "--log-every", "2", "--checkpoint-dir", str(tmp_path / "cli"),
+        "--checkpoint-every", "2",
+    ])
+    assert rc == 0
+
+
+def test_trainer_cli_rejects_bad_mesh():
+    from kubeflow_tpu.train import run as trainer
+
+    with pytest.raises(SystemExit):
+        trainer.parse_mesh("bogus=2", 8)
+
+
+def test_profile_steps_produces_trace(tmp_path):
+    from kubeflow_tpu.train.profiling import profile_steps
+
+    state, _ = tiny_state()
+    step = jax.jit(make_lm_train_step())
+    data = next(batches())
+    logdir = str(tmp_path / "profile")
+    (new_state, metrics), where = profile_steps(
+        logdir, step, state, data, warmup=1, steps=2
+    )
+    assert int(new_state.step) == 3  # state threaded through warmup + trace
+    # A plugins/profile/<run>/ directory with trace artifacts must exist.
+    found = []
+    for root, _dirs, files in __import__("os").walk(logdir):
+        found.extend(files)
+    assert found, f"no trace files under {logdir}"
