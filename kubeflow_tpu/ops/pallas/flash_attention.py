@@ -230,13 +230,16 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
     return out.transpose(0, 2, 1, 3)
 
 
-def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *,
+def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, *,
               causal, scale, q_start, k_start, block_q, block_k):
     """Shared backward tile math: (p, ds, do) for one (q, k) block pair.
     delta = rowsum(dO ∘ O) is recomputed here from the residuals instead of
     being materialized lane-replicated in HBM (it is one scalar per row; a
     (bq, d) elementwise pass in VMEM is cheaper than 128x HBM traffic).
-    The mask convention must stay identical to _fwd_kernel's."""
+    ``glse_ref`` (optional) carries the cotangent of the lse output when
+    the caller consumed it (flash_attention_with_lse): d lse_i/d s_ij = p_ij,
+    so it enters as an extra per-row term inside the ds product.  The mask
+    convention must stay identical to _fwd_kernel's."""
     q = q_ref[0, 0].astype(jnp.float32)
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -244,6 +247,13 @@ def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *,
     do = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0][:, 0:1]  # (bq, 1), lane-replicated source
     delta = jnp.sum(do * o, axis=-1, keepdims=True)  # (bq, 1)
+    if glse_ref is not None:
+        # The forward replicated lse across 128 lanes; the per-row scalar
+        # cotangent is the SUM over lane cotangents (consumers typically
+        # slice one lane, leaving zeros elsewhere — the sum covers both).
+        delta = delta - jnp.sum(
+            glse_ref[0, 0].astype(jnp.float32), axis=-1, keepdims=True
+        )
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
@@ -259,7 +269,7 @@ def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *,
     return q, k, p, ds, do
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, dq_ref,
                acc_ref, *, causal, scale, block_q, block_k, num_k):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -277,7 +287,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     @pl.when(run)
     def _compute():
         _, k, _, ds, _ = _bwd_tile(
-            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
             causal=causal, scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_k=block_k,
         )
@@ -290,7 +300,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _dq_kernel_noglse(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                      acc_ref, **kw):
+    _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, None, dq_ref,
+               acc_ref, **kw)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *,
                 causal, scale, block_q, block_k, num_q):
     ki = pl.program_id(2)
@@ -310,7 +326,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     @pl.when(run)
     def _compute():
         q, _, p, ds, do = _bwd_tile(
-            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+            q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
             causal=causal, scale=scale, q_start=q_start, k_start=k_start,
             block_q=block_q, block_k=block_k,
         )
@@ -327,11 +343,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
+def _dkv_kernel_noglse(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                       dk_ref, dv_ref, dk_acc, dv_acc, **kw):
+    _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, None,
+                dk_ref, dv_ref, dk_acc, dv_acc, **kw)
+
+
 def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
-               block_k, interpret):
+               block_k, interpret, g_lse=None):
     """Blocked FlashAttention-2 backward: a dq pass (kv sequential) and a
     dk/dv pass (q sequential).  GQA: dk/dv are produced per q-head and
-    group-summed in XLA afterwards."""
+    group-summed in XLA afterwards.  ``g_lse`` is the cotangent of the lse
+    output for the with-lse variant (None for plain flash_attention)."""
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
     n_rep = hq // hk
@@ -345,6 +368,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     vt = v.transpose(0, 2, 1, 3)
     ot = out.transpose(0, 2, 1, 3)
     dot = g.transpose(0, 2, 1, 3)
+    with_glse = g_lse is not None
+    extra = (g_lse,) if with_glse else ()
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
@@ -354,13 +379,15 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     lse_spec = pl.BlockSpec(
         (1, 1, bq, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
+    dq_kernel = _dq_kernel if with_glse else _dq_kernel_noglse
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, causal=causal, scale=scale,
+            dq_kernel, causal=causal, scale=scale,
             block_q=bq, block_k=bk, num_k=num_k,
         ),
         grid=(b, hq, num_q, num_k),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
+        + ([lse_spec] if with_glse else []),
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         scratch_shapes=[_scratch((bq, d))],
@@ -368,7 +395,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt, ot, dot, lse)
+    )(qt, kt, vt, ot, dot, lse, *extra)
 
     # dk/dv: grid ordered (k, q) so the q axis is the sequential one.
     q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
@@ -382,13 +409,15 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     dkv_out_spec = pl.BlockSpec(
         (1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)
     )
+    dkv_kernel = _dkv_kernel if with_glse else _dkv_kernel_noglse
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, causal=causal, scale=scale,
+            dkv_kernel, causal=causal, scale=scale,
             block_q=bq, block_k=bk, num_q=num_q,
         ),
         grid=(b, hq, num_k, num_q),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2]
+        + ([lse_spec2] if with_glse else []),
         out_specs=[dkv_out_spec, dkv_out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sk, d), k.dtype),
@@ -399,7 +428,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt, ot, dot, lse)
+    )(qt, kt, vt, ot, dot, lse, *extra)
 
     if n_rep > 1:
         dk = dk.reshape(b, hk, n_rep, sk, d).sum(axis=2)
@@ -438,6 +467,53 @@ def flash_attention(
 ):
     """Flash attention, BSHD layout, GQA via fewer kv heads."""
     return _flash_attention(q, k, v, causal, softmax_scale, block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_with_lse(q, k, v, causal, softmax_scale, block_q,
+                              block_k):
+    interpret = _platform() not in ("tpu", "axon")
+    return _flash_fwd(
+        q, k, v, causal=causal, softmax_scale=softmax_scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        return_residuals=True,
+    )
+
+
+def flash_attention_with_lse(
+    q, k, v, *, causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+):
+    """Flash attention that also returns the per-row logsumexp
+    (lane-replicated [b, h, sq, 128] f32) — the residual block-merging
+    consumers need (ring attention's cross-device flash merge).  Fully
+    differentiable including the lse output."""
+    return _flash_attention_with_lse(
+        q, k, v, causal, softmax_scale, block_q, block_k
+    )
+
+
+def _with_lse_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
+    out, lse = _flash_attention_with_lse(
+        q, k, v, causal, softmax_scale, block_q, block_k
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _with_lse_bwd(causal, softmax_scale, block_q, block_k, res, cotangents):
+    q, k, v, out, lse = res
+    g_out, g_lse = cotangents
+    interpret = _platform() not in ("tpu", "axon")
+    return _flash_bwd(
+        q, k, v, out, lse, g_out, causal=causal,
+        softmax_scale=softmax_scale, block_q=block_q, block_k=block_k,
+        interpret=interpret, g_lse=g_lse.astype(jnp.float32),
+    )
+
+
+_flash_attention_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)
 
 
 def _vjp_fwd(q, k, v, causal, softmax_scale, block_q, block_k):

@@ -62,7 +62,44 @@ def _block_attn(q, k, v, *, scale, mode, q_offset, k_offset):
     return pv, m_safe, l
 
 
-def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
+def _flash_block_attn(q, k_blk, v_blk, *, scale, mode):
+    """Flash-kernel version of one (q-chunk, k-block) fold: the [s, s]
+    block logits never materialize (ops/pallas/flash_attention.py), and the
+    lse output feeds the cross-device merge.  Returns the same
+    (pv, m, l)-triple contract as _block_attn with the normalized
+    convention (pv = normalized out, m = lse, l = 1); a fully-masked block
+    is (0, -inf, 0).  Differentiable: flash_attention_with_lse carries the
+    lse cotangent through its backward kernels."""
+    from kubeflow_tpu.ops.pallas.flash_attention import flash_attention_with_lse
+
+    b, s, h, d = q.shape
+
+    def attended(causal_blk):
+        def fn(q, k_blk, v_blk):
+            out, lse = flash_attention_with_lse(
+                q, k_blk, v_blk, causal=causal_blk, softmax_scale=scale
+            )
+            # lse: lane-replicated [b, h, s, 128] -> [b, h, s, 1].
+            return (
+                out.astype(jnp.float32),
+                lse[..., 0:1],
+                jnp.ones((b, h, s, 1), jnp.float32),
+            )
+        return fn
+
+    def masked(q, k_blk, v_blk):
+        return (
+            jnp.zeros((b, s, h, d), jnp.float32),
+            jnp.full((b, h, s, 1), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h, s, 1), jnp.float32),
+        )
+
+    return jax.lax.switch(
+        mode, [attended(False), attended(True), masked], q, k_blk, v_blk
+    )
+
+
+def _ring_attention_local(q, k, v, *, axis_name, causal, scale, use_flash):
     """Body run per-device under shard_map. q/k/v: local chunks [b,s,h,d]."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -78,15 +115,20 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
             )
         else:
             mode = jnp.zeros((), jnp.int32)
-        pv, bm, bl = _block_attn(
-            q32,
-            k_blk.astype(jnp.float32),
-            v_blk.astype(jnp.float32),
-            scale=scale,
-            mode=mode,
-            q_offset=my_idx * s_local,
-            k_offset=src_idx * s_local,
-        )
+        if use_flash:
+            pv, bm, bl = _flash_block_attn(
+                q, k_blk, v_blk, scale=scale, mode=mode
+            )
+        else:
+            pv, bm, bl = _block_attn(
+                q32,
+                k_blk.astype(jnp.float32),
+                v_blk.astype(jnp.float32),
+                scale=scale,
+                mode=mode,
+                q_offset=my_idx * s_local,
+                k_offset=src_idx * s_local,
+            )
         # Online merge: bm/bl are [b,h,sq,1]; acc is [b,sq,h,d].
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(m - m_new)
@@ -122,20 +164,55 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = False,
     softmax_scale: Optional[float] = None,
+    block_impl: str = "auto",
 ):
     """Exact attention with the sequence dimension sharded over ``axis_name``.
 
     Inputs are global-view BSHD arrays (sharded or shardable on seq); output
     has the same sharding.  Works under jit and composes with dp/fsdp/tp on
     the other mesh axes.
+
+    ``block_impl``: "auto" | "einsum" | "flash" — how each visiting
+    (q-chunk, k-block) pair is folded.  "flash" routes blocks through the
+    Pallas kernel (no [s_local, s_local] logits materialization); "auto"
+    selects it on TPU once the local chunk passes the kernel's
+    ``should_use`` threshold (same gate as ops.dot_product_attention).
     """
     from kubeflow_tpu.parallel.sharding import data_axes
 
+    if block_impl not in ("auto", "einsum", "flash"):
+        raise ValueError(f"unknown block_impl {block_impl!r}")
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
+    ring_size = mesh.shape[axis_name]
+    s_local = q.shape[1] // ring_size
+    if block_impl == "einsum":
+        use_flash = False
+    else:
+        from kubeflow_tpu.ops.pallas import flash_attention as fa
+
+        local_shape = jax.ShapeDtypeStruct(
+            (q.shape[0], s_local, q.shape[2], q.shape[3]), q.dtype
+        )
+        local_kv = jax.ShapeDtypeStruct(
+            (k.shape[0], s_local, k.shape[2], k.shape[3]), k.dtype
+        )
+        ok = fa.supported(local_shape, local_kv, local_kv)
+        if block_impl == "flash":
+            if not ok:
+                raise ValueError(
+                    "flash block_impl unsupported for local chunk shape "
+                    f"{local_shape.shape}"
+                )
+            use_flash = True
+        else:
+            # should_use gates on platform (TPU only — interpret mode on
+            # CPU would be drastically slower) and local chunk length.
+            use_flash = ok and fa.should_use(local_shape)
     spec = P(data_axes(mesh), axis_name, None, None)
     fn = shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+            _ring_attention_local, axis_name=axis_name, causal=causal,
+            scale=scale, use_flash=use_flash,
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),

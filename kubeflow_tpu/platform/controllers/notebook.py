@@ -36,6 +36,7 @@ from kubeflow_tpu.platform.k8s.types import (
     EVENT,
     NOTEBOOK,
     POD,
+    PODDISRUPTIONBUDGET,
     SERVICE,
     STATEFULSET,
     VIRTUALSERVICE,
@@ -116,6 +117,7 @@ class NotebookReconciler(Reconciler):
         sts = self._reconcile_statefulset(notebook)
         self._reconcile_service(notebook)
         self._reconcile_headless_service(notebook)
+        self._reconcile_pdb(notebook)
         if self.use_istio:
             self._reconcile_virtual_service(notebook)
         self._update_status(notebook, sts)
@@ -332,6 +334,56 @@ class NotebookReconciler(Reconciler):
         current["spec"] = want
         meta(current).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
         return self.client.update(current)
+
+    # -- pod disruption budget ----------------------------------------------
+
+    def generate_pdb(self, notebook: Resource) -> Optional[Resource]:
+        """Multi-host slices are all-or-nothing: evicting one worker kills
+        the whole slice's `jax.distributed` job, so voluntary disruptions
+        must never take a single worker.  minAvailable = num_hosts blocks
+        them all; single-host notebooks need no PDB (no reference analogue
+        — the reference never schedules multi-pod workloads)."""
+        tpu = nbapi.tpu_slice(notebook)
+        if not tpu or not tpu.multi_host or nbapi.is_stopped(notebook):
+            return None
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        pdb = {
+            "apiVersion": "policy/v1",
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": f"{name}-slice", "namespace": ns},
+            "spec": {
+                "minAvailable": tpu.num_hosts,
+                "selector": {"matchLabels": {"statefulset": name}},
+            },
+        }
+        set_owner(pdb, notebook)
+        return pdb
+
+    def _reconcile_pdb(self, notebook: Resource) -> None:
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        desired = self.generate_pdb(notebook)
+        pdb_name = f"{name}-slice"
+        try:
+            current = self.client.get(PODDISRUPTIONBUDGET, pdb_name, ns)
+        except errors.NotFound:
+            current = None
+        if desired is None:
+            # Single-host, stopped, or spec changed away from multi-host: a
+            # leftover PDB would block node drains forever.  Read-then-
+            # delete keeps the common single-host reconcile off the API
+            # server's write path entirely.
+            if current is not None:
+                try:
+                    self.client.delete(PODDISRUPTIONBUDGET, pdb_name, ns)
+                except errors.NotFound:
+                    pass
+            return
+        if current is None:
+            self.client.create(desired)
+            return
+        if current.get("spec") != desired.get("spec"):
+            current["spec"] = desired["spec"]
+            self.client.update(current)
 
     # -- istio ---------------------------------------------------------------
 
@@ -598,7 +650,7 @@ def make_controller(client, **kwargs):
         "notebook-controller",
         NotebookReconciler(client, **kwargs),
         primary=NOTEBOOK,
-        owns=[STATEFULSET, SERVICE, VIRTUALSERVICE],
+        owns=[STATEFULSET, SERVICE, VIRTUALSERVICE, PODDISRUPTIONBUDGET],
         watches=[
             (POD, pods_to_notebook_requests),
             (EVENT, events_to_notebook_requests),

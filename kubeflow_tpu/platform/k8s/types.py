@@ -54,6 +54,7 @@ PVC = GVK(CORE, "v1", "PersistentVolumeClaim", "persistentvolumeclaims")
 RESOURCEQUOTA = GVK(CORE, "v1", "ResourceQuota", "resourcequotas")
 
 STATEFULSET = GVK("apps", "v1", "StatefulSet", "statefulsets")
+PODDISRUPTIONBUDGET = GVK("policy", "v1", "PodDisruptionBudget", "poddisruptionbudgets")
 DEPLOYMENT = GVK("apps", "v1", "Deployment", "deployments")
 
 ROLEBINDING = GVK("rbac.authorization.k8s.io", "v1", "RoleBinding", "rolebindings")

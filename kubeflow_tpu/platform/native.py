@@ -62,6 +62,19 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
+        if not hasattr(lib, "kfp_merge_apply"):
+            # Stale prebuilt library from before a symbol was added: rebuild
+            # (make re-links since the sources are newer) and reload; if
+            # that can't produce the symbol, report unavailable so the
+            # pure-Python fallbacks engage instead of crashing.
+            if not _try_build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                return None
+            if not hasattr(lib, "kfp_merge_apply"):
+                return None
         # kfp: JSON patch engine
         lib.kfp_create_patch.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.kfp_create_patch.restype = ctypes.c_void_p
@@ -69,6 +82,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.kfp_apply_patch.restype = ctypes.c_void_p
         lib.kfp_canonical.argtypes = [ctypes.c_char_p]
         lib.kfp_canonical.restype = ctypes.c_void_p
+        lib.kfp_merge_apply.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.kfp_merge_apply.restype = ctypes.c_void_p
+        lib.kfp_merge_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.kfp_merge_create.restype = ctypes.c_void_p
         lib.kfp_last_error.argtypes = []
         lib.kfp_last_error.restype = ctypes.c_char_p
         lib.kfp_free.argtypes = [ctypes.c_void_p]
@@ -156,6 +173,33 @@ def apply_patch(doc: Any, ops: List[Dict[str, Any]]) -> Any:
     import json
 
     return json.loads(apply_patch_json(json.dumps(doc), json.dumps(ops)))
+
+
+# -- RFC 7386 merge patch -----------------------------------------------------
+
+
+def merge_patch_apply(doc: Any, patch: Any) -> Any:
+    """Apply a JSON merge patch (native engine; json at the boundary)."""
+    import json
+
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    out = _call_str(lib.kfp_merge_apply, json.dumps(doc).encode(),
+                    json.dumps(patch).encode())
+    return json.loads(out)
+
+
+def merge_patch_create(before: Any, after: Any) -> Any:
+    """Diff two documents into the merge patch turning before into after."""
+    import json
+
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    out = _call_str(lib.kfp_merge_create, json.dumps(before).encode(),
+                    json.dumps(after).encode())
+    return json.loads(out)
 
 
 # -- workqueue ----------------------------------------------------------------

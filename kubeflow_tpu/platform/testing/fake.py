@@ -194,7 +194,16 @@ class FakeKube:
         with self._lock:
             current = self._get_ref(gvk, name, namespace)
             if patch_type == "merge" or patch_type == "strategic":
-                _merge_patch(current, patch)
+                from kubeflow_tpu.platform import native
+
+                if native.available():
+                    # Native RFC 7386 engine; parity with the Python
+                    # fallback is pinned by tests/ctrlplane/test_native.py.
+                    merged = native.merge_patch_apply(current, patch)
+                    current.clear()
+                    current.update(merged)
+                else:
+                    _merge_patch(current, patch)
             elif patch_type == "json":
                 from kubeflow_tpu.platform.webhook.jsonpatch import apply_patch
 
@@ -360,7 +369,12 @@ def _merge_patch(target: Resource, patch: Any) -> None:
     for k, v in patch.items():
         if v is None:
             target.pop(k, None)
-        elif isinstance(v, dict) and isinstance(target.get(k), dict):
+        elif isinstance(v, dict):
+            if not isinstance(target.get(k), dict):
+                # RFC 7386: patching a non-object target applies the patch
+                # to {} — nulls nested inside the patch value are removal
+                # markers there too, never stored literally.
+                target[k] = {}
             _merge_patch(target[k], v)
         else:
             target[k] = copy.deepcopy(v)

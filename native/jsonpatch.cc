@@ -757,6 +757,56 @@ static void create_patch(const Value& before, const Value& after,
   }
 }
 
+// ---------------------------------------------------------------------------
+// RFC 7386 JSON merge patch (apply + create).  FakeKube.patch and the REST
+// client's merge-patch path use this; semantics mirror
+// kubeflow_tpu/platform/testing/fake.py::_merge_patch.
+// ---------------------------------------------------------------------------
+
+static ValuePtr merge_patch_apply(const Value& target, const Value& patch) {
+  if (patch.kind != Kind::Obj) return deep_copy(patch);
+  ValuePtr result =
+      target.kind == Kind::Obj ? deep_copy(target) : Value::object();
+  for (const auto& kv : patch.obj) {
+    if (kv.second->kind == Kind::Null) {
+      result->erase(kv.first);
+      continue;
+    }
+    ValuePtr* cur = result->find(kv.first);
+    if (cur && (*cur)->kind == Kind::Obj && kv.second->kind == Kind::Obj) {
+      result->set(kv.first, merge_patch_apply(**cur, *kv.second));
+    } else {
+      // RFC 7386: patching a non-object target applies the patch to {},
+      // which also strips nulls nested inside the patch value.
+      Value empty;
+      result->set(kv.first, merge_patch_apply(empty, *kv.second));
+    }
+  }
+  return result;
+}
+
+static ValuePtr merge_patch_create(const Value& before, const Value& after) {
+  if (before.kind != Kind::Obj || after.kind != Kind::Obj)
+    return deep_copy(after);
+  auto patch = Value::object();
+  for (const auto& kv : before.obj) {
+    if (!const_cast<Value&>(after).find(kv.first))
+      patch->set(kv.first, Value::null());
+  }
+  for (const auto& kv : after.obj) {
+    ValuePtr* b = const_cast<Value&>(before).find(kv.first);
+    if (!b) {
+      patch->set(kv.first, deep_copy(*kv.second));
+    } else if (!equal(**b, *kv.second)) {
+      if ((*b)->kind == Kind::Obj && kv.second->kind == Kind::Obj)
+        patch->set(kv.first, merge_patch_create(**b, *kv.second));
+      else
+        patch->set(kv.first, deep_copy(*kv.second));
+    }
+  }
+  return patch;
+}
+
 }  // namespace kf
 
 // ---------------------------------------------------------------------------
@@ -817,6 +867,40 @@ const char* kfp_apply_patch(const char* doc, const char* patch) {
 }
 
 // Round-trip canonicalization (parse + compact serialize); used by tests.
+// RFC 7386: apply a merge patch to a document → merged JSON, or NULL.
+const char* kfp_merge_apply(const char* doc, const char* patch) {
+  try {
+    kf::ValuePtr d = kf::Parser(doc).parse();
+    kf::ValuePtr p = kf::Parser(patch).parse();
+    kf::ValuePtr out = kf::merge_patch_apply(*d, *p);
+    std::string s;
+    kf::serialize(*out, s);
+    return dup_out(s);
+  } catch (const kf::ParseError& e) {
+    g_error = "parse error: " + e.msg;
+  } catch (...) {
+    g_error = "unknown error";
+  }
+  return nullptr;
+}
+
+// RFC 7386: diff two documents → the merge patch turning before into after.
+const char* kfp_merge_create(const char* before, const char* after) {
+  try {
+    kf::ValuePtr b = kf::Parser(before).parse();
+    kf::ValuePtr a = kf::Parser(after).parse();
+    kf::ValuePtr out = kf::merge_patch_create(*b, *a);
+    std::string s;
+    kf::serialize(*out, s);
+    return dup_out(s);
+  } catch (const kf::ParseError& e) {
+    g_error = "parse error: " + e.msg;
+  } catch (...) {
+    g_error = "unknown error";
+  }
+  return nullptr;
+}
+
 const char* kfp_canonical(const char* doc) {
   try {
     kf::ValuePtr d = kf::Parser(doc).parse();

@@ -212,3 +212,77 @@ def test_make_workqueue_returns_native_when_available():
     else:
         assert isinstance(q, _WorkQueue)
     q.shut_down()
+
+
+# -- RFC 7386 merge patch (kfp_merge_*) ---------------------------------------
+
+
+def test_native_merge_patch_matches_python():
+    import copy
+
+    from kubeflow_tpu.platform import native
+    from kubeflow_tpu.platform.testing.fake import _merge_patch
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    cases = [
+        ({"a": 1}, {"a": 2}),
+        ({"a": {"b": 1, "c": 2}}, {"a": {"b": None}}),
+        ({"a": [1, 2]}, {"a": [3]}),                      # arrays replace
+        ({"a": 1}, {"b": {"c": {"d": None, "e": 1}}}),    # nested null strip
+        ({"m": {"x": 1}}, {"m": "scalar"}),               # obj -> scalar
+        ({}, {"a": None}),                                # null on missing
+        ({"u": "ü", "n": 2**63 + 1}, {"u": "v", "big": 2**70}),
+    ]
+    for target, patch in cases:
+        py = copy.deepcopy(target)
+        _merge_patch(py, patch)
+        assert native.merge_patch_apply(target, patch) == py, (target, patch)
+
+
+def test_native_merge_create_roundtrip():
+    from kubeflow_tpu.platform import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    import random
+
+    # No literal nulls and no bools: RFC 7386 cannot express *storing* a
+    # null (it always means "remove"), and the diff's equality follows
+    # Python == where True == 1 — both are outside the k8s-object domain
+    # this engine serves (API objects store neither).
+    def rand_doc(depth=0):
+        r = random.random()
+        if depth > 2 or r < 0.3:
+            return random.choice([1, "s", 3.5, [1, 2], "t"])
+        return {
+            f"k{i}": rand_doc(depth + 1) for i in range(random.randint(0, 4))
+        }
+
+    random.seed(7)
+    for _ in range(50):
+        before = {"root": rand_doc(), "x": rand_doc()}
+        after = {"root": rand_doc(), "y": rand_doc()}
+        patch = native.merge_patch_create(before, after)
+        assert native.merge_patch_apply(before, patch) == after
+
+
+def test_fake_kube_patch_uses_native_merge():
+    from kubeflow_tpu.platform import native
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "annotations": {"a": "1", "b": "2"}},
+        "spec": {"template": {"spec": {"containers": [{"name": "nb"}]}}},
+    })
+    kube.patch(NOTEBOOK, "nb",
+               {"metadata": {"annotations": {"a": None, "c": "3"}}}, "ns")
+    nb = kube.get(NOTEBOOK, "nb", "ns")
+    assert nb["metadata"]["annotations"] == {"b": "2", "c": "3"}

@@ -358,3 +358,29 @@ def test_topology_only_conversion_roundtrip():
         "notebooks.kubeflow.org/tpu-topology"] == "2x4"
     back = nbapi.convert(spoke, "v1beta1")
     assert back["spec"]["tpu"] == {"topology": "2x4"}
+
+
+def test_multi_host_slice_gets_pdb(kube, reconciler):
+    from kubeflow_tpu.platform.k8s.types import PODDISRUPTIONBUDGET
+
+    kube.create(make_notebook("nb", tpu={"accelerator": "v5e", "topology": "4x4"}))
+    reconcile(reconciler)
+    pdb = kube.get(PODDISRUPTIONBUDGET, "nb-slice", "user1")
+    assert pdb["spec"]["minAvailable"] == 2  # v5e 4x4 = 2 hosts
+    assert pdb["spec"]["selector"]["matchLabels"] == {"statefulset": "nb"}
+    # Stopping removes the PDB so drains aren't blocked by an idle slice.
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    nb["metadata"].setdefault("annotations", {})[nbapi.STOP_ANNOTATION] = "now"
+    kube.update(nb)
+    reconcile(reconciler)
+    with pytest.raises(errors.NotFound):
+        kube.get(PODDISRUPTIONBUDGET, "nb-slice", "user1")
+
+
+def test_single_host_gets_no_pdb(kube, reconciler):
+    from kubeflow_tpu.platform.k8s.types import PODDISRUPTIONBUDGET
+
+    kube.create(make_notebook("nb", tpu={"accelerator": "v5e", "topology": "2x4"}))
+    reconcile(reconciler)
+    with pytest.raises(errors.NotFound):
+        kube.get(PODDISRUPTIONBUDGET, "nb-slice", "user1")
