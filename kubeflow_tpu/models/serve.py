@@ -1,0 +1,164 @@
+"""Minimal generation server: serve a model zoo decoder over HTTP.
+
+The platform spawns notebooks; a notebook that trained a model serves it
+with one command:
+
+    python -m kubeflow_tpu.models.serve --model llama_125m \\
+        --checkpoint-dir /workspace/ckpt --port 8080
+
+Endpoints:
+  GET  /healthz             liveness
+  GET  /v1/model            model name/config summary
+  POST /v1/generate         {"tokens": [[...]], "max_new_tokens": 32,
+                             "temperature": 0.8, "top_k": 40, "seed": 0}
+                            -> {"tokens": [[...]]}
+
+The handler batches whatever rows arrive in one request, right-pads them
+to the longest prompt, and calls the jit generate() path (models/
+generate.py) — repeated shapes hit the compile cache.  This is a
+single-process server for notebook-scale serving, not a fleet frontend.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class GenerationService:
+    def __init__(self, model, params, *, default_max_new_tokens: int = 32):
+        self.model = model
+        self.params = params
+        self.default_max_new_tokens = default_max_new_tokens
+        # generate() donates nothing but jit compilation is per-shape; a
+        # lock keeps concurrent requests from racing device memory on tiny
+        # single-chip deployments.
+        self._lock = threading.Lock()
+
+    def generate(self, rows, *, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 eos_token: Optional[int] = None, seed: int = 0):
+        from kubeflow_tpu.models.generate import generate
+
+        if not rows or not all(isinstance(r, list) and r for r in rows):
+            raise ValueError("tokens must be a non-empty list of non-empty rows")
+        vocab = self.model.cfg.vocab_size
+        for r in rows:
+            for t in r:
+                if not isinstance(t, int) or not 0 <= t < vocab:
+                    raise ValueError(f"token {t!r} outside [0, {vocab})")
+        n = max_new_tokens or self.default_max_new_tokens
+        longest = max(len(r) for r in rows)
+        prompt = jnp.array(
+            [r + [0] * (longest - len(r)) for r in rows], jnp.int32
+        )
+        mask = jnp.array(
+            [[1] * len(r) + [0] * (longest - len(r)) for r in rows], bool
+        )
+        with self._lock:
+            out = generate(
+                self.model, self.params, prompt, prompt_mask=mask,
+                max_new_tokens=n, temperature=temperature, top_k=top_k,
+                eos_token=eos_token, rng=jax.random.key(seed),
+            )
+        return jax.device_get(out).tolist()
+
+
+def create_app(service: GenerationService, *, model_name: str = "model"):
+    from kubeflow_tpu.platform.web.framework import App, HttpError, success
+
+    app = App("model-serve")
+
+    @app.route("/healthz")
+    def healthz(request):
+        return success({"healthy": True})
+
+    @app.route("/v1/model")
+    def model_info(request):
+        cfg = service.model.cfg
+        return success({
+            "model": model_name,
+            "config": {
+                k: v for k, v in dataclasses.asdict(cfg).items()
+                if isinstance(v, (int, float, str, bool))
+            },
+        })
+
+    @app.route("/v1/generate", methods=["POST"])
+    def generate(request):
+        body = request.get_json(force=True, silent=True) or {}
+        try:
+            tokens = service.generate(
+                body.get("tokens"),
+                max_new_tokens=body.get("max_new_tokens"),
+                temperature=float(body.get("temperature", 0.0)),
+                top_k=body.get("top_k"),
+                eos_token=body.get("eos_token"),
+                seed=int(body.get("seed", 0)),
+            )
+        except ValueError as e:
+            raise HttpError(400, str(e)) from None
+        return success({"tokens": tokens})
+
+    return app
+
+
+def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
+                 max_seq_len: Optional[int] = None,
+                 seed: int = 0) -> GenerationService:
+    """Build the model; restore params from a train-loop checkpoint when
+    given, else random-init (useful for smoke/serving-path tests)."""
+    from kubeflow_tpu.models import create_model
+
+    overrides = {}
+    if max_seq_len:
+        overrides["max_seq_len"] = max_seq_len
+    model = create_model(model_name, **overrides)
+    tokens = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(seed), tokens)["params"]
+    if checkpoint_dir:
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        with CheckpointManager(checkpoint_dir) as mgr:
+            # Params-only restore: serving doesn't know (or need) the
+            # optimizer the checkpoint was trained with.
+            restored = mgr.restore_params()
+        if restored is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {checkpoint_dir}"
+            )
+        params = jax.tree.map(
+            lambda t, r: jnp.asarray(r, t.dtype), params, restored
+        )
+    return GenerationService(model, params)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="llama_125m")
+    ap.add_argument("--checkpoint-dir", default=None)
+    ap.add_argument("--max-seq-len", type=int, default=None)
+    ap.add_argument("--port", type=int, default=8080)
+    args = ap.parse_args(argv)
+
+    service = load_service(
+        args.model, checkpoint_dir=args.checkpoint_dir,
+        max_seq_len=args.max_seq_len,
+    )
+    app = create_app(service, model_name=args.model)
+    from werkzeug.serving import make_server
+
+    server = make_server("0.0.0.0", args.port, app, threaded=True)
+    print(json.dumps({"serving": args.model, "port": args.port}), flush=True)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,18 +63,13 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError:
             return None
         if not hasattr(lib, "kfp_merge_apply"):
-            # Stale prebuilt library from before a symbol was added: rebuild
-            # (make re-links since the sources are newer) and reload; if
-            # that can't produce the symbol, report unavailable so the
-            # pure-Python fallbacks engage instead of crashing.
-            if not _try_build():
-                return None
-            try:
-                lib = ctypes.CDLL(_LIB_PATH)
-            except OSError:
-                return None
-            if not hasattr(lib, "kfp_merge_apply"):
-                return None
+            # Stale prebuilt library from before a symbol was added.
+            # Rebuild for FUTURE processes (make re-links, sources are
+            # newer) but report unavailable now — dlopen caches the mapped
+            # object by path, so re-CDLL'ing in this process would return
+            # the stale mapping anyway.  Python fallbacks engage.
+            _try_build()
+            return None
         # kfp: JSON patch engine
         lib.kfp_create_patch.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.kfp_create_patch.restype = ctypes.c_void_p
@@ -112,6 +107,14 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def loaded() -> bool:
+    """True only if the library is ALREADY loaded — never triggers the
+    first-use build (which can block ~2 min).  For callers on latency-
+    sensitive or lock-holding paths (FakeKube.patch) where the Python
+    fallback is preferable to waiting on make."""
+    return _lib is not None
 
 
 def preload() -> bool:

@@ -196,9 +196,10 @@ class FakeKube:
             if patch_type == "merge" or patch_type == "strategic":
                 from kubeflow_tpu.platform import native
 
-                if native.available():
-                    # Native RFC 7386 engine; parity with the Python
-                    # fallback is pinned by tests/ctrlplane/test_native.py.
+                # loaded(), not available(): the first available() call may
+                # BUILD the library (~2 min) — never under the store lock.
+                # Parity between the engines is pinned by test_native.py.
+                if native.loaded():
                     merged = native.merge_patch_apply(current, patch)
                     current.clear()
                     current.update(merged)

@@ -52,13 +52,21 @@ class CheckpointManager:
             save_interval_steps=save_interval_steps,
             enable_async_checkpointing=async_save,
         )
-        self._mgr = ocp.CheckpointManager(directory, options=options)
+        # An explicit handler lets a fresh (read-only) manager resolve
+        # item_metadata without having performed a save/restore first, and
+        # the PyTree handler (the layer Standard* wraps, same on-disk
+        # format) additionally accepts PLACEHOLDER targets — both needed by
+        # restore_params.
+        self._mgr = ocp.CheckpointManager(
+            directory, options=options,
+            item_handlers=ocp.PyTreeCheckpointHandler(),
+        )
 
     def save(self, step: int, state: TrainState, *, force: bool = False) -> bool:
         """Queue an async save; returns False if skipped by save_interval."""
         return self._mgr.save(
             int(step),
-            args=self._ocp.args.StandardSave(_as_pytree(state)),
+            args=self._ocp.args.PyTreeSave(_as_pytree(state)),
             force=force,
         )
 
@@ -88,7 +96,7 @@ class CheckpointManager:
             _as_pytree(template),
         )
         restored = self._mgr.restore(
-            int(step), args=self._ocp.args.StandardRestore(abstract)
+            int(step), args=self._ocp.args.PyTreeRestore(abstract)
         )
         return template.replace(
             step=restored["step"],
@@ -96,6 +104,37 @@ class CheckpointManager:
             opt_state=restored["opt_state"],
             batch_stats=restored.get("batch_stats", template.batch_stats),
         )
+
+    def restore_params(self, *, step: Optional[int] = None):
+        """Restore only the params subtree, without needing the training
+        optimizer to rebuild the full TrainState template — the serving
+        path (models/serve.py) reads checkpoints written by any optimizer.
+        Non-params subtrees (opt_state can be 2x params for Adam) are
+        PLACEHOLDER'd so they are neither read from disk nor held in RAM.
+        Returns None when no checkpoint exists."""
+        import jax
+
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        meta = self._mgr.item_metadata(int(step))
+        tree = getattr(meta, "tree", None) or meta
+
+        def abstract(path_is_params, node):
+            if not path_is_params:
+                return self._ocp.PLACEHOLDER
+            return jax.ShapeDtypeStruct(node.shape, node.dtype)
+
+        target = {
+            key: (
+                jax.tree.map(lambda n: abstract(key == "params", n), sub)
+            )
+            for key, sub in tree.items()
+        }
+        restored = self._mgr.restore(
+            int(step), args=self._ocp.args.PyTreeRestore(target)
+        )
+        return restored["params"]
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""

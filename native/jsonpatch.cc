@@ -866,7 +866,6 @@ const char* kfp_apply_patch(const char* doc, const char* patch) {
   return nullptr;
 }
 
-// Round-trip canonicalization (parse + compact serialize); used by tests.
 // RFC 7386: apply a merge patch to a document → merged JSON, or NULL.
 const char* kfp_merge_apply(const char* doc, const char* patch) {
   try {
@@ -901,6 +900,7 @@ const char* kfp_merge_create(const char* before, const char* after) {
   return nullptr;
 }
 
+// Round-trip canonicalization (parse + compact serialize); used by tests.
 const char* kfp_canonical(const char* doc) {
   try {
     kf::ValuePtr d = kf::Parser(doc).parse();

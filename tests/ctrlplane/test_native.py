@@ -286,3 +286,14 @@ def test_fake_kube_patch_uses_native_merge():
                {"metadata": {"annotations": {"a": None, "c": "3"}}}, "ns")
     nb = kube.get(NOTEBOOK, "nb", "ns")
     assert nb["metadata"]["annotations"] == {"b": "2", "c": "3"}
+
+
+def test_loaded_never_builds(monkeypatch):
+    # loaded() must be a pure check: no build side effects even when the
+    # library has not been loaded in this process.
+    from kubeflow_tpu.platform import native
+
+    calls = []
+    monkeypatch.setattr(native, "_try_build", lambda: calls.append(1) or False)
+    native.loaded()
+    assert calls == []
