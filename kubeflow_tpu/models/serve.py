@@ -53,7 +53,14 @@ class GenerationService:
             for t in r:
                 if not isinstance(t, int) or not 0 <= t < vocab:
                     raise ValueError(f"token {t!r} outside [0, {vocab})")
-        n = max_new_tokens or self.default_max_new_tokens
+        n = self.default_max_new_tokens if max_new_tokens is None else max_new_tokens
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            raise ValueError(f"max_new_tokens must be a positive int, got {n!r}")
+        if top_k is not None and (not isinstance(top_k, int)
+                                  or isinstance(top_k, bool) or top_k < 1):
+            raise ValueError(f"top_k must be a positive int, got {top_k!r}")
+        if eos_token is not None and not isinstance(eos_token, int):
+            raise ValueError(f"eos_token must be an int, got {eos_token!r}")
         longest = max(len(r) for r in rows)
         prompt = jnp.array(
             [r + [0] * (longest - len(r)) for r in rows], jnp.int32
@@ -94,6 +101,8 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     def generate(request):
         body = request.get_json(force=True, silent=True) or {}
         try:
+            # int()/float() coercions raise TypeError on null/list inputs —
+            # every malformed field must land as a 400, not a 500.
             tokens = service.generate(
                 body.get("tokens"),
                 max_new_tokens=body.get("max_new_tokens"),
@@ -102,7 +111,7 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
                 eos_token=body.get("eos_token"),
                 seed=int(body.get("seed", 0)),
             )
-        except ValueError as e:
+        except (ValueError, TypeError) as e:
             raise HttpError(400, str(e)) from None
         return success({"tokens": tokens})
 
@@ -121,7 +130,6 @@ def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
         overrides["max_seq_len"] = max_seq_len
     model = create_model(model_name, **overrides)
     tokens = jnp.ones((1, 8), jnp.int32)
-    params = model.init(jax.random.key(seed), tokens)["params"]
     if checkpoint_dir:
         from kubeflow_tpu.train.checkpoint import CheckpointManager
 
@@ -133,9 +141,16 @@ def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
             raise FileNotFoundError(
                 f"no checkpoint found under {checkpoint_dir}"
             )
+        # Shape-only init: the dtype/structure template costs nothing when
+        # the checkpoint supplies every value.
+        template = jax.eval_shape(
+            lambda: model.init(jax.random.key(seed), tokens)
+        )["params"]
         params = jax.tree.map(
-            lambda t, r: jnp.asarray(r, t.dtype), params, restored
+            lambda t, r: jnp.asarray(r, t.dtype), template, restored
         )
+    else:
+        params = model.init(jax.random.key(seed), tokens)["params"]
     return GenerationService(model, params)
 
 

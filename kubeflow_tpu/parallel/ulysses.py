@@ -55,9 +55,15 @@ def _local_ulysses(q, k, v, *, axis_name, causal, scale, attn_fn):
 
 
 def _default_attn(q, k, v, *, causal, scale):
-    """Plain XLA attention on the local head group (full sequence)."""
+    """Attention on the local head group (full sequence): the Pallas flash
+    kernel once the sequence passes its threshold — after the all-to-all
+    each device holds the FULL sequence for its heads, exactly the shape
+    the kernel is built for — else plain XLA."""
     from kubeflow_tpu.ops.attention import xla_attention
+    from kubeflow_tpu.ops.pallas import flash_attention as fa
 
+    if fa.supported(q, k, v) and fa.should_use(q):
+        return fa.flash_attention(q, k, v, causal=causal, softmax_scale=scale)
     return xla_attention(q, k, v, causal=causal, softmax_scale=scale)
 
 

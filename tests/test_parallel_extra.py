@@ -273,3 +273,29 @@ def test_host_batch_size_requires_divisibility(monkeypatch):
     with pytest.raises(ValueError, match="divisible"):
         loader._host_batch_size(6)
     assert loader._host_batch_size(8) == 2
+
+
+def test_ulysses_flash_local_kernel_matches(devices8):
+    # Force the flash local kernel via attn_fn and compare against the
+    # default XLA path (and the unsharded reference).
+    from kubeflow_tpu.ops.attention import xla_attention
+    from kubeflow_tpu.ops.pallas import flash_attention as fa
+    from kubeflow_tpu.parallel import make_mesh
+    from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh(dp=2, sp=4, devices=jax.devices()[:8])
+    k0 = jax.random.key(0)
+    q = jax.random.normal(jax.random.fold_in(k0, 1), (2, 512, 4, 64))
+    k = jax.random.normal(jax.random.fold_in(k0, 2), (2, 512, 4, 64))
+    v = jax.random.normal(jax.random.fold_in(k0, 3), (2, 512, 4, 64))
+
+    def flash_fn(q, k, v, *, causal, scale):
+        return fa.flash_attention(q, k, v, causal=causal, softmax_scale=scale)
+
+    out_flash = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=mesh, causal=True, attn_fn=flash_fn))(q, k, v)
+    out_default = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=mesh, causal=True))(q, k, v)
+    ref = xla_attention(q, k, v, causal=True)
+    assert jnp.max(jnp.abs(out_flash - out_default)) < 2e-5
+    assert jnp.max(jnp.abs(out_flash - ref)) < 2e-5

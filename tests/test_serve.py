@@ -62,6 +62,11 @@ def test_generate_validation_errors(client):
         {"tokens": [[]]},                       # empty row
         {"tokens": [[999999]]},                 # out-of-vocab token
         {"tokens": [["x"]]},                    # non-int token
+        {"tokens": [[1]], "max_new_tokens": 0},     # zero budget
+        {"tokens": [[1]], "max_new_tokens": "abc"},  # non-int budget
+        {"tokens": [[1]], "temperature": None},      # null coercion
+        {"tokens": [[1]], "seed": [1]},              # bad seed type
+        {"tokens": [[1]], "top_k": 0},               # zero top_k
     ):
         resp = client.post("/v1/generate", json=body)
         assert resp.status_code == 400, body
