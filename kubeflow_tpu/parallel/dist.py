@@ -24,27 +24,54 @@ def worker_env() -> dict:
         "hostnames": os.environ.get("TPU_WORKER_HOSTNAMES"),
         "topology": os.environ.get("TPU_TOPOLOGY"),
         "accelerator": os.environ.get("TPU_ACCELERATOR_TYPE"),
+        "hosts_per_slice": os.environ.get("TPU_HOSTS_PER_SLICE"),
+        "num_slices": os.environ.get("MEGASCALE_NUM_SLICES"),
+        "slice_id": os.environ.get("MEGASCALE_SLICE_ID"),
+        "coordinator": os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"),
     }
 
 
+def num_slices() -> int:
+    """Slices in this deployment (1 unless the platform spawned multislice)."""
+    return int(worker_env()["num_slices"] or 1)
+
+
+def slice_id() -> int:
+    """Which slice this worker belongs to (MEGASCALE_SLICE_ID, per the
+    notebook controller's one-StatefulSet-per-slice injection)."""
+    return int(worker_env()["slice_id"] or 0)
+
+
 def initialize_from_env(*, coordinator_port: int = DEFAULT_COORDINATOR_PORT) -> bool:
-    """Join the slice's jax.distributed cluster if this is a multi-host pod.
+    """Join the deployment's jax.distributed cluster if this is a multi-host
+    (or multislice) pod.
 
     Returns True if distributed init ran, False for single-host (no-op).
-    Worker 0 (the StatefulSet's ``<name>-0`` pod, routed by the headless
-    service the notebook controller creates) is the coordinator.
+    ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` are *per-slice* (the libtpu
+    ICI contract); the global process id folds in ``MEGASCALE_SLICE_ID`` so
+    one barrier spans every slice, with worker 0 of slice 0 (the
+    ``<name>-0`` pod routed by the headless service) as coordinator.
     """
     env = worker_env()
     if not env["hostnames"]:
         return False
     hosts = [h.strip() for h in env["hostnames"].split(",") if h.strip()]
-    if len(hosts) <= 1:
+    slices = num_slices()
+    if len(hosts) * slices <= 1:
         return False
     worker_id = int(env["worker_id"] or 0)
-    coordinator = f"{hosts[0]}:{coordinator_port}"
+    if slices > 1 and not env["coordinator"]:
+        # hosts[0] is only the coordinator within ONE slice; without the
+        # cross-slice address every slice would dial its own worker 0 and
+        # all hosts would hang at the barrier — fail fast instead.
+        raise RuntimeError(
+            "MEGASCALE_NUM_SLICES > 1 but MEGASCALE_COORDINATOR_ADDRESS is "
+            "unset; multislice needs the global coordinator address"
+        )
+    coordinator_host = env["coordinator"] or hosts[0]
     jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=len(hosts),
-        process_id=worker_id,
+        coordinator_address=f"{coordinator_host}:{coordinator_port}",
+        num_processes=len(hosts) * slices,
+        process_id=slice_id() * len(hosts) + worker_id,
     )
     return True

@@ -48,6 +48,7 @@ VERSIONS = ("v1alpha1", "v1", "v1beta1")
 TPU_RESOURCE = "google.com/tpu"
 ANNOTATION_TPU_ACCELERATOR = "notebooks.kubeflow.org/tpu-accelerator"
 ANNOTATION_TPU_TOPOLOGY = "notebooks.kubeflow.org/tpu-topology"
+ANNOTATION_TPU_SLICES = "notebooks.kubeflow.org/tpu-slices"
 
 
 class ValidationError(ValueError):
@@ -65,7 +66,9 @@ def validate(notebook: Resource) -> None:
     tpu = notebook.get("spec", {}).get("tpu")
     if tpu:
         try:
-            slice_spec(tpu.get("accelerator", ""), tpu.get("topology"))
+            slice_spec(
+                tpu.get("accelerator", ""), tpu.get("topology"), tpu.get("slices")
+            )
         except ValueError as e:
             raise ValidationError(str(e)) from None
 
@@ -74,7 +77,17 @@ def tpu_slice(notebook: Resource) -> Optional[SliceSpec]:
     tpu = deep_get(notebook, "spec", "tpu")
     if not tpu or not tpu.get("accelerator"):
         return None
-    return slice_spec(tpu["accelerator"], tpu.get("topology"))
+    return slice_spec(tpu["accelerator"], tpu.get("topology"), tpu.get("slices"))
+
+
+def tpu_slice_or_none(notebook: Resource) -> Optional[SliceSpec]:
+    """`tpu_slice` for aggregation paths: a stored-invalid spec.tpu (possible
+    via kubectl or legacy annotation lift — its own reconcile parks it
+    Degraded) yields None instead of crashing the caller."""
+    try:
+        return tpu_slice(notebook)
+    except ValueError:
+        return None
 
 
 def is_stopped(notebook: Resource) -> bool:
@@ -137,6 +150,7 @@ def _to_hub(notebook: Resource) -> Resource:
     annotations = deep_get(nb, "metadata", "annotations", default={}) or {}
     accelerator = annotations.pop(ANNOTATION_TPU_ACCELERATOR, None)
     topology = annotations.pop(ANNOTATION_TPU_TOPOLOGY, None)
+    slices = annotations.pop(ANNOTATION_TPU_SLICES, None)
     containers = deep_get(nb, "spec", "template", "spec", "containers", default=[])
     # Only strip the chip limit when the accelerator annotation identifies
     # the TPU generation (the limit is then derivable from spec.tpu); a bare
@@ -159,6 +173,15 @@ def _to_hub(notebook: Resource) -> Resource:
             tpu["accelerator"] = accelerator
         if topology:
             tpu["topology"] = topology
+        if slices:
+            # Annotations aren't schema-validated; only a sane value (>= 1)
+            # may become stored hub spec — anything else lifts as
+            # single-slice rather than minting a spec every consumer rejects.
+            try:
+                if int(slices) >= 1:
+                    tpu["slices"] = int(slices)
+            except ValueError:
+                pass
         nb.setdefault("spec", {})["tpu"] = tpu
     if annotations == {}:
         deep_get(nb, "metadata", default={}).pop("annotations", None)
@@ -185,6 +208,8 @@ def _from_hub(notebook: Resource, version: str) -> Resource:
             annotations[ANNOTATION_TPU_ACCELERATOR] = tpu["accelerator"]
         if tpu.get("topology"):
             annotations[ANNOTATION_TPU_TOPOLOGY] = tpu["topology"]
+        if tpu.get("slices"):
+            annotations[ANNOTATION_TPU_SLICES] = str(tpu["slices"])
         spec = None
         if tpu.get("accelerator"):
             try:
@@ -291,6 +316,7 @@ def _crd_version(name: str, *, storage: bool) -> dict:
             "properties": {
                 "accelerator": {"type": "string"},
                 "topology": {"type": "string"},
+                "slices": {"type": "integer", "minimum": 1},
             },
         }
     return {

@@ -151,8 +151,25 @@ def _set_tpu(nb, body, defaults) -> None:
         raise HttpError(
             400, f"topology {topology!r} not offered for {accelerator}"
         )
+    slices = tpu.get("slices")
+    if slices is not None:
+        try:
+            slices = int(slices)
+        except (TypeError, ValueError):
+            raise HttpError(400, f"invalid TPU slice count {slices!r}") from None
+        if slices < 1:
+            raise HttpError(400, f"invalid TPU slice count {slices}")
+        # maxSlices: 0 (or absent) = single-slice only; multislice is an
+        # explicit admin opt-in.
+        max_slices = int(defaults.get("tpus", {}).get("maxSlices", 0) or 0)
+        ceiling = max_slices if max_slices > 0 else 1
+        if slices > ceiling:
+            raise HttpError(
+                400, f"slice count {slices} exceeds offered maximum {ceiling}"
+            )
     nb["spec"]["tpu"] = {"accelerator": accelerator,
-                         **({"topology": topology} if topology else {})}
+                         **({"topology": topology} if topology else {}),
+                         **({"slices": slices} if slices and slices > 1 else {})}
 
 
 def _set_volumes(nb, body, defaults) -> List[dict]:

@@ -9,7 +9,13 @@ from typing import Optional
 
 from werkzeug.wrappers import Request
 
-from kubeflow_tpu.platform.k8s.types import TENSORBOARD, deep_get, name_of
+from kubeflow_tpu.platform.k8s.types import (
+    PODDEFAULT,
+    PVC,
+    TENSORBOARD,
+    deep_get,
+    name_of,
+)
 from kubeflow_tpu.platform.web.crud_backend import (
     CrudBackend,
     current_user,
@@ -60,5 +66,26 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
         user = current_user(request)
         backend.delete_resource(user, TENSORBOARD, name, ns)
         return success()
+
+    @app.route("/api/namespaces/<ns>/pvcs")
+    def list_pvcs(request: Request, ns: str):
+        """PVC names for the logspath picker (reference TWA get.py:23-29)."""
+        user = current_user(request)
+        pvcs = backend.list_resources(user, PVC, ns)
+        return success({"pvcs": [name_of(p) for p in pvcs]})
+
+    @app.route("/api/namespaces/<ns>/poddefaults")
+    def list_poddefaults(request: Request, ns: str):
+        """PodDefaults with form label/desc fields (reference TWA get.py:32-47)."""
+        user = current_user(request)
+        out = []
+        for pd in backend.list_resources(user, PODDEFAULT, ns):
+            labels = deep_get(pd, "spec", "selector", "matchLabels", default={}) or {}
+            out.append({
+                "name": name_of(pd),
+                "label": next(iter(labels.keys()), ""),
+                "desc": deep_get(pd, "spec", "desc", default=name_of(pd)),
+            })
+        return success({"poddefaults": out})
 
     return app

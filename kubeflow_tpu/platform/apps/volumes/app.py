@@ -10,7 +10,14 @@ from typing import Optional
 
 from werkzeug.wrappers import Request
 
-from kubeflow_tpu.platform.k8s.types import POD, PVC, STORAGECLASS, deep_get, name_of
+from kubeflow_tpu.platform.k8s.types import (
+    EVENT,
+    POD,
+    PVC,
+    STORAGECLASS,
+    deep_get,
+    name_of,
+)
 from kubeflow_tpu.platform.web.crud_backend import (
     CrudBackend,
     current_user,
@@ -97,11 +104,30 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
         classes = backend.list_resources(user, STORAGECLASS)
         return success({"storageClasses": [name_of(c) for c in classes]})
 
+    @app.route("/api/namespaces/<ns>/pvcs/<name>")
+    def get_pvc(request: Request, ns: str, name: str):
+        """Single PVC (reference volumes get.py:19-22)."""
+        user = current_user(request)
+        return success({"pvc": backend.get_resource(user, PVC, name, ns)})
+
     @app.route("/api/namespaces/<ns>/pvcs/<name>/pods")
     def pvc_pods(request: Request, ns: str, name: str):
         user = current_user(request)
         pods = backend.list_resources(user, POD, ns)
         return success({"pods": _pods_using(pods, name)})
+
+    @app.route("/api/namespaces/<ns>/pvcs/<name>/events")
+    def pvc_events(request: Request, ns: str, name: str):
+        """Events involving one PVC (reference volumes get.py:32-35)."""
+        user = current_user(request)
+        events = [
+            ev for ev in backend.list_resources(user, EVENT, ns)
+            if deep_get(ev, "involvedObject", "name", default="") == name
+            and deep_get(ev, "involvedObject", "kind", default="") in (
+                "PersistentVolumeClaim", "",
+            )
+        ]
+        return success({"events": events})
 
     return app
 

@@ -53,6 +53,10 @@ from kubeflow_tpu.platform.tpu import SliceSpec
 HASH_ANNOTATION = "notebooks.kubeflow.org/generated-hash"
 
 
+class _SliceNameConflict(Exception):
+    """A slice StatefulSet name is already owned by a different notebook."""
+
+
 def _content_hash(obj) -> str:
     return hashlib.sha256(
         json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
@@ -114,13 +118,16 @@ class NotebookReconciler(Reconciler):
                 self.client.update_status(notebook)
             return None
 
-        sts = self._reconcile_statefulset(notebook)
+        stses = self._reconcile_statefulsets(notebook)
+        if stses is None:
+            # Parked on a slice-name conflict (terminal until renamed).
+            return None
         self._reconcile_service(notebook)
         self._reconcile_headless_service(notebook)
         self._reconcile_pdb(notebook)
         if self.use_istio:
             self._reconcile_virtual_service(notebook)
-        self._update_status(notebook, sts)
+        self._update_status(notebook, stses)
         self._mirror_events(notebook)
         self._update_namespace_gauges(req.namespace)
         return None
@@ -133,20 +140,31 @@ class NotebookReconciler(Reconciler):
         for nb in self.client.list(NOTEBOOK, ns):
             if nbapi.is_stopped(nb):
                 continue
-            s = nbapi.tpu_slice(nb)
+            s = nbapi.tpu_slice_or_none(nb)
             if s:
-                chips += s.chips
+                chips += s.total_chips
             running += 1
         metrics.tpu_chips_requested.labels(namespace=ns).set(chips)
         metrics.notebook_running.labels(namespace=ns).set(running)
 
     # -- statefulset ---------------------------------------------------------
 
-    def generate_statefulset(self, notebook: Resource) -> Resource:
+    @staticmethod
+    def slice_sts_name(name: str, slice_idx: int) -> str:
+        """Slice 0 keeps the bare notebook name (so worker 0 is ``<name>-0``
+        — UI routing, culling, and status never change); later slices get
+        ``<name>-s<i>`` StatefulSets, mirroring GKE multislice's
+        one-Job-per-slice layout."""
+        return name if slice_idx == 0 else f"{name}-s{slice_idx}"
+
+    def generate_statefulset(
+        self, notebook: Resource, slice_idx: int = 0
+    ) -> Resource:
         ns = meta(notebook)["namespace"]
         name = name_of(notebook)
         tpu = nbapi.tpu_slice(notebook)
         replicas = 0 if nbapi.is_stopped(notebook) else (tpu.num_hosts if tpu else 1)
+        sts_name = self.slice_sts_name(name, slice_idx)
 
         pod_spec = copy.deepcopy(
             deep_get(notebook, "spec", "template", "spec", default={})
@@ -157,19 +175,23 @@ class NotebookReconciler(Reconciler):
 
         self._inject_prefix_env(main, ns, name)
         if tpu:
-            self._inject_tpu(pod_spec, main, ns, name, tpu)
+            self._inject_tpu(pod_spec, main, ns, name, tpu, slice_idx)
         if self.add_fsgroup:
             pod_spec.setdefault("securityContext", {}).setdefault("fsGroup", 100)
 
         labels = {
-            "statefulset": name,
+            # Per-STS selector label (must be unique per slice so each
+            # StatefulSet selects only its own pods)...
+            "statefulset": sts_name,
+            # ...and the cross-slice notebook label the headless service,
+            # PDB, and status aggregation select on.
             nbapi.LABEL_NOTEBOOK_NAME: name,
         }
         sts = {
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
             "metadata": {
-                "name": name,
+                "name": sts_name,
                 "namespace": ns,
                 "labels": dict(labels),
             },
@@ -177,7 +199,7 @@ class NotebookReconciler(Reconciler):
                 "replicas": replicas,
                 "serviceName": f"{name}-workers",
                 "podManagementPolicy": "Parallel",  # all TPU workers at once
-                "selector": {"matchLabels": {"statefulset": name}},
+                "selector": {"matchLabels": {"statefulset": sts_name}},
                 "template": {
                     "metadata": {"labels": dict(labels)},
                     "spec": pod_spec,
@@ -193,7 +215,7 @@ class NotebookReconciler(Reconciler):
             env.append({"name": "NB_PREFIX", "value": nbapi.nb_prefix(ns, name)})
 
     def _inject_tpu(self, pod_spec: dict, container: dict, ns: str, name: str,
-                    tpu: SliceSpec) -> None:
+                    tpu: SliceSpec, slice_idx: int = 0) -> None:
         # Chip limits on the main container (per pod == per host).
         resources = container.setdefault("resources", {})
         limits = resources.setdefault("limits", {})
@@ -205,8 +227,14 @@ class NotebookReconciler(Reconciler):
         selectors.update(tpu.node_selectors())
         # Worker env: ordinal from the pod-index label (statefulset pods get
         # apps.kubernetes.io/pod-index), hostnames from the headless service.
+        # TPU_WORKER_ID/TPU_WORKER_HOSTNAMES are libtpu's *per-slice* ICI
+        # bootstrap contract (same variables GKE's TPU webhook injects), so
+        # each slice's StatefulSet lists only its own hosts and pod ordinals
+        # restart from 0 per slice; the MEGASCALE_* variables carry the
+        # cross-slice (DCN) identity.
+        sts_name = self.slice_sts_name(name, slice_idx)
         hostnames = ",".join(
-            f"{name}-{i}.{name}-workers.{ns}.svc.{self.cluster_domain}"
+            f"{sts_name}-{i}.{name}-workers.{ns}.svc.{self.cluster_domain}"
             for i in range(tpu.num_hosts)
         )
         env = container.setdefault("env", [])
@@ -220,11 +248,85 @@ class NotebookReconciler(Reconciler):
             {"name": "TPU_ACCELERATOR_TYPE",
              "value": f"{tpu.accelerator.name}-{tpu.chips}"},
             {"name": "TPU_CHIPS_PER_HOST", "value": str(tpu.chips_per_pod)},
+            {"name": "TPU_HOSTS_PER_SLICE", "value": str(tpu.num_hosts)},
         ]
+        if tpu.multi_slice:
+            # DCN mesh contract (GKE multislice parity): every worker learns
+            # its slice, the slice count, and the coordinator — worker 0 of
+            # slice 0 (pod <name>-0, stable across slice STSes).
+            injected += [
+                {"name": "MEGASCALE_SLICE_ID", "value": str(slice_idx)},
+                {"name": "MEGASCALE_NUM_SLICES", "value": str(tpu.num_slices)},
+                {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value":
+                    f"{name}-0.{name}-workers.{ns}.svc.{self.cluster_domain}"},
+            ]
         env.extend(e for e in injected if e["name"] not in have)
 
-    def _reconcile_statefulset(self, notebook: Resource) -> Resource:
-        desired = self.generate_statefulset(notebook)
+    def _reconcile_statefulsets(
+        self, notebook: Resource
+    ) -> Optional[List[Resource]]:
+        """One StatefulSet per slice; stale slice STSes (slices lowered) are
+        deleted so their pods don't linger outside the new mesh.  Returns
+        None when parked on a slice-name conflict."""
+        tpu = nbapi.tpu_slice(notebook)
+        n_slices = tpu.num_slices if tpu else 1
+        ns, name = meta(notebook)["namespace"], name_of(notebook)
+        # Conflict-check every slice name BEFORE writing anything: a partial
+        # deployment (slice 0 created, slice 1 conflicted) would hold TPU
+        # hosts forever at the jax.distributed barrier.
+        try:
+            for s in range(n_slices):
+                self._check_sts_ownership(ns, name, self.slice_sts_name(name, s))
+        except _SliceNameConflict as e:
+            # A sibling notebook legally named `<name>-s<i>` owns that
+            # StatefulSet; fighting over it would flap both workloads.
+            # Park this notebook instead — terminal until renamed.
+            self.recorder.event(notebook, "Warning", "SliceNameConflict", str(e))
+            status = {"conditions": [{
+                "type": "Degraded", "status": "True",
+                "reason": "SliceNameConflict", "message": str(e),
+            }]}
+            if notebook.get("status") != status:
+                parked = copy.deepcopy(notebook)
+                parked["status"] = status
+                self.client.update_status(parked)
+            return None
+        out = [
+            self._reconcile_one_statefulset(notebook, s) for s in range(n_slices)
+        ]
+        expected = {self.slice_sts_name(name, s) for s in range(n_slices)}
+        # A transient list failure must raise (requeue with backoff) — a
+        # silent skip would leave a scaled-down slice's pods holding TPUs
+        # until the next unrelated event.
+        owned = self.client.list(
+            STATEFULSET, ns, label_selector={nbapi.LABEL_NOTEBOOK_NAME: name}
+        )
+        for sts in owned:
+            if name_of(sts) not in expected:
+                try:
+                    self.client.delete(STATEFULSET, name_of(sts), ns)
+                except errors.NotFound:
+                    pass
+        return out
+
+    def _check_sts_ownership(self, ns: str, notebook_name: str,
+                             sts_name: str) -> None:
+        try:
+            current = self.client.get(STATEFULSET, sts_name, ns)
+        except errors.NotFound:
+            return
+        owner = deep_get(current, "metadata", "labels", nbapi.LABEL_NOTEBOOK_NAME)
+        if owner != notebook_name:
+            raise _SliceNameConflict(
+                f"StatefulSet {ns}/{sts_name} belongs to notebook "
+                f"{owner or '<unlabelled>'}, not {notebook_name}; rename one "
+                f"of the notebooks to resolve the multislice name collision"
+            )
+
+    def _reconcile_one_statefulset(
+        self, notebook: Resource, slice_idx: int
+    ) -> Resource:
+        desired = self.generate_statefulset(notebook, slice_idx)
         ns, name = meta(desired)["namespace"], name_of(desired)
         # Semantic ownership via content hash: the live object accretes
         # server defaults (imagePullPolicy, dnsPolicy, ...) that make
@@ -303,7 +405,10 @@ class NotebookReconciler(Reconciler):
                 # Resolve worker DNS before readiness: jax.distributed
                 # rendezvous happens while pods are still NotReady.
                 "publishNotReadyAddresses": True,
-                "selector": {"statefulset": name},
+                # Notebook-name label spans every slice's StatefulSet, so
+                # cross-slice (DCN) worker DNS resolves through this one
+                # governing service.
+                "selector": {nbapi.LABEL_NOTEBOOK_NAME: name},
                 "ports": [{"name": "coordinator", "port": port, "protocol": "TCP"}],
             },
         }
@@ -352,8 +457,8 @@ class NotebookReconciler(Reconciler):
             "kind": "PodDisruptionBudget",
             "metadata": {"name": f"{name}-slice", "namespace": ns},
             "spec": {
-                "minAvailable": tpu.num_hosts,
-                "selector": {"matchLabels": {"statefulset": name}},
+                "minAvailable": tpu.total_hosts,
+                "selector": {"matchLabels": {nbapi.LABEL_NOTEBOOK_NAME: name}},
             },
         }
         set_owner(pdb, notebook)
@@ -473,8 +578,9 @@ class NotebookReconciler(Reconciler):
             for e in events
             if (e.get("involvedObject") or {}).get("kind") == NOTEBOOK.kind
         }
+        sts_names = _notebook_sts_names(notebook)
         for ev in events:
-            if not _event_involves_notebook(ev, name):
+            if not _event_involves_notebook(ev, sts_names):
                 continue
             # Only events from this notebook's lifetime: a recreated
             # notebook must not inherit its predecessor's failures.
@@ -554,10 +660,10 @@ class NotebookReconciler(Reconciler):
 
     # -- status --------------------------------------------------------------
 
-    def _update_status(self, notebook: Resource, sts: Resource) -> None:
+    def _update_status(self, notebook: Resource, stses: List[Resource]) -> None:
         ns, name = meta(notebook)["namespace"], name_of(notebook)
         pods = self.client.list(
-            POD, ns, label_selector={"statefulset": name}
+            POD, ns, label_selector={nbapi.LABEL_NOTEBOOK_NAME: name}
         )
         ready = sum(1 for p in pods if _pod_ready(p))
         worker0 = next(
@@ -565,7 +671,9 @@ class NotebookReconciler(Reconciler):
         )
         status: dict = {
             "readyReplicas": ready,
-            "replicas": deep_get(sts, "spec", "replicas", default=0),
+            "replicas": sum(
+                deep_get(s, "spec", "replicas", default=0) for s in stses
+            ),
         }
         if worker0:
             status["conditions"] = deep_get(worker0, "status", "conditions", default=[])
@@ -615,14 +723,33 @@ def pods_to_notebook_requests(obj: Resource) -> List[Request]:
     return [Request(deep_get(obj, "metadata", "namespace", default=""), nb)]
 
 
-def _event_involves_notebook(ev: Resource, name: str) -> bool:
+def _strip_slice_suffix(sts_name: str) -> str:
+    """``nb-s2`` → ``nb`` (multislice STS naming); anything else unchanged."""
+    prefix, _, tail = sts_name.rpartition("-")
+    if prefix and tail.startswith("s") and tail[1:].isdigit():
+        return prefix
+    return sts_name
+
+
+def _notebook_sts_names(notebook: Resource) -> set:
+    """The exact StatefulSet names this notebook owns — a sibling notebook
+    legally named ``<name>-s1`` must never be treated as one of our slices."""
+    name = name_of(notebook)
+    tpu = nbapi.tpu_slice_or_none(notebook)
+    n_slices = tpu.num_slices if tpu else 1
+    return {
+        NotebookReconciler.slice_sts_name(name, s) for s in range(n_slices)
+    }
+
+
+def _event_involves_notebook(ev: Resource, sts_names: set) -> bool:
     io = ev.get("involvedObject") or {}
     kind, obj_name = io.get("kind"), io.get("name", "")
     if kind == "StatefulSet":
-        return obj_name == name
+        return obj_name in sts_names
     if kind == "Pod":
         prefix, _, ordinal = obj_name.rpartition("-")
-        return prefix == name and ordinal.isdigit()
+        return prefix in sts_names and ordinal.isdigit()
     return False
 
 
@@ -635,11 +762,21 @@ def events_to_notebook_requests(obj: Resource) -> List[Request]:
     io = obj.get("involvedObject") or {}
     kind, obj_name = io.get("kind"), io.get("name", "")
     if kind == "StatefulSet":
-        return [Request(ns, obj_name)]
+        reqs = [Request(ns, obj_name)]
+        stripped = _strip_slice_suffix(obj_name)
+        if stripped != obj_name:
+            # Multislice STS <nb>-s<i>: also try the owning notebook; the
+            # wrong candidate resolves to NotFound in reconcile and drops.
+            reqs.append(Request(ns, stripped))
+        return reqs
     if kind == "Pod":
         prefix, _, ordinal = obj_name.rpartition("-")
         if prefix and ordinal.isdigit():
-            return [Request(ns, prefix)]
+            reqs = [Request(ns, prefix)]
+            stripped = _strip_slice_suffix(prefix)
+            if stripped != prefix:
+                reqs.append(Request(ns, stripped))
+            return reqs
     return []
 
 

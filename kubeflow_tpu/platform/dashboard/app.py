@@ -130,11 +130,11 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
                 continue
             total = 0
             for nb in notebooks:
-                from kubeflow_tpu.platform.apis.notebook import tpu_slice
+                from kubeflow_tpu.platform.apis.notebook import tpu_slice_or_none
 
-                s = tpu_slice(nb)
+                s = tpu_slice_or_none(nb)
                 if s:
-                    total += s.chips
+                    total += s.total_chips
             if total:
                 requested[ns_name] = total
         return success({

@@ -49,6 +49,11 @@ async function loadTpus() {
   for (const option of offeredTpus) {
     acc.append(el("option", { value: option.accelerator }, option.accelerator));
   }
+  // Multislice is an admin opt-in (tpus.maxSlices > 1 in the spawner config).
+  const maxSlices = (config && config.tpus && config.tpus.maxSlices) || 0;
+  const slicesLabel = document.getElementById("tpu-slices-label");
+  slicesLabel.hidden = maxSlices <= 1;
+  if (maxSlices > 1) document.getElementById("tpu-slices").max = maxSlices;
   syncTopologies();
 }
 
@@ -154,6 +159,8 @@ function spawnBody(form) {
   const accelerator = data.get("tpuAccelerator");
   if (accelerator) {
     body.tpus = { accelerator, topology: data.get("tpuTopology") || "" };
+    const slices = parseInt(data.get("tpuSlices"), 10);
+    if (slices > 1) body.tpus.slices = slices;
   }
   if (data.get("workspace") === "none") body.workspaceVolume = null;
   return body;

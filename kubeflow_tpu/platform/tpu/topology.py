@@ -58,17 +58,35 @@ def parse_topology(topology: str) -> Tuple[int, ...]:
 
 @dataclasses.dataclass(frozen=True)
 class SliceSpec:
-    """Everything the scheduler-facing side needs to place one slice."""
+    """Everything the scheduler-facing side needs to place one slice group.
+
+    ``num_slices > 1`` is multislice: N identical ICI slices of ``topology``
+    joined over DCN (the data-center network).  Chips/hosts fields are
+    per-slice; ``total_*`` aggregate across slices.
+    """
 
     accelerator: TpuAccelerator
     topology: str
     chips: int
     num_hosts: int
     chips_per_pod: int
+    num_slices: int = 1
 
     @property
     def multi_host(self) -> bool:
-        return self.num_hosts > 1
+        return self.total_hosts > 1
+
+    @property
+    def multi_slice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def total_hosts(self) -> int:
+        return self.num_hosts * self.num_slices
+
+    @property
+    def total_chips(self) -> int:
+        return self.chips * self.num_slices
 
     def node_selectors(self) -> Dict[str, str]:
         return {
@@ -80,8 +98,18 @@ class SliceSpec:
         return {RESOURCE_TPU: str(self.chips_per_pod)}
 
 
-def slice_spec(accelerator: str, topology: Optional[str] = None) -> SliceSpec:
-    """Resolve (accelerator, topology) → SliceSpec, validating the shape."""
+def slice_spec(
+    accelerator: str, topology: Optional[str] = None, slices: Optional[int] = None
+) -> SliceSpec:
+    """Resolve (accelerator, topology[, slices]) → SliceSpec, validating."""
+    if slices is None:
+        slices = 1
+    try:
+        slices = int(slices)
+    except (TypeError, ValueError):
+        raise ValueError(f"invalid TPU slice count {slices!r}") from None
+    if slices < 1:
+        raise ValueError(f"invalid TPU slice count {slices}")
     if accelerator not in ACCELERATORS:
         raise ValueError(
             f"unknown TPU accelerator {accelerator!r}; known: {sorted(ACCELERATORS)}"
@@ -109,6 +137,7 @@ def slice_spec(accelerator: str, topology: Optional[str] = None) -> SliceSpec:
         chips=chips,
         num_hosts=num_hosts,
         chips_per_pod=chips_per_pod,
+        num_slices=slices,
     )
 
 

@@ -360,6 +360,129 @@ def test_topology_only_conversion_roundtrip():
     assert back["spec"]["tpu"] == {"topology": "2x4"}
 
 
+def test_multislice_notebook(kube, reconciler):
+    """spec.tpu.slices > 1: one StatefulSet per slice (GKE multislice's
+    one-job-per-slice layout), per-slice libtpu env, MEGASCALE identity."""
+    kube.create(make_notebook(
+        tpu={"accelerator": "v5e", "topology": "4x4", "slices": 2}
+    ))
+    reconcile(reconciler)
+    for idx, sts_name in enumerate(["nb", "nb-s1"]):
+        sts = kube.get(STATEFULSET, sts_name, "user1")
+        # 2 hosts per 4x4 slice, ordinals restarting per slice.
+        assert deep_get(sts, "spec", "replicas") == 2
+        assert deep_get(sts, "spec", "serviceName") == "nb-workers"
+        assert deep_get(sts, "spec", "selector", "matchLabels") == {
+            "statefulset": sts_name
+        }
+        container = deep_get(sts, "spec", "template", "spec", "containers")[0]
+        # Chip limit stays per-host.
+        assert container["resources"]["limits"]["google.com/tpu"] == "8"
+        env = {e["name"]: e for e in container["env"]}
+        # libtpu's ICI bootstrap env is per-slice: only this slice's hosts.
+        hostnames = env["TPU_WORKER_HOSTNAMES"]["value"].split(",")
+        assert hostnames == [
+            f"{sts_name}-{i}.nb-workers.user1.svc.cluster.local"
+            for i in range(2)
+        ]
+        assert env["TPU_HOSTS_PER_SLICE"]["value"] == "2"
+        assert env["MEGASCALE_SLICE_ID"]["value"] == str(idx)
+        assert env["MEGASCALE_NUM_SLICES"]["value"] == "2"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"]["value"] == (
+            "nb-0.nb-workers.user1.svc.cluster.local"
+        )
+    # The PDB protects the whole multislice job across both STSes.
+    from kubeflow_tpu.platform.k8s.types import PODDISRUPTIONBUDGET
+
+    pdb = kube.get(PODDISRUPTIONBUDGET, "nb-slice", "user1")
+    assert pdb["spec"]["minAvailable"] == 4
+    assert pdb["spec"]["selector"]["matchLabels"] == {"notebook-name": "nb"}
+    # The headless service spans every slice's pods.
+    headless = kube.get(SERVICE, "nb-workers", "user1")
+    assert headless["spec"]["selector"] == {"notebook-name": "nb"}
+
+
+def test_multislice_name_conflict_parks_notebook(kube, reconciler):
+    """A sibling notebook legally named <name>-s1 owns that STS name: the
+    multislice notebook parks Degraded instead of fighting over it."""
+    kube.create(make_notebook("train-s1"))
+    reconcile(reconciler, "train-s1")
+    kube.create(make_notebook(
+        "train", tpu={"accelerator": "v5e", "topology": "4x4", "slices": 2}
+    ))
+    reconcile(reconciler, "train")
+    # The sibling's StatefulSet is untouched (its notebook-name label stands).
+    sts = kube.get(STATEFULSET, "train-s1", "user1")
+    assert deep_get(sts, "metadata", "labels", "notebook-name") == "train-s1"
+    env = deep_get(sts, "spec", "template", "spec", "containers")[0].get("env", [])
+    assert not any(e["name"].startswith("MEGASCALE") for e in env)
+    # The multislice notebook is parked with a clear condition...
+    nb = kube.get(NOTEBOOK, "train", "user1")
+    conds = deep_get(nb, "status", "conditions", default=[])
+    assert any(c.get("reason") == "SliceNameConflict" for c in conds)
+    # ...and NO partial deployment happened: slice 0's STS was never created
+    # (it would hold TPU hosts forever at the jax.distributed barrier).
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "train", "user1")
+
+
+def test_sibling_slice_named_notebook_events_not_cross_mirrored(kube, reconciler):
+    """Events for notebook 'nb-s1' must not be mirrored onto notebook 'nb'."""
+    kube.create(make_notebook("nb"))
+    kube.create(make_notebook("nb-s1"))
+    kube.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "sib-ev", "namespace": "user1"},
+        "involvedObject": {"kind": "Pod", "name": "nb-s1-0"},
+        "reason": "FailedScheduling", "message": "no TPU", "type": "Warning",
+        "lastTimestamp": "2099-01-01T00:00:00Z",
+    })
+    reconcile(reconciler, "nb")
+    from kubeflow_tpu.platform.k8s.types import EVENT
+
+    def mirrors_onto(name):
+        return [
+            e for e in kube.list(EVENT, "user1")
+            if (e.get("involvedObject") or {}).get("kind") == "Notebook"
+            and (e.get("involvedObject") or {}).get("name") == name
+            and NotebookReconciler.MIRROR_ANNOTATION
+            in (deep_get(e, "metadata", "annotations", default={}) or {})
+        ]
+
+    assert mirrors_onto("nb") == []
+    # But the owner does get it.
+    reconcile(reconciler, "nb-s1")
+    assert len(mirrors_onto("nb-s1")) == 1
+
+
+def test_multislice_scale_down_deletes_stale_sts(kube, reconciler):
+    kube.create(make_notebook(
+        tpu={"accelerator": "v5e", "topology": "4x4", "slices": 3}
+    ))
+    reconcile(reconciler)
+    assert kube.get(STATEFULSET, "nb-s2", "user1")
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    nb["spec"]["tpu"]["slices"] = 2
+    kube.update(nb)
+    reconcile(reconciler)
+    assert kube.get(STATEFULSET, "nb-s1", "user1")
+    import pytest as _pytest
+    from kubeflow_tpu.platform.k8s import errors as _errors
+
+    with _pytest.raises(_errors.NotFound):
+        kube.get(STATEFULSET, "nb-s2", "user1")
+
+
+def test_single_slice_has_no_megascale_env(kube, reconciler):
+    kube.create(make_notebook(tpu={"accelerator": "v5e", "topology": "4x4"}))
+    reconcile(reconciler)
+    sts = kube.get(STATEFULSET, "nb", "user1")
+    container = deep_get(sts, "spec", "template", "spec", "containers")[0]
+    names = {e["name"] for e in container["env"]}
+    assert "MEGASCALE_NUM_SLICES" not in names
+    assert "TPU_HOSTS_PER_SLICE" in names
+
+
 def test_multi_host_slice_gets_pdb(kube, reconciler):
     from kubeflow_tpu.platform.k8s.types import PODDISRUPTIONBUDGET
 
@@ -367,7 +490,7 @@ def test_multi_host_slice_gets_pdb(kube, reconciler):
     reconcile(reconciler)
     pdb = kube.get(PODDISRUPTIONBUDGET, "nb-slice", "user1")
     assert pdb["spec"]["minAvailable"] == 2  # v5e 4x4 = 2 hosts
-    assert pdb["spec"]["selector"]["matchLabels"] == {"statefulset": "nb"}
+    assert pdb["spec"]["selector"]["matchLabels"] == {"notebook-name": "nb"}
     # Stopping removes the PDB so drains aren't blocked by an idle slice.
     nb = kube.get(NOTEBOOK, "nb", "user1")
     nb["metadata"].setdefault("annotations", {})[nbapi.STOP_ANNOTATION] = "now"

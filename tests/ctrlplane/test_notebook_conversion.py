@@ -60,6 +60,15 @@ def test_v1alpha1_drops_container_state_but_keeps_tpu():
     assert back["spec"]["tpu"] == {"accelerator": "v5e", "topology": "2x4"}
 
 
+def test_multislice_roundtrip_is_lossless():
+    hub = hub_notebook()
+    hub["spec"]["tpu"]["slices"] = 2
+    v1 = nbapi.convert(hub, "v1")
+    assert v1["metadata"]["annotations"][nbapi.ANNOTATION_TPU_SLICES] == "2"
+    back = nbapi.convert(v1, "v1beta1")
+    assert back == hub
+
+
 def test_no_tpu_roundtrip():
     hub = hub_notebook(tpu=False)
     v1 = nbapi.convert(hub, "v1")

@@ -84,6 +84,30 @@ def test_jwa_spawn_flow(jwa, kube):
     assert rows[0]["status"]["phase"] in ("waiting", "running")
 
 
+def test_jwa_spawn_multislice(jwa, kube):
+    body = {
+        "name": "ms",
+        "tpus": {"accelerator": "v5e", "topology": "4x4", "slices": 2},
+    }
+    r = http.post(
+        f"{jwa}/api/namespaces/user1/notebooks", json=body, headers=USER_HEADER
+    )
+    assert r.status_code == 200, r.text
+    nb = kube.get(NOTEBOOK, "ms", "user1")
+    assert nb["spec"]["tpu"] == {
+        "accelerator": "v5e", "topology": "4x4", "slices": 2,
+    }
+    # The config ceiling (maxSlices: 4) rejects over-asking.
+    r = http.post(
+        f"{jwa}/api/namespaces/user1/notebooks",
+        json={"name": "big",
+              "tpus": {"accelerator": "v5e", "topology": "4x4", "slices": 9}},
+        headers=USER_HEADER,
+    )
+    assert r.status_code == 400
+    assert "exceeds" in r.json()["log"]
+
+
 def test_jwa_rejects_unoffered_topology(jwa):
     body = {"name": "bad", "tpus": {"accelerator": "v5e", "topology": "16x16"}}
     r = http.post(
@@ -155,6 +179,62 @@ def test_vwa_pvc_lifecycle(kube):
     assert http.delete(
         f"{base}/api/namespaces/user1/pvcs/data", headers=USER_HEADER
     ).status_code == 200
+
+
+def test_vwa_single_pvc_and_events(kube):
+    from kubeflow_tpu.platform.apps.volumes.app import create_app
+
+    base = serve(create_app(kube, auth=auth()))
+    http.post(
+        f"{base}/api/namespaces/user1/pvcs",
+        json={"name": "data", "size": "5Gi"}, headers=USER_HEADER,
+    )
+    r = http.get(f"{base}/api/namespaces/user1/pvcs/data", headers=USER_HEADER)
+    assert r.status_code == 200
+    assert deep_get(r.json()["pvc"], "spec", "resources", "requests", "storage") == "5Gi"
+    # Events route returns only events involving this claim.
+    kube.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev1", "namespace": "user1"},
+        "involvedObject": {"kind": "PersistentVolumeClaim", "name": "data"},
+        "reason": "ProvisioningSucceeded", "message": "ok",
+    })
+    kube.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "ev2", "namespace": "user1"},
+        "involvedObject": {"kind": "PersistentVolumeClaim", "name": "other"},
+        "reason": "x", "message": "not ours",
+    })
+    evs = http.get(
+        f"{base}/api/namespaces/user1/pvcs/data/events", headers=USER_HEADER
+    ).json()["events"]
+    assert [e["reason"] for e in evs] == ["ProvisioningSucceeded"]
+
+
+def test_twa_pvcs_and_poddefaults(kube):
+    from kubeflow_tpu.platform.apps.tensorboards.app import create_app
+
+    base = serve(create_app(kube, auth=auth()))
+    kube.create({
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": {"name": "logs", "namespace": "user1"},
+        "spec": {"resources": {"requests": {"storage": "1Gi"}}},
+    })
+    kube.create({
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+        "metadata": {"name": "tpu-v5e", "namespace": "user1"},
+        "spec": {"selector": {"matchLabels": {"tpu-v5e": "true"}},
+                 "desc": "Attach v5e TPU env"},
+    })
+    pvcs = http.get(
+        f"{base}/api/namespaces/user1/pvcs", headers=USER_HEADER
+    ).json()["pvcs"]
+    assert pvcs == ["logs"]
+    pds = http.get(
+        f"{base}/api/namespaces/user1/poddefaults", headers=USER_HEADER
+    ).json()["poddefaults"]
+    assert pds == [{"name": "tpu-v5e", "label": "tpu-v5e",
+                    "desc": "Attach v5e TPU env"}]
 
 
 def test_twa_tensorboard_lifecycle(kube):
@@ -238,10 +318,25 @@ def test_dashboard_tpu_overview(kube):
         "spec": {"template": {"spec": {"containers": [{"image": "x"}]}},
                  "tpu": {"accelerator": "v5e", "topology": "4x4"}},
     })
+    # A multislice notebook counts every slice's chips; an invalid stored
+    # spec must not 500 the endpoint.
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "ms", "namespace": "user1"},
+        "spec": {"template": {"spec": {"containers": [{"image": "x"}]}},
+                 "tpu": {"accelerator": "v5e", "topology": "2x4", "slices": 2}},
+    })
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "bad", "namespace": "user1"},
+        "spec": {"template": {"spec": {"containers": [{"image": "x"}]}},
+                 "tpu": {"accelerator": "v5e", "topology": "3x3"}},
+    })
     base = serve(create_app(kube, auth=auth()))
     overview = http.get(f"{base}/api/tpu-overview", headers=USER_HEADER).json()
     assert overview["clusterCapacityChips"] == 16  # two 8-chip fake nodes
-    assert overview["requestedChipsByNamespace"] == {"user1": 16}
+    # nb 4x4 = 16 + ms 2x4 x 2 slices = 16; 'bad' skipped.
+    assert overview["requestedChipsByNamespace"] == {"user1": 32}
 
 
 def test_csrf_double_submit(kube):

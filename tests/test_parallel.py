@@ -32,6 +32,91 @@ def test_make_mesh_rejects_bad_sizes(devices8):
         make_mesh(dp=3, devices=devices8)
 
 
+def test_hybrid_mesh_slice_major_layout(devices8):
+    from kubeflow_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(
+        ici=MeshConfig(fsdp=2, tp=2), dcn=MeshConfig(dp=2), devices=devices8
+    )
+    assert mesh.devices.shape == (1, 2, 2, 1, 2, 1)
+    # dp (the DCN axis) must split the devices into contiguous slice-major
+    # blocks: dp=0 gets devices 0-3, dp=1 gets 4-7 — so a dp all-reduce is
+    # the only traffic crossing the slice boundary.
+    dp0 = mesh.devices[0, 0].flatten()
+    dp1 = mesh.devices[0, 1].flatten()
+    assert {d.id for d in dp0} == {d.id for d in devices8[:4]}
+    assert {d.id for d in dp1} == {d.id for d in devices8[4:]}
+
+
+def test_hybrid_mesh_executes_collectives(devices8):
+    from jax.sharding import NamedSharding
+    from kubeflow_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(
+        ici=MeshConfig(fsdp=4), dcn=MeshConfig(dp=2), devices=devices8
+    )
+    x = jnp.arange(16.0).reshape(8, 2)
+    sharding = NamedSharding(mesh, P(("dp", "fsdp")))
+
+    @jax.jit
+    def total(x):
+        return jnp.sum(x)
+
+    out = total(jax.device_put(x, sharding))
+    assert float(out) == float(jnp.sum(x))
+
+
+def test_hybrid_mesh_rejects_wrong_count(devices8):
+    from kubeflow_tpu.parallel.mesh import make_hybrid_mesh
+
+    with pytest.raises(ValueError):
+        make_hybrid_mesh(
+            ici=MeshConfig(fsdp=3), dcn=MeshConfig(dp=2), devices=devices8
+        )
+
+
+def test_dist_slice_identity(monkeypatch):
+    from kubeflow_tpu.parallel import dist
+
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_HOSTS_PER_SLICE", "2")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "4")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "2")
+    assert dist.num_slices() == 4
+    assert dist.slice_id() == 2
+    monkeypatch.delenv("MEGASCALE_SLICE_ID")
+    assert dist.slice_id() == 0
+
+
+def test_dist_initialize_multislice_process_grid(monkeypatch):
+    """initialize_from_env folds slice id into the global process id."""
+    from kubeflow_tpu.parallel import dist
+
+    calls = {}
+
+    def fake_init(**kw):
+        calls.update(kw)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv(
+        "TPU_WORKER_HOSTNAMES", "nb-s1-0.nb-workers,nb-s1-1.nb-workers"
+    )
+    monkeypatch.setenv("TPU_HOSTS_PER_SLICE", "2")
+    monkeypatch.setenv("MEGASCALE_NUM_SLICES", "2")
+    monkeypatch.setenv("MEGASCALE_SLICE_ID", "1")
+    monkeypatch.setenv("MEGASCALE_COORDINATOR_ADDRESS", "nb-0.nb-workers")
+    assert dist.initialize_from_env() is True
+    assert calls["num_processes"] == 4
+    assert calls["process_id"] == 3  # slice 1, worker 1
+    assert calls["coordinator_address"].startswith("nb-0.nb-workers:")
+    # Multislice without the global coordinator address must fail fast, not
+    # hang every slice at its own local barrier.
+    monkeypatch.delenv("MEGASCALE_COORDINATOR_ADDRESS")
+    with pytest.raises(RuntimeError, match="MEGASCALE_COORDINATOR_ADDRESS"):
+        dist.initialize_from_env()
+
+
 def test_llama_param_specs():
     model = create_model("llama_debug")
     tokens = jnp.ones((2, 16), jnp.int32)
