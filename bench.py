@@ -22,12 +22,17 @@ import jax.numpy as jnp
 
 # Established on TPU v5e (single chip, bf16, batch 256, synthetic ImageNet
 # shapes) at round 1.  Update only with justification in BASELINE.md.
+# Methodology note: 2538.49 was a single-window measurement; the bench now
+# reports best-of-WINDOWS (see below), whose max-statistic sits at the top
+# of the single-window distribution — so vs_baseline ~1.0 under the new
+# protocol means parity with the best single-window session, not a gain.
 BASELINE_IMAGES_PER_SEC = 2538.49  # first hardware measurement, 2026-07-29
 
 BATCH = 256
 IMAGE = 224
 WARMUP = 5
 STEPS = 20
+WINDOWS = 3
 
 
 def main() -> int:
@@ -58,13 +63,19 @@ def main() -> int:
     # inflates throughput ~60x (BASELINE.md).  float() forces the whole chain.
     float(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
-    dt = time.perf_counter() - t0
+    # Several measurement windows, best one reported: the tunneled backend
+    # shows ~15% run-to-run interference (2157-2538 img/s across sessions
+    # for identical code), and the best window is the stable estimator of
+    # what the chip itself does.
+    best_dt = float("inf")
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        best_dt = min(best_dt, time.perf_counter() - t0)
 
-    ips = BATCH * STEPS / dt
+    ips = BATCH * STEPS / best_dt
     vs = 1.0 if BASELINE_IMAGES_PER_SEC is None else ips / BASELINE_IMAGES_PER_SEC
     print(
         json.dumps(

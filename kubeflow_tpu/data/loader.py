@@ -130,12 +130,22 @@ def synthetic_lm_batches(
     vocab_size: int,
     seed: int = 0,
     steps: Optional[int] = None,
+    start: int = 0,
 ) -> Iterator[np.ndarray]:
-    """Host-local random token batches [local_batch, seq_len] (int32)."""
+    """Host-local random token batches [local_batch, seq_len] (int32).
+
+    Step-indexed: batch ``i`` depends only on ``(seed, host, i)``, so a
+    resumed run (``start = restored step``) replays the exact stream a
+    non-interrupted run would have seen — checkpoint/resume is bit-exact
+    including the data order.  Both ``start`` and ``steps`` are absolute
+    step indices (the stream yields batches ``start .. steps-1``, matching
+    the train loop's optimizer step numbering), NOT a count from ``start``.
+    """
     local = _host_batch_size(global_batch)
-    rng = np.random.default_rng(seed + jax.process_index())
-    i = 0
+    host = jax.process_index()
+    i = start
     while steps is None or i < steps:
+        rng = np.random.default_rng((seed, host, i))
         yield rng.integers(0, vocab_size, (local, seq_len), dtype=np.int32)
         i += 1
 
@@ -148,12 +158,16 @@ def synthetic_image_batches(
     channels: int = 3,
     seed: int = 0,
     steps: Optional[int] = None,
+    start: int = 0,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-    """Host-local (images [l,H,W,C] f32, labels [l] int32) batches."""
+    """Host-local (images [l,H,W,C] f32, labels [l] int32) batches.
+    Step-indexed like synthetic_lm_batches (``start``/``steps`` are absolute
+    step indices) — exact stream under resume."""
     local = _host_batch_size(global_batch)
-    rng = np.random.default_rng(seed + jax.process_index())
-    i = 0
+    host = jax.process_index()
+    i = start
     while steps is None or i < steps:
+        rng = np.random.default_rng((seed, host, i))
         images = rng.standard_normal((local, image_size, image_size, channels)).astype(
             np.float32
         )

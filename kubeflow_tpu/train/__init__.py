@@ -3,14 +3,20 @@
 from kubeflow_tpu.train.steps import (
     TrainState,
     create_train_state,
+    make_classification_grad_fn,
     make_classification_train_step,
+    make_grad_accum_step,
+    make_lm_grad_fn,
     make_lm_train_step,
 )
 
 __all__ = [
     "TrainState",
     "create_train_state",
+    "make_classification_grad_fn",
     "make_classification_train_step",
+    "make_grad_accum_step",
+    "make_lm_grad_fn",
     "make_lm_train_step",
     "CheckpointManager",
 ]

@@ -40,7 +40,7 @@ class LoopConfig:
 def train_loop(
     state,
     step_fn: Callable,
-    batches: Iterable,
+    batches,  # Iterable, or Callable[[start_step], Iterable] for exact resume
     cfg: LoopConfig,
     *,
     eval_fn: Optional[Callable] = None,
@@ -69,7 +69,10 @@ def train_loop(
             log.info("resumed from checkpoint at step %d", start_step)
 
     history: List[Dict[str, Any]] = []
-    it = iter(batches)
+    # A callable gets the resume point: pair it with step-indexed generators
+    # (data/loader.py `start=`) and the resumed run replays the exact stream
+    # an uninterrupted run would have consumed.
+    it = iter(batches(start_step) if callable(batches) else batches)
     last_metrics = None
     t0 = time.perf_counter()
     window_started_at = start_step

@@ -57,16 +57,26 @@ def build_lm(args, mesh):
         jax.random.key(args.seed), model, tokens, optax.adamw(args.lr)
     )
     state = shard_train_state(state, mesh, llama_rules())
+    if args.grad_accum > 1:
+        from kubeflow_tpu.train import make_grad_accum_step, make_lm_grad_fn
+
+        pure_step = make_grad_accum_step(make_lm_grad_fn(), args.grad_accum)
+    else:
+        pure_step = make_lm_train_step()
     step, data_sharding = make_sharded_train_step(
-        make_lm_train_step(), state, mesh, llama_rules()
+        pure_step, state, mesh, llama_rules()
     )
-    batches = ShardedLoader(
-        synthetic_lm_batches(
-            global_batch=args.batch, seq_len=args.seq, vocab_size=vocab,
-            seed=args.seed,
-        ),
-        data_sharding,
-    )
+    def batches(start_step=0):
+        # Step-indexed stream: resume replays exactly what an uninterrupted
+        # run would have consumed from `start_step` on.
+        return ShardedLoader(
+            synthetic_lm_batches(
+                global_batch=args.batch, seq_len=args.seq, vocab_size=vocab,
+                seed=args.seed, start=start_step,
+            ),
+            data_sharding,
+        )
+
     return state, step, batches
 
 
@@ -94,17 +104,30 @@ def build_image(args, mesh):
         optax.sgd(args.lr, momentum=0.9), init_kwargs={"train": False},
     )
     state = shard_train_state(state, mesh, resnet_rules())
+    if args.grad_accum > 1:
+        from kubeflow_tpu.train import (
+            make_classification_grad_fn,
+            make_grad_accum_step,
+        )
+
+        pure_step = make_grad_accum_step(
+            make_classification_grad_fn(has_batch_stats=True),
+            args.grad_accum, has_batch_stats=True,
+        )
+    else:
+        pure_step = make_classification_train_step(has_batch_stats=True)
     step, data_sharding = make_sharded_train_step(
-        make_classification_train_step(has_batch_stats=True),
-        state, mesh, resnet_rules(),
+        pure_step, state, mesh, resnet_rules(),
     )
-    batches = ShardedLoader(
-        synthetic_image_batches(
-            global_batch=args.batch, image_size=args.image_size,
-            num_classes=args.num_classes, seed=args.seed,
-        ),
-        data_sharding,
-    )
+    def batches(start_step=0):
+        return ShardedLoader(
+            synthetic_image_batches(
+                global_batch=args.batch, image_size=args.image_size,
+                num_classes=args.num_classes, seed=args.seed, start=start_step,
+            ),
+            data_sharding,
+        )
+
     return state, step, batches
 
 
@@ -118,6 +141,9 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-classes", type=int, default=1000)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatches accumulated per optimizer step "
+                         "(scanned inside one jit; batch must divide evenly)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mesh", default="auto")
     ap.add_argument("--checkpoint-dir", default=None)

@@ -61,10 +61,11 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return -jnp.mean(ll)
 
 
-def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool = False):
-    """Step for image/sequence classifiers: batch = (inputs, int labels)."""
+def make_classification_grad_fn(*, has_batch_stats: bool, has_dropout: bool = False):
+    """(state, batch, rng) → (grads, new_model_state, metrics) for image/
+    sequence classifiers: batch = (inputs, int labels)."""
 
-    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+    def grad_fn(state: TrainState, batch, rng: Optional[jax.Array] = None):
         inputs, labels = batch
 
         def loss_fn(params):
@@ -86,23 +87,32 @@ def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool =
         (loss, (new_model_state, acc)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
+        return grads, new_model_state, {"loss": loss, "accuracy": acc}
+
+    return grad_fn
+
+
+def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool = False):
+    """Step for image/sequence classifiers: batch = (inputs, int labels)."""
+    grad_fn = make_classification_grad_fn(
+        has_batch_stats=has_batch_stats, has_dropout=has_dropout
+    )
+
+    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+        grads, new_model_state, metrics = grad_fn(state, batch, rng)
         state = state.apply_gradients(grads)
         if has_batch_stats:
             state = state.replace(batch_stats=new_model_state["batch_stats"])
-        return state, {"loss": loss, "accuracy": acc}
+        return state, metrics
 
     return step
 
 
-def make_lm_train_step(*, aux_loss_weight: float = 0.0):
-    """Next-token-prediction step: batch = tokens[b,s] or (tokens, segment_ids)
-    for packed sequences (segment_ids are threaded into attention masking).
+def make_lm_grad_fn(*, aux_loss_weight: float = 0.0):
+    """(state, batch, rng) → (grads, new_model_state, metrics) for
+    next-token prediction; see make_lm_train_step for batch forms."""
 
-    ``aux_loss_weight`` > 0 collects the ``"losses"`` collection sowed by MoE
-    layers (``moe_aux_loss``) and adds the weighted sum to the objective.
-    """
-
-    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+    def grad_fn(state: TrainState, batch, rng: Optional[jax.Array] = None):
         if isinstance(batch, (tuple, list)):
             tokens = batch[0]
             segment_ids = batch[1] if len(batch) > 1 else None
@@ -136,10 +146,82 @@ def make_lm_train_step(*, aux_loss_weight: float = 0.0):
         (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params
         )
-        state = state.apply_gradients(grads)
         metrics = {"loss": loss}
         if aux_loss_weight:
             metrics["moe_aux_loss"] = aux
+        return grads, {}, metrics
+
+    return grad_fn
+
+
+def make_lm_train_step(*, aux_loss_weight: float = 0.0):
+    """Next-token-prediction step: batch = tokens[b,s] or (tokens, segment_ids)
+    for packed sequences (segment_ids are threaded into attention masking).
+
+    ``aux_loss_weight`` > 0 collects the ``"losses"`` collection sowed by MoE
+    layers (``moe_aux_loss``) and adds the weighted sum to the objective.
+    """
+    grad_fn = make_lm_grad_fn(aux_loss_weight=aux_loss_weight)
+
+    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+        grads, _, metrics = grad_fn(state, batch, rng)
+        state = state.apply_gradients(grads)
+        return state, metrics
+
+    return step
+
+
+def make_grad_accum_step(
+    grad_fn: Callable,
+    n_accum: int,
+    *,
+    has_batch_stats: bool = False,
+):
+    """Accumulate gradients over ``n_accum`` microbatches inside ONE jitted
+    step (``lax.scan``), then apply a single optimizer update.
+
+    The batch's leading axis is split into ``n_accum`` equal microbatches,
+    so the effective batch is the full input while peak activation memory is
+    that of one microbatch — the standard trade when a model's optimal batch
+    does not fit HBM.  Metrics are averaged over microbatches; with
+    batch_stats the last microbatch's stats win (the usual convention — EMA
+    stats converge regardless of which microbatch closes the step).
+    """
+    if n_accum < 1:
+        raise ValueError(f"n_accum must be >= 1, got {n_accum}")
+
+    def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
+        def split(x):
+            if x.shape[0] % n_accum:
+                raise ValueError(
+                    f"batch axis {x.shape[0]} not divisible by n_accum {n_accum}"
+                )
+            return x.reshape((n_accum, x.shape[0] // n_accum) + x.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+
+        def body(carry, mb_and_i):
+            grads_acc, stats = carry
+            mb, i = mb_and_i
+            mb_rng = None if rng is None else jax.random.fold_in(rng, i)
+            st = state if stats is None else state.replace(batch_stats=stats)
+            grads, new_model_state, metrics = grad_fn(st, mb, mb_rng)
+            grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+            new_stats = (
+                new_model_state.get("batch_stats") if has_batch_stats else None
+            )
+            return (grads_acc, new_stats), metrics
+
+        zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+        init = (zero_grads, state.batch_stats if has_batch_stats else None)
+        (grads_sum, stats), metrics_seq = jax.lax.scan(
+            body, init, (micro, jnp.arange(n_accum))
+        )
+        grads = jax.tree.map(lambda g: g / n_accum, grads_sum)
+        state = state.apply_gradients(grads)
+        if has_batch_stats:
+            state = state.replace(batch_stats=stats)
+        metrics = jax.tree.map(jnp.mean, metrics_seq)
         return state, metrics
 
     return step

@@ -66,6 +66,91 @@ def test_loop_checkpoint_resume(tmp_path):
     assert not jnp.allclose(restored_leaf, fresh_leaf, atol=1e-6)
 
 
+def test_grad_accum_matches_full_batch_step():
+    """Mean-of-microbatch-grads == full-batch grad (equal microbatches), so
+    the accumulated step must match the plain step bit-for-bit-ish."""
+    from kubeflow_tpu.train import make_grad_accum_step, make_lm_grad_fn
+
+    state_a, _ = tiny_state()
+    state_b = jax.tree.map(lambda x: x, state_a)
+    batch = jax.random.randint(jax.random.key(7), (4, 32), 0, 256)
+    plain = jax.jit(make_lm_train_step())
+    accum = jax.jit(make_grad_accum_step(make_lm_grad_fn(), n_accum=4))
+    state_a, m_a = plain(state_a, batch)
+    state_b, m_b = accum(state_b, batch)
+    for pa, pb in zip(jax.tree.leaves(state_a.params),
+                      jax.tree.leaves(state_b.params)):
+        assert jnp.allclose(pa, pb, atol=2e-5), float(jnp.abs(pa - pb).max())
+    # Metrics are averaged over microbatches; the mean of per-microbatch
+    # losses equals the full-batch loss for equal-size microbatches.
+    assert abs(float(m_a["loss"]) - float(m_b["loss"])) < 2e-4
+
+
+def test_grad_accum_batch_stats_path():
+    from kubeflow_tpu.models import create_model
+    from kubeflow_tpu.train import (
+        make_classification_grad_fn,
+        make_grad_accum_step,
+    )
+
+    model = create_model("resnet_tiny", num_classes=10)
+    images = jnp.ones((8, 32, 32, 3), jnp.float32)
+    state = create_train_state(
+        jax.random.key(0), model, images, optax.sgd(0.1),
+        init_kwargs={"train": False},
+    )
+    step = jax.jit(make_grad_accum_step(
+        make_classification_grad_fn(has_batch_stats=True),
+        n_accum=2, has_batch_stats=True,
+    ))
+    labels = jnp.zeros((8,), jnp.int32)
+    before = jax.tree.leaves(state.batch_stats)[0].copy()
+    state, metrics = step(state, (images, labels))
+    assert jnp.isfinite(metrics["loss"])
+    # batch_stats actually advanced through the scan.
+    after = jax.tree.leaves(state.batch_stats)[0]
+    assert not jnp.allclose(before, after)
+
+
+def test_grad_accum_rejects_indivisible_batch():
+    from kubeflow_tpu.train import make_grad_accum_step, make_lm_grad_fn
+
+    step = make_grad_accum_step(make_lm_grad_fn(), n_accum=3)
+    state, _ = tiny_state()
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, jnp.ones((4, 32), jnp.int32))
+
+
+def test_step_indexed_batches_resume_exactly():
+    from kubeflow_tpu.data.loader import synthetic_lm_batches
+
+    full = list(synthetic_lm_batches(
+        global_batch=4, seq_len=8, vocab_size=50, seed=3, steps=6))
+    resumed = list(synthetic_lm_batches(
+        global_batch=4, seq_len=8, vocab_size=50, seed=3, steps=6, start=4))
+    assert len(resumed) == 2
+    assert (resumed[0] == full[4]).all() and (resumed[1] == full[5]).all()
+
+
+def test_loop_callable_batches_gets_resume_point(tmp_path):
+    state, _ = tiny_state()
+    step = jax.jit(make_lm_train_step())
+    cfg = LoopConfig(total_steps=4, log_every=0,
+                     checkpoint_dir=str(tmp_path), checkpoint_every=2)
+    train_loop(state, step, lambda start: batches(seed=start), cfg)
+    seen = []
+
+    def make_batches(start):
+        seen.append(start)
+        return batches(seed=start)
+
+    fresh, _ = tiny_state()
+    cfg2 = dataclasses.replace(cfg, total_steps=6)
+    state2, _ = train_loop(fresh, step, make_batches, cfg2)
+    assert seen == [4]  # resumed at the checkpointed step
+    assert int(state2.step) == 6
+
+
 def test_loop_eval_hook():
     state, _ = tiny_state()
     step = jax.jit(make_lm_train_step())
