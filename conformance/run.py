@@ -92,6 +92,49 @@ def multi_host_slice():
         e2e.close()
 
 
+@check("multislice-dcn")
+def multislice_dcn():
+    """spec.tpu.slices spawns one StatefulSet per ICI slice with per-slice
+    libtpu bootstrap env and MEGASCALE cross-slice identity, all behind one
+    headless service — the GKE-multislice contract."""
+    from kubeflow_tpu.platform.k8s.types import (
+        PODDISRUPTIONBUDGET, STATEFULSET, deep_get,
+    )
+
+    e2e = _e2e()
+    try:
+        e2e.kube.add_tpu_node("tpu-ms-1", topology="4x4")
+        ns = e2e.register()
+        resp = e2e.jupyter.post(
+            f"/api/namespaces/{ns}/notebooks",
+            json={"name": "ms-nb",
+                  "tpus": {"accelerator": "v5e", "topology": "4x4",
+                           "slices": 2}},
+            headers=e2e.user,
+        )
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        for idx, sts_name in enumerate(["ms-nb", "ms-nb-s1"]):
+            sts = e2e._wait(
+                lambda n=sts_name: e2e._get(STATEFULSET, n, ns), sts_name
+            )
+            assert deep_get(sts, "spec", "replicas") == 2, sts_name
+            env = {e.get("name"): e.get("value") for e in deep_get(
+                sts, "spec", "template", "spec", "containers",
+                default=[{}])[0].get("env", [])}
+            assert env.get("MEGASCALE_SLICE_ID") == str(idx)
+            assert env.get("MEGASCALE_NUM_SLICES") == "2"
+            hosts = (env.get("TPU_WORKER_HOSTNAMES") or "").split(",")
+            assert len(hosts) == 2 and all(
+                h.startswith(f"{sts_name}-") for h in hosts
+            ), hosts
+        pdb = e2e._wait(
+            lambda: e2e._get(PODDISRUPTIONBUDGET, "ms-nb-slice", ns), "pdb"
+        )
+        assert deep_get(pdb, "spec", "minAvailable") == 4
+    finally:
+        e2e.close()
+
+
 @check("webhook-merge-semantics")
 def webhook_merge():
     """PodDefault merge: identical-or-error on name collisions, conflict

@@ -84,6 +84,68 @@ def _pipeline_local(params, x, *, fn, axis_name, n_micro):
     return outputs.reshape((batch,) + outputs.shape[2:])
 
 
+def _pipeline_local_interleaved(
+    chunks, x, *, fn, axis_name, n_micro, n_rounds
+):
+    """Per-device body for the circular (interleaved) schedule.
+
+    chunks: this device's ``n_rounds`` stage chunks, leaves [v, ...] —
+    local row r is GLOBAL stage ``r * P + d`` (round-robin placement), so
+    an activation travels d=0..P-1 with r=0, wraps to d=0, travels again
+    with r=1, and so on: v laps of the ring apply all v*P stages in order.
+
+    Microbatch m enters device 0 at tick m; device d applies round r to it
+    at tick ``m + d + r*P``.  With n_micro <= P no two activations ever
+    collide at a device, so the schedule is closed-form and branch-free.
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    batch = x.shape[0]
+    if batch % n_micro or batch < n_micro:
+        raise ValueError(
+            f"per-device batch {batch} not divisible into {n_micro} microbatches"
+        )
+    micro = batch // n_micro
+    xs = x.reshape((n_micro, micro) + x.shape[1:])
+
+    state = jnp.zeros_like(xs[0])
+    outputs = jnp.zeros_like(xs)
+    # Full ring: the wrap edge (P-1 → 0) carries activations into their
+    # next round.
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    total_ticks = n_rounds * n_stages + n_stages - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        rel = t - stage
+        r = jnp.clip(rel // n_stages, 0, n_rounds - 1)
+        params_r = jax.tree.map(
+            lambda c: jax.lax.dynamic_index_in_dim(c, r, 0, keepdims=False),
+            chunks,
+        )
+        inject = jax.lax.dynamic_index_in_dim(
+            xs, jnp.clip(t, 0, n_micro - 1), axis=0, keepdims=False
+        )
+        x_in = jnp.where((stage == 0) & (t < n_micro), inject, state)
+        y = fn(params_r, x_in)
+        # Last device on its last round emits microbatch t - (v*P - 1).
+        out_idx = t - (n_rounds * n_stages - 1)
+        idx = jnp.clip(out_idx, 0, n_micro - 1)
+        prev = jax.lax.dynamic_index_in_dim(outputs, idx, axis=0, keepdims=False)
+        done = (stage == n_stages - 1) & (out_idx >= 0) & (out_idx < n_micro)
+        val = jnp.where(done, y, prev)
+        outputs = jax.lax.dynamic_update_index_in_dim(outputs, val, idx, 0)
+        state = jax.lax.ppermute(y, axis_name, ring)
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(
+        tick, (state, outputs), jnp.arange(total_ticks)
+    )
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    outputs = jax.lax.psum(outputs, axis_name)
+    return outputs.reshape((batch,) + outputs.shape[2:])
+
+
 def pipeline_apply(
     fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Any,
@@ -91,34 +153,72 @@ def pipeline_apply(
     *,
     mesh: Mesh,
     n_micro: int,
+    interleave: int = 1,
     axis_name: str = "pp",
     param_specs: Any = None,
     x_spec: P = None,
 ):
-    """Run ``x`` through P pipeline stages of ``fn`` (one per ``pp`` device).
+    """Run ``x`` through the pipeline stages of ``fn`` over the ``pp`` axis.
 
     ``stage_params``: pytree whose leaves have a leading stage axis of size
-    P — leaf shape [P, ...]; each device receives its own [...] slice.
+    ``P * interleave`` — stage order is application order (stage 0 first).
     ``n_micro`` divides the *per-device* batch (the global batch divided by
     the data-axis extent), since microbatching happens after the data split.
+
+    ``interleave=1`` is GPipe: device d holds stage d, bubble (P-1) thick
+    ticks out of M + P - 1 — choose n_micro >> P.  ``interleave=v > 1`` is
+    the circular schedule: device d holds the v stages {d, P+d, ..} and
+    activations lap the ring v times, so the bubble is (P-1) ticks of a
+    v×-smaller stage — the standard bubble reduction when microbatches are
+    scarce (requires n_micro <= P; accumulate gradients across calls for
+    bigger effective batches, train.steps.make_grad_accum_step).
+
     ``param_specs``: optional PartitionSpec pytree for the *per-stage* param
     leaves (the ``pp`` leading axis is prepended here); defaults to stage
     sharding only.  ``x_spec``: spec for inputs/outputs (no ``pp`` entry —
     they are replicated over pp); defaults to batch over (dp, fsdp).
     """
     n_stages = mesh.shape[axis_name]
+    total_stages = n_stages * interleave
+    if interleave < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    if interleave > 1 and n_micro > n_stages:
+        raise ValueError(
+            f"interleaved schedule needs n_micro <= pp ({n_stages}), got "
+            f"{n_micro}; accumulate gradients across calls instead"
+        )
     leaves = jax.tree.leaves(stage_params)
     for leaf in leaves:
-        if leaf.shape[0] != n_stages:
+        if leaf.shape[0] != total_stages:
             raise ValueError(
-                f"stage_params leaves need leading axis {n_stages}, got {leaf.shape}"
+                f"stage_params leaves need leading axis {total_stages}, "
+                f"got {leaf.shape}"
             )
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
     if x_spec is None:
         from kubeflow_tpu.parallel.sharding import data_axes
 
         x_spec = P(data_axes(mesh))
+    if interleave > 1:
+        # Round-robin placement: global stage r*P + d → device d, local row
+        # r.  [v*P, ...] → [v, P, ...] → [P, v, ...].
+        stage_params = jax.tree.map(
+            lambda p: jnp.moveaxis(
+                p.reshape((interleave, n_stages) + p.shape[1:]), 0, 1
+            ),
+            stage_params,
+        )
     if param_specs is None:
         in_param_specs = jax.tree.map(lambda _: P(axis_name), stage_params)
+    elif interleave > 1:
+        # The reshape above inserted a local rounds axis after pp, so
+        # per-stage spec entries shift by one: (pp, None[v], *spec).
+        in_param_specs = jax.tree.map(
+            lambda s: P(axis_name, None, *s), param_specs,
+            is_leaf=lambda s: isinstance(s, P),
+        )
     else:
         in_param_specs = jax.tree.map(
             lambda s: P(axis_name, *s), param_specs, is_leaf=lambda s: isinstance(s, P)
@@ -126,10 +226,15 @@ def pipeline_apply(
 
     def body(params, x):
         # shard_map leaves the leading pp axis of size 1 on each device's
-        # param block; strip it so fn sees one stage's params.
+        # param block; strip it so fn sees this device's params.
         params = jax.tree.map(lambda p: p[0], params)
-        return _pipeline_local(
-            params, x, fn=fn, axis_name=axis_name, n_micro=n_micro
+        if interleave == 1:
+            return _pipeline_local(
+                params, x, fn=fn, axis_name=axis_name, n_micro=n_micro
+            )
+        return _pipeline_local_interleaved(
+            params, x, fn=fn, axis_name=axis_name, n_micro=n_micro,
+            n_rounds=interleave,
         )
 
     return shard_map(

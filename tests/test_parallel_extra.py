@@ -133,6 +133,93 @@ def test_pipeline_differentiable(devices8):
         assert jnp.max(jnp.abs(a - b)) < 1e-4
 
 
+@pytest.mark.parametrize("interleave", [2, 4])
+def test_pipeline_interleaved_matches_sequential(devices8, interleave):
+    pp, n_micro = 2, 2
+    mesh = make_mesh(pp=pp, dp=8 // pp, devices=devices8)
+    d, hidden, batch = 8, 16, 16
+    total = pp * interleave
+    per_stage = _stage_params(jax.random.key(0), total, d, hidden)
+    stacked = stack_stage_params(per_stage)
+    x = jax.random.normal(jax.random.key(1), (batch, d))
+
+    out = jax.jit(
+        lambda p, x: pipeline_apply(
+            _mlp_stage, p, x, mesh=mesh, n_micro=n_micro, interleave=interleave
+        )
+    )(stacked, x)
+
+    ref = x
+    for p in per_stage:
+        ref = _mlp_stage(p, ref)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+def test_pipeline_interleaved_differentiable(devices8):
+    pp, v, d, hidden, batch = 2, 2, 4, 8, 8
+    mesh = make_mesh(pp=pp, dp=4, devices=devices8)
+    total = pp * v
+    stacked = stack_stage_params(_stage_params(jax.random.key(0), total, d, hidden))
+    x = jax.random.normal(jax.random.key(1), (batch, d))
+
+    def loss(p, x):
+        y = pipeline_apply(
+            _mlp_stage, p, x, mesh=mesh, n_micro=2, interleave=v
+        )
+        return jnp.mean(y**2)
+
+    g = jax.jit(jax.grad(loss))(stacked, x)
+
+    def loss_ref(p_list, x):
+        for p in p_list:
+            x = _mlp_stage(p, x)
+        return jnp.mean(x**2)
+
+    per_stage = [jax.tree.map(lambda l: l[i], stacked) for i in range(total)]
+    g_ref_list = jax.grad(loss_ref)(per_stage, x)
+    g_ref = jax.tree.map(lambda *xs: jnp.stack(xs), *g_ref_list)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_ref)):
+        assert jnp.max(jnp.abs(a - b)) < 1e-4
+
+
+def test_pipeline_interleaved_with_param_specs(devices8):
+    """User param_specs must shift past the inserted local rounds axis."""
+    from jax.sharding import PartitionSpec as PS
+
+    pp, v, d, hidden = 2, 2, 8, 16
+    mesh = make_mesh(pp=pp, tp=2, dp=2, devices=devices8)
+    per = _stage_params(jax.random.key(0), pp * v, d, hidden)
+    stacked = stack_stage_params(per)
+    x = jax.random.normal(jax.random.key(1), (8, d))
+    # Megatron layout: w1 column-split, w2 row-split; the stage fn running
+    # inside shard_map must psum the partial second matmul over tp itself.
+    specs = {"w1": PS(None, "tp"), "b1": PS("tp"),
+             "w2": PS("tp", None), "b2": PS(None)}
+
+    def tp_stage(params, x):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return jax.lax.psum(h @ params["w2"], "tp") + params["b2"]
+
+    out = jax.jit(lambda p, x: pipeline_apply(
+        tp_stage, p, x, mesh=mesh, n_micro=2, interleave=v,
+        param_specs=specs,
+    ))(stacked, x)
+    ref = x
+    for p in per:
+        ref = _mlp_stage(p, ref)
+    assert jnp.max(jnp.abs(out - ref)) < 1e-4
+
+
+def test_pipeline_interleaved_rejects_excess_microbatches(devices8):
+    mesh = make_mesh(pp=2, dp=4, devices=devices8)
+    stacked = stack_stage_params(_stage_params(jax.random.key(0), 4, 4, 8))
+    with pytest.raises(ValueError, match="n_micro <= pp"):
+        pipeline_apply(
+            _mlp_stage, stacked, jnp.zeros((8, 4)), mesh=mesh,
+            n_micro=4, interleave=2,
+        )
+
+
 def test_pipeline_rejects_bad_stage_axis(devices8):
     mesh = make_mesh(pp=2, dp=4, devices=devices8)
     bad = {"w": jnp.zeros((3, 4, 4))}  # leading axis 3 != pp 2
