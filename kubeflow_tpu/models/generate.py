@@ -66,6 +66,12 @@ def generate(model, params, prompt: jax.Array, *,
     cached decode equals the re-forward oracle only while no token is
     dropped — the standard Switch/GShard decode behavior.
     """
+    # int8-served params widen here, INSIDE the jit, so XLA fuses the
+    # dequant into each consuming matmul and HBM keeps the int8 copy
+    # (models/quantize.py); plain params pass through untouched.
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
     b, prompt_len = prompt.shape
     # The cache is bucketed to exactly the tokens this call can produce —
     # decode attends over cache_len keys, not the model's full max_seq_len

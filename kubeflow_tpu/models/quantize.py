@@ -1,0 +1,138 @@
+"""Weight-only int8 quantization for serving.
+
+The reference platform ships no inference stack at all (SURVEY.md §2.13);
+this is part of the TPU rebuild's model zoo.  Rationale, TPU-first: decode
+is HBM-bandwidth-bound — every generated token streams the full weight set
+from HBM — so storing matmul weights as int8 (+ one scale per output
+channel) halves the bytes per token versus bf16.  Dequantization happens
+inside the jitted forward (``scale * int8``), which XLA fuses into the
+consuming matmul: weights stay int8 in HBM and widen on the fly in
+VMEM/registers, so the bandwidth saving is real, not cosmetic.
+
+Scheme: symmetric per-channel (absmax / 127) on the LAST axis of every
+``kernel``/``embedding`` leaf with rank >= 2; biases, norm scales, and
+other small leaves stay in their original dtype (they are bandwidth-
+irrelevant and precision-critical).
+
+Usage::
+
+    qparams = quantize_params(params)              # offline, once
+    logits  = model.apply({"params": dequantize_params(qparams)}, tokens)
+    #         ^ inside jit — the dequant fuses, HBM holds int8
+
+``quantize_params`` returns a plain pytree (QTensor dataclass leaves), so
+it checkpoints, shards (shard the ``q`` leaf exactly like the original
+weight), and jits like any other params tree.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """int8 values + per-output-channel scales standing in for one weight."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q
+        self.scale = scale
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    def dequantize(self, dtype=jnp.bfloat16) -> jax.Array:
+        return (self.q.astype(dtype) * self.scale.astype(dtype))
+
+    def __repr__(self):
+        return f"QTensor(shape={tuple(self.q.shape)}, scale={tuple(self.scale.shape)})"
+
+
+# Final path segment must be exactly `kernel` or `embedding` — a suffix
+# match would also catch T5's `rel_embedding` attention-bias table, a tiny
+# precision-critical leaf with zero bandwidth upside.
+DEFAULT_PATTERN = re.compile(r"(^|.*\.)(kernel|embedding)$")
+
+
+def _leaf_path(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+    return ".".join(parts)
+
+
+def quantize_array(w: jax.Array) -> QTensor:
+    """Symmetric per-channel int8: scale = absmax/127 over all but the last
+    axis (output channels for the (in, ..., out) kernel convention)."""
+    axes = tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axes, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(
+        jnp.int8
+    )
+    return QTensor(q, scale.astype(jnp.float32))
+
+
+def quantize_params(
+    params: Any,
+    *,
+    predicate: Optional[Callable[[str, jax.Array], bool]] = None,
+) -> Any:
+    """Quantize every matmul weight in a params pytree to int8.
+
+    ``predicate(path, leaf) -> bool`` overrides the default selection
+    (rank >= 2 leaves whose path ends in ``kernel`` or ``embedding``).
+    """
+
+    def should(path: str, leaf) -> bool:
+        if predicate is not None:
+            return predicate(path, leaf)
+        return (
+            hasattr(leaf, "ndim") and leaf.ndim >= 2
+            and DEFAULT_PATTERN.match(path) is not None
+        )
+
+    def one(path, leaf):
+        name = _leaf_path(path)
+        if should(name, leaf):
+            return quantize_array(leaf)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def dequantize_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
+    """Widen QTensor leaves back to ``dtype`` (call INSIDE jit so XLA fuses
+    the widening into each consuming matmul; HBM keeps the int8 copy)."""
+    return jax.tree.map(
+        lambda leaf: leaf.dequantize(dtype) if isinstance(leaf, QTensor) else leaf,
+        qparams,
+        is_leaf=lambda leaf: isinstance(leaf, QTensor),
+    )
+
+
+def quantized_bytes(params: Any) -> int:
+    """Total parameter bytes (int8 + scales for QTensors, itemsize else)."""
+    total = 0
+    for leaf in jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QTensor)
+    ):
+        if isinstance(leaf, QTensor):
+            total += leaf.q.size * 1 + leaf.scale.size * 4
+        elif hasattr(leaf, "size"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -120,7 +120,7 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
 
 def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
                  max_seq_len: Optional[int] = None,
-                 seed: int = 0) -> GenerationService:
+                 seed: int = 0, quantize: Optional[str] = None) -> GenerationService:
     """Build the model; restore params from a train-loop checkpoint when
     given, else random-init (useful for smoke/serving-path tests)."""
     from kubeflow_tpu.models import create_model
@@ -151,6 +151,14 @@ def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
         )
     else:
         params = model.init(jax.random.key(seed), tokens)["params"]
+    if quantize:
+        if quantize != "int8":
+            raise ValueError(f"unsupported quantization {quantize!r} (int8)")
+        from kubeflow_tpu.models.quantize import quantize_params
+
+        # Weight-only int8: halves HBM bytes per decoded token; generate()
+        # dequantizes inside the jit so the widening fuses into matmuls.
+        params = quantize_params(params)
     return GenerationService(model, params)
 
 
@@ -160,11 +168,13 @@ def main(argv=None) -> int:
     ap.add_argument("--checkpoint-dir", default=None)
     ap.add_argument("--max-seq-len", type=int, default=None)
     ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--quantize", choices=["int8"], default=None,
+                    help="weight-only int8 serving (halved HBM per token)")
     args = ap.parse_args(argv)
 
     service = load_service(
         args.model, checkpoint_dir=args.checkpoint_dir,
-        max_seq_len=args.max_seq_len,
+        max_seq_len=args.max_seq_len, quantize=args.quantize,
     )
     app = create_app(service, model_name=args.model)
     from werkzeug.serving import make_server

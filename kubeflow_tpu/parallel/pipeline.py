@@ -101,7 +101,7 @@ def _pipeline_local_interleaved(
     n_stages = jax.lax.psum(1, axis_name)
     stage = jax.lax.axis_index(axis_name)
     batch = x.shape[0]
-    if batch % n_micro or batch < n_micro:
+    if batch % n_micro:
         raise ValueError(
             f"per-device batch {batch} not divisible into {n_micro} microbatches"
         )
@@ -113,7 +113,9 @@ def _pipeline_local_interleaved(
     # Full ring: the wrap edge (P-1 → 0) carries activations into their
     # next round.
     ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
-    total_ticks = n_rounds * n_stages + n_stages - 1
+    # Last microbatch (m = n_micro-1) leaves the last device's last round
+    # at tick m + v*P - 1; anything beyond that is pure drain waste.
+    total_ticks = n_rounds * n_stages + n_micro - 1
 
     def tick(carry, t):
         state, outputs = carry
@@ -194,9 +196,6 @@ def pipeline_apply(
                 f"stage_params leaves need leading axis {total_stages}, "
                 f"got {leaf.shape}"
             )
-    batch = x.shape[0]
-    if batch % n_micro:
-        raise ValueError(f"batch {batch} not divisible by n_micro {n_micro}")
     if x_spec is None:
         from kubeflow_tpu.parallel.sharding import data_axes
 

@@ -1,0 +1,125 @@
+"""Weight-only int8 quantization for serving (models/quantize.py)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.quantize import (
+    QTensor,
+    dequantize_params,
+    quantize_array,
+    quantize_params,
+    quantized_bytes,
+)
+
+
+def tiny_llama():
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=64)
+    model = Llama(cfg)
+    tokens = jnp.ones((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), tokens)["params"]
+    return model, params, tokens
+
+
+def test_quantize_array_roundtrip_error_bounded():
+    w = jax.random.normal(jax.random.key(1), (64, 32)) * 0.2
+    qt = quantize_array(w)
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 32)  # per-output-channel
+    err = jnp.abs(qt.dequantize(jnp.float32) - w)
+    # Symmetric int8: error bounded by scale/2 per channel.
+    assert float(jnp.max(err / qt.scale)) <= 0.51
+
+
+def test_quantize_params_selects_matmul_weights_only():
+    _, params, _ = tiny_llama()
+    qparams = quantize_params(params)
+    leaves = jax.tree.leaves(qparams, is_leaf=lambda x: isinstance(x, QTensor))
+    kinds = {type(l).__name__ for l in leaves}
+    assert "QTensor" in kinds
+    # Norm scales stay full-precision.
+    flat = jax.tree_util.tree_flatten_with_path(
+        qparams, is_leaf=lambda x: isinstance(x, QTensor)
+    )[0]
+    for path, leaf in flat:
+        name = ".".join(str(getattr(p, "key", "")) for p in path)
+        if "norm" in name:
+            assert not isinstance(leaf, QTensor), name
+        if name.endswith("kernel") or name.endswith("embedding"):
+            assert isinstance(leaf, QTensor), name
+
+
+def test_t5_rel_embedding_not_quantized():
+    """`rel_embedding` (T5's attention-bias table) must stay full precision
+    — only exact `kernel`/`embedding` path segments quantize."""
+    from kubeflow_tpu.models import create_model
+
+    model = create_model("t5_debug")
+    enc = jnp.ones((1, 8), jnp.int32)
+    dec = jnp.ones((1, 4), jnp.int32)
+    params = model.init(jax.random.key(0), enc, dec)["params"]
+    qparams = quantize_params(params)
+    flat = jax.tree_util.tree_flatten_with_path(
+        qparams, is_leaf=lambda x: isinstance(x, QTensor)
+    )[0]
+    rel = [(".".join(str(getattr(p, "key", "")) for p in path), leaf)
+           for path, leaf in flat if "rel_embedding" in
+           ".".join(str(getattr(p, "key", "")) for p in path)]
+    assert rel, "t5_debug should have a rel_embedding leaf"
+    for name, leaf in rel:
+        assert not isinstance(leaf, QTensor), name
+
+
+def test_quantized_forward_close_to_full_precision():
+    model, params, tokens = tiny_llama()
+    full = model.apply({"params": params}, tokens)
+    deq = dequantize_params(quantize_params(params), jnp.float32)
+    quant = model.apply({"params": deq}, tokens)
+    # Per-channel int8 on a tiny random model: logits track closely.
+    denom = float(jnp.std(full))
+    assert float(jnp.max(jnp.abs(full - quant))) / denom < 0.15
+
+
+def test_generate_accepts_quantized_params():
+    from kubeflow_tpu.models.generate import generate
+
+    model, params, _ = tiny_llama()
+    prompt = jnp.array([[3, 5, 7, 9]], jnp.int32)
+    out_full = generate(model, params, prompt, max_new_tokens=8)
+    out_q = generate(model, quantize_params(params), prompt, max_new_tokens=8)
+    assert out_q.shape == out_full.shape == (1, 8)
+    assert out_q.dtype == out_full.dtype
+
+
+def test_quantized_bytes_halved():
+    _, params, _ = tiny_llama()
+    orig = sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(params))
+    qbytes = quantized_bytes(quantize_params(params))
+    # f32 kernels -> int8 (+small scales): well under half.
+    assert qbytes < orig * 0.5
+
+
+def test_serve_with_int8_quantization():
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.models.serve import create_app, load_service
+
+    svc = load_service("llama_debug", max_seq_len=64, quantize="int8")
+    client = Client(create_app(svc, model_name="llama_debug"))
+    resp = client.post("/v1/generate", json={
+        "tokens": [[3, 5, 7]], "max_new_tokens": 4,
+    })
+    assert resp.status_code == 200, resp.get_data(as_text=True)
+    out = resp.get_json()
+    assert len(out["tokens"]) == 1 and len(out["tokens"][0]) == 4
+
+
+def test_serve_rejects_unknown_quantization():
+    from kubeflow_tpu.models.serve import load_service
+
+    with pytest.raises(ValueError, match="unsupported quantization"):
+        load_service("llama_debug", max_seq_len=64, quantize="int4")
