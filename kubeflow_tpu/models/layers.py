@@ -83,10 +83,29 @@ class Attention(nn.Module):
             k, v, bias = self._update_cache(k, v, max_decode_len)
             if mask_bias is not None:
                 bias = bias + mask_bias
-            out = ops.dot_product_attention(
-                q, k, v, causal=False, bias=bias, impl="xla",
-                softmax_scale=self.softmax_scale,
-            )
+            out = None
+            if s == 1:
+                # Single-token decode: a Pallas flash-decode kernel exists
+                # (ops/pallas/flash_decode.py) but measured SLOWER than
+                # XLA's decode on the current backend (BASELINE.md), so it
+                # is opt-in only: KUBEFLOW_TPU_FORCE_FLASH_DECODE=1.
+                from kubeflow_tpu.ops.pallas import flash_decode as fd
+
+                # bias must be head-uniform to collapse into a [b, S] row;
+                # a per-head bias (ALiBi/T5-style) must take the XLA path.
+                if fd.force_enabled() and bias.shape[1] == 1:
+                    rows = jnp.broadcast_to(
+                        bias[:, 0, 0, :], (b, k.shape[1])
+                    ).astype(jnp.float32)
+                    if fd.supported(q, k, v, bias_rows=rows):
+                        out = fd.flash_decode(
+                            q, k, v, rows, softmax_scale=self.softmax_scale
+                        )
+            if out is None:
+                out = ops.dot_product_attention(
+                    q, k, v, causal=False, bias=bias, impl="xla",
+                    softmax_scale=self.softmax_scale,
+                )
         else:
             out = ops.dot_product_attention(
                 q,
@@ -109,7 +128,14 @@ class Attention(nn.Module):
         return the full cache plus the mask bias hiding future/unwritten
         slots.  Works for prefill (s>1 at index 0) and single-token decode
         (s=1) under one jit trace each — no data-dependent Python control
-        flow (SURVEY-mandated XLA semantics)."""
+        flow (SURVEY-mandated XLA semantics).
+
+        The cache stays sequence-major ([b, S, kv_h, d]) — XLA's preferred
+        decode layout.  A dS-major layout feeding the Pallas flash-decode
+        kernel was measured end to end and LOST to XLA on the current
+        backend (BASELINE.md decode-kernel log), so the kernel remains an
+        opt-in (KUBEFLOW_TPU_FORCE_FLASH_DECODE=1) and the storage serves
+        the default path."""
         b, s, kv_heads, head_dim = k.shape
         if max_decode_len is None:
             raise ValueError("decode=True requires max_decode_len")

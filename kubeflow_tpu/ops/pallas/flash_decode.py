@@ -1,0 +1,204 @@
+"""Single-token decode attention kernel for TPU (Pallas/Mosaic).
+
+Decode attention (one query token against an S-slot KV cache) is the
+bandwidth-bound inner loop of generation: per generated token every layer
+streams its whole cache from HBM.  XLA's unfused path materializes the
+[b, h, S] logits to HBM and reads the cache a second time for the
+softmax·V contraction; this kernel folds the whole thing into one pass
+with an online-softmax accumulator, so HBM sees each cache byte exactly
+once and the logits never leave VMEM.
+
+Layout matters more than FLOPs here: the kernel wants (d, S)-transposed
+per-head tiles (``flash_decode_ds``) so the long S axis sits on the
+128-lane minor dimension at full density; (bk, d=64) tiles lane-pad
+64→128 and double the DMA bytes.  Three layouts were measured end to end
+on the tunneled v5e (BASELINE.md decode-kernel log) and ALL lost to XLA's
+decode there — per-grid-cell overhead on tiny GQA tiles dominates and the
+chip's achievable bandwidth leaves no single-pass headroom — so the model
+cache stays sequence-major ([b, S, kv_h, d], layers.py `_update_cache`)
+and this kernel is opt-in (KUBEFLOW_TPU_FORCE_FLASH_DECODE=1) via the
+transposing `flash_decode` wrapper, kept correctness-tested for
+full-bandwidth hardware where the single-pass math wins.
+
+* The q "tile" is the GQA group — all ``g = h / kv_h`` query heads that
+  share one kv head.  For MHA g=1 the score product is a skinny matvec;
+  fine — this kernel is HBM-bound, not MXU-bound.
+* The additive bias row ([b, S]: padding slots + unwritten slots at
+  -1e30) rides the same grid, replicated to 8 sublanes for Mosaic tiling.
+* kv blocks ride the innermost (sequential) grid axis; (m, l, acc)
+  scratch carries across it, like the training kernel
+  (flash_attention.py).
+
+No backward: decode is inference-only.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kubeflow_tpu.ops.pallas.flash_attention import (
+    _compiler_params as _fa_compiler_params,
+    _platform,
+    _scratch,
+    pltpu,
+)
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_K = 512
+
+
+def force_enabled() -> bool:
+    """Test/debug override: use the kernel (interpret mode off-TPU) even
+    where the platform gate would fall back to XLA."""
+    import os
+
+    return os.environ.get("KUBEFLOW_TPU_FORCE_FLASH_DECODE", "") == "1"
+
+
+def _pick_block(S: int) -> Optional[int]:
+    for bk in (DEFAULT_BLOCK_K, 256, 128):
+        if S % bk == 0:
+            return bk
+    return None
+
+
+def supported(q, k, v, *, bias_rows=None, ds_major=False) -> bool:
+    """Shape gate; the caller falls back to XLA when False.
+
+    ``ds_major=True`` checks k/v as [b, kv_h, d, S] (the model cache
+    layout), else [b, S, kv_h, d]."""
+    if pltpu is None:
+        return False
+    b, s, h, d = q.shape
+    if ds_major:
+        bk_, kv_h, dk, S = k.shape
+    else:
+        bk_, S, kv_h, dk = k.shape
+    if s != 1 or bk_ != b or v.shape != k.shape or d != dk:
+        return False
+    if h % kv_h != 0:
+        return False
+    if d % 8 != 0 or d > 256:
+        return False
+    if bias_rows is not None and bias_rows.shape != (b, S):
+        return False
+    return _pick_block(S) is not None
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, num_k):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)        # (g, d)
+    k = k_ref[0, 0].astype(jnp.float32)        # (d, bk) — dS-major tile
+    s = jax.lax.dot_general(
+        q, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                   # (g, bk)
+    s = s + bias_ref[0, 0][None, :]             # (bk,) broadcast over g
+
+    m_prev = m_ref[...]                         # (g, 128) lane-replicated
+    row_max = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, row_max)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, 0:1])
+    l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+    # PV: p (g, bk) × v (d, bk) contracted over bk → (g, d).
+    acc_ref[...] = acc_ref[...] * alpha[:, 0:1] + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_ref[...][:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _compiler_params(interpret):
+    return _fa_compiler_params(
+        interpret, ("parallel", "parallel", "arbitrary")
+    )
+
+
+def flash_decode_ds(
+    q, k_ds, v_ds, bias_rows=None, *,
+    softmax_scale: Optional[float] = None,
+    block_k: Optional[int] = None,
+):
+    """Decode attention over a dS-MAJOR cache: q [b, 1, h, d],
+    k/v [b, kv_h, d, S], optional additive bias row [b, S].
+    Returns [b, 1, h, d].
+
+    (d, S) per-head tiles put the long S axis on the 128-lane minor
+    dimension, so a (d=64, bk) block is fully dense — a (bk, d=64) layout
+    would lane-pad 64→128 and double the DMA bytes, which measured SLOWER
+    than XLA end to end."""
+    b, s, h, d = q.shape
+    _, kv_h, _, S = k_ds.shape
+    if s != 1:
+        raise ValueError(f"flash_decode is single-token only, got s={s}")
+    g = h // kv_h
+    bk = block_k or _pick_block(S)
+    if bk is None or S % bk:
+        raise ValueError(f"cache length {S} has no supported block size")
+    scale = softmax_scale if softmax_scale is not None else d**-0.5
+    if bias_rows is None:
+        bias_rows = jnp.zeros((b, S), jnp.float32)
+    # Mosaic wants >= (8, 128) tiles: replicate the bias row across 8
+    # sublanes (a few extra KB per step vs the cache's GBs — noise).
+    bias8 = jnp.broadcast_to(
+        bias_rows.astype(jnp.float32)[:, None, :], (b, 8, S)
+    )
+    # GQA grouping: consecutive q heads share a kv head (q head j ↔ kv head
+    # j // g — the training kernel's hi // n_rep convention).
+    qg = q[:, 0].reshape(b, kv_h, g, d)
+    num_k = S // bk
+    interpret = _platform() not in ("tpu", "axon")
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, num_k=num_k),
+        grid=(b, kv_h, num_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, d, bk), lambda bi, hi, ki: (bi, hi, 0, ki)),
+            pl.BlockSpec((1, 1, d, bk), lambda bi, hi, ki: (bi, hi, 0, ki)),
+            pl.BlockSpec((1, 8, bk), lambda bi, hi, ki: (bi, 0, ki)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, kv_h, g, d), q.dtype),
+        scratch_shapes=[
+            _scratch((g, d)),     # acc
+            _scratch((g, 128)),   # m
+            _scratch((g, 128)),   # l
+        ],
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(qg, k_ds, v_ds, bias8)
+    return out.reshape(b, h, d)[:, None]
+
+
+def flash_decode(
+    q, k, v, bias_rows=None, *,
+    softmax_scale: Optional[float] = None,
+    block_k: Optional[int] = None,
+):
+    """Decode attention, sequence-major cache k/v [b, S, kv_h, d] — the
+    model cache layout; inputs are transposed to the kernel's dS-major
+    tiles on entry.  Callers that already hold a dS-major cache can use
+    ``flash_decode_ds`` directly."""
+    return flash_decode_ds(
+        q, k.transpose(0, 2, 3, 1), v.transpose(0, 2, 3, 1), bias_rows,
+        softmax_scale=softmax_scale, block_k=block_k,
+    )
