@@ -1,0 +1,145 @@
+"""Sequence packing: variable-length documents → fixed [rows, seq_len]
+token matrices with segment ids.
+
+Padding is wasted MXU time: a batch of raw documents padded to seq_len
+spends FLOPs and HBM on pad tokens.  Packing places several documents in
+one row and tells attention where the boundaries are via segment ids
+(ops/attention.py masks cross-segment pairs; kubeflow_tpu.train's LM step
+masks cross-boundary and pad targets out of the loss).
+
+The bin-packing itself (best-fit decreasing) runs in the native C++
+engine when available (native/packer.cc via platform/native.py) with a
+pure-Python mirror — the same native-with-fallback pattern as the
+platform's JSON-patch and workqueue hot paths.
+
+Conventions: segment ids start at 1 per row; 0 marks padding slots.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def pack_documents(
+    lengths: Sequence[int], row_len: int
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Assign documents to rows, best-fit decreasing.
+
+    Returns ``(row_assignment, row_offset, n_rows)``: for document i,
+    ``row_assignment[i]`` is its row and ``row_offset[i]`` its first slot.
+    Raises ValueError if any length is < 1 or > row_len.
+    """
+    from kubeflow_tpu.platform import native
+
+    lengths = np.asarray(lengths, dtype=np.int64)
+    result = native.native_pack(lengths, row_len)
+    if result is not None:
+        return result
+    return _pack_python(lengths, row_len)
+
+
+def _pack_python(lengths: np.ndarray, row_len: int):
+    """Pure-Python best-fit decreasing (parity-tested vs the C++ engine)."""
+    if any(l < 1 or l > row_len for l in lengths):
+        raise ValueError(f"invalid document lengths for row_len={row_len}")
+    order = sorted(range(len(lengths)), key=lambda i: -int(lengths[i]))
+    assignment = np.empty(len(lengths), dtype=np.int64)
+    offset = np.empty(len(lengths), dtype=np.int64)
+    open_rows: List[Tuple[int, int]] = []  # sorted (remaining, row_id)
+    used: List[int] = []
+    for i in order:
+        length = int(lengths[i])
+        j = bisect.bisect_left(open_rows, (length, -1))
+        if j == len(open_rows):
+            row = len(used)
+            used.append(0)
+        else:
+            row = open_rows[j][1]
+            del open_rows[j]
+        assignment[i] = row
+        offset[i] = used[row]
+        used[row] += length
+        rem = row_len - used[row]
+        if rem > 0:
+            bisect.insort(open_rows, (rem, row))
+    return assignment, offset, len(used)
+
+
+def pack_tokens(
+    docs: Sequence[np.ndarray], row_len: int, *, pad_id: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack token documents into ``(tokens, segment_ids)`` matrices of
+    shape [n_rows, row_len].  Documents longer than row_len raise (split
+    upstream — silently truncating training data hides bugs)."""
+    lengths = [len(d) for d in docs]
+    assignment, offset, n_rows = pack_documents(lengths, row_len)
+    tokens = np.full((n_rows, row_len), pad_id, dtype=np.int32)
+    segments = np.zeros((n_rows, row_len), dtype=np.int32)
+    seg_counter = np.zeros(n_rows, dtype=np.int32)
+    # Row-local segment numbering must be stable in document order.
+    for i, doc in enumerate(docs):
+        r, o = int(assignment[i]), int(offset[i])
+        seg_counter[r] += 1
+        tokens[r, o:o + lengths[i]] = np.asarray(doc, dtype=np.int32)
+        segments[r, o:o + lengths[i]] = seg_counter[r]
+    return tokens, segments
+
+
+def _materialize_rows(
+    window, lengths, assignment, offset, keep_rows: int, seq_len: int,
+    pad_id: int,
+):
+    """Token/segment matrices for rows < keep_rows, plus the documents that
+    landed in later rows (carried into the next window — never dropped)."""
+    tokens = np.full((keep_rows, seq_len), pad_id, dtype=np.int32)
+    segments = np.zeros((keep_rows, seq_len), dtype=np.int32)
+    seg_counter = np.zeros(keep_rows, dtype=np.int32)
+    carry: List[np.ndarray] = []
+    for i, doc in enumerate(window):
+        r, o = int(assignment[i]), int(offset[i])
+        if r >= keep_rows:
+            carry.append(doc)
+            continue
+        seg_counter[r] += 1
+        tokens[r, o:o + lengths[i]] = np.asarray(doc, dtype=np.int32)
+        segments[r, o:o + lengths[i]] = seg_counter[r]
+    return tokens, segments, carry
+
+
+def packed_lm_batches(
+    docs, *, batch_rows: int, seq_len: int, pad_id: int = 0,
+    drop_remainder: bool = True,
+):
+    """Generator: stream of token documents → (tokens, segment_ids) batches
+    of shape [batch_rows, seq_len].  Packs over a rolling window; documents
+    the packer places beyond batch_rows carry into the next window — no
+    document is ever silently dropped (documents longer than seq_len
+    raise)."""
+    window: List[np.ndarray] = []
+    total = 0
+    for doc in docs:
+        doc = np.asarray(doc)
+        window.append(doc)
+        total += len(doc)
+        if total < batch_rows * seq_len:
+            continue
+        lengths = [len(d) for d in window]
+        assignment, offset, n_rows = pack_documents(lengths, seq_len)
+        if n_rows < batch_rows:
+            continue  # not enough full rows yet; keep accumulating
+        tokens, segments, carry = _materialize_rows(
+            window, lengths, assignment, offset, batch_rows, seq_len, pad_id
+        )
+        yield tokens, segments
+        window = carry
+        total = sum(len(d) for d in carry)
+    while window and not drop_remainder:
+        lengths = [len(d) for d in window]
+        assignment, offset, n_rows = pack_documents(lengths, seq_len)
+        tokens, segments, carry = _materialize_rows(
+            window, lengths, assignment, offset, batch_rows, seq_len, pad_id
+        )
+        yield tokens, segments
+        window = carry

@@ -62,7 +62,7 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
-        if not hasattr(lib, "kfp_merge_apply"):
+        if not hasattr(lib, "kfpk_pack"):
             # Stale prebuilt library from before a symbol was added.
             # Rebuild for FUTURE processes (make re-links, sources are
             # newer) but report unavailable now — dlopen caches the mapped
@@ -101,6 +101,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.kfq_pending.argtypes = [ctypes.c_void_p]
         lib.kfq_pending.restype = ctypes.c_int64
         lib.kfq_shutdown.argtypes = [ctypes.c_void_p]
+        # kfpk: sequence packer
+        _i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.kfpk_pack.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int64,
+                                  _i64p, _i64p]
+        lib.kfpk_pack.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -289,3 +294,34 @@ class NativeWorkQueue:
                 self._q = None
         except Exception:
             pass
+
+
+# -- sequence packer ----------------------------------------------------------
+
+
+def native_pack(lengths, row_len: int):
+    """Best-fit-decreasing packing via the C++ engine.
+
+    ``lengths``: int64 numpy array of document lengths.  Returns
+    ``(row_assignment, row_offset, n_rows)`` int64 arrays, or None when the
+    native library is unavailable (caller uses the Python fallback).
+    Raises ValueError for invalid lengths (the engine's -1)."""
+    lib = _load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    n = len(lengths)
+    assignment = np.empty(n, dtype=np.int64)
+    offset = np.empty(n, dtype=np.int64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    rows = lib.kfpk_pack(
+        lengths.ctypes.data_as(i64p), n, int(row_len),
+        assignment.ctypes.data_as(i64p), offset.ctypes.data_as(i64p),
+    )
+    if rows < 0:
+        raise ValueError(
+            f"invalid document lengths for row_len={row_len}"
+        )
+    return assignment, offset, int(rows)

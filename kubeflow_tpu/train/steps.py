@@ -54,11 +54,19 @@ def create_train_state(
     )
 
 
-def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean softmax cross-entropy over integer labels, f32."""
+def cross_entropy(
+    logits: jax.Array, labels: jax.Array,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mean softmax cross-entropy over integer labels, f32.  ``weights``
+    (same shape as labels) turns it into a weighted mean — the packed-
+    sequence path zeroes pad and cross-document targets."""
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    if weights is None:
+        return -jnp.mean(ll)
+    w = weights.astype(jnp.float32)
+    return -jnp.sum(ll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def make_classification_grad_fn(*, has_batch_stats: bool, has_dropout: bool = False):
@@ -140,7 +148,16 @@ def make_lm_grad_fn(*, aux_loss_weight: float = 0.0):
             # Shift: predict token t+1 from prefix..t.
             logits = logits[:, :-1]
             targets = tokens[:, 1:]
-            loss = cross_entropy(logits, targets)
+            weights = None
+            if segment_ids is not None:
+                # Packed rows (data/packing.py): a target only counts when
+                # it continues the SAME document (no cross-document
+                # prediction) and is not a pad slot (segment 0).
+                weights = (
+                    (segment_ids[:, 1:] == segment_ids[:, :-1])
+                    & (segment_ids[:, 1:] != 0)
+                )
+            loss = cross_entropy(logits, targets, weights=weights)
             return loss + aux_loss_weight * aux, (loss, aux)
 
         (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
