@@ -150,6 +150,24 @@ def synthetic_lm_batches(
         i += 1
 
 
+def synthetic_lm_documents(
+    *,
+    vocab_size: int,
+    seed: int = 0,
+    min_len: int = 8,
+    max_len: int = 256,
+    docs: Optional[int] = None,
+) -> Iterator[np.ndarray]:
+    """Variable-length random token documents — the input side of the
+    packing pipeline (kubeflow_tpu.data.packing.packed_lm_batches)."""
+    rng = np.random.default_rng((seed, jax.process_index()))
+    i = 0
+    while docs is None or i < docs:
+        n = int(rng.integers(min_len, max_len + 1))
+        yield rng.integers(1, vocab_size, n, dtype=np.int32)
+        i += 1
+
+
 def synthetic_image_batches(
     *,
     global_batch: int,

@@ -75,15 +75,10 @@ def pack_tokens(
     upstream — silently truncating training data hides bugs)."""
     lengths = [len(d) for d in docs]
     assignment, offset, n_rows = pack_documents(lengths, row_len)
-    tokens = np.full((n_rows, row_len), pad_id, dtype=np.int32)
-    segments = np.zeros((n_rows, row_len), dtype=np.int32)
-    seg_counter = np.zeros(n_rows, dtype=np.int32)
-    # Row-local segment numbering must be stable in document order.
-    for i, doc in enumerate(docs):
-        r, o = int(assignment[i]), int(offset[i])
-        seg_counter[r] += 1
-        tokens[r, o:o + lengths[i]] = np.asarray(doc, dtype=np.int32)
-        segments[r, o:o + lengths[i]] = seg_counter[r]
+    tokens, segments, carry = _materialize_rows(
+        docs, lengths, assignment, offset, n_rows, row_len, pad_id
+    )
+    assert not carry  # keep_rows == n_rows: everything materializes
     return tokens, segments
 
 
@@ -127,8 +122,8 @@ def packed_lm_batches(
             continue
         lengths = [len(d) for d in window]
         assignment, offset, n_rows = pack_documents(lengths, seq_len)
-        if n_rows < batch_rows:
-            continue  # not enough full rows yet; keep accumulating
+        # total >= batch_rows*seq_len and each row holds <= seq_len tokens,
+        # so n_rows >= batch_rows here — always enough rows to emit.
         tokens, segments, carry = _materialize_rows(
             window, lengths, assignment, offset, batch_rows, seq_len, pad_id
         )

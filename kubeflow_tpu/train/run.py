@@ -67,6 +67,29 @@ def build_lm(args, mesh):
         pure_step, state, mesh, llama_rules()
     )
     def batches(start_step=0):
+        if args.packed:
+            # Packed documents (data/packing.py): padding-free rows with
+            # segment ids; the packer's rolling window is stateful, so this
+            # stream is NOT step-indexed — resume restarts the stream
+            # (random synthetic data; real corpora should resume by shard).
+            from kubeflow_tpu.data.loader import (
+                _host_batch_size,
+                synthetic_lm_documents,
+            )
+            from kubeflow_tpu.data.packing import packed_lm_batches
+
+            max_len = min(256, args.seq)
+            return ShardedLoader(
+                packed_lm_batches(
+                    synthetic_lm_documents(
+                        vocab_size=vocab, seed=args.seed,
+                        min_len=min(8, max_len), max_len=max_len,
+                    ),
+                    batch_rows=_host_batch_size(args.batch),
+                    seq_len=args.seq,
+                ),
+                data_sharding,
+            )
         # Step-indexed stream: resume replays exactly what an uninterrupted
         # run would have consumed from `start_step` on.
         return ShardedLoader(
@@ -144,6 +167,9 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="microbatches accumulated per optimizer step "
                          "(scanned inside one jit; batch must divide evenly)")
+    ap.add_argument("--packed", action="store_true",
+                    help="lm task: pack variable-length documents into "
+                         "padding-free rows with segment ids")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mesh", default="auto")
     ap.add_argument("--checkpoint-dir", default=None)

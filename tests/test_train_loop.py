@@ -197,6 +197,17 @@ def test_trainer_cli_smoke(devices8, tmp_path):
     assert rc == 0
 
 
+def test_trainer_cli_packed_smoke(devices8, tmp_path):
+    from kubeflow_tpu.train.run import main
+
+    rc = main([
+        "--model", "llama_debug", "--steps", "3", "--batch", "8",
+        "--seq", "64", "--packed", "--mesh", "dp=2,fsdp=4",
+        "--log-every", "1",
+    ])
+    assert rc == 0
+
+
 def test_trainer_cli_rejects_bad_mesh():
     from kubeflow_tpu.train import run as trainer
 
