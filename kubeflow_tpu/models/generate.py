@@ -146,3 +146,72 @@ def generate(model, params, prompt: jax.Array, *,
     carry = (cache, first, lengths, rng, done0)
     _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "bos_token", "eos_token"),
+)
+def generate_seq2seq(model, params, source: jax.Array, *,
+                     source_mask: Optional[jax.Array] = None,
+                     rng: Optional[jax.Array] = None,
+                     max_new_tokens: int = 32,
+                     bos_token: int = 0,
+                     eos_token: Optional[int] = 1,
+                     temperature: float = 0.0,
+                     top_k: Optional[int] = None) -> jax.Array:
+    """Encoder-decoder generation (T5-style model with ``encode`` /
+    ``decode`` apply methods): encode the source ONCE, then scan cached
+    single-token decoder steps.  Returns [batch, max_new_tokens] token ids;
+    rows pad with EOS after emitting it.
+
+    T5 convention: decoding starts from ``bos_token`` (the pad id, 0) and
+    ``eos_token`` is 1.
+    """
+    b = source.shape[0]
+    if rng is None:
+        rng = jax.random.key(0)
+    if source_mask is not None:
+        source_mask = source_mask.astype(bool)
+    encoded = model.apply({"params": params}, source, source_mask,
+                          method="encode")
+    # Cache sizes to exactly the decode budget: step t attends slots <= t.
+    cache_len = max_new_tokens
+    tok0 = jnp.full((b, 1), bos_token, jnp.int32)
+    logits, state = model.apply(
+        {"params": params}, encoded, tok0,
+        source_mask=source_mask, decode=True,
+        step=jnp.zeros((), jnp.int32), max_decode_len=cache_len,
+        mutable=["cache"], method="decode",
+    )
+    rng, sub = jax.random.split(rng)
+    first = sample_logits(logits[:, -1], sub, temperature=temperature,
+                          top_k=top_k)
+
+    def step_fn(carry, i):
+        cache, token, rng, done = carry
+        rng, sub = jax.random.split(rng)
+        logits, new_state = model.apply(
+            {"params": params, "cache": cache}, encoded, token[:, None],
+            source_mask=source_mask, decode=True,
+            step=i, max_decode_len=cache_len,
+            mutable=["cache"], method="decode",
+        )
+        nxt = sample_logits(logits[:, -1], sub, temperature=temperature,
+                            top_k=top_k)
+        if eos_token is not None:
+            nxt = jnp.where(done, eos_token, nxt)
+            done = done | (nxt == eos_token)
+        return (new_state["cache"], nxt, rng, done), nxt
+
+    done0 = jnp.zeros((b,), dtype=bool)
+    if eos_token is not None:
+        done0 = first == eos_token
+    if max_new_tokens == 1:
+        return first[:, None]
+    carry = (state["cache"], first, rng, done0)
+    _, rest = jax.lax.scan(
+        step_fn, carry, jnp.arange(1, max_new_tokens, dtype=jnp.int32)
+    )
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

@@ -61,24 +61,38 @@ class Attention(nn.Module):
             feats, axis=-1, use_bias=False, dtype=self.dtype, name=name
         )
         q = dense((self.num_heads, head_dim), "q_proj")(x)
-        src = x if kv is None else kv
-        k = dense((kv_heads, head_dim), "k_proj")(src)
-        v = dense((kv_heads, head_dim), "v_proj")(src)
+        k_proj = dense((kv_heads, head_dim), "k_proj")
+        v_proj = dense((kv_heads, head_dim), "v_proj")
+        if decode and kv is not None:
+            # Cross-attention under decode: the source is static for the
+            # whole generation, so project K/V ONCE (first call initializes
+            # the cache variables; scan steps reuse them — without this,
+            # every generated token re-projects the full encoder output in
+            # every layer).
+            ck = self.variable("cache", "cached_cross_key", lambda: k_proj(kv))
+            cv = self.variable("cache", "cached_cross_value", lambda: v_proj(kv))
+            k, v = ck.value, cv.value
+        else:
+            src = x if kv is None else kv
+            k = k_proj(src)
+            v = v_proj(src)
         if self.rope and kv is None:
             if positions is None:
                 positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
             q = apply_rope(q, positions, theta=self.rope_theta)
             k = apply_rope(k, positions, theta=self.rope_theta)
-        if decode:
+        if decode and kv is not None:
+            # Cross-attention with the once-projected K/V: no positional
+            # masking (every source token is visible modulo mask_bias).
+            out = ops.dot_product_attention(
+                q, k, v, causal=False, bias=mask_bias, impl="xla",
+                softmax_scale=self.softmax_scale,
+            )
+        elif decode:
             if segment_ids is not None:
                 raise ValueError(
                     "decode=True does not support packed sequences "
                     "(segment_ids); the cache is one sequence per batch row"
-                )
-            if kv is not None:
-                raise ValueError(
-                    "decode=True caches self-attention only; cross-attention "
-                    "k/v are static per call — compute them outside the loop"
                 )
             k, v, bias = self._update_cache(k, v, max_decode_len)
             if mask_bias is not None:

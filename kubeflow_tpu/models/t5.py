@@ -82,7 +82,14 @@ class RelativeBias(nn.Module):
     cfg: T5Config
     bidirectional: bool
 
-    @nn.compact
+    def setup(self):
+        cfg = self.cfg
+        self.rel_embedding = self.param(
+            "rel_embedding",
+            nn.initializers.normal(stddev=1.0 / np.sqrt(cfg.dim)),
+            (cfg.rel_buckets, cfg.n_heads),
+        )
+
     def __call__(self, q_len: int, k_len: int):
         cfg = self.cfg
         ctx = np.arange(q_len)[:, None] - np.arange(k_len)[None, :]
@@ -90,13 +97,29 @@ class RelativeBias(nn.Module):
             -ctx, bidirectional=self.bidirectional,
             num_buckets=cfg.rel_buckets, max_distance=cfg.rel_max_distance,
         )  # [q, k] static
-        table = self.param(
-            "rel_embedding",
-            nn.initializers.normal(stddev=1.0 / np.sqrt(cfg.dim)),
-            (cfg.rel_buckets, cfg.n_heads),
-        )
-        bias = table[jnp.asarray(buckets)]            # [q, k, heads]
-        return jnp.transpose(bias, (2, 0, 1))[None]   # [1, heads, q, k]
+        bias = self.rel_embedding[jnp.asarray(buckets)]  # [q, k, heads]
+        return jnp.transpose(bias, (2, 0, 1))[None]      # [1, heads, q, k]
+
+    def at_position(self, pos, k_len: int):
+        """Bias row for ONE query at traced position ``pos`` against keys
+        0..k_len-1 → [1, heads, 1, k_len] (causal decode only; future keys
+        are masked by the cache bias, their bucket value is irrelevant).
+
+        The distance→bucket map is precomputed with the SAME static numpy
+        function the training path uses and indexed with the traced
+        position — bit-exact parity with ``__call__`` (a traced float32
+        re-derivation measurably disagreed with numpy's float64 at large
+        distances, flipping buckets)."""
+        cfg = self.cfg
+        dist = np.arange(k_len)                          # q_pos - k_pos >= 0
+        table = relative_position_bucket(
+            -dist, bidirectional=False,
+            num_buckets=cfg.rel_buckets, max_distance=cfg.rel_max_distance,
+        )                                                # [k_len] static
+        n = jnp.clip(pos - jnp.arange(k_len), 0, k_len - 1)
+        buckets = jnp.asarray(table)[n]
+        bias = self.rel_embedding[buckets]               # [k_len, heads]
+        return jnp.transpose(bias, (1, 0))[None, :, None, :]
 
 
 class GatedGelu(nn.Module):
@@ -135,19 +158,20 @@ class T5DecoderBlock(nn.Module):
     cfg: T5Config
 
     @nn.compact
-    def __call__(self, x, encoded, self_bias, cross_bias):
+    def __call__(self, x, encoded, self_bias, cross_bias, *,
+                 decode=False, max_decode_len=None):
         cfg = self.cfg
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="self_attn_norm")(x)
         h = Attention(
             num_heads=cfg.n_heads, head_dim=cfg.head_dim, causal=True,
             dtype=cfg.dtype, softmax_scale=1.0, name="self_attn",
-        )(h, mask_bias=self_bias)
+        )(h, mask_bias=self_bias, decode=decode, max_decode_len=max_decode_len)
         x = x + h
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="cross_attn_norm")(x)
         h = Attention(
             num_heads=cfg.n_heads, head_dim=cfg.head_dim, dtype=cfg.dtype,
             softmax_scale=1.0, name="cross_attn",
-        )(h, kv=encoded, mask_bias=cross_bias)
+        )(h, kv=encoded, mask_bias=cross_bias, decode=decode)
         x = x + h
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
         return x + GatedGelu(cfg.ffn_dim, cfg.dtype, name="mlp")(h)
@@ -156,45 +180,91 @@ class T5DecoderBlock(nn.Module):
 class T5(nn.Module):
     """Returns [batch, target_len, vocab] logits for (source, target) token
     pairs; ``source_mask`` (True = real token) masks encoder padding out of
-    both encoder self-attention and decoder cross-attention."""
+    both encoder self-attention and decoder cross-attention.
+
+    ``encode`` / ``decode`` are exposed as separate apply methods so
+    autoregressive generation runs the encoder ONCE and scans cached
+    decoder steps (models/generate.py ``generate_seq2seq``); ``__call__``
+    composes them, so training is unchanged.
+    """
 
     cfg: T5Config
 
-    @nn.compact
-    def __call__(self, source, target, *,
-                 source_mask: Optional[jnp.ndarray] = None):
+    def setup(self):
         cfg = self.cfg
+        # Attribute names double as param-tree names: identical to the
+        # previous @nn.compact layout (embed, encoder_i, decoder_i, ...).
+        self.embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.encoder_rel_bias = RelativeBias(cfg, bidirectional=True)
+        self.encoder_blocks = [
+            T5EncoderBlock(cfg, name=f"encoder_{i}")
+            for i in range(cfg.n_encoder_layers)
+        ]
+        self.encoder_norm = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.decoder_rel_bias = RelativeBias(cfg, bidirectional=False)
+        self.decoder_blocks = [
+            T5DecoderBlock(cfg, name=f"decoder_{i}")
+            for i in range(cfg.n_decoder_layers)
+        ]
+        self.decoder_norm = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype)
+        self.lm_head = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=jnp.float32
+        )
+
+    @staticmethod
+    def _pad_bias(source, source_mask):
         b, src_len = source.shape
-        tgt_len = target.shape[1]
         if source_mask is None:
             source_mask = jnp.ones((b, src_len), dtype=bool)
-        pad = jnp.where(source_mask, 0.0, -1e30)[:, None, None, :]
+        return jnp.where(source_mask, 0.0, -1e30)[:, None, None, :]
 
-        embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype,
-                         name="embed")
+    def encode(self, source, source_mask: Optional[jnp.ndarray] = None):
+        """Encoder stack → [b, src_len, dim] (bidirectional relative bias,
+        shared across layers)."""
+        src_len = source.shape[1]
+        pad = self._pad_bias(source, source_mask)
+        x = self.embed(source)
+        enc_bias = self.encoder_rel_bias(src_len, src_len) + pad
+        for block in self.encoder_blocks:
+            x = block(x, enc_bias)
+        return self.encoder_norm(x)
 
-        # Encoder: bidirectional relative bias, shared across layers.
-        x = embed(source)
-        enc_bias = RelativeBias(cfg, bidirectional=True,
-                                name="encoder_rel_bias")(src_len, src_len)
-        enc_bias = enc_bias + pad
-        for i in range(cfg.n_encoder_layers):
-            x = T5EncoderBlock(cfg, name=f"encoder_{i}")(x, enc_bias)
-        encoded = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype,
-                          name="encoder_norm")(x)
+    def decode(self, encoded, target, *,
+               source_mask: Optional[jnp.ndarray] = None,
+               decode: bool = False,
+               step=None,
+               max_decode_len: Optional[int] = None):
+        """Decoder stack over a precomputed ``encoded`` source.
 
-        # Decoder: causal relative bias for self-attention, encoder padding
-        # bias for cross-attention (cross gets no relative bias, per T5).
-        y = embed(target)
-        dec_bias = RelativeBias(cfg, bidirectional=False,
-                                name="decoder_rel_bias")(tgt_len, tgt_len)
-        for i in range(cfg.n_decoder_layers):
-            y = T5DecoderBlock(cfg, name=f"decoder_{i}")(
-                y, encoded, dec_bias, pad
-            )
-        y = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="decoder_norm")(y)
-        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                        name="lm_head")(y)
+        Training (``decode=False``): full causal pass, static relative
+        bias.  Generation (``decode=True``): single-token steps against
+        the self-attention KV cache; ``step`` (traced scalar) positions
+        the relative bias row, ``max_decode_len`` sizes the cache.
+        """
+        b = encoded.shape[0]
+        src_mask = (
+            source_mask if source_mask is not None
+            else jnp.ones((b, encoded.shape[1]), dtype=bool)
+        )
+        pad = jnp.where(src_mask, 0.0, -1e30)[:, None, None, :]
+        y = self.embed(target)
+        if decode:
+            if step is None or max_decode_len is None:
+                raise ValueError("decode=True needs step and max_decode_len")
+            self_bias = self.decoder_rel_bias.at_position(step, max_decode_len)
+        else:
+            tgt_len = target.shape[1]
+            self_bias = self.decoder_rel_bias(tgt_len, tgt_len)
+        for block in self.decoder_blocks:
+            y = block(y, encoded, self_bias, pad,
+                      decode=decode, max_decode_len=max_decode_len)
+        y = self.decoder_norm(y)
+        return self.lm_head(y)
+
+    def __call__(self, source, target, *,
+                 source_mask: Optional[jnp.ndarray] = None):
+        encoded = self.encode(source, source_mask)
+        return self.decode(encoded, target, source_mask=source_mask)
 
 
 def _factory(name):
