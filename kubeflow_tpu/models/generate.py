@@ -169,6 +169,10 @@ def generate_seq2seq(model, params, source: jax.Array, *,
     T5 convention: decoding starts from ``bos_token`` (the pad id, 0) and
     ``eos_token`` is 1.
     """
+    # int8-served params widen inside the jit (see generate()).
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
     b = source.shape[0]
     if rng is None:
         rng = jax.random.key(0)

@@ -31,6 +31,42 @@ import jax
 import jax.numpy as jnp
 
 
+def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
+                      limit_new, limit_source, top_k, eos_token):
+    """Shared request validation + right-padding for both services.
+    Returns (tokens [b, longest] int32, mask [b, longest] bool, n)."""
+    if not rows or not all(isinstance(r, list) and r for r in rows):
+        raise ValueError("tokens must be a non-empty list of non-empty rows")
+    for r in rows:
+        for t in r:
+            if not isinstance(t, int) or not 0 <= t < vocab:
+                raise ValueError(f"token {t!r} outside [0, {vocab})")
+    n = default_max if max_new_tokens is None else max_new_tokens
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        raise ValueError(f"max_new_tokens must be a positive int, got {n!r}")
+    if limit_new and n > limit_new:
+        raise ValueError(
+            f"max_new_tokens {n} exceeds the service limit {limit_new}"
+        )
+    longest = max(len(r) for r in rows)
+    if limit_source and longest > limit_source:
+        raise ValueError(
+            f"input length {longest} exceeds the service limit {limit_source}"
+        )
+    if top_k is not None and (not isinstance(top_k, int)
+                              or isinstance(top_k, bool) or top_k < 1):
+        raise ValueError(f"top_k must be a positive int, got {top_k!r}")
+    if eos_token is not None and not isinstance(eos_token, int):
+        raise ValueError(f"eos_token must be an int, got {eos_token!r}")
+    tokens = jnp.array(
+        [r + [0] * (longest - len(r)) for r in rows], jnp.int32
+    )
+    mask = jnp.array(
+        [[1] * len(r) + [0] * (longest - len(r)) for r in rows], bool
+    )
+    return tokens, mask, n
+
+
 class GenerationService:
     def __init__(self, model, params, *, default_max_new_tokens: int = 32):
         self.model = model
@@ -46,31 +82,58 @@ class GenerationService:
                  eos_token: Optional[int] = None, seed: int = 0):
         from kubeflow_tpu.models.generate import generate
 
-        if not rows or not all(isinstance(r, list) and r for r in rows):
-            raise ValueError("tokens must be a non-empty list of non-empty rows")
-        vocab = self.model.cfg.vocab_size
-        for r in rows:
-            for t in r:
-                if not isinstance(t, int) or not 0 <= t < vocab:
-                    raise ValueError(f"token {t!r} outside [0, {vocab})")
-        n = self.default_max_new_tokens if max_new_tokens is None else max_new_tokens
-        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
-            raise ValueError(f"max_new_tokens must be a positive int, got {n!r}")
-        if top_k is not None and (not isinstance(top_k, int)
-                                  or isinstance(top_k, bool) or top_k < 1):
-            raise ValueError(f"top_k must be a positive int, got {top_k!r}")
-        if eos_token is not None and not isinstance(eos_token, int):
-            raise ValueError(f"eos_token must be an int, got {eos_token!r}")
-        longest = max(len(r) for r in rows)
-        prompt = jnp.array(
-            [r + [0] * (longest - len(r)) for r in rows], jnp.int32
-        )
-        mask = jnp.array(
-            [[1] * len(r) + [0] * (longest - len(r)) for r in rows], bool
+        # prompt+new > max_seq_len additionally 400s via generate()'s own
+        # cache_len check (caught below as ValueError).
+        prompt, mask, n = _validate_and_pad(
+            rows, self.model.cfg.vocab_size,
+            max_new_tokens=max_new_tokens,
+            default_max=self.default_max_new_tokens,
+            limit_new=self.model.cfg.max_seq_len,
+            limit_source=self.model.cfg.max_seq_len,
+            top_k=top_k, eos_token=eos_token,
         )
         with self._lock:
             out = generate(
                 self.model, self.params, prompt, prompt_mask=mask,
+                max_new_tokens=n, temperature=temperature, top_k=top_k,
+                eos_token=eos_token, rng=jax.random.key(seed),
+            )
+        return jax.device_get(out).tolist()
+
+
+class Seq2SeqGenerationService:
+    """Same request contract as GenerationService, encoder-decoder models:
+    ``tokens`` rows are SOURCE sequences; the response is the generated
+    target continuation (T5 convention: BOS = pad id 0, EOS = 1)."""
+
+    def __init__(self, model, params, *, default_max_new_tokens: int = 32,
+                 max_target_len: int = 512, max_source_len: int = 4096):
+        self.model = model
+        self.params = params
+        self.default_max_new_tokens = default_max_new_tokens
+        # T5 configs carry no max_seq_len, so the request bounds live on
+        # the service — without them one request can size the per-layer KV
+        # caches (and the O(S^2) encoder) arbitrarily.
+        self.max_target_len = max_target_len
+        self.max_source_len = max_source_len
+        self._lock = threading.Lock()
+
+    def generate(self, rows, *, max_new_tokens: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 eos_token: Optional[int] = 1, seed: int = 0):
+        from kubeflow_tpu.models.generate import generate_seq2seq
+
+        source, mask, n = _validate_and_pad(
+            rows, self.model.cfg.vocab_size,
+            max_new_tokens=max_new_tokens,
+            default_max=self.default_max_new_tokens,
+            limit_new=self.max_target_len,
+            limit_source=self.max_source_len,
+            top_k=top_k, eos_token=eos_token,
+        )
+        with self._lock:
+            out = generate_seq2seq(
+                self.model, self.params, source, source_mask=mask,
                 max_new_tokens=n, temperature=temperature, top_k=top_k,
                 eos_token=eos_token, rng=jax.random.key(seed),
             )
@@ -103,13 +166,18 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
         try:
             # int()/float() coercions raise TypeError on null/list inputs —
             # every malformed field must land as a 400, not a 500.
+            kwargs = {}
+            if "eos_token" in body:
+                # Only forward when the client set it, so each service's
+                # own default applies (seq2seq defaults to EOS=1).
+                kwargs["eos_token"] = body["eos_token"]
             tokens = service.generate(
                 body.get("tokens"),
                 max_new_tokens=body.get("max_new_tokens"),
                 temperature=float(body.get("temperature", 0.0)),
                 top_k=body.get("top_k"),
-                eos_token=body.get("eos_token"),
                 seed=int(body.get("seed", 0)),
+                **kwargs,
             )
         except (ValueError, TypeError) as e:
             raise HttpError(400, str(e)) from None
@@ -125,11 +193,20 @@ def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
     given, else random-init (useful for smoke/serving-path tests)."""
     from kubeflow_tpu.models import create_model
 
-    overrides = {}
+    model = create_model(model_name)
     if max_seq_len:
-        overrides["max_seq_len"] = max_seq_len
-    model = create_model(model_name, **overrides)
+        if hasattr(model.cfg, "max_seq_len"):
+            model = create_model(model_name, max_seq_len=max_seq_len)
+        else:
+            # Don't silently drop an explicit operator request.
+            raise ValueError(
+                f"{model_name} has no max_seq_len config; drop --max-seq-len"
+            )
+    # Encoder-decoder models expose encode/decode apply methods and init
+    # with a (source, target) pair; decoder-only models init with tokens.
+    seq2seq = hasattr(model, "encode")
     tokens = jnp.ones((1, 8), jnp.int32)
+    init_args = (tokens, jnp.ones((1, 4), jnp.int32)) if seq2seq else (tokens,)
     if checkpoint_dir:
         from kubeflow_tpu.train.checkpoint import CheckpointManager
 
@@ -144,13 +221,13 @@ def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
         # Shape-only init: the dtype/structure template costs nothing when
         # the checkpoint supplies every value.
         template = jax.eval_shape(
-            lambda: model.init(jax.random.key(seed), tokens)
+            lambda: model.init(jax.random.key(seed), *init_args)
         )["params"]
         params = jax.tree.map(
             lambda t, r: jnp.asarray(r, t.dtype), template, restored
         )
     else:
-        params = model.init(jax.random.key(seed), tokens)["params"]
+        params = model.init(jax.random.key(seed), *init_args)["params"]
     if quantize:
         if quantize != "int8":
             raise ValueError(f"unsupported quantization {quantize!r} (int8)")
@@ -159,6 +236,8 @@ def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
         # Weight-only int8: halves HBM bytes per decoded token; generate()
         # dequantizes inside the jit so the widening fuses into matmuls.
         params = quantize_params(params)
+    if seq2seq:
+        return Seq2SeqGenerationService(model, params)
     return GenerationService(model, params)
 
 

@@ -212,8 +212,10 @@ class T5(nn.Module):
         )
 
     @staticmethod
-    def _pad_bias(source, source_mask):
-        b, src_len = source.shape
+    def _pad_bias(shape_source, source_mask):
+        """Encoder-padding additive bias from any [b, src_len]-shaped array
+        (source tokens or encoded activations' leading dims)."""
+        b, src_len = shape_source.shape[0], shape_source.shape[1]
         if source_mask is None:
             source_mask = jnp.ones((b, src_len), dtype=bool)
         return jnp.where(source_mask, 0.0, -1e30)[:, None, None, :]
@@ -241,12 +243,7 @@ class T5(nn.Module):
         the self-attention KV cache; ``step`` (traced scalar) positions
         the relative bias row, ``max_decode_len`` sizes the cache.
         """
-        b = encoded.shape[0]
-        src_mask = (
-            source_mask if source_mask is not None
-            else jnp.ones((b, encoded.shape[1]), dtype=bool)
-        )
-        pad = jnp.where(src_mask, 0.0, -1e30)[:, None, None, :]
+        pad = self._pad_bias(encoded, source_mask)
         y = self.embed(target)
         if decode:
             if step is None or max_decode_len is None:

@@ -102,6 +102,76 @@ def test_serve_from_checkpoint(tmp_path):
     assert len(out[0]) == 3
 
 
+def test_serve_seq2seq_model():
+    """T5 serving: `tokens` rows are sources, response is the generated
+    target — same HTTP contract, routed to the seq2seq service."""
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.models.generate import generate_seq2seq
+    from kubeflow_tpu.models.serve import (
+        Seq2SeqGenerationService,
+        create_app,
+        load_service,
+    )
+
+    svc = load_service("t5_debug")
+    assert isinstance(svc, Seq2SeqGenerationService)
+    client = Client(create_app(svc, model_name="t5_debug"))
+    rows = [[5, 9, 2, 7], [3, 4]]
+    resp = client.post("/v1/generate", json={
+        "tokens": rows, "max_new_tokens": 6,
+    })
+    assert resp.status_code == 200, resp.get_data(as_text=True)
+    got = resp.get_json()["tokens"]
+    src = jnp.array([[5, 9, 2, 7], [3, 4, 0, 0]], jnp.int32)
+    mask = jnp.array([[1, 1, 1, 1], [1, 1, 0, 0]], bool)
+    want = generate_seq2seq(
+        svc.model, svc.params, src, source_mask=mask, max_new_tokens=6,
+    )
+    assert got == jax.device_get(want).tolist()
+
+
+def test_serve_seq2seq_request_limits():
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.models.serve import create_app, load_service
+
+    svc = load_service("t5_debug")
+    client = Client(create_app(svc, model_name="t5_debug"))
+    r = client.post("/v1/generate", json={
+        "tokens": [[5]], "max_new_tokens": 100_000_000,
+    })
+    assert r.status_code == 400
+    assert "limit" in r.get_json()["log"]
+    r = client.post("/v1/generate", json={
+        "tokens": [list(range(1, 60)) * 100], "max_new_tokens": 2,
+    })
+    assert r.status_code == 400
+
+
+def test_serve_max_seq_len_rejected_for_seq2seq():
+    import pytest as _pytest
+
+    from kubeflow_tpu.models.serve import load_service
+
+    with _pytest.raises(ValueError, match="max_seq_len"):
+        load_service("t5_debug", max_seq_len=512)
+
+
+def test_serve_seq2seq_int8():
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.models.serve import create_app, load_service
+
+    svc = load_service("t5_debug", quantize="int8")
+    client = Client(create_app(svc, model_name="t5_debug"))
+    resp = client.post("/v1/generate", json={
+        "tokens": [[5, 9, 2]], "max_new_tokens": 4,
+    })
+    assert resp.status_code == 200, resp.get_data(as_text=True)
+    assert len(resp.get_json()["tokens"][0]) == 4
+
+
 def test_serve_missing_checkpoint_raises(tmp_path):
     from kubeflow_tpu.models.serve import load_service
 
