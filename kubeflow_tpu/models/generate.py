@@ -219,3 +219,123 @@ def generate_seq2seq(model, params, source: jax.Array, *,
         step_fn, carry, jnp.arange(1, max_new_tokens, dtype=jnp.int32)
     )
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "beams", "bos_token",
+                     "eos_token", "length_penalty"),
+)
+def beam_search_seq2seq(model, params, source: jax.Array, *,
+                        source_mask: Optional[jax.Array] = None,
+                        max_new_tokens: int = 32,
+                        beams: int = 4,
+                        bos_token: int = 0,
+                        eos_token: int = 1,
+                        length_penalty: float = 0.6) -> jax.Array:
+    """Beam search for encoder-decoder models, jit end-to-end.
+
+    The beam axis folds into the batch axis (``b*beams`` rows share one
+    cached decoder), each step expands every live beam over the vocab and
+    keeps the ``beams`` best by score; the KV cache rows are re-gathered
+    to follow their parent beam (one ``take`` per step — the scan stays a
+    single compiled program).  Finished beams (emitted EOS) freeze: they
+    only continue with EOS at zero added score.  Final ranking uses GNMT
+    length normalization ``score / ((5+len)/6)^length_penalty``.
+
+    Returns [batch, max_new_tokens] token ids of the best beam.
+    """
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    b, src_len = source.shape
+    if source_mask is None:
+        source_mask = jnp.ones((b, src_len), dtype=bool)
+    source_mask = source_mask.astype(bool)
+
+    # Encode once, then tile to the beam-folded batch.
+    encoded = model.apply({"params": params}, source, source_mask,
+                          method="encode")
+    encoded = jnp.repeat(encoded, beams, axis=0)          # [b*beams, S, d]
+    mask_r = jnp.repeat(source_mask, beams, axis=0)
+    cache_len = max_new_tokens
+
+    tok0 = jnp.full((b * beams, 1), bos_token, jnp.int32)
+    logits, state = model.apply(
+        {"params": params}, encoded, tok0,
+        source_mask=mask_r, decode=True,
+        step=jnp.zeros((), jnp.int32), max_decode_len=cache_len,
+        mutable=["cache"], method="decode",
+    )
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    vocab = logp0.shape[-1]
+    logp0 = logp0.reshape(b, beams, vocab)[:, 0]          # beams identical
+    # Seed: the top `beams` first tokens.
+    scores, first = jax.lax.top_k(logp0, beams)           # [b, beams]
+    first = first.astype(jnp.int32)
+    alive = first != eos_token                            # [b, beams]
+
+    def step_fn(carry, i):
+        cache, token, scores, alive = carry
+        logits, new_state = model.apply(
+            {"params": params, "cache": cache}, encoded,
+            token.reshape(b * beams, 1),
+            source_mask=mask_r, decode=True,
+            step=i, max_decode_len=cache_len,
+            mutable=["cache"], method="decode",
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).reshape(b, beams, vocab)
+        # Frozen beams may only emit EOS, at no score change.
+        eos_only = jnp.full((vocab,), -jnp.inf).at[eos_token].set(0.0)
+        logp = jnp.where(alive[..., None], logp, eos_only[None, None])
+        total = scores[..., None] + logp                  # [b, beams, V]
+        flat_scores, flat_idx = jax.lax.top_k(
+            total.reshape(b, beams * vocab), beams
+        )
+        parent = (flat_idx // vocab).astype(jnp.int32)    # [b, beams]
+        token = (flat_idx % vocab).astype(jnp.int32)
+        # Re-gather cache rows to follow the surviving beams' parents.
+        gather = (jnp.arange(b)[:, None] * beams + parent).reshape(-1)
+        cache = jax.tree.map(
+            lambda x: jnp.take(x, gather, axis=0)
+            if hasattr(x, "ndim") and x.ndim > 0 and x.shape[0] == b * beams
+            else x,
+            new_state["cache"],
+        )
+        alive = jnp.take_along_axis(alive, parent, axis=1) & (
+            token != eos_token
+        )
+        return (cache, token, flat_scores, alive), (token, parent)
+
+    carry = (state["cache"], first, scores, alive)
+    (cache, token, scores, alive), (toks, parents) = jax.lax.scan(
+        step_fn, carry, jnp.arange(1, max_new_tokens, dtype=jnp.int32)
+    )
+
+    # Backtrack the parent pointers into full sequences [b, beams, T].
+    def back(carry, tp):
+        beam_idx = carry
+        tok_t, parent_t = tp
+        tok = jnp.take_along_axis(tok_t, beam_idx, axis=1)
+        beam_idx = jnp.take_along_axis(parent_t, beam_idx, axis=1)
+        return beam_idx, tok
+
+    beam_idx0 = jnp.broadcast_to(jnp.arange(beams)[None], (b, beams))
+    beam_idx, rev = jax.lax.scan(
+        back, beam_idx0, (toks, parents), reverse=True
+    )
+    first_tok = jnp.take_along_axis(first, beam_idx, axis=1)
+    seqs = jnp.concatenate(
+        [first_tok[:, :, None], jnp.moveaxis(rev, 0, 2)], axis=2
+    )                                                     # [b, beams, T]
+    # GNMT length normalization over the effective (pre-EOS) length.
+    lengths = jnp.sum(
+        jnp.cumprod(seqs != eos_token, axis=2), axis=2
+    ) + 1.0
+    norm = ((5.0 + lengths) / 6.0) ** length_penalty
+    best = jnp.argmax(scores / norm, axis=1)              # [b]
+    return jnp.take_along_axis(
+        seqs, best[:, None, None], axis=1
+    )[:, 0]

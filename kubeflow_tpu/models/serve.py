@@ -32,15 +32,19 @@ import jax.numpy as jnp
 
 
 def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
-                      limit_new, limit_source, top_k, eos_token):
+                      limit_new, limit_source, top_k, eos_token,
+                      limit_rows: int = 64):
     """Shared request validation + right-padding for both services.
-    Returns (tokens [b, longest] int32, mask [b, longest] bool, n)."""
+    Returns (tokens [b, longest] int32, mask [b, longest] bool, n).
+
+    Size limits reject BEFORE the O(total tokens) Python scan — an
+    oversized request must not cost a 50M-iteration loop to 400."""
     if not rows or not all(isinstance(r, list) and r for r in rows):
         raise ValueError("tokens must be a non-empty list of non-empty rows")
-    for r in rows:
-        for t in r:
-            if not isinstance(t, int) or not 0 <= t < vocab:
-                raise ValueError(f"token {t!r} outside [0, {vocab})")
+    if limit_rows and len(rows) > limit_rows:
+        raise ValueError(
+            f"batch of {len(rows)} rows exceeds the service limit {limit_rows}"
+        )
     n = default_max if max_new_tokens is None else max_new_tokens
     if not isinstance(n, int) or isinstance(n, bool) or n < 1:
         raise ValueError(f"max_new_tokens must be a positive int, got {n!r}")
@@ -53,6 +57,10 @@ def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
         raise ValueError(
             f"input length {longest} exceeds the service limit {limit_source}"
         )
+    for r in rows:
+        for t in r:
+            if not isinstance(t, int) or not 0 <= t < vocab:
+                raise ValueError(f"token {t!r} outside [0, {vocab})")
     if top_k is not None and (not isinstance(top_k, int)
                               or isinstance(top_k, bool) or top_k < 1):
         raise ValueError(f"top_k must be a positive int, got {top_k!r}")
@@ -186,9 +194,11 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     return app
 
 
-def load_service(model_name: str, *, checkpoint_dir: Optional[str] = None,
-                 max_seq_len: Optional[int] = None,
-                 seed: int = 0, quantize: Optional[str] = None) -> GenerationService:
+def load_service(
+    model_name: str, *, checkpoint_dir: Optional[str] = None,
+    max_seq_len: Optional[int] = None,
+    seed: int = 0, quantize: Optional[str] = None,
+) -> "GenerationService | Seq2SeqGenerationService":
     """Build the model; restore params from a train-loop checkpoint when
     given, else random-init (useful for smoke/serving-path tests)."""
     from kubeflow_tpu.models import create_model

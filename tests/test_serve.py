@@ -147,6 +147,12 @@ def test_serve_seq2seq_request_limits():
         "tokens": [list(range(1, 60)) * 100], "max_new_tokens": 2,
     })
     assert r.status_code == 400
+    # Batch-axis limit: memory scales with rows too.
+    r = client.post("/v1/generate", json={
+        "tokens": [[5]] * 1000, "max_new_tokens": 2,
+    })
+    assert r.status_code == 400
+    assert "rows" in r.get_json()["log"]
 
 
 def test_serve_max_seq_len_rejected_for_seq2seq():

@@ -175,3 +175,67 @@ def test_seq2seq_eos_padding(model_and_params):
         hits = np.where(row == 1)[0]
         if hits.size:
             assert (row[hits[0]:] == 1).all()
+
+
+def test_beam_search_beam1_equals_greedy(model_and_params):
+    """beams=1 must reproduce greedy decoding exactly — pins the cache
+    re-gather, parent backtracking, and EOS freezing machinery."""
+    from kubeflow_tpu.models.generate import (
+        beam_search_seq2seq,
+        generate_seq2seq,
+    )
+
+    model, params = model_and_params
+    src = jax.random.randint(jax.random.key(3), (2, 9), 2, 128)
+    greedy = generate_seq2seq(model, params, src, max_new_tokens=10)
+    beam1 = beam_search_seq2seq(
+        model, params, src, max_new_tokens=10, beams=1
+    )
+    assert (greedy == beam1).all(), (greedy, beam1)
+
+
+def _sequence_logprob(model, params, src, seqs, eos=1):
+    """Sum log p(token_t | prefix) over each sequence up to+incl first EOS."""
+    b, t = seqs.shape
+    bos = jnp.zeros((b, 1), jnp.int32)
+    tgt_in = jnp.concatenate([bos, seqs[:, :-1]], axis=1)
+    logits = model.apply({"params": params}, src, tgt_in)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    tok_lp = jnp.take_along_axis(logp, seqs[..., None], axis=-1)[..., 0]
+    # Count tokens up to and including the first EOS.
+    before = jnp.cumprod(seqs != eos, axis=1)
+    keep = jnp.concatenate(
+        [jnp.ones((b, 1)), before[:, :-1].astype(jnp.float32)], axis=1
+    )
+    return jnp.sum(tok_lp * keep, axis=1)
+
+
+def test_beam_search_finds_higher_scoring_sequences(model_and_params):
+    from kubeflow_tpu.models.generate import beam_search_seq2seq
+
+    model, params = model_and_params
+    src = jax.random.randint(jax.random.key(4), (3, 9), 2, 128)
+    b1 = beam_search_seq2seq(model, params, src, max_new_tokens=8,
+                             beams=1, length_penalty=0.0)
+    b4 = beam_search_seq2seq(model, params, src, max_new_tokens=8,
+                             beams=4, length_penalty=0.0)
+    s1 = _sequence_logprob(model, params, src, b1)
+    s4 = _sequence_logprob(model, params, src, b4)
+    # Wider search never scores worse on this model (and the scores come
+    # from an independent full-forward rescoring, pinning the beam
+    # bookkeeping against the actual model distribution).
+    assert (s4 >= s1 - 1e-4).all(), (s1, s4)
+
+
+def test_beam_search_eos_padding(model_and_params):
+    from kubeflow_tpu.models.generate import beam_search_seq2seq
+
+    model, params = model_and_params
+    src = jnp.ones((2, 6), jnp.int32)
+    out = beam_search_seq2seq(model, params, src, max_new_tokens=8, beams=3)
+    arr = np.asarray(out)
+    assert arr.shape == (2, 8)
+    for row in arr:
+        hits = np.where(row == 1)[0]
+        if hits.size:
+            assert (row[hits[0]:] == 1).all()
