@@ -297,12 +297,21 @@ def beam_search_seq2seq(model, params, source: jax.Array, *,
         parent = (flat_idx // vocab).astype(jnp.int32)    # [b, beams]
         token = (flat_idx % vocab).astype(jnp.int32)
         # Re-gather cache rows to follow the surviving beams' parents.
+        # Cross-attention K/V are identical across a batch group's beams
+        # (projected from the repeated encoder output), so gathering them
+        # would be a semantic no-op costing a full HBM copy per step —
+        # skip them.
         gather = (jnp.arange(b)[:, None] * beams + parent).reshape(-1)
-        cache = jax.tree.map(
-            lambda x: jnp.take(x, gather, axis=0)
-            if hasattr(x, "ndim") and x.ndim > 0 and x.shape[0] == b * beams
-            else x,
-            new_state["cache"],
+
+        def regather(path, x):
+            if any("cached_cross" in str(getattr(p, "key", "")) for p in path):
+                return x
+            if hasattr(x, "ndim") and x.ndim > 0 and x.shape[0] == b * beams:
+                return jnp.take(x, gather, axis=0)
+            return x
+
+        cache = jax.tree_util.tree_map_with_path(
+            regather, new_state["cache"]
         )
         alive = jnp.take_along_axis(alive, parent, axis=1) & (
             token != eos_token
@@ -330,10 +339,14 @@ def beam_search_seq2seq(model, params, source: jax.Array, *,
     seqs = jnp.concatenate(
         [first_tok[:, :, None], jnp.moveaxis(rev, 0, 2)], axis=2
     )                                                     # [b, beams, T]
-    # GNMT length normalization over the effective (pre-EOS) length.
-    lengths = jnp.sum(
-        jnp.cumprod(seqs != eos_token, axis=2), axis=2
-    ) + 1.0
+    # GNMT length normalization over the effective length: tokens up to
+    # and including the first EOS, capped at T for beams that never
+    # finished (the uncapped sum+1 would credit them a phantom token and
+    # skew the normalized ranking toward unfinished beams).
+    lengths = jnp.minimum(
+        jnp.sum(jnp.cumprod(seqs != eos_token, axis=2), axis=2) + 1.0,
+        float(seqs.shape[2]),
+    )
     norm = ((5.0 + lengths) / 6.0) ** length_penalty
     best = jnp.argmax(scores / norm, axis=1)              # [b]
     return jnp.take_along_axis(

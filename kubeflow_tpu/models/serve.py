@@ -76,10 +76,12 @@ def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
 
 
 class GenerationService:
-    def __init__(self, model, params, *, default_max_new_tokens: int = 32):
+    def __init__(self, model, params, *, default_max_new_tokens: int = 32,
+                 max_batch_rows: int = 64):
         self.model = model
         self.params = params
         self.default_max_new_tokens = default_max_new_tokens
+        self.max_batch_rows = max_batch_rows
         # generate() donates nothing but jit compilation is per-shape; a
         # lock keeps concurrent requests from racing device memory on tiny
         # single-chip deployments.
@@ -99,6 +101,7 @@ class GenerationService:
             limit_new=self.model.cfg.max_seq_len,
             limit_source=self.model.cfg.max_seq_len,
             top_k=top_k, eos_token=eos_token,
+            limit_rows=self.max_batch_rows,
         )
         with self._lock:
             out = generate(
@@ -115,7 +118,8 @@ class Seq2SeqGenerationService:
     target continuation (T5 convention: BOS = pad id 0, EOS = 1)."""
 
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
-                 max_target_len: int = 512, max_source_len: int = 4096):
+                 max_target_len: int = 512, max_source_len: int = 4096,
+                 max_batch_rows: int = 64):
         self.model = model
         self.params = params
         self.default_max_new_tokens = default_max_new_tokens
@@ -124,6 +128,7 @@ class Seq2SeqGenerationService:
         # caches (and the O(S^2) encoder) arbitrarily.
         self.max_target_len = max_target_len
         self.max_source_len = max_source_len
+        self.max_batch_rows = max_batch_rows
         self._lock = threading.Lock()
 
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
@@ -138,6 +143,7 @@ class Seq2SeqGenerationService:
             limit_new=self.max_target_len,
             limit_source=self.max_source_len,
             top_k=top_k, eos_token=eos_token,
+            limit_rows=self.max_batch_rows,
         )
         with self._lock:
             out = generate_seq2seq(

@@ -66,6 +66,17 @@ def test_llama_multihost_notebook_runs_tiny(devices8, tmp_path):
     exec(compile(src, "nb05", "exec"), {})
 
 
+def test_packing_int8_beam_notebook_runs_tiny(devices8):
+    src = _code("07_packing_int8_beam.ipynb")
+    src = src.replace('CFG = "llama_125m"', 'CFG = "llama_debug"')
+    src = src.replace("SEQ = 512", "SEQ = 64")
+    src = src.replace("STEPS = 3", "STEPS = 1")
+    src = src.replace('T5_CONFIGS["t5_small"]', 'T5_CONFIGS["t5_debug"]')
+    src = src.replace("max_new_tokens=12", "max_new_tokens=4")
+    src = src.replace("(2, 24)", "(2, 8)")
+    exec(compile(src, "nb07", "exec"), {})
+
+
 def test_pytorch_xla_notebook_structure():
     src = _code("03_bert_finetune_pytorch_xla.ipynb")
     for needle in ("torch_xla", "xla_device", "AdamW", "mark_step"):
