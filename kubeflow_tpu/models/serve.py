@@ -204,6 +204,7 @@ def load_service(
     model_name: str, *, checkpoint_dir: Optional[str] = None,
     max_seq_len: Optional[int] = None,
     seed: int = 0, quantize: Optional[str] = None,
+    mesh_spec: Optional[str] = None,
 ) -> "GenerationService | Seq2SeqGenerationService":
     """Build the model; restore params from a train-loop checkpoint when
     given, else random-init (useful for smoke/serving-path tests)."""
@@ -221,6 +222,21 @@ def load_service(
     # Encoder-decoder models expose encode/decode apply methods and init
     # with a (source, target) pair; decoder-only models init with tokens.
     seq2seq = hasattr(model, "encode")
+    mesh = None
+    if mesh_spec:
+        # Validate the SPMD flags BEFORE the (potentially multi-GB)
+        # checkpoint restore — a typo'd spec must fail in milliseconds.
+        if seq2seq:
+            raise ValueError("--mesh serving currently supports the "
+                             "decoder-only families")
+        if quantize:
+            raise ValueError("--mesh with --quantize is not supported yet "
+                             "(QTensor leaves carry their own layouts)")
+        from kubeflow_tpu.parallel.sharding import rules_for_model
+        from kubeflow_tpu.train.run import parse_mesh
+
+        rules = rules_for_model(model)
+        mesh = parse_mesh(mesh_spec, len(jax.devices()))
     tokens = jnp.ones((1, 8), jnp.int32)
     init_args = (tokens, jnp.ones((1, 4), jnp.int32)) if seq2seq else (tokens,)
     if checkpoint_dir:
@@ -252,6 +268,14 @@ def load_service(
         # Weight-only int8: halves HBM bytes per decoded token; generate()
         # dequantizes inside the jit so the widening fuses into matmuls.
         params = quantize_params(params)
+    if mesh is not None:
+        # SPMD serving: place params sharded over the mesh by the model
+        # family's partition rules; the jitted generate path then runs
+        # tensor-parallel, XLA inserting the collectives (sharding follows
+        # the placed operands — no generate() changes needed).
+        from kubeflow_tpu.parallel.sharding import shard_params
+
+        params = shard_params(params, mesh, rules)
     if seq2seq:
         return Seq2SeqGenerationService(model, params)
     return GenerationService(model, params)
@@ -265,11 +289,15 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--quantize", choices=["int8"], default=None,
                     help="weight-only int8 serving (halved HBM per token)")
+    ap.add_argument("--mesh", default=None,
+                    help="SPMD serving: shard params over a mesh, e.g. "
+                         "'tp=4' (tensor parallel across 4 chips)")
     args = ap.parse_args(argv)
 
     service = load_service(
         args.model, checkpoint_dir=args.checkpoint_dir,
         max_seq_len=args.max_seq_len, quantize=args.quantize,
+        mesh_spec=args.mesh,
     )
     app = create_app(service, model_name=args.model)
     from werkzeug.serving import make_server

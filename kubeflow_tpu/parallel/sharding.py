@@ -70,6 +70,26 @@ def resnet_rules() -> Rules:
     )
 
 
+def rules_for_model(model) -> Rules:
+    """Partition rules for a model-zoo instance, by family.
+
+    Explicit registry rather than a regex guess: an unknown family must
+    raise (a silent catch-all would replicate every weight — ``tp=8``
+    would 'work' with zero parallelism)."""
+    name = type(model).__name__
+    table = {
+        "Llama": llama_rules,
+        "ViT": vit_rules,
+        "ResNet": resnet_rules,
+    }
+    if name not in table:
+        raise ValueError(
+            f"no partition rules registered for model family {name!r}; "
+            f"known: {sorted(table)}"
+        )
+    return table[name]()
+
+
 def _path_str(path) -> str:
     parts = []
     for p in path:

@@ -20,6 +20,9 @@ import jax
 
 
 def parse_mesh(spec: str, n_devices: int):
+    """'auto' or 'tp=4,fsdp=2' → Mesh.  Raises ValueError on a bad spec
+    (library error contract — callers like serve.load_service handle it;
+    the CLI surfaces it as a clean exit via main's argparse error)."""
     from kubeflow_tpu.parallel import default_mesh_config, make_mesh
     from kubeflow_tpu.parallel.mesh import MeshConfig
 
@@ -31,8 +34,13 @@ def parse_mesh(spec: str, n_devices: int):
             continue
         key, _, value = part.partition("=")
         if key not in MeshConfig.__dataclass_fields__:
-            raise SystemExit(f"unknown mesh axis {key!r}")
-        axes[key] = int(value)
+            raise ValueError(f"unknown mesh axis {key!r} in {spec!r}")
+        try:
+            axes[key] = int(value)
+        except ValueError:
+            raise ValueError(
+                f"mesh axis {key!r} needs an integer, got {value!r}"
+            ) from None
     return make_mesh(**axes)
 
 
@@ -187,7 +195,10 @@ def main(argv: Optional[list] = None) -> int:
     from kubeflow_tpu.parallel.context import global_mesh
     from kubeflow_tpu.train.loop import LoopConfig, train_loop
 
-    mesh = parse_mesh(args.mesh, len(jax.devices()))
+    try:
+        mesh = parse_mesh(args.mesh, len(jax.devices()))
+    except ValueError as e:
+        ap.error(str(e))  # clean CLI exit, not a traceback
     print(f"devices={len(jax.devices())} mesh={dict(mesh.shape)}", flush=True)
 
     build = build_lm if args.task == "lm" else build_image

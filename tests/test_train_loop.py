@@ -211,8 +211,10 @@ def test_trainer_cli_packed_smoke(devices8, tmp_path):
 def test_trainer_cli_rejects_bad_mesh():
     from kubeflow_tpu.train import run as trainer
 
-    with pytest.raises(SystemExit):
+    with pytest.raises(ValueError, match="unknown mesh axis"):
         trainer.parse_mesh("bogus=2", 8)
+    with pytest.raises(ValueError, match="integer"):
+        trainer.parse_mesh("tp=two", 8)
 
 
 def test_profile_steps_produces_trace(tmp_path):
