@@ -155,13 +155,44 @@ class Seq2SeqGenerationService:
 
 
 def create_app(service: GenerationService, *, model_name: str = "model"):
+    import time
+
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Histogram,
+        generate_latest,
+    )
+
     from kubeflow_tpu.platform.web.framework import App, HttpError, success
 
     app = App("model-serve")
+    # Per-app registry: one process can serve several models/tests without
+    # duplicate-timeseries collisions.
+    registry = CollectorRegistry()
+    requests_total = Counter(
+        "generate_requests_total", "Generation requests by outcome",
+        ["outcome"], registry=registry,
+    )
+    request_seconds = Histogram(
+        "generate_request_seconds",
+        "Wall time of /v1/generate requests (includes any compile)",
+        buckets=(0.05, 0.2, 1, 5, 20, 60, 180),
+        registry=registry,
+    )
+    tokens_total = Counter(
+        "generate_tokens_total", "Tokens generated", registry=registry,
+    )
 
     @app.route("/healthz")
     def healthz(request):
         return success({"healthy": True})
+
+    @app.route("/metrics")
+    def metrics(request):
+        from werkzeug.wrappers import Response
+
+        return Response(generate_latest(registry), mimetype="text/plain")
 
     @app.route("/v1/model")
     def model_info(request):
@@ -177,6 +208,7 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     @app.route("/v1/generate", methods=["POST"])
     def generate(request):
         body = request.get_json(force=True, silent=True) or {}
+        t0 = time.perf_counter()
         try:
             # int()/float() coercions raise TypeError on null/list inputs —
             # every malformed field must land as a 400, not a 500.
@@ -194,7 +226,14 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
                 **kwargs,
             )
         except (ValueError, TypeError) as e:
+            requests_total.labels(outcome="invalid").inc()
             raise HttpError(400, str(e)) from None
+        except Exception:
+            requests_total.labels(outcome="error").inc()
+            raise
+        requests_total.labels(outcome="ok").inc()
+        request_seconds.observe(time.perf_counter() - t0)
+        tokens_total.inc(sum(len(r) for r in tokens))
         return success({"tokens": tokens})
 
     return app
@@ -242,22 +281,34 @@ def load_service(
     if checkpoint_dir:
         from kubeflow_tpu.train.checkpoint import CheckpointManager
 
-        with CheckpointManager(checkpoint_dir) as mgr:
-            # Params-only restore: serving doesn't know (or need) the
-            # optimizer the checkpoint was trained with.
-            restored = mgr.restore_params()
-        if restored is None:
-            raise FileNotFoundError(
-                f"no checkpoint found under {checkpoint_dir}"
-            )
         # Shape-only init: the dtype/structure template costs nothing when
         # the checkpoint supplies every value.
         template = jax.eval_shape(
             lambda: model.init(jax.random.key(seed), *init_args)
         )["params"]
-        params = jax.tree.map(
-            lambda t, r: jnp.asarray(r, t.dtype), template, restored
-        )
+        if mesh is not None:
+            # Restore DIRECTLY into the mesh-sharded layout: a model
+            # larger than one chip's HBM must never materialize
+            # replicated on device 0 first.
+            from jax.sharding import NamedSharding
+
+            from kubeflow_tpu.parallel.sharding import tree_specs
+
+            specs = tree_specs(template, rules)
+            template = jax.tree.map(
+                lambda t, s: jax.ShapeDtypeStruct(
+                    t.shape, t.dtype, sharding=NamedSharding(mesh, s)
+                ),
+                template, specs,
+            )
+        with CheckpointManager(checkpoint_dir) as mgr:
+            # Params-only restore: serving doesn't know (or need) the
+            # optimizer the checkpoint was trained with.
+            params = mgr.restore_params(template=template)
+        if params is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {checkpoint_dir}"
+            )
     else:
         params = model.init(jax.random.key(seed), *init_args)["params"]
     if quantize:
@@ -294,11 +345,14 @@ def main(argv=None) -> int:
                          "'tp=4' (tensor parallel across 4 chips)")
     args = ap.parse_args(argv)
 
-    service = load_service(
-        args.model, checkpoint_dir=args.checkpoint_dir,
-        max_seq_len=args.max_seq_len, quantize=args.quantize,
-        mesh_spec=args.mesh,
-    )
+    try:
+        service = load_service(
+            args.model, checkpoint_dir=args.checkpoint_dir,
+            max_seq_len=args.max_seq_len, quantize=args.quantize,
+            mesh_spec=args.mesh,
+        )
+    except ValueError as e:
+        ap.error(str(e))  # clean CLI exit, not a traceback
     app = create_app(service, model_name=args.model)
     from werkzeug.serving import make_server
 

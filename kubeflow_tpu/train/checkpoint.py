@@ -105,13 +105,19 @@ class CheckpointManager:
             batch_stats=restored.get("batch_stats", template.batch_stats),
         )
 
-    def restore_params(self, *, step: Optional[int] = None):
+    def restore_params(self, *, step: Optional[int] = None, template=None):
         """Restore only the params subtree, without needing the training
         optimizer to rebuild the full TrainState template — the serving
         path (models/serve.py) reads checkpoints written by any optimizer.
         Non-params subtrees (opt_state can be 2x params for Adam) are
         PLACEHOLDER'd so they are neither read from disk nor held in RAM.
-        Returns None when no checkpoint exists."""
+        Returns None when no checkpoint exists.
+
+        ``template``: optional abstract params pytree (shape/dtype, and
+        optionally sharding) — leaves restore directly into that
+        dtype/placement.  SPMD serving passes mesh-sharded leaves here so
+        a model larger than one device's HBM never materializes
+        replicated."""
         import jax
 
         step = step if step is not None else self.latest_step()
@@ -125,12 +131,20 @@ class CheckpointManager:
                 return self._ocp.PLACEHOLDER
             return jax.ShapeDtypeStruct(node.shape, node.dtype)
 
-        target = {
-            key: (
-                jax.tree.map(lambda n: abstract(key == "params", n), sub)
-            )
-            for key, sub in tree.items()
-        }
+        target = {}
+        for key, sub in tree.items():
+            if key == "params" and template is not None:
+                target[key] = jax.tree.map(
+                    lambda t: jax.ShapeDtypeStruct(
+                        t.shape, t.dtype,
+                        sharding=getattr(t, "sharding", None),
+                    ),
+                    template,
+                )
+            else:
+                target[key] = jax.tree.map(
+                    lambda n: abstract(key == "params", n), sub
+                )
         restored = self._mgr.restore(
             int(step), args=self._ocp.args.PyTreeRestore(target)
         )

@@ -178,6 +178,16 @@ def test_serve_seq2seq_int8():
     assert len(resp.get_json()["tokens"][0]) == 4
 
 
+def test_serve_metrics_endpoint(client):
+    client.post("/v1/generate", json={"tokens": [[5, 9]], "max_new_tokens": 2})
+    client.post("/v1/generate", json={"tokens": []})  # invalid
+    text = client.get("/metrics").get_data(as_text=True)
+    assert 'generate_requests_total{outcome="ok"} 1.0' in text
+    assert 'generate_requests_total{outcome="invalid"} 1.0' in text
+    assert "generate_tokens_total 2.0" in text
+    assert "generate_request_seconds_bucket" in text
+
+
 def test_serve_spmd_mesh_matches_single_device(devices8):
     """--mesh serving: params sharded tensor-parallel over the mesh produce
     the same tokens as the unsharded service."""
@@ -194,6 +204,46 @@ def test_serve_spmd_mesh_matches_single_device(devices8):
 
     leaf = jax.tree.leaves(spmd.params)[0]
     assert len(leaf.sharding.device_set) > 1
+
+
+def test_serve_spmd_restores_checkpoint_sharded(devices8, tmp_path):
+    """--mesh + --checkpoint-dir restores DIRECTLY into the sharded layout
+    (no replicated staging) and serves the same tokens as unsharded."""
+    import dataclasses
+
+    import optax
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.models.serve import load_service
+    from kubeflow_tpu.train import create_train_state, make_lm_train_step
+    from kubeflow_tpu.train.loop import LoopConfig, train_loop
+
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=64)
+    model = Llama(cfg)
+    state = create_train_state(
+        jax.random.key(0), model, jnp.ones((2, 64), jnp.int32),
+        optax.adamw(1e-3),
+    )
+    step = jax.jit(make_lm_train_step())
+
+    def batches():
+        while True:
+            yield jax.random.randint(jax.random.key(1), (2, 64), 0, 256)
+
+    train_loop(state, step, batches(), LoopConfig(
+        total_steps=2, log_every=0, checkpoint_dir=str(tmp_path),
+        checkpoint_every=1,
+    ))
+    plain = load_service("llama_debug", max_seq_len=64,
+                         checkpoint_dir=str(tmp_path))
+    spmd = load_service("llama_debug", max_seq_len=64,
+                        checkpoint_dir=str(tmp_path), mesh_spec="tp=2,fsdp=4")
+    leaf = jax.tree.leaves(spmd.params)[0]
+    assert len(leaf.sharding.device_set) > 1
+    rows = [[5, 9, 2]]
+    assert plain.generate(rows, max_new_tokens=4) == spmd.generate(
+        rows, max_new_tokens=4
+    )
 
 
 def test_serve_mesh_rejects_unsupported_combos():
