@@ -270,13 +270,8 @@ def beam_search_seq2seq(model, params, source: jax.Array, *,
     logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
     vocab = logp0.shape[-1]
     logp0 = logp0.reshape(b, beams, vocab)[:, 0]          # beams identical
-    # Seed: the top `beams` first tokens.
-    scores, first = jax.lax.top_k(logp0, beams)           # [b, beams]
-    first = first.astype(jnp.int32)
-    alive = first != eos_token                            # [b, beams]
 
-    def step_fn(carry, i):
-        cache, token, scores, alive = carry
+    def step_apply(cache, token, i):
         logits, new_state = model.apply(
             {"params": params, "cache": cache}, encoded,
             token.reshape(b * beams, 1),
@@ -287,9 +282,37 @@ def beam_search_seq2seq(model, params, source: jax.Array, *,
         logp = jax.nn.log_softmax(
             logits[:, -1].astype(jnp.float32), axis=-1
         ).reshape(b, beams, vocab)
-        # Frozen beams may only emit EOS, at no score change.
-        eos_only = jnp.full((vocab,), -jnp.inf).at[eos_token].set(0.0)
-        logp = jnp.where(alive[..., None], logp, eos_only[None, None])
+        return logp, new_state["cache"]
+
+    return _beam_loop(
+        step_apply, logp0, state["cache"], b=b, beams=beams, vocab=vocab,
+        eos_token=eos_token, length_penalty=length_penalty,
+        max_new_tokens=max_new_tokens,
+    )
+
+
+def _beam_loop(step_apply, logp0, cache0, *, b, beams, vocab, eos_token,
+               length_penalty, max_new_tokens):
+    """Shared beam machinery: seed from ``logp0`` [b, vocab], scan
+    ``step_apply(cache, token [b, beams], i) -> (logp [b, beams, vocab],
+    cache)`` steps with parent re-gather and EOS freezing, backtrack, and
+    rank with GNMT length normalization.  ``cache0`` must already be
+    beam-tiled ([b*beams] leading rows).  ``eos_token=None`` disables
+    freezing (pure max-score search)."""
+    scores, first = jax.lax.top_k(logp0, beams)           # [b, beams]
+    first = first.astype(jnp.int32)
+    alive = (
+        first != eos_token if eos_token is not None
+        else jnp.ones((b, beams), dtype=bool)
+    )
+
+    def step_fn(carry, i):
+        cache, token, scores, alive = carry
+        logp, cache = step_apply(cache, token, i)
+        if eos_token is not None:
+            # Frozen beams may only emit EOS, at no score change.
+            eos_only = jnp.full((vocab,), -jnp.inf).at[eos_token].set(0.0)
+            logp = jnp.where(alive[..., None], logp, eos_only[None, None])
         total = scores[..., None] + logp                  # [b, beams, V]
         flat_scores, flat_idx = jax.lax.top_k(
             total.reshape(b, beams * vocab), beams
@@ -310,15 +333,16 @@ def beam_search_seq2seq(model, params, source: jax.Array, *,
                 return jnp.take(x, gather, axis=0)
             return x
 
-        cache = jax.tree_util.tree_map_with_path(
-            regather, new_state["cache"]
-        )
-        alive = jnp.take_along_axis(alive, parent, axis=1) & (
-            token != eos_token
-        )
+        cache = jax.tree_util.tree_map_with_path(regather, cache)
+        if eos_token is not None:
+            alive = jnp.take_along_axis(alive, parent, axis=1) & (
+                token != eos_token
+            )
         return (cache, token, flat_scores, alive), (token, parent)
 
-    carry = (state["cache"], first, scores, alive)
+    carry = (cache0, first, scores, alive)
+    if max_new_tokens == 1:
+        return first[:, :1]
     (cache, token, scores, alive), (toks, parents) = jax.lax.scan(
         step_fn, carry, jnp.arange(1, max_new_tokens, dtype=jnp.int32)
     )
@@ -343,12 +367,97 @@ def beam_search_seq2seq(model, params, source: jax.Array, *,
     # and including the first EOS, capped at T for beams that never
     # finished (the uncapped sum+1 would credit them a phantom token and
     # skew the normalized ranking toward unfinished beams).
-    lengths = jnp.minimum(
-        jnp.sum(jnp.cumprod(seqs != eos_token, axis=2), axis=2) + 1.0,
-        float(seqs.shape[2]),
-    )
+    if eos_token is not None:
+        lengths = jnp.minimum(
+            jnp.sum(jnp.cumprod(seqs != eos_token, axis=2), axis=2) + 1.0,
+            float(seqs.shape[2]),
+        )
+    else:
+        lengths = jnp.full((b, beams), float(seqs.shape[2]))
     norm = ((5.0 + lengths) / 6.0) ** length_penalty
     best = jnp.argmax(scores / norm, axis=1)              # [b]
     return jnp.take_along_axis(
         seqs, best[:, None, None], axis=1
     )[:, 0]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "beams", "eos_token",
+                     "length_penalty"),
+)
+def beam_search(model, params, prompt: jax.Array, *,
+                prompt_mask: Optional[jax.Array] = None,
+                max_new_tokens: int = 32,
+                beams: int = 4,
+                eos_token: Optional[int] = None,
+                length_penalty: float = 0.6) -> jax.Array:
+    """Beam search for decoder-only models: one prefill over the prompt,
+    then the shared beam loop (cache tiled to b*beams rows, parent
+    re-gather per step).  Same prompt-padding contract as ``generate``.
+
+    Returns [batch, max_new_tokens] token ids of the best beam."""
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    b, prompt_len = prompt.shape
+    cache_len = prompt_len + max_new_tokens
+    if cache_len > model.cfg.max_seq_len:
+        raise ValueError(
+            f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"= {cache_len} exceeds max_seq_len {model.cfg.max_seq_len}"
+        )
+    if prompt_mask is None:
+        prompt_mask = jnp.ones((b, prompt_len), dtype=bool)
+    prompt_mask = prompt_mask.astype(bool)
+    positions = jnp.maximum(
+        jnp.cumsum(prompt_mask.astype(jnp.int32), axis=-1) - 1, 0
+    )
+    lengths = prompt_mask.sum(axis=-1).astype(jnp.int32)  # [b]
+    slot_valid = jnp.concatenate(
+        [prompt_mask,
+         jnp.ones((b, cache_len - prompt_len), dtype=bool)], axis=-1
+    )
+    pad_bias = jnp.where(slot_valid, 0.0, -1e30)[:, None, None, :]
+
+    # Prefill on the raw batch, then tile cache/bias/positions per beam.
+    logits, state = model.apply(
+        {"params": params}, prompt, positions=positions, decode=True,
+        mask_bias=pad_bias, token_mask=prompt_mask, cache_len=cache_len,
+        mutable=["cache"],
+    )
+    idx = jnp.broadcast_to(
+        (lengths - 1)[:, None, None], (b, 1, logits.shape[-1])
+    )
+    logp0 = jax.nn.log_softmax(
+        jnp.take_along_axis(logits, idx, axis=1)[:, 0].astype(jnp.float32),
+        axis=-1,
+    )                                                     # [b, vocab]
+    vocab = logp0.shape[-1]
+    cache0 = jax.tree.map(
+        lambda x: jnp.repeat(x, beams, axis=0)
+        if hasattr(x, "ndim") and x.ndim > 0 and x.shape[0] == b else x,
+        state["cache"],
+    )
+    pad_bias_r = jnp.repeat(pad_bias, beams, axis=0)
+    lengths_r = jnp.repeat(lengths, beams, axis=0)        # [b*beams]
+
+    def step_apply(cache, token, i):
+        # Scan step i feeds generated token i-1, at position lengths+i-1.
+        pos = (lengths_r + i - 1)[:, None]
+        logits, new_state = model.apply(
+            {"params": params, "cache": cache},
+            token.reshape(b * beams, 1),
+            positions=pos, decode=True, mask_bias=pad_bias_r,
+            cache_len=cache_len, mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).reshape(b, beams, vocab)
+        return logp, new_state["cache"]
+
+    return _beam_loop(
+        step_apply, logp0, cache0, b=b, beams=beams, vocab=vocab,
+        eos_token=eos_token, length_penalty=length_penalty,
+        max_new_tokens=max_new_tokens,
+    )

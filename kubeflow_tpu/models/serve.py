@@ -209,6 +209,12 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     def generate(request):
         body = request.get_json(force=True, silent=True) or {}
         t0 = time.perf_counter()
+        try:  # noqa: SIM105 — latency must cover every outcome
+            return _generate(body)
+        finally:
+            request_seconds.observe(time.perf_counter() - t0)
+
+    def _generate(body):
         try:
             # int()/float() coercions raise TypeError on null/list inputs —
             # every malformed field must land as a 400, not a 500.
@@ -232,7 +238,6 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
             requests_total.labels(outcome="error").inc()
             raise
         requests_total.labels(outcome="ok").inc()
-        request_seconds.observe(time.perf_counter() - t0)
         tokens_total.inc(sum(len(r) for r in tokens))
         return success({"tokens": tokens})
 
@@ -319,11 +324,11 @@ def load_service(
         # Weight-only int8: halves HBM bytes per decoded token; generate()
         # dequantizes inside the jit so the widening fuses into matmuls.
         params = quantize_params(params)
-    if mesh is not None:
-        # SPMD serving: place params sharded over the mesh by the model
-        # family's partition rules; the jitted generate path then runs
-        # tensor-parallel, XLA inserting the collectives (sharding follows
-        # the placed operands — no generate() changes needed).
+    if mesh is not None and not checkpoint_dir:
+        # SPMD serving, random-init path: place params sharded over the
+        # mesh by the family rules (the checkpoint path above already
+        # restored directly into the sharded layout); the jitted generate
+        # path then runs tensor-parallel, XLA inserting the collectives.
         from kubeflow_tpu.parallel.sharding import shard_params
 
         params = shard_params(params, mesh, rules)
@@ -351,7 +356,7 @@ def main(argv=None) -> int:
             max_seq_len=args.max_seq_len, quantize=args.quantize,
             mesh_spec=args.mesh,
         )
-    except ValueError as e:
+    except (ValueError, FileNotFoundError) as e:
         ap.error(str(e))  # clean CLI exit, not a traceback
     app = create_app(service, model_name=args.model)
     from werkzeug.serving import make_server

@@ -96,7 +96,10 @@ class CheckpointManager:
             _as_pytree(template),
         )
         restored = self._mgr.restore(
-            int(step), args=self._ocp.args.PyTreeRestore(abstract)
+            int(step),
+            args=self._ocp.args.PyTreeRestore(
+                abstract, restore_args=self._restore_args(abstract)
+            ),
         )
         return template.replace(
             step=restored["step"],
@@ -146,9 +149,28 @@ class CheckpointManager:
                     lambda n: abstract(key == "params", n), sub
                 )
         restored = self._mgr.restore(
-            int(step), args=self._ocp.args.PyTreeRestore(target)
+            int(step),
+            args=self._ocp.args.PyTreeRestore(
+                target, restore_args=self._restore_args(target)
+            ),
         )
         return restored["params"]
+
+    def _restore_args(self, target):
+        """Per-leaf RestoreArgs: THIS is where Orbax honors shardings — a
+        plain ShapeDtypeStruct.sharding is silently ignored by the
+        installed version (arrays land replicated on device 0; probed
+        directly), so every sharded leaf gets an ArrayRestoreArgs."""
+
+        def one(node):
+            sharding = getattr(node, "sharding", None)
+            if sharding is not None:
+                return self._ocp.ArrayRestoreArgs(
+                    sharding=sharding, dtype=node.dtype
+                )
+            return self._ocp.RestoreArgs()
+
+        return jax.tree.map(one, target)
 
     def wait(self) -> None:
         """Block until queued async saves are durable."""

@@ -166,3 +166,51 @@ def test_decode_rejects_segment_ids(model_and_params):
         model.apply({"params": params}, tokens, decode=True,
                     segment_ids=jnp.zeros((1, 4), jnp.int32),
                     mutable=["cache"])
+
+
+def test_beam_search_beam1_equals_greedy(model_and_params):
+    """Decoder-only beams=1 == greedy generate — pins the prefill seeding,
+    cache tiling, per-step positions, and backtracking."""
+    from kubeflow_tpu.models.generate import beam_search
+
+    model, params = model_and_params
+    prompt = jnp.array([[3, 7, 11, 2], [9, 4, 1, 8]], jnp.int32)
+    greedy = generate(model, params, prompt, max_new_tokens=8)
+    beam1 = beam_search(model, params, prompt, max_new_tokens=8, beams=1)
+    assert (greedy == beam1).all(), (greedy, beam1)
+
+
+def test_beam_search_respects_prompt_padding(model_and_params):
+    from kubeflow_tpu.models.generate import beam_search
+
+    model, params = model_and_params
+    short = jnp.array([[3, 7, 11]], jnp.int32)
+    padded = jnp.array([[3, 7, 11, 0, 0]], jnp.int32)
+    mask = jnp.array([[1, 1, 1, 0, 0]], bool)
+    a = beam_search(model, params, short, max_new_tokens=6, beams=3)
+    b = beam_search(model, params, padded, prompt_mask=mask,
+                    max_new_tokens=6, beams=3)
+    assert (a == b).all()
+
+
+def test_beam_search_improves_sequence_score(model_and_params):
+    from kubeflow_tpu.models.generate import beam_search
+
+    model, params = model_and_params
+    prompt = jnp.array([[5, 2, 9, 13]], jnp.int32)
+
+    def score(seq):
+        full = jnp.concatenate([prompt, seq], axis=1)
+        logits = model.apply({"params": params}, full)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        plen = prompt.shape[1]
+        tok_lp = jnp.take_along_axis(
+            logp[:, plen - 1:-1], seq[..., None], axis=-1
+        )[..., 0]
+        return float(tok_lp.sum())
+
+    b1 = beam_search(model, params, prompt, max_new_tokens=6, beams=1,
+                     length_penalty=0.0)
+    b4 = beam_search(model, params, prompt, max_new_tokens=6, beams=4,
+                     length_penalty=0.0)
+    assert score(b4) >= score(b1) - 1e-4
