@@ -270,9 +270,6 @@ def load_service(
     if mesh_spec:
         # Validate the SPMD flags BEFORE the (potentially multi-GB)
         # checkpoint restore — a typo'd spec must fail in milliseconds.
-        if seq2seq:
-            raise ValueError("--mesh serving currently supports the "
-                             "decoder-only families")
         if quantize:
             raise ValueError("--mesh with --quantize is not supported yet "
                              "(QTensor leaves carry their own layouts)")

@@ -19,10 +19,12 @@ from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
 from kubeflow_tpu.parallel.mesh import default_mesh_config
 from kubeflow_tpu.parallel.sharding import (
     batch_sharding,
+    bert_rules,
     infer_state_shardings,
     llama_rules,
     resnet_rules,
     shard_params,
+    t5_rules,
     vit_rules,
 )
 from kubeflow_tpu.parallel.train import make_sharded_train_step
@@ -31,7 +33,9 @@ __all__ = [
     "MeshConfig",
     "make_mesh",
     "default_mesh_config",
+    "bert_rules",
     "resnet_rules",
+    "t5_rules",
     "vit_rules",
     "batch_sharding",
     "infer_state_shardings",

@@ -61,6 +61,35 @@ def vit_rules() -> Rules:
     )
 
 
+def t5_rules() -> Rules:
+    return (
+        (r".*embed.*embedding$", P("tp", "fsdp")),
+        (r".*(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp", None)),
+        (r".*o_proj.*kernel$", P("tp", None, "fsdp")),
+        (r".*(wi_0|wi_1).*kernel$", P("fsdp", "tp")),
+        (r".*/wo/kernel$", P("tp", "fsdp")),  # paths join with "/"
+        (r".*lm_head.*kernel$", P("fsdp", "tp")),
+        # Per-head relative-bias tables follow the head (tp) split.
+        (r".*rel_embedding$", P(None, "tp")),
+        (r".*", P()),
+    )
+
+
+def bert_rules() -> Rules:
+    return (
+        (r".*(tok_embed|pos_embed).*embedding$", P("tp", "fsdp")),
+        # Segment-type table has 2 rows in every config — vocab axis must
+        # stay replicated or tp>2 meshes fail at placement.
+        (r".*type_embed.*embedding$", P(None, "fsdp")),
+        (r".*(q_proj|k_proj|v_proj).*kernel$", P("fsdp", "tp", None)),
+        (r".*o_proj.*kernel$", P("tp", None, "fsdp")),
+        (r".*fc1.*kernel$", P("fsdp", "tp")),
+        (r".*fc2.*kernel$", P("tp", "fsdp")),
+        (r".*(pooler|classifier).*kernel$", P("fsdp", None)),
+        (r".*", P()),
+    )
+
+
 def resnet_rules() -> Rules:
     # Convs: shard output channels on tp, nothing else; batch-norm stats
     # replicated.  FSDP on convnets this small isn't worth the gathers.
@@ -81,6 +110,8 @@ def rules_for_model(model) -> Rules:
         "Llama": llama_rules,
         "ViT": vit_rules,
         "ResNet": resnet_rules,
+        "T5": t5_rules,
+        "Bert": bert_rules,
     }
     if name not in table:
         raise ValueError(

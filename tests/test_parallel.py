@@ -117,6 +117,56 @@ def test_dist_initialize_multislice_process_grid(monkeypatch):
         dist.initialize_from_env()
 
 
+def test_t5_and_bert_rules_cover_every_matmul_weight():
+    """Every kernel/embedding leaf must get a non-replicated spec — a rule
+    gap would silently serve 'tensor parallel' with replicated weights."""
+    from jax.tree_util import tree_flatten_with_path
+
+    from kubeflow_tpu.parallel import bert_rules, t5_rules
+    from kubeflow_tpu.parallel.sharding import tree_specs
+
+    cases = []
+    t5 = create_model("t5_debug")
+    t5p = t5.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32),
+                  jnp.ones((1, 4), jnp.int32))["params"]
+    cases.append((t5p, t5_rules()))
+    bert = create_model("bert_debug")
+    bp = bert.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+    cases.append((bp, bert_rules()))
+    for params, rules in cases:
+        specs = tree_specs(params, rules)
+        flat_p = tree_flatten_with_path(params)[0]
+        flat_s = jax.tree.leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        for (path, leaf), spec in zip(flat_p, flat_s):
+            name = ".".join(str(getattr(k, "key", "")) for k in path)
+            if (name.endswith("kernel") or name.endswith("embedding")) \
+                    and leaf.ndim >= 2 and "type_embed" not in name:
+                assert any(a is not None for a in spec), (
+                    f"{name} replicated: {spec}"
+                )
+
+
+def test_rules_place_on_mesh(devices8):
+    """Specs must actually PLACE (divisibility): a rule putting a tiny
+    fixed axis (e.g. BERT's 2-row type table) on tp would pass spec checks
+    but fail device_put."""
+    from kubeflow_tpu.parallel import bert_rules, t5_rules
+    from kubeflow_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(tp=2, fsdp=2, dp=2, devices=devices8)
+    t5 = create_model("t5_debug")
+    t5p = t5.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32),
+                  jnp.ones((1, 4), jnp.int32))["params"]
+    shard_params(t5p, mesh, t5_rules())
+    bert = create_model("bert_debug")
+    bp = bert.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))["params"]
+    placed = shard_params(bp, mesh, bert_rules())
+    leaf = jax.tree.leaves(placed)[0]
+    assert len(leaf.sharding.device_set) > 1
+
+
 def test_llama_param_specs():
     model = create_model("llama_debug")
     tokens = jnp.ones((2, 16), jnp.int32)

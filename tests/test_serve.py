@@ -249,11 +249,23 @@ def test_serve_spmd_restores_checkpoint_sharded(devices8, tmp_path):
 def test_serve_mesh_rejects_unsupported_combos():
     from kubeflow_tpu.models.serve import load_service
 
-    with pytest.raises(ValueError, match="decoder-only"):
-        load_service("t5_debug", mesh_spec="tp=2")
     with pytest.raises(ValueError, match="quantize"):
         load_service("llama_debug", max_seq_len=64, quantize="int8",
                      mesh_spec="tp=2")
+
+
+def test_serve_spmd_seq2seq_matches_single_device(devices8):
+    """T5 under --mesh: params sharded by t5_rules, same generations."""
+    from kubeflow_tpu.models.serve import load_service
+
+    plain = load_service("t5_debug")
+    spmd = load_service("t5_debug", mesh_spec="tp=2,fsdp=4")
+    rows = [[5, 9, 2, 7]]
+    assert plain.generate(rows, max_new_tokens=5) == spmd.generate(
+        rows, max_new_tokens=5
+    )
+    leaf = jax.tree.leaves(spmd.params)[0]
+    assert len(leaf.sharding.device_set) > 1
 
 
 def test_serve_missing_checkpoint_raises(tmp_path):
