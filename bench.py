@@ -67,23 +67,31 @@ def main() -> int:
     # shows ~15% run-to-run interference (2157-2538 img/s across sessions
     # for identical code), and the best window is the stable estimator of
     # what the chip itself does.
-    best_dt = float("inf")
+    dts = []
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
         for _ in range(STEPS):
             state, metrics = step(state, batch)
         float(metrics["loss"])
-        best_dt = min(best_dt, time.perf_counter() - t0)
+        dts.append(time.perf_counter() - t0)
 
-    ips = BATCH * STEPS / best_dt
-    vs = 1.0 if BASELINE_IMAGES_PER_SEC is None else ips / BASELINE_IMAGES_PER_SEC
+    # Both estimators on one line: value/vs_baseline stay best-window (the
+    # stable estimator under tunnel interference), value_mean_window is the
+    # like-for-like number vs the round-1 single-window baseline — consumers
+    # comparing across protocols use the mean, not the max-statistic.
+    ips = BATCH * STEPS / min(dts)
+    ips_mean = BATCH * STEPS * len(dts) / sum(dts)
+    base = BASELINE_IMAGES_PER_SEC
     print(
         json.dumps(
             {
                 "metric": "resnet50_images_per_sec_per_chip",
                 "value": round(ips, 2),
                 "unit": "images/sec",
-                "vs_baseline": round(vs, 4),
+                "vs_baseline": 1.0 if base is None else round(ips / base, 4),
+                "value_mean_window": round(ips_mean, 2),
+                "vs_baseline_mean": 1.0 if base is None
+                else round(ips_mean / base, 4),
             }
         )
     )

@@ -59,12 +59,16 @@ def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
         )
     for r in rows:
         for t in r:
-            if not isinstance(t, int) or not 0 <= t < vocab:
+            # bool is an int subclass: JSON true/false must 400, not
+            # silently become token 1/0.
+            if isinstance(t, bool) or not isinstance(t, int) \
+                    or not 0 <= t < vocab:
                 raise ValueError(f"token {t!r} outside [0, {vocab})")
     if top_k is not None and (not isinstance(top_k, int)
                               or isinstance(top_k, bool) or top_k < 1):
         raise ValueError(f"top_k must be a positive int, got {top_k!r}")
-    if eos_token is not None and not isinstance(eos_token, int):
+    if eos_token is not None and (isinstance(eos_token, bool)
+                                  or not isinstance(eos_token, int)):
         raise ValueError(f"eos_token must be an int, got {eos_token!r}")
     tokens = jnp.array(
         [r + [0] * (longest - len(r)) for r in rows], jnp.int32
@@ -75,7 +79,27 @@ def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
     return tokens, mask, n
 
 
+# "Client did not set eos_token" sentinel: each service resolves it to its
+# own default_eos_token, so the generate path and the token metric can never
+# disagree about which sentinel ends a row.
+_UNSET = object()
+
+
+def _generated_token_count(rows, eos_token):
+    """Tokens produced per row, counting through the first EOS and
+    excluding the post-EOS padding generate() right-fills with — the
+    throughput metric must not credit padding as generated tokens."""
+    if eos_token is None:
+        return sum(len(r) for r in rows)
+    total = 0
+    for r in rows:
+        total += r.index(eos_token) + 1 if eos_token in r else len(r)
+    return total
+
+
 class GenerationService:
+    default_eos_token: Optional[int] = None
+
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
                  max_batch_rows: int = 64):
         self.model = model
@@ -89,9 +113,11 @@ class GenerationService:
 
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 eos_token: Optional[int] = None, seed: int = 0):
+                 eos_token=_UNSET, seed: int = 0):
         from kubeflow_tpu.models.generate import generate
 
+        if eos_token is _UNSET:
+            eos_token = self.default_eos_token
         # prompt+new > max_seq_len additionally 400s via generate()'s own
         # cache_len check (caught below as ValueError).
         prompt, mask, n = _validate_and_pad(
@@ -117,6 +143,8 @@ class Seq2SeqGenerationService:
     ``tokens`` rows are SOURCE sequences; the response is the generated
     target continuation (T5 convention: BOS = pad id 0, EOS = 1)."""
 
+    default_eos_token: Optional[int] = 1
+
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
                  max_target_len: int = 512, max_source_len: int = 4096,
                  max_batch_rows: int = 64):
@@ -133,9 +161,11 @@ class Seq2SeqGenerationService:
 
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 eos_token: Optional[int] = 1, seed: int = 0):
+                 eos_token=_UNSET, seed: int = 0):
         from kubeflow_tpu.models.generate import generate_seq2seq
 
+        if eos_token is _UNSET:
+            eos_token = self.default_eos_token
         source, mask, n = _validate_and_pad(
             rows, self.model.cfg.vocab_size,
             max_new_tokens=max_new_tokens,
@@ -238,7 +268,8 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
             requests_total.labels(outcome="error").inc()
             raise
         requests_total.labels(outcome="ok").inc()
-        tokens_total.inc(sum(len(r) for r in tokens))
+        eos = body.get("eos_token", service.default_eos_token)
+        tokens_total.inc(_generated_token_count(tokens, eos))
         return success({"tokens": tokens})
 
     return app

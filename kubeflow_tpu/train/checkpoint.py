@@ -168,6 +168,12 @@ class CheckpointManager:
                 return self._ocp.ArrayRestoreArgs(
                     sharding=sharding, dtype=node.dtype
                 )
+            dtype = getattr(node, "dtype", None)
+            if dtype is not None:
+                # Unsharded leaves still restore in the TEMPLATE dtype: a
+                # checkpoint saved in another dtype must not leak its
+                # on-disk dtype into the serving model.
+                return self._ocp.RestoreArgs(dtype=dtype)
             return self._ocp.RestoreArgs()
 
         return jax.tree.map(one, target)

@@ -67,6 +67,7 @@ def test_generate_validation_errors(client):
         {"tokens": [[1]], "temperature": None},      # null coercion
         {"tokens": [[1]], "seed": [1]},              # bad seed type
         {"tokens": [[1]], "top_k": 0},               # zero top_k
+        {"tokens": [[1]], "eos_token": True},        # bool eos sentinel
     ):
         resp = client.post("/v1/generate", json=body)
         assert resp.status_code == 400, body
@@ -274,3 +275,34 @@ def test_serve_missing_checkpoint_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         load_service("llama_debug", checkpoint_dir=str(tmp_path / "none"),
                      max_seq_len=64)
+
+
+def test_token_rows_reject_bools(client):
+    """JSON true is an int subclass in Python — it must 400, not silently
+    become token 1 (ADVICE r1)."""
+    resp = client.post("/v1/generate", json={"tokens": [[True, 2]]})
+    assert resp.status_code == 400
+
+
+def test_tokens_total_excludes_post_eos_padding():
+    """generate() right-pads finished rows with EOS; the throughput counter
+    counts through the first EOS only (ADVICE r1)."""
+
+    class StubService:
+        default_eos_token = None
+
+        class model:
+            class cfg:
+                vocab_size = 256
+
+        def generate(self, rows, **kw):
+            return [[5, 7, 9, 9], [1, 2, 3, 4]]
+
+    c = Client(create_app(StubService(), model_name="stub"))
+    resp = c.post("/v1/generate",
+                  json={"tokens": [[1], [1]], "eos_token": 9})
+    assert resp.status_code == 200
+    text = c.get("/metrics").get_data(as_text=True)
+    # Row 0 counts through its first EOS (3 tokens); row 1 never hit EOS
+    # (all 4 count): 7 total, not the 8 raw slots.
+    assert "generate_tokens_total 7.0" in text

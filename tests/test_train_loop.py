@@ -233,3 +233,21 @@ def test_profile_steps_produces_trace(tmp_path):
     for root, _dirs, files in __import__("os").walk(logdir):
         found.extend(files)
     assert found, f"no trace files under {logdir}"
+
+
+def test_restore_params_casts_to_template_dtype(tmp_path):
+    """Unsharded params-only restore lands in the TEMPLATE dtype: a float32
+    checkpoint served by a bfloat16 model must not silently restore float32
+    (ADVICE r1 — train/checkpoint.py _restore_args unsharded branch)."""
+    from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+    state, _ = tiny_state()
+    with CheckpointManager(str(tmp_path)) as mgr:
+        mgr.save(0, state, force=True)
+    template = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, jnp.bfloat16), state.params
+    )
+    with CheckpointManager(str(tmp_path)) as mgr:
+        params = mgr.restore_params(template=template)
+    dtypes = {x.dtype for x in jax.tree_util.tree_leaves(params)}
+    assert dtypes == {jnp.dtype(jnp.bfloat16)}
