@@ -1,15 +1,17 @@
 #!/usr/bin/env python3
-"""Headline benchmark: in-notebook ResNet50 training throughput (images/sec/chip).
+"""Headline benchmarks (one JSON line per metric, primary metric LAST).
 
-This is the compute half of the BASELINE.md metric pair ("notebook
-spawn-to-ready sec; in-notebook ResNet50 images/sec/chip").  The reference
-platform publishes no numbers (BASELINE.md) — the baseline here is the one
-this repo established on first measurement on a TPU v5e chip; vs_baseline
-tracks regressions/improvements against it.
+1. llama8k_train_tokens_per_sec — long-context Llama train step (seq 8192,
+   bf16, remat) with the Pallas flash-attention kernel, measured end-to-end
+   against the identical model with XLA attention.  ``vs_baseline`` IS the
+   flash/XLA ratio: the round-1 kernel table showed 11.9x at the op level
+   (BASELINE.md); this metric is that win carried to a whole train step
+   (VERDICT r1 item 3).
+2. resnet50_images_per_sec_per_chip — the original BASELINE.md compute
+   metric; vs_baseline tracks the round-1 hardware measurement.
 
-Prints ONE JSON line:
-  {"metric": "resnet50_images_per_sec_per_chip", "value": N,
-   "unit": "images/sec", "vs_baseline": N}
+The reference platform publishes no numbers (BASELINE.md) — baselines are
+the ones this repo established on first measurement on a TPU v5e chip.
 """
 from __future__ import annotations
 
@@ -35,7 +37,97 @@ STEPS = 20
 WINDOWS = 3
 
 
-def main() -> int:
+def llama_8k_bench() -> None:
+    """Long-context train throughput, flash kernel vs XLA attention.
+
+    Protocol notes (BASELINE.md): amortized in-jit step loops with a final
+    scalar fetch (block_until_ready returns early through the tunnel);
+    best-of-windows against run-to-run interference.  remat=True for both
+    arms — at seq 8192 the XLA arm's [b, h, s, s] score tensors are ~2 GB
+    per layer, so rematerialization is what makes the comparison runnable
+    at all (and is the production setting for long context).
+    """
+    import dataclasses
+
+    import optax
+
+    from kubeflow_tpu.models.llama import Llama, LlamaConfig
+    from kubeflow_tpu.train import create_train_state, make_lm_train_step
+
+    # KFT_BENCH_SMOKE=1: tiny flash-supported shapes (interpret-mode pallas
+    # on CPU) so the whole code path is testable without the chip.
+    smoke = bool(int(__import__("os").environ.get("KFT_BENCH_SMOKE", "0")))
+    seq = 256 if smoke else LLAMA_SEQ
+    batch, steps, windows, warmup = (
+        (1, 1, 1, 1) if smoke
+        else (LLAMA_BATCH, LLAMA_STEPS, LLAMA_WINDOWS, LLAMA_WARMUP)
+    )
+    base_cfg = (
+        LlamaConfig(vocab_size=512, dim=128, n_layers=2, n_heads=2,
+                    n_kv_heads=2, ffn_dim=256, max_seq_len=seq,
+                    dtype=jnp.bfloat16, remat=True)
+        if smoke
+        # h=8 d=128 matches the round-1 kernel table row (seq 8192,
+        # batch 2 — 11.9x at the op level); 4 layers + 8k vocab keep the
+        # A/B to minutes on one chip while staying attention-bound.
+        else LlamaConfig(
+            vocab_size=8192, dim=1024, n_layers=4, n_heads=8, n_kv_heads=8,
+            ffn_dim=4096, max_seq_len=seq, dtype=jnp.bfloat16, remat=True,
+        )
+    )
+    rng = jax.random.key(0)
+    tokens = jax.random.randint(
+        jax.random.fold_in(rng, 1), (batch, seq), 0, base_cfg.vocab_size
+    )
+
+    def measure(attn_impl: str) -> float:
+        cfg = dataclasses.replace(base_cfg, attn_impl=attn_impl)
+        model = Llama(cfg)
+        state = create_train_state(
+            rng, model, tokens, optax.sgd(1e-3, momentum=0.9)
+        )
+        step = jax.jit(make_lm_train_step(), donate_argnums=(0,))
+        s = state
+        for _ in range(warmup):
+            s, metrics = step(s, tokens)
+        float(metrics["loss"])
+        best_dt = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                s, metrics = step(s, tokens)
+            float(metrics["loss"])
+            best_dt = min(best_dt, time.perf_counter() - t0)
+        return batch * seq * steps / best_dt
+
+    flash_tps = measure("pallas")
+    xla_tps = measure("xla")
+    print(
+        json.dumps(
+            {
+                "metric": "llama8k_train_tokens_per_sec",
+                "value": round(flash_tps, 1),
+                "unit": "tokens/sec",
+                # The baseline for the flash arm is the XLA arm, same
+                # protocol, same process: >= 1.5 is the VERDICT bar.
+                "vs_baseline": round(flash_tps / xla_tps, 4),
+                "xla_tokens_per_sec": round(xla_tps, 1),
+                "seq_len": seq,
+                "batch": batch,
+            }
+        ),
+        flush=True,
+    )
+
+
+LLAMA_SEQ = 8192
+LLAMA_BATCH = 2
+LLAMA_STEPS = 3
+LLAMA_WINDOWS = 2
+LLAMA_WARMUP = 2
+
+
+def resnet50_bench() -> None:
     import optax
 
     from kubeflow_tpu.models import create_model
@@ -93,8 +185,14 @@ def main() -> int:
                 "vs_baseline_mean": 1.0 if base is None
                 else round(ips_mean / base, 4),
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def main() -> int:
+    llama_8k_bench()
+    resnet50_bench()  # primary metric: printed last, parsed by the driver
     return 0
 
 

@@ -206,15 +206,27 @@ class Controller:
                 self.reconcile_count += 1
                 if result and result.requeue_after:
                     self.queue.add(req, delay=result.requeue_after)
-            except Exception:
+            except Exception as e:
                 self.error_count += 1
+                from kubeflow_tpu.platform.k8s.errors import Conflict
                 from kubeflow_tpu.platform.runtime import metrics
 
                 metrics.reconcile_errors_total.labels(controller=self.name).inc()
-                log.error(
-                    "%s: reconcile %s/%s failed:\n%s",
-                    self.name, req.namespace, req.name, traceback.format_exc(),
-                )
+                if isinstance(e, Conflict):
+                    # Optimistic-concurrency 409: the requeue IS the
+                    # resolution (same as controller-runtime).  One line,
+                    # no stack — a traceback on the expected path would
+                    # train readers to ignore real ones (VERDICT r1).
+                    log.info(
+                        "%s: reconcile %s/%s conflicted (will retry): %s",
+                        self.name, req.namespace, req.name, e,
+                    )
+                else:
+                    log.error(
+                        "%s: reconcile %s/%s failed:\n%s",
+                        self.name, req.namespace, req.name,
+                        traceback.format_exc(),
+                    )
                 self.queue.add_rate_limited(req)
 
     # -- lifecycle -----------------------------------------------------------

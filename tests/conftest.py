@@ -18,7 +18,60 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import faulthandler  # noqa: E402
+import sys  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Per-test wall-clock cap (pytest-timeout is not in the image).  A watchdog
+# thread — not SIGALRM — because a hung test is usually stuck inside an XLA
+# compile/execute C call, where Python signal handlers don't run.  On
+# expiry: dump all thread stacks, then hard-exit so CI fails in bounded
+# time instead of hanging (the pytest-timeout "thread" method semantics).
+DEFAULT_TEST_TIMEOUT_S = float(os.environ.get("KFT_TEST_TIMEOUT_S", "600"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long mesh/pipeline/train tests "
+        "(quick tier: -m 'not slow')",
+    )
+    config.addinivalue_line(
+        "markers", "timeout(seconds): override the per-test wall-clock cap",
+    )
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    marker = item.get_closest_marker("timeout")
+    seconds = float(marker.args[0]) if marker else DEFAULT_TEST_TIMEOUT_S
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(seconds):
+            # Un-redirect fd 2 so the dump survives os._exit (pytest's
+            # fd-level capture would otherwise swallow it).
+            capman = item.config.pluginmanager.getplugin("capturemanager")
+            try:
+                if capman is not None:
+                    capman.suspend_global_capture(in_=True)
+            except Exception:
+                pass
+            sys.stderr.write(
+                f"\n\n=== TIMEOUT: {item.nodeid} exceeded {seconds:.0f}s; "
+                "thread stacks follow ===\n"
+            )
+            faulthandler.dump_traceback()
+            sys.stderr.flush()
+            os._exit(70)
+
+    t = threading.Thread(target=watchdog, daemon=True, name="test-watchdog")
+    t.start()
+    try:
+        return (yield)
+    finally:
+        done.set()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,37 @@
+"""bench.py emission paths: outside of these smokes the benchmarks only
+execute on the TPU chip at round end, so a typo would surface exactly when
+the headline number is being recorded (review r2).  KFT_BENCH_SMOKE=1
+shrinks the llama arm to flash-supported tiny shapes (interpret-mode pallas
+on CPU)."""
+import json
+
+import pytest
+
+
+@pytest.mark.slow
+def test_bench_llama_smoke_emits_metric(capsys, monkeypatch):
+    monkeypatch.setenv("KFT_BENCH_SMOKE", "1")
+    import bench
+
+    bench.llama_8k_bench()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "llama8k_train_tokens_per_sec"
+    assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
+    assert out["value"] > 0 and out["xla_tokens_per_sec"] > 0
+
+
+@pytest.mark.slow
+def test_bench_resnet_emits_metric(capsys, monkeypatch):
+    import bench
+
+    for name, val in (("BATCH", 4), ("IMAGE", 32), ("WARMUP", 1),
+                      ("STEPS", 1), ("WINDOWS", 2)):
+        monkeypatch.setattr(bench, name, val)
+    bench.resnet50_bench()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "resnet50_images_per_sec_per_chip"
+    assert {"value", "vs_baseline", "value_mean_window",
+            "vs_baseline_mean"} <= set(out)
+    assert out["value"] >= out["value_mean_window"] > 0

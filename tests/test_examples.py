@@ -34,6 +34,7 @@ def test_mnist_scipy_runs():
     exec(compile(_code("01_mnist_scipy.ipynb"), "nb01", "exec"), {})
 
 
+@pytest.mark.slow
 def test_resnet50_notebook_runs_tiny(devices8):
     src = _code("02_resnet50_cifar.ipynb")
     src = src.replace("BATCH = 256", "BATCH = 8")
@@ -66,6 +67,7 @@ def test_llama_multihost_notebook_runs_tiny(devices8, tmp_path):
     exec(compile(src, "nb05", "exec"), {})
 
 
+@pytest.mark.slow
 def test_packing_int8_beam_notebook_runs_tiny(devices8):
     src = _code("07_packing_int8_beam.ipynb")
     src = src.replace('CFG = "llama_125m"', 'CFG = "llama_debug"')

@@ -30,6 +30,7 @@ def naive_greedy(model, params, prompt, n):
     return jnp.stack(out, axis=1)
 
 
+@pytest.mark.slow
 def test_greedy_cache_matches_full_forward(model_and_params):
     model, params = model_and_params
     prompt = jnp.array([[5, 9, 2, 7, 11, 3]], jnp.int32)
@@ -95,6 +96,7 @@ def test_sampled_generation_is_reproducible(model_and_params):
     assert (a == b).all()
 
 
+@pytest.mark.slow
 def test_decode_with_remat_and_moe():
     # remat and MoE variants must also trace through the decode path.
     for name in ("mixtral_debug",):

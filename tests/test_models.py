@@ -154,6 +154,7 @@ def test_llama_scan_layers_matches_loop():
     assert (g1 == g2).all()
 
 
+@pytest.mark.slow
 def test_llama_scan_layers_sharded_step(devices8):
     import dataclasses
 

@@ -23,6 +23,7 @@ def test_moe_mlp_forward_shape_and_finite():
     assert jnp.all(jnp.isfinite(y))
 
 
+@pytest.mark.slow
 def test_moe_dispatch_respects_capacity():
     # With capacity_factor tiny, most tokens overflow and the layer output
     # must shrink toward zero (dropped tokens contribute nothing).
@@ -61,6 +62,7 @@ def test_mixtral_param_specs():
     assert mlp["router"]["kernel"] == P("fsdp", None)
 
 
+@pytest.mark.slow
 def test_expert_parallel_step_matches_single_device(devices8):
     """Loss after one ep=4 sharded step equals the single-device step."""
     model = create_model("mixtral_debug")
@@ -84,6 +86,7 @@ def test_expert_parallel_step_matches_single_device(devices8):
         assert float(metrics["moe_aux_loss"]) >= 1.0 - 1e-5
 
 
+@pytest.mark.slow
 def test_moe_aux_loss_with_scan_layers(devices8):
     # Under scan_layers the sowed per-layer aux losses arrive as ONE
     # stacked (n_layers,) leaf; the lm step must still produce a scalar

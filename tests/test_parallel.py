@@ -194,6 +194,7 @@ def test_ring_attention_matches_reference(devices8, causal):
     assert jnp.max(jnp.abs(out - ref)) < 2e-5
 
 
+@pytest.mark.slow
 def test_sharded_train_step_matches_single_device(devices8):
     """The same step on a dp/fsdp/tp mesh must produce the same loss as on
     one device — sharding is an implementation detail, not math."""
@@ -216,12 +217,14 @@ def test_sharded_train_step_matches_single_device(devices8):
     assert abs(float(metrics["loss"]) - float(ref_metrics["loss"])) < 1e-4
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun(devices8):
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_graft_entry_forward():
     import __graft_entry__ as g
 
@@ -231,6 +234,7 @@ def test_graft_entry_forward():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_flash_blocks_match(devices8, causal):
     # block_impl="flash" folds visiting blocks through the Pallas kernel
     # (with-lse variant); outputs and grads must match the einsum path.

@@ -75,6 +75,7 @@ def test_relative_position_buckets():
     assert (uni[0][7:] == uni[0][7]).all()
 
 
+@pytest.mark.slow
 def test_train_step_decreases_loss():
     import optax
 
@@ -115,6 +116,7 @@ def test_registry_has_t5():
     assert isinstance(m, T5)
 
 
+@pytest.mark.slow
 def test_seq2seq_cached_decode_matches_full_forward(model_and_params):
     """Greedy cached generation == the uncached argmax loop that re-runs
     the full decoder each step (pins cache writes AND the dynamic-position

@@ -177,6 +177,7 @@ def test_loop_stops_on_data_exhaustion():
     assert int(state.step) == 3
 
 
+@pytest.mark.slow
 def test_trainer_cli_smoke(devices8, tmp_path):
     from kubeflow_tpu.train import run as trainer
 
