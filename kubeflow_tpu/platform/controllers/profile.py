@@ -298,14 +298,21 @@ class ProfileReconciler(Reconciler):
             }),
         ]
         for binding_name, role, subject in bindings:
+            # The role/user annotations are KFAM's marker for USER bindings
+            # (what the contributors view lists); the ServiceAccount
+            # bindings must not carry them or default-editor/viewer show up
+            # as namespace contributors (caught by the DOM frontend tests).
+            annotations = {}
+            if subject.get("kind") != "ServiceAccount":
+                annotations = {"role": role.removeprefix("kubeflow-"),
+                               "user": subject.get("name", "")}
             rb = {
                 "apiVersion": "rbac.authorization.k8s.io/v1",
                 "kind": "RoleBinding",
                 "metadata": {
                     "name": binding_name,
                     "namespace": ns,
-                    "annotations": {"role": role.removeprefix("kubeflow-"),
-                                    "user": subject.get("name", "")},
+                    "annotations": annotations,
                 },
                 "roleRef": {
                     "apiGroup": "rbac.authorization.k8s.io",

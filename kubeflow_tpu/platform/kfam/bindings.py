@@ -65,6 +65,11 @@ class BindingManager:
             bound_user = annotations.get("user")
             if not role or not bound_user:
                 continue
+            subjects = rb.get("subjects") or [{}]
+            if subjects[0].get("kind") == "ServiceAccount":
+                # Defensive: SA plumbing bindings are infrastructure, not
+                # contributors, even if annotated by an older controller.
+                continue
             if user and bound_user != user:
                 continue
             out.append({

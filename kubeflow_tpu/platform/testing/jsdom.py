@@ -1,0 +1,1158 @@
+"""DOM shim + browser harness: run the shipped SPAs against real backends.
+
+Pairs with ``jsengine.py`` (the JS interpreter) to replace the reference's
+Cypress tier (reference jupyter/frontend/cypress/e2e/form-page.cy.ts) in an
+image with no JS runtime.  The harness:
+
+* parses the app's real ``index.html`` into an element tree,
+* executes the real ``app.js`` (ES modules resolved from disk),
+* bridges ``fetch`` into a werkzeug test Client of the real WSGI backend
+  (cookies round-trip, so the CSRF double-submit path is exercised too),
+* surfaces clicks/typing/submits and a timer queue to the test.
+
+So a test drives the same artifact a browser would: fill the spawn form,
+click Launch, and the POST that reaches the Flask backend was built by the
+checked-in JS.  Rename a DOM id or a form field and these tests fail.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import html.parser
+import json as _json
+import math
+import random as _random
+import re as _re
+import urllib.parse
+from typing import Any, Callable, Dict, List, Optional
+
+from kubeflow_tpu.platform.testing.jsengine import (
+    UNDEF,
+    Env,
+    Interpreter,
+    JSArray,
+    JSException,
+    JSObject,
+    JSPromise,
+    JSRegExp,
+    ModuleSystem,
+    call_function,
+    js_number,
+    js_to_string,
+    js_truthy,
+    make_error,
+)
+
+VOID_TAGS = {"area", "base", "br", "col", "embed", "hr", "img", "input",
+             "link", "meta", "source", "track", "wbr"}
+
+
+# ---------------------------------------------------------------------------
+# DOM
+# ---------------------------------------------------------------------------
+
+
+class Node:
+    pass
+
+
+class TextNode(Node):
+    def __init__(self, text: str):
+        self.data = text
+        self.parentNode = None
+
+    @property
+    def textContent(self):
+        return self.data
+
+
+class Element(Node):
+    def __init__(self, tag: str, document: "Document" = None):
+        self.tagName = tag.upper()
+        self._tag = tag.lower()
+        self.attributes: Dict[str, str] = {}
+        self.childNodes: List[Node] = []
+        self.parentNode: Optional[Element] = None
+        self._listeners: Dict[str, List[Callable]] = {}
+        self._document = document
+        self._value: Optional[str] = None  # explicit .value override
+        self.checked = False
+        self.hidden = False
+        self.disabled = False
+        self.open = False  # <dialog>
+        self.classList = ClassList(self)
+        self.dataset = Dataset(self)
+        self.style = JSObject()
+
+    # -- identity / attributes ----------------------------------------------
+
+    @property
+    def id(self):
+        return self.attributes.get("id", "")
+
+    @id.setter
+    def id(self, v):
+        self.attributes["id"] = js_to_string(v)
+
+    @property
+    def className(self):
+        return self.attributes.get("class", "")
+
+    @className.setter
+    def className(self, v):
+        self.attributes["class"] = js_to_string(v)
+
+    @property
+    def title(self):
+        return self.attributes.get("title", "")
+
+    @title.setter
+    def title(self, v):
+        self.attributes["title"] = js_to_string(v)
+
+    @property
+    def name(self):
+        return self.attributes.get("name", "")
+
+    def getAttribute(self, name):
+        return self.attributes.get(js_to_string(name), None)
+
+    def setAttribute(self, name, value):
+        self.attributes[js_to_string(name)] = js_to_string(value)
+
+    def removeAttribute(self, name):
+        self.attributes.pop(js_to_string(name), None)
+
+    def hasAttribute(self, name):
+        return js_to_string(name) in self.attributes
+
+    # -- value semantics (inputs / selects / textarea) -----------------------
+
+    @property
+    def value(self):
+        if self._value is not None:
+            return self._value
+        if self._tag == "select":
+            opts = [c for c in self._descendants() if getattr(c, "_tag", "") == "option"]
+            for o in opts:
+                if o._value is not None or "selected" in o.attributes:
+                    if o._value is not None:
+                        continue
+                    return o.attributes.get("value", o.textContent)
+            for o in opts:
+                if getattr(o, "_selected", False):
+                    return o.attributes.get("value", o.textContent)
+            return opts[0].attributes.get("value", opts[0].textContent) if opts else ""
+        if self._tag == "textarea":
+            return self.textContent
+        return self.attributes.get("value", "")
+
+    @value.setter
+    def value(self, v):
+        v = js_to_string(v)
+        if self._tag == "select":
+            self._value = None
+            for o in self._descendants():
+                if getattr(o, "_tag", "") == "option":
+                    o._selected = o.attributes.get("value", o.textContent) == v
+            self._value = v
+        else:
+            self._value = v
+
+    @property
+    def max(self):
+        return self.attributes.get("max", "")
+
+    @max.setter
+    def max(self, v):
+        self.attributes["max"] = js_to_string(v)
+
+    @property
+    def type(self):
+        return self.attributes.get("type", "")
+
+    # -- tree ----------------------------------------------------------------
+
+    @property
+    def children(self):
+        return JSArray(c for c in self.childNodes if isinstance(c, Element))
+
+    @property
+    def firstChild(self):
+        return self.childNodes[0] if self.childNodes else None
+
+    @property
+    def options(self):
+        """<select>: its option descendants, in document order."""
+        return JSArray(n for n in self._descendants() if n._tag == "option")
+
+    def insertBefore(self, node, ref=None):
+        if not isinstance(node, Node):
+            node = TextNode(js_to_string(node))
+        if node.parentNode is not None:
+            node.parentNode.childNodes.remove(node)
+        node.parentNode = self
+        if ref is None or ref is UNDEF or ref not in self.childNodes:
+            self.childNodes.append(node)
+        else:
+            self.childNodes.insert(self.childNodes.index(ref), node)
+        return node
+
+    def _descendants(self):
+        for c in self.childNodes:
+            if isinstance(c, Element):
+                yield c
+                yield from c._descendants()
+
+    def append(self, *nodes):
+        for n in nodes:
+            if isinstance(n, JSArray):
+                self.append(*n)
+                continue
+            if not isinstance(n, Node):
+                n = TextNode(js_to_string(n))
+            if n.parentNode is not None:
+                n.parentNode.childNodes.remove(n)
+            n.parentNode = self
+            self.childNodes.append(n)
+        return UNDEF
+
+    appendChild = append
+
+    def prepend(self, *nodes):
+        for n in reversed(nodes):
+            if not isinstance(n, Node):
+                n = TextNode(js_to_string(n))
+            n.parentNode = self
+            self.childNodes.insert(0, n)
+        return UNDEF
+
+    def replaceChildren(self, *nodes):
+        for c in self.childNodes:
+            c.parentNode = None
+        self.childNodes = []
+        self.append(*nodes)
+        return UNDEF
+
+    def remove(self):
+        if self.parentNode is not None:
+            self.parentNode.childNodes.remove(self)
+            self.parentNode = None
+        return UNDEF
+
+    def closest(self, selector):
+        node = self
+        while node is not None:
+            if isinstance(node, Element) and _matches(node, _parse_selector_seq(selector)[-1]):
+                return node
+            node = node.parentNode
+        return None
+
+    def contains(self, other):
+        while other is not None:
+            if other is self:
+                return True
+            other = other.parentNode
+        return False
+
+    # -- text ----------------------------------------------------------------
+
+    @property
+    def textContent(self):
+        out = []
+        for c in self.childNodes:
+            out.append(c.textContent if isinstance(c, (Element, TextNode)) else "")
+        return "".join(out)
+
+    @textContent.setter
+    def textContent(self, v):
+        self.replaceChildren(TextNode(js_to_string(v)))
+
+    # -- querying ------------------------------------------------------------
+
+    def querySelector(self, selector):
+        found = self.querySelectorAll(selector)
+        return found[0] if found else None
+
+    def querySelectorAll(self, selector):
+        out = JSArray()
+        for sel in js_to_string(selector).split(","):
+            seq = _parse_selector_seq(sel.strip())
+            for node in self._descendants():
+                if _matches_seq(node, seq) and node not in out:
+                    out.append(node)
+        return out
+
+    def getElementsByTagName(self, tag):
+        t = js_to_string(tag).lower()
+        return JSArray(n for n in self._descendants() if n._tag == t)
+
+    # -- events --------------------------------------------------------------
+
+    def addEventListener(self, etype, handler, *_opts):
+        self._listeners.setdefault(js_to_string(etype), []).append(handler)
+        return UNDEF
+
+    def removeEventListener(self, etype, handler, *_opts):
+        try:
+            self._listeners.get(js_to_string(etype), []).remove(handler)
+        except ValueError:
+            pass
+        return UNDEF
+
+    def dispatchEvent(self, event):
+        node = self
+        while node is not None:
+            for h in list(getattr(node, "_listeners", {}).get(event.type, [])):
+                call_function(h, [event])
+            node = node.parentNode
+        return not event.defaultPrevented
+
+    def click(self):
+        if self.disabled:
+            return True  # a real browser fires nothing on disabled controls
+        return self.dispatchEvent(DOMEvent("click", self))
+
+    # -- form / dialog -------------------------------------------------------
+
+    def showModal(self):
+        self.open = True
+        return UNDEF
+
+    def close(self):
+        self.open = False
+        self.dispatchEvent(DOMEvent("close", self))
+        return UNDEF
+
+    def reset(self):
+        for n in self._descendants():
+            tag = n._tag
+            if tag == "input":
+                n._value = None
+                n.checked = "checked" in n.attributes
+            elif tag == "select":
+                n._value = None
+                for o in n._descendants():
+                    if o._tag == "option":
+                        o._selected = False
+            elif tag == "textarea":
+                n._value = None
+        return UNDEF
+
+    def requestSubmit(self):
+        ev = DOMEvent("submit", self)
+        self.dispatchEvent(ev)
+        return UNDEF
+
+    def focus(self):
+        return UNDEF
+
+    def blur(self):
+        return UNDEF
+
+    def __repr__(self):
+        ident = ("#" + self.id) if self.id else ""
+        cls = ("." + ".".join(self.className.split())) if self.className else ""
+        return f"<{self._tag}{ident}{cls}>"
+
+
+class ClassList:
+    def __init__(self, el: Element):
+        self._el = el
+
+    def _classes(self):
+        return [c for c in self._el.attributes.get("class", "").split() if c]
+
+    def _store(self, classes):
+        self._el.attributes["class"] = " ".join(classes)
+
+    def add(self, *names):
+        cs = self._classes()
+        for n in names:
+            n = js_to_string(n)
+            if n not in cs:
+                cs.append(n)
+        self._store(cs)
+        return UNDEF
+
+    def remove(self, *names):
+        names = {js_to_string(n) for n in names}
+        self._store([c for c in self._classes() if c not in names])
+        return UNDEF
+
+    def toggle(self, name, force=UNDEF):
+        name = js_to_string(name)
+        cs = self._classes()
+        want = (name not in cs) if force is UNDEF else js_truthy(force)
+        if want and name not in cs:
+            cs.append(name)
+        if not want and name in cs:
+            cs.remove(name)
+        self._store(cs)
+        return want
+
+    def contains(self, name):
+        return js_to_string(name) in self._classes()
+
+
+class Dataset:
+    """data-* attribute proxy: dataset.fooBar <-> data-foo-bar."""
+
+    def __init__(self, el: Element):
+        object.__setattr__(self, "_el", el)
+
+    @staticmethod
+    def _attr(name: str) -> str:
+        return "data-" + _re.sub(r"([A-Z])", r"-\1", name).lower()
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        val = self._el.attributes.get(self._attr(name))
+        return UNDEF if val is None else val
+
+    def __setattr__(self, name, value):
+        self._el.attributes[self._attr(name)] = js_to_string(value)
+
+
+class DOMEvent:
+    def __init__(self, etype: str, target: Element, detail=None):
+        self.type = etype
+        self.target = target
+        self.currentTarget = target
+        self.defaultPrevented = False
+        self.detail = detail
+
+    def preventDefault(self):
+        self.defaultPrevented = True
+        return UNDEF
+
+    def stopPropagation(self):
+        return UNDEF
+
+
+# -- selectors ---------------------------------------------------------------
+
+_SEL_RE = _re.compile(
+    r"(?P<tag>[a-zA-Z][\w-]*)?"
+    r"(?P<parts>(?:[#.][\w-]+|\[[^\]]+\])*)"
+)
+
+
+def _parse_selector(sel: str):
+    m = _SEL_RE.fullmatch(sel.strip())
+    if not m:
+        raise ValueError(f"unsupported selector {sel!r}")
+    tag = (m.group("tag") or "").lower()
+    ids, classes, attrs = [], [], []
+    for part in _re.findall(r"[#.][\w-]+|\[[^\]]+\]", m.group("parts") or ""):
+        if part.startswith("#"):
+            ids.append(part[1:])
+        elif part.startswith("."):
+            classes.append(part[1:])
+        else:
+            inner = part[1:-1]
+            if "=" in inner:
+                k, v = inner.split("=", 1)
+                attrs.append((k.strip(), v.strip().strip("\"'")))
+            else:
+                attrs.append((inner.strip(), None))
+    return (tag, ids, classes, attrs)
+
+
+def _parse_selector_seq(sel: str):
+    return [_parse_selector(p) for p in sel.split()]
+
+
+def _matches(el: Element, parsed) -> bool:
+    tag, ids, classes, attrs = parsed
+    if tag and el._tag != tag:
+        return False
+    if any(el.id != i for i in ids):
+        return False
+    cs = el.className.split()
+    if any(c not in cs for c in classes):
+        return False
+    for k, v in attrs:
+        if v is None:
+            if k not in el.attributes:
+                return False
+        elif el.attributes.get(k) != v:
+            return False
+    return True
+
+
+def _matches_seq(el: Element, seq) -> bool:
+    if not _matches(el, seq[-1]):
+        return False
+    node = el.parentNode
+    for parsed in reversed(seq[:-1]):
+        while node is not None and not (
+            isinstance(node, Element) and _matches(node, parsed)
+        ):
+            node = node.parentNode
+        if node is None:
+            return False
+        node = node.parentNode
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Document + HTML parsing
+# ---------------------------------------------------------------------------
+
+
+class Document(Element):
+    def __init__(self):
+        super().__init__("#document", self)
+        self.cookie_jar: Dict[str, str] = {}
+        self.hidden = False
+        self.body: Optional[Element] = None
+        self.head: Optional[Element] = None
+
+    @property
+    def cookie(self):
+        return "; ".join(f"{k}={v}" for k, v in self.cookie_jar.items())
+
+    @cookie.setter
+    def cookie(self, s):
+        part = js_to_string(s).split(";", 1)[0]
+        if "=" in part:
+            k, v = part.split("=", 1)
+            self.cookie_jar[k.strip()] = v.strip()
+
+    def getElementById(self, eid):
+        eid = js_to_string(eid)
+        for n in self._descendants():
+            if n.id == eid:
+                return n
+        return None
+
+    def createElement(self, tag):
+        return Element(js_to_string(tag), self)
+
+    def createTextNode(self, text):
+        return TextNode(js_to_string(text))
+
+
+class _HTMLBuilder(html.parser.HTMLParser):
+    def __init__(self, document: Document):
+        super().__init__(convert_charrefs=True)
+        self.doc = document
+        self.stack: List[Element] = [document]
+
+    @staticmethod
+    def _build(tag, attrs, doc):
+        el = Element(tag, doc)
+        for k, v in attrs:
+            el.attributes[k] = v if v is not None else ""
+        # Boolean HTML attributes surface as element properties.
+        for flag in ("hidden", "disabled", "checked", "open"):
+            if flag in el.attributes:
+                setattr(el, flag, True)
+        if "selected" in el.attributes:
+            el._selected = True
+        return el
+
+    def handle_starttag(self, tag, attrs):
+        el = self._build(tag, attrs, self.doc)
+        self.stack[-1].append(el)
+        if tag == "body":
+            self.doc.body = el
+        if tag == "head":
+            self.doc.head = el
+        if tag not in VOID_TAGS:
+            self.stack.append(el)
+
+    def handle_startendtag(self, tag, attrs):
+        self.stack[-1].append(self._build(tag, attrs, self.doc))
+
+    def handle_endtag(self, tag):
+        for i in range(len(self.stack) - 1, 0, -1):
+            if self.stack[i]._tag == tag:
+                del self.stack[i:]
+                break
+
+    def handle_data(self, data):
+        if data.strip():
+            self.stack[-1].append(TextNode(data))
+
+
+def parse_html(src: str) -> Document:
+    doc = Document()
+    builder = _HTMLBuilder(doc)
+    builder.feed(src)
+    if doc.body is None:
+        doc.body = Element("body", doc)
+        doc.append(doc.body)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Browser plumbing: FormData, fetch, URL, timers
+# ---------------------------------------------------------------------------
+
+
+class FormData:
+    def __init__(self, form: Element):
+        self._entries: List[tuple] = []
+        for n in form._descendants():
+            tag = n._tag
+            name = n.attributes.get("name")
+            if not name or n.disabled:
+                continue
+            if tag == "input":
+                itype = n.attributes.get("type", "text")
+                if itype in ("checkbox", "radio"):
+                    if n.checked:
+                        self._entries.append(
+                            (name, n.attributes.get("value", "on")))
+                else:
+                    self._entries.append((name, n.value))
+            elif tag in ("select", "textarea"):
+                self._entries.append((name, n.value))
+
+    def get(self, name):
+        name = js_to_string(name)
+        for k, v in self._entries:
+            if k == name:
+                return v
+        return None
+
+    def getAll(self, name):
+        name = js_to_string(name)
+        return JSArray(v for k, v in self._entries if k == name)
+
+    def has(self, name):
+        return any(k == js_to_string(name) for k, _ in self._entries)
+
+    def entries(self):
+        return JSArray(JSArray(kv) for kv in self._entries)
+
+
+class Response:
+    def __init__(self, status: int, body_text: str, status_text: str = ""):
+        self.status = status
+        self.ok = 200 <= status < 300
+        self.statusText = status_text or str(status)
+        self._text = body_text
+
+    def json(self):
+        try:
+            return JSPromise.resolve(py_to_js(_json.loads(self._text)))
+        except Exception:
+            return JSPromise.reject(
+                make_error("Unexpected token in JSON", "SyntaxError"))
+
+    def text(self):
+        return JSPromise.resolve(self._text)
+
+
+def py_to_js(v):
+    if isinstance(v, dict):
+        return JSObject({k: py_to_js(x) for k, x in v.items()})
+    if isinstance(v, list):
+        return JSArray(py_to_js(x) for x in v)
+    return v
+
+
+def js_to_py(v):
+    if v is UNDEF:
+        return None
+    if isinstance(v, dict):
+        return {k: js_to_py(x) for k, x in v.items() if x is not UNDEF}
+    if isinstance(v, (JSArray, list)):
+        return [js_to_py(x) for x in v]
+    return v
+
+
+class JSDate:
+    _js_class = None  # set after definition for instanceof
+
+    def __init__(self, *args):
+        if not args:
+            self._dt = _dt.datetime.now(_dt.timezone.utc)
+        elif isinstance(args[0], (int, float)) and not isinstance(args[0], bool):
+            self._dt = _dt.datetime.fromtimestamp(
+                args[0] / 1000.0, _dt.timezone.utc)
+        else:
+            s = js_to_string(args[0])
+            try:
+                self._dt = _dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+                if self._dt.tzinfo is None:
+                    self._dt = self._dt.replace(tzinfo=_dt.timezone.utc)
+            except ValueError:
+                self._dt = None  # Invalid Date
+
+    def getTime(self):
+        if self._dt is None:
+            return float("nan")
+        return int(self._dt.timestamp() * 1000)
+
+    def toISOString(self):
+        if self._dt is None:
+            raise JSException(make_error("Invalid Date", "RangeError"))
+        return self._dt.strftime("%Y-%m-%dT%H:%M:%S.") + \
+            f"{self._dt.microsecond // 1000:03d}Z"
+
+    def toLocaleString(self):
+        return "" if self._dt is None else self._dt.strftime("%Y-%m-%d %H:%M:%S")
+
+    toLocaleTimeString = toLocaleString
+    toLocaleDateString = toLocaleString
+
+
+class URLSearchParams:
+    def __init__(self, init=""):
+        s = js_to_string(init)
+        if s.startswith("?"):
+            s = s[1:]
+        self._params = urllib.parse.parse_qsl(s, keep_blank_values=True)
+
+    def get(self, name):
+        name = js_to_string(name)
+        for k, v in self._params:
+            if k == name:
+                return v
+        return None
+
+    def set(self, name, value):
+        name, value = js_to_string(name), js_to_string(value)
+        self._params = [(k, v) for k, v in self._params if k != name]
+        self._params.append((name, value))
+        return UNDEF
+
+    def delete(self, name):
+        name = js_to_string(name)
+        self._params = [(k, v) for k, v in self._params if k != name]
+        return UNDEF
+
+    def toString(self):
+        return urllib.parse.urlencode(self._params)
+
+
+class JSURL:
+    def __init__(self, href, base=None):
+        href = getattr(href, "href", None) or js_to_string(href)
+        if base is not None:
+            href = urllib.parse.urljoin(js_to_string(base), href)
+        self._parts = urllib.parse.urlsplit(href)
+        self.searchParams = URLSearchParams(self._parts.query)
+
+    @property
+    def pathname(self):
+        return self._parts.path
+
+    @property
+    def search(self):
+        q = self.searchParams.toString()
+        return ("?" + q) if q else ""
+
+    @property
+    def href(self):
+        return urllib.parse.urlunsplit(self._parts._replace(
+            query=self.searchParams.toString()))
+
+    def toString(self):
+        return self.href
+
+
+class Location:
+    def __init__(self, href: str):
+        self._url = JSURL(href)
+
+    @property
+    def href(self):
+        return self._url.href
+
+    @property
+    def search(self):
+        return self._url.search
+
+    @property
+    def pathname(self):
+        return self._url.pathname
+
+    @property
+    def origin(self):
+        p = self._url._parts
+        return f"{p.scheme}://{p.netloc}" if p.scheme else ""
+
+    def toString(self):
+        return self.href
+
+
+class History:
+    def __init__(self, window):
+        self._window = window
+
+    def replaceState(self, _state, _title, url):
+        self._window.location = Location(
+            urllib.parse.urljoin(self._window.location.href,
+                                 getattr(url, "href", None) or js_to_string(url))
+        )
+        return UNDEF
+
+    pushState = replaceState
+
+
+class Timers:
+    def __init__(self):
+        self._next_id = 1
+        self.pending: Dict[int, dict] = {}
+
+    def set_timeout(self, fn, ms=0, *args):
+        tid = self._next_id
+        self._next_id += 1
+        self.pending[tid] = {"fn": fn, "ms": js_number(ms), "args": list(args),
+                             "interval": False}
+        return tid
+
+    def set_interval(self, fn, ms=0, *args):
+        tid = self.set_timeout(fn, ms, *args)
+        self.pending[tid]["interval"] = True
+        return tid
+
+    def clear(self, tid=UNDEF):
+        if isinstance(tid, (int, float)):
+            self.pending.pop(int(tid), None)
+        return UNDEF
+
+    def fire_all(self, include_intervals=True):
+        """Run every pending timer once (intervals stay registered)."""
+        for tid in list(self.pending):
+            entry = self.pending.get(tid)
+            if entry is None:
+                continue
+            if entry["interval"] and not include_intervals:
+                continue
+            if not entry["interval"]:
+                del self.pending[tid]
+            call_function(entry["fn"], entry["args"])
+
+
+class Window:
+    def __init__(self, harness: "BrowserHarness", href: str):
+        self.location = Location(href)
+        self.history = History(self)
+        self._harness = harness
+
+    def confirm(self, text=""):
+        self._harness.confirm_prompts.append(js_to_string(text))
+        return self._harness.confirm_response
+
+    def alert(self, text=""):
+        self._harness.alerts.append(js_to_string(text))
+        return UNDEF
+
+    def open(self, url, *_):
+        self._harness.opened_windows.append(js_to_string(url))
+        return None
+
+    def addEventListener(self, *_):
+        return UNDEF
+
+    def scrollTo(self, *_):
+        return UNDEF
+
+
+# ---------------------------------------------------------------------------
+# The harness
+# ---------------------------------------------------------------------------
+
+
+class BrowserHarness:
+    """Load an SPA's index.html + app.js against a WSGI backend client.
+
+    ``client``: a ``werkzeug.test.Client`` of the backend app — or a mapping
+    of path-prefix -> Client for SPAs that call more than one service.
+    ``user``: trusted-header identity sent on every fetched request.
+    """
+
+    def __init__(self, frontend_dir: str, client, *,
+                 url: str = "http://spa.test/?ns=user1",
+                 user: Optional[str] = "test-user@kubeflow.org",
+                 user_header: str = "kubeflow-userid",
+                 index: str = "index.html"):
+        import os
+
+        self.frontend_dir = frontend_dir
+        self.clients = client if isinstance(client, dict) else {"": client}
+        self.user = user
+        self.user_header = user_header
+        self.confirm_response = True
+        self.confirm_prompts: List[str] = []
+        self.alerts: List[str] = []
+        self.opened_windows: List[str] = []
+        self.errors: List[Any] = []
+        self.console: List[str] = []
+        self.requests: List[dict] = []
+        self.timers = Timers()
+
+        with open(os.path.join(frontend_dir, index)) as f:
+            self.document = parse_html(f.read())
+        self.window = Window(self, url)
+
+        self.interp = Interpreter()
+        self.modules = ModuleSystem(self.interp)
+        self._install_globals()
+
+        for script in self.document.getElementsByTagName("script"):
+            src = script.attributes.get("src")
+            if not src:
+                continue
+            path = os.path.normpath(os.path.join(frontend_dir, src))
+            if not os.path.exists(path):
+                # served-path imports like /frontend/shared/common.js
+                path = os.path.normpath(os.path.join(
+                    os.path.dirname(frontend_dir), src.lstrip("/")))
+            self.modules.run_module(path)
+
+    # -- fetch bridge --------------------------------------------------------
+
+    def _client_for(self, path: str):
+        best, best_len = None, -1
+        for prefix, client in self.clients.items():
+            if path.startswith(prefix) and len(prefix) > best_len:
+                best, best_len = client, len(prefix)
+        return best
+
+    def _fetch(self, path, opts=UNDEF):
+        path = js_to_string(path)
+        opts = opts if isinstance(opts, dict) else {}
+        method = js_to_string(opts.get("method", "GET")).upper()
+        headers = {k: js_to_string(v)
+                   for k, v in (opts.get("headers") or {}).items()}
+        if self.user:
+            headers.setdefault(self.user_header, self.user)
+        if self.document.cookie:
+            headers["Cookie"] = self.document.cookie
+        body = opts.get("body")
+        data = js_to_string(body) if body not in (None, UNDEF) else None
+        client = self._client_for(path)
+        if client is None:
+            return JSPromise.reject(make_error(
+                f"fetch: no backend for {path}", "TypeError"))
+        self.requests.append({"method": method, "path": path, "body": data})
+        resp = client.open(path, method=method, data=data, headers=headers)
+        for cookie in resp.headers.getlist("Set-Cookie"):
+            self.document.cookie = cookie
+        return JSPromise.resolve(Response(
+            resp.status_code, resp.get_data(as_text=True),
+            resp.status.split(" ", 1)[-1] if " " in resp.status else resp.status,
+        ))
+
+    # -- globals -------------------------------------------------------------
+
+    def _install_globals(self):
+        g = self.interp.globals
+        doc = self.document
+
+        def parse_int(s, base=10):
+            s = js_to_string(s).strip()
+            m = _re.match(r"[+-]?\d+" if js_number(base) == 10 else
+                          r"[+-]?[0-9a-fA-F]+", s)
+            return int(m.group(0), int(js_number(base))) if m else float("nan")
+
+        def parse_float(s):
+            m = _re.match(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?",
+                          js_to_string(s).strip())
+            return js_number(m.group(0)) if m else float("nan")
+
+        json_ns = JSObject({
+            "stringify": lambda v, *_a: _json.dumps(js_to_py(v)),
+            "parse": lambda s: py_to_js(_json.loads(js_to_string(s))),
+        })
+        math_ns = JSObject({
+            "max": lambda *xs: _norm(max(js_number(x) for x in xs)) if xs else float("-inf"),
+            "min": lambda *xs: _norm(min(js_number(x) for x in xs)) if xs else float("inf"),
+            "round": lambda x: _norm(math.floor(js_number(x) + 0.5)),
+            "floor": lambda x: _norm(math.floor(js_number(x))),
+            "ceil": lambda x: _norm(math.ceil(js_number(x))),
+            "abs": lambda x: _norm(abs(js_number(x))),
+            "random": lambda: _random.random(),
+            "trunc": lambda x: _norm(math.trunc(js_number(x))),
+            "pow": lambda a, b: _norm(js_number(a) ** js_number(b)),
+            "sqrt": lambda x: _norm(math.sqrt(js_number(x))),
+        })
+        object_ns = JSObject({
+            "assign": _object_assign,
+            "keys": lambda o: JSArray(o.keys()) if isinstance(o, dict) else JSArray(),
+            "values": lambda o: JSArray(o.values()) if isinstance(o, dict) else JSArray(),
+            "entries": lambda o: JSArray(
+                JSArray([k, v]) for k, v in o.items()) if isinstance(o, dict)
+                else JSArray(),
+            "fromEntries": lambda pairs: JSObject(
+                {js_to_string(k): v for k, v in pairs}),
+        })
+        array_ns = JSObject({
+            "isArray": lambda v=UNDEF: isinstance(v, JSArray),
+            "from": lambda it, fn=UNDEF: JSArray(
+                call_function(fn, [x, i]) if callable(fn) else x
+                for i, x in enumerate(list(it))),
+        })
+
+        def make_date(*args):
+            return JSDate(*args)
+
+        date_ctor = make_date
+        # Date.now() as a property of the constructor function: wrap.
+        date_ns = _CallableWithProps(date_ctor, {
+            "now": lambda: int(
+                _dt.datetime.now(_dt.timezone.utc).timestamp() * 1000),
+        })
+
+        promise_ns = _CallableWithProps(
+            lambda executor=UNDEF: _promise_from_executor(executor), {
+                "resolve": JSPromise.resolve,
+                "reject": JSPromise.reject,
+                "all": lambda arr: _promise_all(arr),
+            })
+
+        def console_write(*args):
+            self.console.append(" ".join(js_to_string(a) for a in args))
+            return UNDEF
+
+        g.declare("document", doc)
+        g.declare("window", self.window)
+        g.declare("location", self.window.location)
+        g.declare("history", self.window.history)
+        g.declare("fetch", self._fetch)
+        g.declare("console", JSObject({
+            "log": console_write, "warn": console_write,
+            "error": console_write, "info": console_write,
+            "debug": console_write,
+        }))
+        g.declare("JSON", json_ns)
+        g.declare("Math", math_ns)
+        g.declare("Object", object_ns)
+        g.declare("Array", array_ns)
+        g.declare("Date", date_ns)
+        g.declare("Promise", promise_ns)
+        g.declare("Node", Node)
+        g.declare("Element", Element)
+        g.declare("FormData", FormData)
+        g.declare("URLSearchParams", URLSearchParams)
+        g.declare("URL", JSURL)
+        g.declare("RegExp", JSRegExp)
+        g.declare("Error", _error_ctor("Error"))
+        g.declare("TypeError", _error_ctor("TypeError"))
+        g.declare("String", lambda v="": js_to_string(v))
+        g.declare("Number", _CallableWithProps(
+            lambda v=0: js_number(v), {
+                "isInteger": lambda v=UNDEF: isinstance(v, int)
+                and not isinstance(v, bool),
+                "isFinite": lambda v=UNDEF: isinstance(v, (int, float))
+                and not isinstance(v, bool) and math.isfinite(v),
+                "parseFloat": parse_float, "parseInt": parse_int,
+            }))
+        g.declare("Boolean", lambda v=UNDEF: js_truthy(v))
+        g.declare("parseInt", parse_int)
+        g.declare("parseFloat", parse_float)
+        g.declare("isNaN", lambda v=UNDEF: (
+            isinstance(js_number(v), float) and math.isnan(js_number(v))))
+        g.declare("encodeURIComponent",
+                  lambda s="": urllib.parse.quote(js_to_string(s), safe=""))
+        g.declare("decodeURIComponent",
+                  lambda s="": urllib.parse.unquote(js_to_string(s)))
+        g.declare("setTimeout", self.timers.set_timeout)
+        g.declare("setInterval", self.timers.set_interval)
+        g.declare("clearTimeout", self.timers.clear)
+        g.declare("clearInterval", self.timers.clear)
+        g.declare("NaN", float("nan"))
+        g.declare("Infinity", float("inf"))
+        g.declare("globalThis", self.window)
+
+    # -- test-facing helpers -------------------------------------------------
+
+    def get(self, element_id: str) -> Element:
+        el = self.document.getElementById(element_id)
+        assert el is not None, f"no element #{element_id}"
+        return el
+
+    def query(self, selector: str) -> Element:
+        el = self.document.querySelector(selector)
+        assert el is not None, f"no element matching {selector!r}"
+        return el
+
+    def query_all(self, selector: str):
+        return self.document.querySelectorAll(selector)
+
+    def set_value(self, selector: str, value, *, event: str = "change"):
+        el = self.query(selector)
+        el.value = value
+        el.dispatchEvent(DOMEvent(event, el))
+        return el
+
+    def click(self, selector: str):
+        return self.query(selector).click()
+
+    def submit(self, selector: str):
+        return self.query(selector).requestSubmit()
+
+    def fire_timers(self):
+        """Run every queued timeout/interval once (polling refresh etc.)."""
+        self.timers.fire_all()
+
+    def text(self, selector: str) -> str:
+        return self.query(selector).textContent
+
+
+def _norm(x):
+    if isinstance(x, float) and math.isfinite(x) and x.is_integer():
+        return int(x)
+    return x
+
+
+def _object_assign(target, *sources):
+    for s in sources:
+        if isinstance(s, dict):
+            target.update(s)
+    return target
+
+
+class _CallableWithProps:
+    """A constructor function that also carries static properties
+    (``Date.now``, ``Promise.resolve``, …)."""
+
+    def __init__(self, fn, props: Dict[str, Any]):
+        self._fn = fn
+        for k, v in props.items():
+            setattr(self, k, v)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+
+def _error_ctor(name):
+    def ctor(message=""):
+        return JSObject({"name": name, "message": js_to_string(message)})
+
+    return ctor
+
+
+def _promise_from_executor(executor):
+    box = {"state": "fulfilled", "value": UNDEF}
+
+    def resolve(v=UNDEF):
+        box["state"], box["value"] = "fulfilled", v
+        return UNDEF
+
+    def reject(v=UNDEF):
+        box["state"], box["value"] = "rejected", v
+        return UNDEF
+
+    if callable(executor):
+        call_function(executor, [resolve, reject])
+    return JSPromise(box["state"], box["value"])
+
+
+def _promise_all(arr):
+    out = JSArray()
+    for p in list(arr):
+        if isinstance(p, JSPromise):
+            if p.state == "rejected":
+                return p
+            out.append(p.value)
+        else:
+            out.append(p)
+    return JSPromise.resolve(out)

@@ -113,19 +113,3 @@ def test_dashboard_lists_namespace_contributors():
         headers={"kubeflow-userid": "mallory@x.io"},
     )
     assert resp.status_code == 403
-
-
-def test_spawner_form_fields_match_backend_contract():
-    """The JS form posts these field names; build_notebook must accept them
-    (regression guard tying frontend to form.py)."""
-    import os
-
-    js = open(
-        os.path.join(
-            os.path.dirname(__file__), "..", "..",
-            "kubeflow_tpu", "platform", "frontend", "jupyter", "app.js",
-        )
-    ).read()
-    for field in ("name", "cpu", "memory", "tpus", "customImage",
-                  "customImageCheck", "configurations", "workspaceVolume"):
-        assert field in js, f"spawner JS no longer sends {field}"
