@@ -104,7 +104,15 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         )
         if not pods:
             raise HttpError(404, f"no pods for notebook {name}")
-        return success({"pod": pods[0]})
+        # "pod" is worker 0 (back-compat); "pods" lists every worker of a
+        # multi-host slice for the detail page's log selector, in ordinal
+        # order (lexicographic would put nb-10 before nb-2).
+        def ordinal(pod):
+            prefix, _, tail = name_of(pod).rpartition("-")
+            return (prefix, int(tail)) if tail.isdigit() else (name_of(pod), -1)
+
+        pods = sorted(pods, key=ordinal)
+        return success({"pod": pods[0], "pods": [name_of(p) for p in pods]})
 
     @app.route("/api/namespaces/<ns>/notebooks/<name>/pod/<pod>/logs")
     def get_pod_logs(request: Request, ns: str, name: str, pod: str):

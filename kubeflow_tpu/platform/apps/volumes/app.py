@@ -112,9 +112,27 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
 
     @app.route("/api/namespaces/<ns>/pvcs/<name>/pods")
     def pvc_pods(request: Request, ns: str, name: str):
+        """Pods mounting the PVC, with phase + mount path — what the
+        volume-details page tables (reference volume-details-page)."""
         user = current_user(request)
-        pods = backend.list_resources(user, POD, ns)
-        return success({"pods": _pods_using(pods, name)})
+        out = []
+        for pod, vol in _pods_mounting(
+            backend.list_resources(user, POD, ns), name
+        ):
+            mount = ""
+            for c in deep_get(pod, "spec", "containers", default=[]) or []:
+                for m in c.get("volumeMounts") or []:
+                    if m.get("name") == vol.get("name"):
+                        mount = m.get("mountPath", "")
+                        break
+                if mount:
+                    break
+            out.append({
+                "name": name_of(pod),
+                "phase": deep_get(pod, "status", "phase", default="Pending"),
+                "mountPath": mount,
+            })
+        return success({"pods": out})
 
     @app.route("/api/namespaces/<ns>/pvcs/<name>/events")
     def pvc_events(request: Request, ns: str, name: str):
@@ -132,11 +150,15 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> A
     return app
 
 
-def _pods_using(pods, claim: str):
-    out = []
+def _pods_mounting(pods, claim: str):
+    """(pod, volume) pairs for pods whose spec references ``claim`` — the
+    single claim-matching traversal both the list and details views use."""
     for pod in pods:
         for vol in deep_get(pod, "spec", "volumes", default=[]) or []:
             if deep_get(vol, "persistentVolumeClaim", "claimName") == claim:
-                out.append(name_of(pod))
+                yield pod, vol
                 break
-    return out
+
+
+def _pods_using(pods, claim: str):
+    return [name_of(pod) for pod, _vol in _pods_mounting(pods, claim)]

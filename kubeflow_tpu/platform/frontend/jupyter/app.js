@@ -1,5 +1,11 @@
-/* Notebook spawner + table SPA.  The TPU accelerator/topology selector
-   replaces the reference's GPU vendor dropdown (form-gpus component). */
+/* Notebook spawner + table + detail SPA.  The TPU accelerator/topology
+   selector replaces the reference's GPU vendor dropdown (form-gpus
+   component); the spawner exposes every backend form setter (form.py):
+   server type image groups, cpu/memory, TPU + multislice, workspace volume
+   (default/custom/none), data volumes (new PVC or attach existing),
+   shm, PodDefault configurations, affinity/toleration groups.  The detail
+   view is the reference's notebook-page (overview/logs/events/yaml tabs,
+   reference jupyter/frontend/src/app/pages/notebook-page/). */
 import {
   api, namespace, el, toast, statusDot, age, poll, confirmDialog,
 } from "./shared/common.js";
@@ -8,23 +14,74 @@ const ns = namespace();
 document.getElementById("ns-label").textContent = "namespace: " + ns;
 
 let config = null;
+let offeredTpus = [];
+let existingPvcs = [];
+let volumeRows = [];
+let detailName = null;
+
+const IMAGE_GROUPS = {
+  "jupyter": "image",
+  "group-two": "imageGroupTwo",
+  "group-three": "imageGroupThree",
+};
+
+function serverType() {
+  const checked = document.querySelector("[name=serverType]:checked");
+  return checked ? checked.value : "jupyter";
+}
+
+function fillImageSelect() {
+  const field = IMAGE_GROUPS[serverType()] || "image";
+  const group = config[field] || {};
+  const select = document.getElementById("image-select");
+  select.replaceChildren();
+  for (const image of group.options || [group.value]) {
+    const opt = el("option", { value: image }, image.split("/").pop());
+    if (image === group.value) opt.setAttribute("selected", "");
+    select.append(opt);
+  }
+  if (!group.readOnly) {
+    select.append(el("option", { value: "__custom__" }, "custom image…"));
+  }
+  select.disabled = !!group.readOnly;
+  document.getElementById("custom-image-row").hidden = true;
+}
+
+function applyReadOnly(field, control) {
+  if ((config[field] || {}).readOnly) control.disabled = true;
+}
 
 async function loadConfig() {
   config = (await api("/api/config")).config;
+  fillImageSelect();
   const select = document.getElementById("image-select");
-  select.replaceChildren();
-  for (const image of config.image.options || [config.image.value]) {
-    select.append(el("option", { value: image, selected: image === config.image.value ? "" : null }, image.split("/").pop()));
-  }
-  select.append(el("option", { value: "__custom__" }, "custom image…"));
   select.addEventListener("change", () => {
     document.getElementById("custom-image-row").hidden = select.value !== "__custom__";
   });
-  document.querySelector("[name=cpu]").value = config.cpu.value;
-  document.querySelector("[name=memory]").value = config.memory.value;
+  for (const radio of document.querySelectorAll("[name=serverType]")) {
+    radio.addEventListener("change", fillImageSelect);
+  }
+  const cpu = document.querySelector("[name=cpu]");
+  const memory = document.querySelector("[name=memory]");
+  cpu.value = config.cpu.value;
+  memory.value = config.memory.value;
+  applyReadOnly("cpu", cpu);
+  applyReadOnly("memory", memory);
+  const shm = document.getElementById("shm-check");
+  shm.checked = !!(config.shm && config.shm.value);
+  applyReadOnly("shm", shm);
+  const affinity = document.getElementById("affinity-select");
+  for (const opt of (config.affinityConfig && config.affinityConfig.options) || []) {
+    affinity.append(el("option", { value: opt.configKey }, opt.displayName || opt.configKey));
+  }
+  applyReadOnly("affinityConfig", affinity);
+  const tolerations = document.getElementById("toleration-select");
+  for (const opt of (config.tolerationGroup && config.tolerationGroup.options) || []) {
+    tolerations.append(el("option", { value: opt.groupKey }, opt.displayName || opt.groupKey));
+  }
+  applyReadOnly("tolerationGroup", tolerations);
+  applyReadOnly("workspaceVolume", document.getElementById("workspace-select"));
 }
-
-let offeredTpus = [];
 
 function syncTopologies() {
   const acc = document.getElementById("tpu-acc");
@@ -75,9 +132,180 @@ async function loadPoddefaults() {
   }
 }
 
-function connectUrl(nb) {
-  return `/notebook/${nb.namespace}/${nb.name}/`;
+async function loadExistingPvcs() {
+  try {
+    // The route returns raw PVC objects (name under metadata).
+    existingPvcs = (await api(`/api/namespaces/${ns}/pvcs`)).pvcs
+      .map((p) => (p.metadata ? p.metadata.name : p.name));
+  } catch (e) {
+    existingPvcs = [];
+  }
+  for (const row of volumeRows) fillPvcOptions(row);
 }
+
+/* -- data volume rows (reference form-data-volumes component) ------------- */
+
+function fillPvcOptions(row) {
+  if (!row.pvcSel) return;
+  const current = row.pvcSel.value;
+  row.pvcSel.replaceChildren();
+  for (const name of existingPvcs) {
+    row.pvcSel.append(el("option", { value: name }, name));
+  }
+  if (current) row.pvcSel.value = current;
+}
+
+let volumeRowSeq = 0;
+
+function addVolumeRow() {
+  volumeRowSeq += 1; // monotonic: a removed row's mount path never recurs
+  const typeSel = el("select", { class: "vol-type" },
+    el("option", { value: "new" }, "New PVC"),
+    el("option", { value: "existing" }, "Existing PVC"));
+  const nameIn = el("input", { class: "vol-name", placeholder: "{notebook-name}-data" });
+  const sizeIn = el("input", { class: "vol-size", value: "10Gi" });
+  const pvcSel = el("select", { class: "vol-existing" });
+  const mountIn = el("input", { class: "vol-mount", value: `/data/vol-${volumeRowSeq}` });
+  const removeBtn = el("button", { type: "button", class: "ghost vol-remove" }, "✕");
+  const newFields = el("span", {}, nameIn, sizeIn);
+  const existingFields = el("span", { hidden: "" }, pvcSel);
+  const root = el("div", { class: "row vol-row" },
+    typeSel, newFields, existingFields, el("span", {}, "mount at"), mountIn, removeBtn);
+  const row = { root, typeSel, nameIn, sizeIn, pvcSel, mountIn };
+  typeSel.addEventListener("change", () => {
+    newFields.hidden = typeSel.value !== "new";
+    existingFields.hidden = typeSel.value !== "existing";
+  });
+  removeBtn.addEventListener("click", () => {
+    volumeRows = volumeRows.filter((r) => r !== row);
+    root.remove();
+  });
+  fillPvcOptions(row);
+  volumeRows.push(row);
+  document.getElementById("data-volumes").append(root);
+  return row;
+}
+
+function clearVolumeRows() {
+  volumeRows = [];
+  document.getElementById("data-volumes").replaceChildren();
+}
+
+/* -- spawn ---------------------------------------------------------------- */
+
+function connectUrl(nb) {
+  return `/notebook/${nb.namespace || ns}/${nb.name}/`;
+}
+
+function spawnBody(form) {
+  const data = new FormData(form);
+  const body = {
+    name: data.get("name"),
+    serverType: data.get("serverType") || "jupyter",
+    cpu: data.get("cpu"),
+    memory: data.get("memory"),
+    shm: !!data.get("shm"),
+    configurations: [...document.querySelectorAll("#poddefault-chips .chip.on")]
+      .map((chip) => chip.dataset.label),
+  };
+  if (data.get("image") === "__custom__") {
+    body.customImage = data.get("customImage");
+    body.customImageCheck = true;
+  } else if (data.get("image")) {
+    const field = IMAGE_GROUPS[body.serverType] || "image";
+    body[field] = data.get("image");
+  }
+  const accelerator = data.get("tpuAccelerator");
+  if (accelerator) {
+    body.tpus = { accelerator, topology: data.get("tpuTopology") || "" };
+    const slices = parseInt(data.get("tpuSlices"), 10);
+    if (slices > 1) body.tpus.slices = slices;
+  }
+  const workspace = data.get("workspace");
+  if (workspace === "none") {
+    body.workspaceVolume = null;
+  } else if (workspace === "custom") {
+    body.workspaceVolume = {
+      mount: "/home/jovyan",
+      newPvc: {
+        metadata: { name: data.get("workspaceName") || "{notebook-name}-workspace" },
+        spec: {
+          resources: { requests: { storage: data.get("workspaceSize") || "10Gi" } },
+          accessModes: ["ReadWriteOnce"],
+        },
+      },
+    };
+  }
+  const dataVolumes = [];
+  for (const row of volumeRows) {
+    if (row.typeSel.value === "existing") {
+      if (!row.pvcSel.value) continue;
+      dataVolumes.push({
+        mount: row.mountIn.value,
+        existingSource: { persistentVolumeClaim: { claimName: row.pvcSel.value } },
+      });
+    } else {
+      if (!row.nameIn.value) continue;
+      dataVolumes.push({
+        mount: row.mountIn.value,
+        newPvc: {
+          metadata: { name: row.nameIn.value },
+          spec: {
+            resources: { requests: { storage: row.sizeIn.value || "10Gi" } },
+            accessModes: ["ReadWriteOnce"],
+          },
+        },
+      });
+    }
+  }
+  if (dataVolumes.length) body.dataVolumes = dataVolumes;
+  const affinity = document.getElementById("affinity-select").value;
+  if (affinity) body.affinityConfig = affinity;
+  const tolerations = document.getElementById("toleration-select").value;
+  if (tolerations) body.tolerationGroup = tolerations;
+  return body;
+}
+
+function wireSpawner() {
+  const dialog = document.getElementById("spawner");
+  document.getElementById("tpu-acc").addEventListener("change", syncTopologies);
+  document.getElementById("workspace-select").addEventListener("change", (ev) => {
+    document.getElementById("workspace-custom-row").hidden = ev.target.value !== "custom";
+  });
+  document.getElementById("add-volume").addEventListener("click", () => addVolumeRow());
+  document.getElementById("new-notebook").addEventListener("click", () => {
+    loadTpus();
+    loadPoddefaults();
+    loadExistingPvcs();
+    // Re-apply config defaults a form.reset() reverted to HTML attributes.
+    document.getElementById("shm-check").checked = !!(config.shm && config.shm.value);
+    const cpu = document.querySelector("[name=cpu]");
+    const memory = document.querySelector("[name=memory]");
+    if (!cpu.disabled) cpu.value = config.cpu.value;
+    if (!memory.disabled) memory.value = config.memory.value;
+    dialog.showModal();
+  });
+  document.getElementById("spawn-cancel").addEventListener("click", () => dialog.close());
+  document.getElementById("spawn-form").addEventListener("submit", async (ev) => {
+    ev.preventDefault();
+    const body = spawnBody(ev.target);
+    try {
+      await api(`/api/namespaces/${ns}/notebooks`, {
+        method: "POST",
+        body: JSON.stringify(body),
+      });
+      toast("Launching " + body.name);
+      dialog.close();
+      ev.target.reset();
+      clearVolumeRows();
+      refreshTable();
+    } catch (e) {
+      toast(e.message, true);
+    }
+  });
+}
+
+/* -- table ---------------------------------------------------------------- */
 
 async function refreshTable() {
   let notebooks = [];
@@ -97,13 +325,18 @@ async function refreshTable() {
       : "—";
     tbody.append(el("tr", {},
       el("td", {}, statusDot((nb.status && nb.status.phase) || "waiting")),
-      el("td", {}, el("a", { href: connectUrl(nb), target: "_blank" }, nb.name)),
+      el("td", {}, el("a", {
+        href: `?ns=${ns}&nb=${nb.name}`,
+        class: "nb-name",
+        onclick: (ev) => { ev.preventDefault(); showDetail(nb.name); },
+      }, nb.name)),
       el("td", { class: "mono", title: nb.image }, nb.shortImage),
       el("td", {}, tpuText),
       el("td", {}, nb.cpu || "—"),
       el("td", {}, nb.memory || "—"),
       el("td", {}, age(nb.age)),
       el("td", {},
+        el("a", { class: "button ghost", href: connectUrl(nb), target: "_blank" }, "Connect"),
         el("button", {
           class: "ghost",
           onclick: () => toggleStop(nb, !stopped),
@@ -141,59 +374,184 @@ async function removeNotebook(nb) {
   }
 }
 
-function spawnBody(form) {
-  const data = new FormData(form);
-  const body = {
-    name: data.get("name"),
-    cpu: data.get("cpu"),
-    memory: data.get("memory"),
-    configurations: [...document.querySelectorAll("#poddefault-chips .chip.on")]
-      .map((chip) => chip.dataset.label),
-  };
-  if (data.get("image") === "__custom__") {
-    body.customImage = data.get("customImage");
-    body.customImageCheck = true;
-  } else {
-    body.image = data.get("image");
+/* -- detail page (overview / logs / events / yaml) ------------------------ */
+
+function selectTab(tab) {
+  for (const a of document.querySelectorAll("#detail-tabs a")) {
+    a.classList.toggle("active", a.dataset.tab === tab);
   }
-  const accelerator = data.get("tpuAccelerator");
-  if (accelerator) {
-    body.tpus = { accelerator, topology: data.get("tpuTopology") || "" };
-    const slices = parseInt(data.get("tpuSlices"), 10);
-    if (slices > 1) body.tpus.slices = slices;
+  for (const name of ["overview", "logs", "events", "yaml"]) {
+    document.getElementById("tab-" + name).hidden = name !== tab;
   }
-  if (data.get("workspace") === "none") body.workspaceVolume = null;
-  return body;
+  if (tab === "logs") loadPods().then(loadLogs).catch((e) => toast(e.message, true));
+  if (tab === "events") loadEvents().catch((e) => toast(e.message, true));
 }
 
-function wireSpawner() {
-  const dialog = document.getElementById("spawner");
-  document.getElementById("tpu-acc").addEventListener("change", syncTopologies);
-  document.getElementById("new-notebook").addEventListener("click", () => {
-    loadTpus();
-    loadPoddefaults();
-    dialog.showModal();
-  });
-  document.getElementById("spawn-cancel").addEventListener("click", () => dialog.close());
-  document.getElementById("spawn-form").addEventListener("submit", async (ev) => {
-    ev.preventDefault();
-    const body = spawnBody(ev.target);
-    try {
-      await api(`/api/namespaces/${ns}/notebooks`, {
-        method: "POST",
-        body: JSON.stringify(body),
-      });
-      toast("Launching " + body.name);
-      dialog.close();
-      ev.target.reset();
-      refreshTable();
-    } catch (e) {
-      toast(e.message, true);
+async function showDetail(name) {
+  detailName = name;
+  document.getElementById("view-table").hidden = true;
+  document.getElementById("view-detail").hidden = false;
+  document.getElementById("detail-title").textContent = name;
+  document.getElementById("detail-connect").href = `/notebook/${ns}/${name}/`;
+  selectTab("overview");
+  try {
+    await refreshDetail();
+  } catch (e) {
+    toast(e.message, true);
+  }
+}
+
+function backToTable() {
+  detailName = null;
+  document.getElementById("view-detail").hidden = true;
+  document.getElementById("view-table").hidden = false;
+  refreshTable();
+}
+
+async function refreshDetail() {
+  const nb = (await api(`/api/namespaces/${ns}/notebooks/${detailName}`)).notebook;
+  const spec = ((nb.spec || {}).template || {}).spec || {};
+  const container = (spec.containers || [{}])[0];
+  const resources = container.resources || {};
+  const requests = resources.requests || {};
+  const tpu = (nb.spec || {}).tpu;
+  const list = document.getElementById("overview-list");
+  list.replaceChildren();
+  const add = (k, v) => list.append(el("dt", {}, k), el("dd", {}, v));
+  add("Image", container.image || "—");
+  add("TPU", tpu
+    ? `${tpu.accelerator}${tpu.topology ? " " + tpu.topology : ""}` +
+      (tpu.slices > 1 ? ` × ${tpu.slices} slices` : "")
+    : "none");
+  add("CPU", requests.cpu || "—");
+  add("Memory", requests.memory || "—");
+  add("Created", (nb.metadata || {}).creationTimestamp
+    ? age((nb.metadata || {}).creationTimestamp) + " ago" : "—");
+  add("Volumes", (spec.volumes || []).map((v) => v.name).join(", ") || "none");
+  const conditions = (nb.status || {}).conditions || [];
+  const tbody = document.querySelector("#cond-table tbody");
+  tbody.replaceChildren();
+  for (const c of conditions) {
+    tbody.append(el("tr", {},
+      el("td", {}, c.type || ""), el("td", {}, c.status || ""),
+      el("td", {}, c.reason || ""), el("td", {}, c.message || "")));
+  }
+  document.getElementById("yaml-output").textContent = toYaml(nb);
+}
+
+async function loadPods() {
+  const select = document.getElementById("log-pod-select");
+  select.replaceChildren();
+  try {
+    const out = await api(`/api/namespaces/${ns}/notebooks/${detailName}/pod`);
+    for (const pod of out.pods || []) {
+      select.append(el("option", { value: pod }, pod));
     }
+  } catch (e) {
+    document.getElementById("log-output").textContent =
+      "No pods (notebook may be stopped or still scheduling).";
+    throw e;
+  }
+}
+
+async function loadLogs() {
+  const pod = document.getElementById("log-pod-select").value;
+  if (!pod) return;
+  try {
+    const out = await api(
+      `/api/namespaces/${ns}/notebooks/${detailName}/pod/${pod}/logs`);
+    document.getElementById("log-output").textContent = out.logs.join("\n");
+  } catch (e) {
+    document.getElementById("log-output").textContent = e.message;
+  }
+}
+
+async function loadEvents() {
+  const out = await api(`/api/namespaces/${ns}/notebooks/${detailName}/events`);
+  const events = out.events || [];
+  document.getElementById("ev-empty").hidden = events.length > 0;
+  const tbody = document.querySelector("#ev-table tbody");
+  tbody.replaceChildren();
+  for (const ev of events) {
+    tbody.append(el("tr", {},
+      el("td", {}, age(ev.lastTimestamp || ev.firstTimestamp)),
+      el("td", {}, ev.type || ""),
+      el("td", {}, ev.reason || ""),
+      el("td", {}, ev.message || "")));
+  }
+}
+
+/* Minimal YAML rendering of the CR for the yaml tab (reference shows the
+   object as YAML; JSON in, YAML out — strings quoted only when needed). */
+const YAML_NEEDS_QUOTES = new RegExp(
+  "[:#\\[\\]{}&*!|>'\"%@`]|^\\s|\\s$|^-" +
+  // Any number-like string (int/float/exponent) must quote or it changes
+  // type on re-parse ("1.5" label -> 1.5 number).
+  "|^[+]?(\\d+\\.?\\d*|\\.\\d+)([eE][+-]?\\d+)?$" +
+  "|^(true|false|null)$");
+
+function yamlScalar(v) {
+  if (v === null || v === undefined) return "null";
+  if (typeof v === "boolean" || typeof v === "number") return String(v);
+  const s = String(v);
+  if (s === "" || YAML_NEEDS_QUOTES.test(s)) return JSON.stringify(s);
+  return s;
+}
+
+function toYaml(v, indent = "") {
+  if (Array.isArray(v)) {
+    if (!v.length) return indent + "[]";
+    return v.map((item) => {
+      if (item && typeof item === "object") {
+        const body = toYaml(item, indent + "  ");
+        return indent + "- " + body.slice(indent.length + 2);
+      }
+      return indent + "- " + yamlScalar(item);
+    }).join("\n");
+  }
+  if (v && typeof v === "object") {
+    const keys = Object.keys(v);
+    if (!keys.length) return indent + "{}";
+    return keys.map((k) => {
+      const item = v[k];
+      if (Array.isArray(item)) {
+        return item.length
+          ? indent + k + ":\n" + toYaml(item, indent + "  ")
+          : indent + k + ": []";
+      }
+      if (item && typeof item === "object") {
+        return Object.keys(item).length
+          ? indent + k + ":\n" + toYaml(item, indent + "  ")
+          : indent + k + ": {}";
+      }
+      return indent + k + ": " + yamlScalar(item);
+    }).join("\n");
+  }
+  return indent + yamlScalar(v);
+}
+
+/* -- wiring --------------------------------------------------------------- */
+
+document.getElementById("detail-back").addEventListener("click", backToTable);
+for (const a of document.querySelectorAll("#detail-tabs a")) {
+  a.addEventListener("click", (ev) => {
+    ev.preventDefault();
+    selectTab(a.dataset.tab);
   });
 }
+document.getElementById("logs-refresh").addEventListener("click", () => {
+  loadLogs();
+});
+document.getElementById("log-pod-select").addEventListener("change", () => {
+  loadLogs();
+});
 
 loadConfig().then(() => {
   wireSpawner();
-  poll(refreshTable, 10000);
+  // poll() runs its callback immediately, so no extra initial refresh.
+  poll(() => {
+    if (detailName === null) refreshTable();
+  }, 10000);
+  const deepLink = new URLSearchParams(window.location.search).get("nb");
+  if (deepLink) showDetail(deepLink);
 }).catch((e) => toast(e.message, true));

@@ -22,7 +22,11 @@ async function refresh() {
   for (const pvc of pvcs) {
     tbody.append(el("tr", {},
       el("td", {}, statusDot(PHASES[pvc.status] || "waiting")),
-      el("td", {}, pvc.name),
+      el("td", {}, el("a", {
+        href: `?ns=${ns}&pvc=${pvc.name}`,
+        class: "pvc-name",
+        onclick: (ev) => { ev.preventDefault(); showDetail(pvc.name); },
+      }, pvc.name)),
       el("td", {}, pvc.capacity),
       el("td", {}, (pvc.modes || []).join(", ")),
       el("td", {}, pvc.class || "default"),
@@ -48,6 +52,68 @@ async function remove(pvc) {
     toast(e.message, true);
   }
 }
+
+/* -- volume details (reference volume-details-page) ----------------------- */
+
+let detailName = null;
+
+async function showDetail(name) {
+  detailName = name;
+  document.getElementById("view-table").hidden = true;
+  document.getElementById("view-detail").hidden = false;
+  document.getElementById("detail-title").textContent = name;
+  try {
+    await refreshDetail();
+  } catch (e) {
+    toast(e.message, true);
+  }
+}
+
+function backToTable() {
+  detailName = null;
+  document.getElementById("view-detail").hidden = true;
+  document.getElementById("view-table").hidden = false;
+  refresh();
+}
+
+async function refreshDetail() {
+  const pvc = (await api(`/api/namespaces/${ns}/pvcs/${detailName}`)).pvc;
+  const spec = pvc.spec || {};
+  const list = document.getElementById("detail-list");
+  list.replaceChildren();
+  const add = (k, v) => list.append(el("dt", {}, k), el("dd", {}, v));
+  add("Status", ((pvc.status || {}).phase) || "Pending");
+  add("Size", (((spec.resources || {}).requests || {}).storage) || "—");
+  add("Access modes", (spec.accessModes || []).join(", ") || "—");
+  add("Storage class", spec.storageClassName || "cluster default");
+  add("Created", (pvc.metadata || {}).creationTimestamp
+    ? age((pvc.metadata || {}).creationTimestamp) + " ago" : "—");
+
+  const pods = (await api(`/api/namespaces/${ns}/pvcs/${detailName}/pods`)).pods;
+  document.getElementById("detail-pods-empty").hidden = pods.length > 0;
+  const ptbody = document.querySelector("#detail-pods-table tbody");
+  ptbody.replaceChildren();
+  for (const pod of pods) {
+    ptbody.append(el("tr", {},
+      el("td", { class: "mono" }, pod.name),
+      el("td", {}, pod.phase),
+      el("td", { class: "mono" }, pod.mountPath || "—")));
+  }
+
+  const events = (await api(`/api/namespaces/${ns}/pvcs/${detailName}/events`)).events;
+  document.getElementById("detail-ev-empty").hidden = events.length > 0;
+  const etbody = document.querySelector("#detail-ev-table tbody");
+  etbody.replaceChildren();
+  for (const ev of events) {
+    etbody.append(el("tr", {},
+      el("td", {}, age(ev.lastTimestamp || ev.firstTimestamp)),
+      el("td", {}, ev.type || ""),
+      el("td", {}, ev.reason || ""),
+      el("td", {}, ev.message || "")));
+  }
+}
+
+document.getElementById("detail-back").addEventListener("click", backToTable);
 
 async function loadClasses() {
   try {
@@ -83,4 +149,9 @@ document.getElementById("create-form").addEventListener("submit", async (ev) => 
 });
 
 loadClasses();
-poll(refresh, 10000);
+// poll() runs its callback immediately, so no extra initial refresh.
+poll(() => {
+  if (detailName === null) refresh();
+}, 10000);
+const deepLink = new URLSearchParams(window.location.search).get("pvc");
+if (deepLink) showDetail(deepLink);

@@ -310,6 +310,22 @@ class Element(Node):
     def click(self):
         if self.disabled:
             return True  # a real browser fires nothing on disabled controls
+        if self._tag == "input":
+            itype = self.attributes.get("type", "")
+            if itype == "checkbox":
+                self.checked = not self.checked
+                self.dispatchEvent(DOMEvent("change", self))
+            elif itype == "radio":
+                group = self.attributes.get("name")
+                root = self._document or self
+                if group:
+                    for n in root._descendants():
+                        if (n._tag == "input"
+                                and n.attributes.get("type") == "radio"
+                                and n.attributes.get("name") == group):
+                            n.checked = False
+                self.checked = True
+                self.dispatchEvent(DOMEvent("change", self))
         return self.dispatchEvent(DOMEvent("click", self))
 
     # -- form / dialog -------------------------------------------------------
@@ -435,6 +451,7 @@ class DOMEvent:
 _SEL_RE = _re.compile(
     r"(?P<tag>[a-zA-Z][\w-]*)?"
     r"(?P<parts>(?:[#.][\w-]+|\[[^\]]+\])*)"
+    r"(?P<pseudo>:checked)?"
 )
 
 
@@ -444,6 +461,8 @@ def _parse_selector(sel: str):
         raise ValueError(f"unsupported selector {sel!r}")
     tag = (m.group("tag") or "").lower()
     ids, classes, attrs = [], [], []
+    if m.group("pseudo") == ":checked":
+        attrs.append((":checked", None))
     for part in _re.findall(r"[#.][\w-]+|\[[^\]]+\]", m.group("parts") or ""):
         if part.startswith("#"):
             ids.append(part[1:])
@@ -473,7 +492,10 @@ def _matches(el: Element, parsed) -> bool:
     if any(c not in cs for c in classes):
         return False
     for k, v in attrs:
-        if v is None:
+        if k == ":checked":
+            if not el.checked:
+                return False
+        elif v is None:
             if k not in el.attributes:
                 return False
         elif el.attributes.get(k) != v:
