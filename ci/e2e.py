@@ -31,7 +31,7 @@ from werkzeug.test import Client
 
 
 class E2E:
-    def __init__(self, *, hosts_sim: bool = True):
+    def __init__(self, *, hosts_sim: bool = True, transport: str = "memory"):
         from kubeflow_tpu.platform.apis.poddefault import tpu_pod_default
         from kubeflow_tpu.platform.apps.jupyter.app import create_app as jwa
         from kubeflow_tpu.platform.controllers import culling, profile, tensorboard
@@ -45,29 +45,49 @@ class E2E:
 
         logging.getLogger("werkzeug").setLevel(logging.ERROR)
 
+        # self.kube is always the in-memory store (the kubelet-sim pokes it
+        # directly, standing in for the cluster); api_client is what the
+        # platform components speak.  --transport http interposes the real
+        # REST client against the FakeKube served over HTTP (the envtest
+        # analogue: watches, RV conflicts, patch content types, SARs all
+        # cross a real wire — reference suite_test.go:52-113).
         self.kube = FakeKube()
+        self.http_server = None
+        if transport == "http":
+            from kubeflow_tpu.platform.k8s.client import RestKubeClient
+            from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+
+            self.http_server = HttpKubeServer(self.kube).start()
+            self.api_client = RestKubeClient(self.http_server.base_url)
+        elif transport == "memory":
+            self.api_client = self.kube
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
         self.kube.add_namespace("kubeflow")
         self.kube.add_tpu_node("tpu-node-1", topology="2x4")
         self.kube.create(tpu_pod_default("kubeflow", "v5e", "2x4"))
 
-        self.mgr = Manager(self.kube)
-        self.mgr.add(make_controller(self.kube, use_istio=True))
-        self.mgr.add(profile.make_controller(self.kube))
-        self.mgr.add(tensorboard.make_controller(self.kube))
-        self.mgr.add(culling.make_controller(self.kube, prober=lambda url: None))
+        self.mgr = Manager(self.api_client)
+        self.mgr.add(make_controller(self.api_client, use_istio=True))
+        self.mgr.add(profile.make_controller(self.api_client))
+        self.mgr.add(tensorboard.make_controller(self.api_client))
+        self.mgr.add(culling.make_controller(
+            self.api_client, prober=lambda url: None))
         self.mgr.start()
 
-        self.webhook = WebhookServer(self.kube, host="127.0.0.1", port=0)
+        self.webhook = WebhookServer(self.api_client, host="127.0.0.1", port=0)
         self.webhook.start()
 
-        self.jupyter = Client(jwa(self.kube, secure_cookies=False))
-        self.dashboard = Client(dashboard(self.kube, secure_cookies=False))
+        self.jupyter = Client(jwa(self.api_client, secure_cookies=False))
+        self.dashboard = Client(dashboard(self.api_client, secure_cookies=False))
         self.user = {"kubeflow-userid": "e2e-user@kubeflow.org"}
         self.hosts_sim = hosts_sim
 
     def close(self):
         self.mgr.stop()
         self.webhook.stop()
+        if self.http_server is not None:
+            self.http_server.stop()
 
     # -- steps ---------------------------------------------------------------
 
@@ -232,9 +252,13 @@ class E2E:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", action="store_true", help="print metrics JSON only")
+    ap.add_argument("--transport", choices=["memory", "http"], default="memory",
+                    help="how the platform talks to the API store: in-memory "
+                         "FakeKube, or RestKubeClient over an HTTP shim "
+                         "serving the same store (the envtest analogue)")
     args = ap.parse_args(argv)
 
-    e2e = E2E()
+    e2e = E2E(transport=args.transport)
     try:
         ns = e2e.register()
         spawn_s = e2e.spawn(ns)
@@ -243,12 +267,14 @@ def main(argv=None) -> int:
     finally:
         e2e.close()
 
-    out = {"spawn_to_ready_s": round(spawn_s, 3), "namespace": ns, "ok": True}
+    out = {"spawn_to_ready_s": round(spawn_s, 3), "namespace": ns, "ok": True,
+           "transport": args.transport}
     if args.json:
         print(json.dumps(out))
     else:
-        print(f"E2E OK: spawn-to-ready {out['spawn_to_ready_s']}s (control "
-              f"plane only; image pull excluded) in namespace {ns}")
+        print(f"E2E OK ({args.transport}): spawn-to-ready "
+              f"{out['spawn_to_ready_s']}s (control plane only; image pull "
+              f"excluded) in namespace {ns}")
     return 0
 
 

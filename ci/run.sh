@@ -25,6 +25,9 @@ fi
 echo "=== end-to-end platform gate ==="
 python ci/e2e.py
 
+echo "=== end-to-end platform gate (HTTP transport / envtest analogue) ==="
+python ci/e2e.py --transport http
+
 echo "=== driver contract: single-chip compile ==="
 JAX_PLATFORMS=cpu python -c "
 import __graft_entry__ as g, jax

@@ -59,7 +59,16 @@ class Invalid(ApiError):
 
 
 def error_for_status(status: int, message: str = "", body: Optional[dict] = None) -> ApiError:
-    for cls in (NotFound, Conflict, Forbidden, BadRequest, Invalid):
+    # The Status body's reason is MORE specific than the HTTP code (e.g.
+    # both Conflict and AlreadyExists are 409); honoring it keeps typed
+    # handlers (`except AlreadyExists`) behaving identically in-memory and
+    # over the wire.
+    reason = (body or {}).get("reason", "")
+    classes = (NotFound, AlreadyExists, Conflict, Forbidden, BadRequest, Invalid)
+    for cls in classes:
+        if cls.reason == reason:
+            return cls(message, body=body)
+    for cls in classes:
         if cls.status == status:
             return cls(message, body=body)
     return ApiError(message, status=status, body=body)

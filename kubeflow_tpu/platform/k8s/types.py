@@ -73,9 +73,9 @@ TENSORBOARD = GVK("tensorboard.kubeflow.org", "v1alpha1", "Tensorboard", "tensor
 
 WELL_KNOWN: tuple[GVK, ...] = (
     POD, SERVICE, NAMESPACE, NODE, EVENT, SECRET, CONFIGMAP, SERVICEACCOUNT,
-    PVC, RESOURCEQUOTA, STATEFULSET, DEPLOYMENT, ROLEBINDING, CLUSTERROLE,
-    STORAGECLASS, VIRTUALSERVICE, AUTHORIZATIONPOLICY, NOTEBOOK, PROFILE,
-    PODDEFAULT, TENSORBOARD,
+    PVC, RESOURCEQUOTA, STATEFULSET, PODDISRUPTIONBUDGET, DEPLOYMENT,
+    ROLEBINDING, CLUSTERROLE, STORAGECLASS, LEASE, VIRTUALSERVICE,
+    AUTHORIZATIONPOLICY, NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD,
 )
 
 

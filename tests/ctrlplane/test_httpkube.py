@@ -1,0 +1,255 @@
+"""RestKubeClient against the HTTP FakeKube shim (VERDICT r1 item 5).
+
+Every wire behavior the in-memory suites could not exercise: JSON Status
+errors mapping to typed exceptions, resourceVersion 409s, patch dispatch by
+Content-Type, selector serialization, chunked watch streams with RV resume,
+SARs, the pod-log subresource, and the token bucket in front of it all."""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.client import RestKubeClient
+from kubeflow_tpu.platform.k8s.types import (
+    EVENT,
+    NAMESPACE,
+    NOTEBOOK,
+    POD,
+    PROFILE,
+)
+from kubeflow_tpu.platform.testing import FakeKube
+from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+
+
+@pytest.fixture(scope="module")
+def stack():
+    kube = FakeKube()
+    kube.add_namespace("user1")
+    server = HttpKubeServer(kube).start()
+    client = RestKubeClient(server.base_url, qps=0)
+    yield kube, client
+    server.stop()
+
+
+def nb(name, ns="user1"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "img"}]}}},
+    }
+
+
+def test_crud_round_trip(stack):
+    kube, client = stack
+    created = client.create(nb("rt"))
+    assert created["metadata"]["resourceVersion"]
+    got = client.get(NOTEBOOK, "rt", "user1")
+    assert got["spec"]["template"]["spec"]["containers"][0]["image"] == "img"
+    got["spec"]["template"]["spec"]["containers"][0]["image"] = "img:2"
+    updated = client.update(got)
+    assert updated["metadata"]["generation"] == 2
+    names = [n["metadata"]["name"] for n in client.list(NOTEBOOK, "user1")]
+    assert "rt" in names
+    client.delete(NOTEBOOK, "rt", "user1")
+    with pytest.raises(errors.NotFound):
+        client.get(NOTEBOOK, "rt", "user1")
+
+
+def test_resource_version_conflict_is_409(stack):
+    kube, client = stack
+    client.create(nb("conf"))
+    a = client.get(NOTEBOOK, "conf", "user1")
+    b = client.get(NOTEBOOK, "conf", "user1")
+    client.update(a)  # bumps RV
+    with pytest.raises(errors.Conflict):
+        client.update(b)  # stale RV over the wire -> JSON Status 409
+    client.delete(NOTEBOOK, "conf", "user1")
+
+
+def test_create_conflict_and_dry_run(stack):
+    kube, client = stack
+    client.create(nb("dup"))
+    with pytest.raises(errors.Conflict):
+        client.create(nb("dup"))
+    # dry-run: accepted but never stored.
+    client.create(nb("ghost"), dry_run=True)
+    with pytest.raises(errors.NotFound):
+        client.get(NOTEBOOK, "ghost", "user1")
+    client.delete(NOTEBOOK, "dup", "user1")
+
+
+def test_patch_types_over_content_type(stack):
+    kube, client = stack
+    client.create(nb("patchy"))
+    out = client.patch(
+        NOTEBOOK, "patchy", {"metadata": {"annotations": {"a": "1"}}}, "user1"
+    )
+    assert out["metadata"]["annotations"]["a"] == "1"
+    out = client.patch(
+        NOTEBOOK, "patchy",
+        [{"op": "add", "path": "/metadata/annotations/b", "value": "2"}],
+        "user1", patch_type="json",
+    )
+    assert out["metadata"]["annotations"]["b"] == "2"
+    client.delete(NOTEBOOK, "patchy", "user1")
+
+
+def test_update_status_subresource(stack):
+    kube, client = stack
+    client.create(nb("status-nb"))
+    got = client.get(NOTEBOOK, "status-nb", "user1")
+    got["status"] = {"readyReplicas": 1}
+    client.update_status(got)
+    assert client.get(NOTEBOOK, "status-nb", "user1")["status"] == {
+        "readyReplicas": 1}
+    client.delete(NOTEBOOK, "status-nb", "user1")
+
+
+def test_selectors_serialize_over_the_wire(stack):
+    kube, client = stack
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "sel-1", "namespace": "user1",
+                     "labels": {"notebook-name": "sel-nb"}},
+        "spec": {"containers": [{"name": "c", "image": "i"}]},
+    })
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "sel-2", "namespace": "user1",
+                     "labels": {"notebook-name": "other"}},
+        "spec": {"containers": [{"name": "c", "image": "i"}]},
+    })
+    pods = client.list(POD, "user1",
+                       label_selector={"notebook-name": "sel-nb"})
+    assert [p["metadata"]["name"] for p in pods] == ["sel-1"]
+    kube.create({
+        "apiVersion": "v1", "kind": "Event",
+        "metadata": {"name": "sel-ev", "namespace": "user1"},
+        "involvedObject": {"kind": "Pod", "name": "sel-1"},
+        "reason": "x", "message": "y", "type": "Normal",
+    })
+    evs = client.list(EVENT, "user1",
+                      field_selector={"involvedObject.name": "sel-1"})
+    assert [e["metadata"]["name"] for e in evs] == ["sel-ev"]
+
+
+def test_cluster_scoped_paths(stack):
+    kube, client = stack
+    client.create({
+        "apiVersion": "kubeflow.org/v1", "kind": "Profile",
+        "metadata": {"name": "wire-prof"},
+        "spec": {"owner": {"kind": "User", "name": "a@x.io"}},
+    })
+    assert client.get(PROFILE, "wire-prof")["spec"]["owner"]["name"] == "a@x.io"
+    namespaces = [n["metadata"]["name"] for n in client.list(NAMESPACE)]
+    assert "user1" in namespaces
+    client.delete(PROFILE, "wire-prof")
+
+
+def test_watch_streams_chunked_lines(stack):
+    kube, client = stack
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for etype, obj in client.watch(NOTEBOOK, "user1", stop=stop):
+            seen.append((etype, obj["metadata"]["name"]))
+            if len(seen) >= 2:
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)  # let the watch register
+    kube.create(nb("w-1"))
+    kube.create(nb("w-2"))
+    t.join(timeout=10)
+    stop.set()
+    assert not t.is_alive()
+    assert ("ADDED", "w-1") in seen and ("ADDED", "w-2") in seen
+    kube.delete(NOTEBOOK, "w-1", "user1")
+    kube.delete(NOTEBOOK, "w-2", "user1")
+
+
+def test_watch_resume_from_rv_skips_backlog(stack):
+    kube, client = stack
+    client.create(nb("rv-1"))
+    _, rv = client.list_with_rv(NOTEBOOK, "user1")
+    stop = threading.Event()
+    seen = []
+
+    def consume():
+        for etype, obj in client.watch(
+            NOTEBOOK, "user1", resource_version=rv, stop=stop
+        ):
+            seen.append((etype, obj["metadata"]["name"]))
+            return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    kube.create(nb("rv-2"))
+    t.join(timeout=10)
+    stop.set()
+    # The resumed watch never replays rv-1's ADDED backlog.
+    assert seen == [("ADDED", "rv-2")]
+    kube.delete(NOTEBOOK, "rv-1", "user1")
+    kube.delete(NOTEBOOK, "rv-2", "user1")
+
+
+def test_subject_access_review_round_trip(stack):
+    kube, client = stack
+    kube.authz_policy = lambda user, verb, gvk, **kw: user == "allowed@x.io"
+    try:
+        assert client.can_i("allowed@x.io", "list", NOTEBOOK, "user1")
+        assert not client.can_i("denied@x.io", "list", NOTEBOOK, "user1")
+    finally:
+        kube.authz_policy = None
+
+
+def test_pod_log_subresource(stack):
+    kube, client = stack
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "loggy", "namespace": "user1"},
+        "spec": {"containers": [{"name": "main", "image": "i"}]},
+    })
+    kube.set_pod_logs("user1", "loggy", "hello wire", container="main")
+    assert client.pod_logs("loggy", "user1", container="main") == "hello wire"
+
+
+def test_unknown_resource_is_404_status(stack):
+    kube, client = stack
+    from kubeflow_tpu.platform.k8s.types import GVK
+
+    bogus = GVK("nope.example.com", "v1", "Widget", "widgets")
+    with pytest.raises(errors.NotFound):
+        client.list(bogus, "user1")
+
+
+def test_already_exists_reason_survives_the_wire(stack):
+    """409 + reason AlreadyExists must raise AlreadyExists (not bare
+    Conflict) so typed handlers behave identically in both transports."""
+    kube, client = stack
+    client.create(nb("typed-dup"))
+    with pytest.raises(errors.AlreadyExists):
+        client.create(nb("typed-dup"))
+    client.delete(NOTEBOOK, "typed-dup", "user1")
+
+
+def test_sar_resolves_real_gvk(stack):
+    """The SAR endpoint reconstructs the registered GVK (kind/version), not
+    a plural-as-kind fabrication, so policies keyed on gvk.kind agree."""
+    kube, client = stack
+    kube.authz_policy = lambda user, verb, gvk, **kw: gvk.kind == "Notebook"
+    try:
+        assert client.can_i("u@x.io", "list", NOTEBOOK, "user1")
+        assert not client.can_i("u@x.io", "list", POD, "user1")
+    finally:
+        kube.authz_policy = None
