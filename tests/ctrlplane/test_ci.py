@@ -68,3 +68,32 @@ def test_conformance_report_contract(tmp_path):
     names = {n for n, _ in CHECKS}
     assert {"notebook-spawn-lifecycle", "multi-host-slice",
             "webhook-merge-semantics", "api-authn-authz"} <= names
+
+
+def test_image_chain_consistency():
+    """Every image directory is reachable from the Makefile graph and every
+    Dockerfile's default BASE_IMAGE points at an image the Makefile builds
+    (a renamed/added image that isn't wired in fails here)."""
+    import os
+    import re
+
+    images = os.path.join(os.path.dirname(__file__), "..", "..", "images")
+    makefile = open(os.path.join(images, "Makefile")).read()
+    dirs = sorted(
+        d for d in os.listdir(images)
+        if os.path.isdir(os.path.join(images, d))
+        and os.path.exists(os.path.join(images, d, "Dockerfile"))
+        and d not in ("platform", "ci")  # built by their own harness
+    )
+    for d in dirs:
+        assert re.search(rf"^{re.escape(d)}:", makefile, re.M), (
+            f"images/{d} has no Makefile target"
+        )
+        dockerfile = open(os.path.join(images, d, "Dockerfile")).read()
+        m = re.search(r"ARG BASE_IMAGE=ghcr.io/kubeflow-tpu/([\w-]+):", dockerfile)
+        if m:
+            parent = m.group(1)
+            assert re.search(rf"^{re.escape(parent)}:", makefile, re.M) or \
+                parent == "base", f"images/{d} builds FROM unbuilt {parent}"
+    # The TF chain exists as BASELINE config 2 names it.
+    assert "jupyter-tensorflow-tpu-full" in dirs

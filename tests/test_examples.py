@@ -96,3 +96,53 @@ def test_generation_t5_notebook_runs_tiny(devices8):
     src = src.replace("0, 32000)", "0, 128)")
     src = src.replace("STEPS = 3", "STEPS = 1")
     exec(compile(src, "nb06", "exec"), {})
+
+
+@pytest.mark.slow
+def test_pytorch_xla_notebook_one_step_cpu():
+    """BASELINE config 3 actually executes: torch_xla is absent in this
+    image, so the notebook's documented CPU fallback runs one real
+    fine-tune step on a tiny random BERT (KFT_SMOKE=1 hermetic branch) —
+    no longer JSON-validation-only (VERDICT r1 item 9)."""
+    import math
+
+    os.environ["KFT_SMOKE"] = "1"
+    try:
+        scope = {}
+        exec(compile(_code("03_bert_finetune_pytorch_xla.ipynb"),
+                     "nb03", "exec"), scope)
+    finally:
+        os.environ.pop("KFT_SMOKE", None)
+    losses = scope["losses"]
+    assert len(losses) == 2 and all(math.isfinite(v) for v in losses)
+    # The device line reports what ran; be loud about the torch_xla gap.
+    import importlib.util
+
+    if importlib.util.find_spec("torch_xla") is None:
+        print("NOTE: torch_xla not installed in this image; "
+              "the notebook executed its CPU fallback path.")
+
+
+def test_tensorflow_notebook_structure():
+    """BASELINE config 2 (jupyter-tensorflow-tpu-full): this image ships no
+    TF, so the notebook must at least carry the TPUStrategy + CPU-fallback
+    structure (the image chain exists — images/jupyter-tensorflow-tpu*)."""
+    src = _code("08_resnet_cifar_tensorflow.ipynb")
+    for needle in ("TPUClusterResolver", "TPUStrategy",
+                   "get_strategy()", "ResNet50"):
+        assert needle in src, needle
+
+
+@pytest.mark.slow
+def test_tensorflow_notebook_runs_tiny_when_tf_present():
+    import importlib.util
+
+    if importlib.util.find_spec("tensorflow") is None:
+        pytest.skip("tensorflow not installed in this image; structural "
+                    "validation only (see BASELINE.md config 2 note)")
+    src = (_code("08_resnet_cifar_tensorflow.ipynb")
+           .replace("STEPS = 50", "STEPS = 1")
+           .replace("BATCH = 256", "BATCH = 8")
+           .replace("steps_per_epoch=10", "steps_per_epoch=1")
+           .replace("steps_per_execution=10", "steps_per_execution=1"))
+    exec(compile(src, "nb08", "exec"), {})
