@@ -62,7 +62,7 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
-        if not hasattr(lib, "kfpk_pack"):
+        if not hasattr(lib, "kfq_is_processing"):  # newest required symbol
             # Stale prebuilt library from before a symbol was added.
             # Rebuild for FUTURE processes (make re-links, sources are
             # newer) but report unavailable now — dlopen caches the mapped
@@ -98,6 +98,9 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.kfq_is_pending.restype = ctypes.c_int
         lib.kfq_get.argtypes = [ctypes.c_void_p, ctypes.c_double]
         lib.kfq_get.restype = ctypes.c_int64
+        lib.kfq_done.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.kfq_is_processing.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.kfq_is_processing.restype = ctypes.c_int
         lib.kfq_pending.argtypes = [ctypes.c_void_p]
         lib.kfq_pending.restype = ctypes.c_int64
         lib.kfq_shutdown.argtypes = [ctypes.c_void_p]
@@ -240,8 +243,11 @@ class NativeWorkQueue:
             self._from_id[key] = req
         return key
 
-    # Mapping mutations and the C enqueue run under one lock so a concurrent
-    # prune (in get()) can never orphan a just-enqueued key.
+    # Mapping mutations and the C enqueue run under one Python lock.
+    # kfq_get deliberately blocks OUTSIDE that lock, so done()'s prune must
+    # check kfq_is_processing: another worker may have popped this key
+    # between our kfq_done and the prune check (a real race, reproduced in
+    # review r2 — 10 orphaned ids in ~10k get/done cycles without it).
 
     def add(self, req: Any, *, delay: float = 0.0) -> None:
         with self._lock:
@@ -267,19 +273,25 @@ class NativeWorkQueue:
         if key < 0:
             return None
         with self._lock:
-            req = self._from_id.get(key)
-            # Keep the id maps bounded (the Python _WorkQueue only retains
-            # currently-pending entries): drop the mapping once the key has
-            # no pending entry and no backoff state.  A later add() simply
-            # assigns a fresh id.
+            return self._from_id.get(key)
+
+    def done(self, req: Any) -> None:
+        """Release the per-key exclusion taken by get().  Also the point
+        where the id maps stay bounded: drop the mapping once the key has
+        no pending/dirty entry and no backoff state — a later add() simply
+        assigns a fresh id."""
+        with self._lock:
+            key = self._to_id.get(req)
+            if key is None:
+                return
+            self._lib.kfq_done(self._q, key)
             if (
-                req is not None
-                and not self._lib.kfq_is_pending(self._q, key)
+                not self._lib.kfq_is_pending(self._q, key)
+                and not self._lib.kfq_is_processing(self._q, key)
                 and self._lib.kfq_failures(self._q, key) == 0
             ):
                 del self._to_id[req]
                 del self._from_id[key]
-            return req
 
     def pending(self) -> int:
         return int(self._lib.kfq_pending(self._q))

@@ -5,9 +5,11 @@ reference's reconcilers are built on sigs.k8s.io/controller-runtime —
 SURVEY.md §2.1): per-controller rate-limited workqueues with in-flight
 dedup, watches on the primary kind, owned kinds (events mapped to the
 controlling owner), and custom mappers; exponential backoff on error;
-periodic resync.  Threads, not goroutines; one worker per controller by
-default preserves the single-reconciler-per-key model the reference relies
-on for concurrency safety (SURVEY.md §5 "race detection").
+periodic resync.  Threads, not goroutines.  The workqueue enforces
+per-key mutual exclusion between get() and done() (client-go semantics),
+so the single-reconciler-per-key model the reference relies on for
+concurrency safety (SURVEY.md §5 "race detection") holds at ANY worker
+count — pinned under fire by tests/ctrlplane/test_race_stress.py.
 """
 from __future__ import annotations
 
@@ -43,7 +45,12 @@ class Reconciler:
 
 
 class _WorkQueue:
-    """Delaying + rate-limited queue with dedup of pending items."""
+    """Delaying + rate-limited queue with dedup of pending items AND
+    per-key mutual exclusion: a key returned by get() is "processing" until
+    done(key) — re-adds meanwhile park in a dirty set and re-enqueue on
+    done (client-go workqueue semantics), so a controller may run
+    ``workers > 1`` without two workers ever reconciling one key at once
+    (the single-reconciler-per-key model, SURVEY.md §5 race detection)."""
 
     def __init__(self, *, base_delay: float = 0.05, max_delay: float = 30.0):
         self._cond = threading.Condition()
@@ -51,6 +58,8 @@ class _WorkQueue:
         # req -> (seq of the live heap entry, its scheduled time).  Stale heap
         # entries (superseded by an earlier reschedule) are dropped on pop.
         self._pending: Dict[Request, Tuple[int, float]] = {}
+        self._processing: set = set()
+        self._dirty: Dict[Request, float] = {}  # re-adds during processing
         self._seq = 0
         self._failures: Dict[Request, int] = {}
         self._base = base_delay
@@ -64,6 +73,14 @@ class _WorkQueue:
             if self._shutdown:
                 return
             when = time.monotonic() + max(delay, 0.0)
+            if req in self._processing:
+                # Parked until done(); keep the EARLIEST requested time so a
+                # watch event doesn't wait out a backoff and a backoff isn't
+                # silently turned into an immediate retry.
+                cur = self._dirty.get(req)
+                if cur is None or when < cur:
+                    self._dirty[req] = when
+                return
             live = self._pending.get(req)
             if live is not None and live[1] <= when:
                 return  # an entry at least as early is already queued
@@ -95,6 +112,7 @@ class _WorkQueue:
                     if live is None or live[0] != seq:
                         continue  # superseded by a rescheduled entry
                     del self._pending[req]
+                    self._processing.add(req)
                     return req
                 if now >= deadline:
                     return None
@@ -102,6 +120,17 @@ class _WorkQueue:
                 if self._heap:
                     wait = min(wait, self._heap[0][0] - now)
                 self._cond.wait(timeout=max(wait, 0.001))
+
+    def done(self, req: Request) -> None:
+        """Mark a get()-returned key finished; a parked re-add fires now."""
+        with self._cond:
+            self._processing.discard(req)
+            when = self._dirty.pop(req, None)
+            if when is not None and not self._shutdown:
+                self._seq += 1
+                self._pending[req] = (self._seq, when)
+                heapq.heappush(self._heap, (when, self._seq, req))
+                self._cond.notify()
 
     def shut_down(self) -> None:
         with self._cond:
@@ -201,33 +230,41 @@ class Controller:
             if req is None:
                 continue
             try:
-                result = self.reconciler.reconcile(req)
-                self.queue.forget(req)
-                self.reconcile_count += 1
-                if result and result.requeue_after:
-                    self.queue.add(req, delay=result.requeue_after)
-            except Exception as e:
-                self.error_count += 1
-                from kubeflow_tpu.platform.k8s.errors import Conflict
-                from kubeflow_tpu.platform.runtime import metrics
+                self._reconcile_one(req)
+            finally:
+                # Releases the per-key exclusion; a re-add parked while we
+                # reconciled fires now.
+                self.queue.done(req)
 
-                metrics.reconcile_errors_total.labels(controller=self.name).inc()
-                if isinstance(e, Conflict):
-                    # Optimistic-concurrency 409: the requeue IS the
-                    # resolution (same as controller-runtime).  One line,
-                    # no stack — a traceback on the expected path would
-                    # train readers to ignore real ones (VERDICT r1).
-                    log.info(
-                        "%s: reconcile %s/%s conflicted (will retry): %s",
-                        self.name, req.namespace, req.name, e,
-                    )
-                else:
-                    log.error(
-                        "%s: reconcile %s/%s failed:\n%s",
-                        self.name, req.namespace, req.name,
-                        traceback.format_exc(),
-                    )
-                self.queue.add_rate_limited(req)
+    def _reconcile_one(self, req: Request) -> None:
+        try:
+            result = self.reconciler.reconcile(req)
+            self.queue.forget(req)
+            self.reconcile_count += 1
+            if result and result.requeue_after:
+                self.queue.add(req, delay=result.requeue_after)
+        except Exception as e:
+            self.error_count += 1
+            from kubeflow_tpu.platform.k8s.errors import Conflict
+            from kubeflow_tpu.platform.runtime import metrics
+
+            metrics.reconcile_errors_total.labels(controller=self.name).inc()
+            if isinstance(e, Conflict):
+                # Optimistic-concurrency 409: the requeue IS the
+                # resolution (same as controller-runtime).  One line,
+                # no stack — a traceback on the expected path would
+                # train readers to ignore real ones (VERDICT r1).
+                log.info(
+                    "%s: reconcile %s/%s conflicted (will retry): %s",
+                    self.name, req.namespace, req.name, e,
+                )
+            else:
+                log.error(
+                    "%s: reconcile %s/%s failed:\n%s",
+                    self.name, req.namespace, req.name,
+                    traceback.format_exc(),
+                )
+            self.queue.add_rate_limited(req)
 
     # -- lifecycle -----------------------------------------------------------
 

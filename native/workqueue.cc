@@ -8,11 +8,17 @@
 //
 // Semantics (must stay in lock-step with the Python implementation):
 //   * add(key, delay): an entry at least as early already pending → no-op;
-//     otherwise (re)schedule, superseding any later pending entry.
+//     otherwise (re)schedule, superseding any later pending entry.  A key
+//     currently processing (returned by get, not yet done) parks in the
+//     dirty set instead and re-enqueues on done — per-key mutual exclusion
+//     so multiple workers never reconcile one key concurrently (client-go
+//     workqueue semantics).
 //   * add_rate_limited(key): exponential backoff 2^failures * base, capped.
 //   * forget(key): reset the failure count (called after a clean reconcile).
 //   * get(timeout): block until an entry is due or timeout; pops the live
-//     entry, dropping stale superseded heap nodes.
+//     entry, dropping stale superseded heap nodes; marks it processing.
+//   * done(key): release the key; a parked dirty re-add fires (earliest
+//     requested time wins, so backoffs aren't flattened to immediate).
 
 #include <chrono>
 #include <condition_variable>
@@ -20,6 +26,7 @@
 #include <mutex>
 #include <queue>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace kfq {
@@ -48,12 +55,36 @@ class Queue {
     TimePoint when =
         Clock::now() + std::chrono::duration_cast<Clock::duration>(
                            std::chrono::duration<double>(delay_s < 0 ? 0 : delay_s));
+    if (processing_.count(key)) {
+      auto d = dirty_.find(key);
+      if (d == dirty_.end() || when < d->second) dirty_[key] = when;
+      return;
+    }
     auto it = pending_.find(key);
     if (it != pending_.end() && it->second.second <= when) return;
     ++seq_;
     pending_[key] = {seq_, when};
     heap_.push(Entry{when, seq_, key});
     cv_.notify_one();
+  }
+
+  void done(int64_t key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    processing_.erase(key);
+    auto d = dirty_.find(key);
+    if (d == dirty_.end()) return;
+    TimePoint when = d->second;
+    dirty_.erase(d);
+    if (shutdown_) return;
+    ++seq_;
+    pending_[key] = {seq_, when};
+    heap_.push(Entry{when, seq_, key});
+    cv_.notify_one();
+  }
+
+  bool is_processing(int64_t key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return processing_.count(key) != 0;
   }
 
   void add_rate_limited(int64_t key) {
@@ -80,7 +111,7 @@ class Queue {
 
   bool is_pending(int64_t key) {
     std::lock_guard<std::mutex> lk(mu_);
-    return pending_.count(key) != 0;
+    return pending_.count(key) != 0 || dirty_.count(key) != 0;
   }
 
   // Returns the popped key, or -1 on timeout / shutdown.
@@ -106,6 +137,7 @@ class Queue {
         Entry e = heap_.top();
         heap_.pop();
         pending_.erase(e.key);
+        processing_.insert(e.key);
         return e.key;
       }
       if (now >= deadline) return -1;
@@ -117,7 +149,7 @@ class Queue {
 
   size_t pending_count() {
     std::lock_guard<std::mutex> lk(mu_);
-    return pending_.size();
+    return pending_.size() + dirty_.size();
   }
 
   void shut_down() {
@@ -132,6 +164,8 @@ class Queue {
   std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> heap_;
   // key -> (seq of live entry, scheduled time)
   std::unordered_map<int64_t, std::pair<uint64_t, TimePoint>> pending_;
+  std::unordered_set<int64_t> processing_;
+  std::unordered_map<int64_t, TimePoint> dirty_;
   std::unordered_map<int64_t, int> failures_;
   uint64_t seq_ = 0;
   double base_;
@@ -171,6 +205,14 @@ int kfq_is_pending(void* q, int64_t key) {
 
 int64_t kfq_get(void* q, double timeout_s) {
   return static_cast<kfq::Queue*>(q)->get(timeout_s);
+}
+
+void kfq_done(void* q, int64_t key) {
+  static_cast<kfq::Queue*>(q)->done(key);
+}
+
+int kfq_is_processing(void* q, int64_t key) {
+  return static_cast<kfq::Queue*>(q)->is_processing(key) ? 1 : 0;
 }
 
 int64_t kfq_pending(void* q) {

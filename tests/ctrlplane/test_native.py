@@ -169,13 +169,29 @@ def test_queue_rate_limit_backoff_and_forget(q):
     r = Request("ns", "err")
     q.add_rate_limited(r)  # 0.01
     assert q.get(1.0) == r
+    q.done(r)
     q.add_rate_limited(r)  # 0.02
     t0 = time.monotonic()
     assert q.get(1.0) == r
     assert time.monotonic() - t0 >= 0.01
+    q.done(r)
     q.forget(r)
     q.add_rate_limited(r)  # back to base delay
     assert q.get(1.0) == r
+    q.done(r)
+    q.shut_down()
+
+
+@pytest.mark.parametrize("q", _queues(), ids=lambda q: type(q).__name__)
+def test_queue_per_key_exclusion_parity(q):
+    r = Request("ns", "x")
+    q.add(r)
+    assert q.get(0.5) == r
+    q.add(r)                       # parked while processing
+    assert q.get(0.05) is None     # never delivered concurrently
+    q.done(r)
+    assert q.get(0.5) == r         # fires after done
+    q.done(r)
     q.shut_down()
 
 
@@ -201,7 +217,8 @@ def test_native_queue_id_maps_stay_bounded():
         q.add(r)
         assert q.get(1.0) == r
         q.forget(r)
-    assert len(q._to_id) == 0  # pruned at pop (no pending, no failures)
+        q.done(r)
+    assert len(q._to_id) == 0  # pruned at done (no pending, no failures)
     q.shut_down()
 
 

@@ -30,12 +30,44 @@ def test_backoff_grows_and_forget_resets():
     r = Request("ns", "a")
     q.add_rate_limited(r)  # 0.05
     assert q.get(timeout=1.0) == r
+    q.done(r)
     q.add_rate_limited(r)  # 0.1
     t0 = time.monotonic()
     assert q.get(timeout=1.0) == r
     assert time.monotonic() - t0 >= 0.08
+    q.done(r)
     q.forget(r)
     q.add_rate_limited(r)  # back to 0.05
     t0 = time.monotonic()
     assert q.get(timeout=1.0) == r
     assert time.monotonic() - t0 < 0.09
+    q.done(r)
+
+
+def test_processing_key_parks_readds_until_done():
+    """Per-key mutual exclusion: while a key is out (get..done), re-adds
+    park and must NOT be delivered to another worker."""
+    q = _WorkQueue()
+    r = Request("ns", "a")
+    q.add(r)
+    assert q.get(timeout=0.5) == r
+    q.add(r)  # watch event during reconcile
+    assert q.get(timeout=0.1) is None  # parked: no concurrent delivery
+    q.done(r)
+    assert q.get(timeout=0.5) == r  # fires after done
+    q.done(r)
+    assert q.get(timeout=0.05) is None
+
+
+def test_dirty_readd_keeps_earliest_delay():
+    """A backoff re-add parked during processing keeps its delay; a watch
+    event re-add during processing fires immediately after done."""
+    q = _WorkQueue()
+    r = Request("ns", "a")
+    q.add(r)
+    assert q.get(timeout=0.5) == r
+    q.add(r, delay=0.3)   # backoff requeue from the worker
+    q.done(r)
+    assert q.get(timeout=0.05) is None  # still honoring the delay
+    assert q.get(timeout=1.0) == r
+    q.done(r)
