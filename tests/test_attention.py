@@ -215,3 +215,35 @@ def test_pallas_rms_norm_rejects_unaligned():
 
     with _pytest.raises(ValueError, match="128"):
         ops.rms_norm(jnp.ones((4, 100)), jnp.ones((100,)), impl="pallas")
+
+
+def test_default_blocks_heuristic():
+    from kubeflow_tpu.ops.pallas.flash_attention import default_blocks
+
+    assert default_blocks(8192, 8192) == (1024, 1024)
+    assert default_blocks(4096, 4096) == (512, 512)
+    assert default_blocks(2048, 2048) == (256, 256)
+    assert default_blocks(256, 256) == (256, 256)
+    # Non-power-of-two lengths still divide their blocks.
+    bq, bk = default_blocks(3072, 3072)
+    assert 3072 % bq == 0 and 3072 % bk == 0
+    # Ragged lengths fall back to exactly the legacy defaults, so the
+    # supported() gate (which checks those) keeps its meaning: shapes it
+    # rejects never reach the kernel with any block size.
+    assert default_blocks(640, 640) == (256, 256)
+
+
+@pytest.mark.slow
+def test_flash_matches_xla_at_auto_block_sizes():
+    """Exactness at a length where the heuristic picks 512-wide tiles (the
+    block study changed the default; the math must not change with it)."""
+    from kubeflow_tpu.ops.attention import xla_attention
+    from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, S, H, D = 1, 4096, 1, 64
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D), jnp.float32)
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D), jnp.float32)
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D), jnp.float32)
+    out = flash_attention(q, k, v, causal=True)  # auto: 512x512
+    ref = xla_attention(q, k, v, causal=True)
+    assert jnp.max(jnp.abs(out - ref)) < 2e-3
