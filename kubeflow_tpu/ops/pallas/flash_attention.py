@@ -461,10 +461,12 @@ def default_blocks(sq: int, sk: int) -> tuple:
     at seq 8192 with 1024x1024 vs the 256x256 floor — until VMEM bounds
     them (2048 tiles fail to compile at d=128).  Ragged lengths fall back
     to the floor, which divides everything supported() admits."""
-    bq = min(1024, max(DEFAULT_BLOCK_Q, sq // 8))
-    bk = min(1024, max(DEFAULT_BLOCK_K, sk // 8))
-    if sq % bq or sk % bk:
-        bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
+    bq = min(1024, max(DEFAULT_BLOCK_Q, (sq // 8) // 8 * 8))
+    bk = min(1024, max(DEFAULT_BLOCK_K, (sk // 8) // 128 * 128))
+    if sq % bq:
+        bq = min(DEFAULT_BLOCK_Q, sq)
+    if sk % bk:
+        bk = min(DEFAULT_BLOCK_K, sk)
     return bq, bk
 
 

@@ -68,7 +68,10 @@ def error_for_status(status: int, message: str = "", body: Optional[dict] = None
     for cls in classes:
         if cls.reason == reason:
             return cls(message, body=body)
-    for cls in classes:
+    # Status-code fallback: only base classes.  AlreadyExists inherits 409
+    # from Conflict; a reason-less 409 is an optimistic-concurrency conflict,
+    # not a create collision, so it must map to the generic Conflict.
+    for cls in (NotFound, Conflict, Forbidden, BadRequest, Invalid):
         if cls.status == status:
             return cls(message, body=body)
     return ApiError(message, status=status, body=body)

@@ -269,6 +269,10 @@ class NativeWorkQueue:
             return self._lib.kfq_failures(self._q, key) if key is not None else 0
 
     def get(self, timeout: float = 0.2) -> Optional[Any]:
+        """Pop a key, taking the per-key exclusion.  The caller MUST pair
+        every non-None return with done(key) (in a finally); otherwise
+        re-adds park in the dirty set and the key is never delivered
+        again (client-go workqueue contract)."""
         key = self._lib.kfq_get(self._q, timeout)  # blocking: outside the lock
         if key < 0:
             return None

@@ -141,7 +141,14 @@ class _WorkQueue:
 def make_workqueue(*, base_delay: float = 0.05, max_delay: float = 30.0):
     """Prefer the native C++ workqueue (libkfnative kfq_*); fall back to
     the pure-Python _WorkQueue.  Interfaces are identical; parity is
-    enforced by tests/ctrlplane/test_native.py."""
+    enforced by tests/ctrlplane/test_native.py.
+
+    Contract (same as client-go's workqueue): every key returned by
+    ``get()`` MUST be released with ``done(key)`` — normally in a
+    ``finally`` — even if processing raises.  ``get()`` takes a per-key
+    exclusion: until ``done()``, re-adds of the key park in the dirty set
+    and the key is never re-delivered, so an unpaired ``get()`` wedges the
+    key permanently."""
     from kubeflow_tpu.platform import native
 
     if native.available():

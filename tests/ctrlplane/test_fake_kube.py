@@ -130,3 +130,15 @@ def test_generate_name(kube):
     a, b = kube.create(dict(obj)), kube.create(dict(obj))
     assert a["metadata"]["name"] != b["metadata"]["name"]
     assert a["metadata"]["name"].startswith("ev-")
+
+
+def test_error_for_status_reasonless_409_is_generic_conflict():
+    """A 409 whose Status body lacks a reason is an optimistic-concurrency
+    conflict (resourceVersion mismatch), not a create collision; it must
+    not classify as AlreadyExists (which also carries status 409)."""
+    err = errors.error_for_status(409, "rv mismatch", body={})
+    assert type(err) is errors.Conflict
+    # With the explicit reason, the subclass is still selected.
+    err = errors.error_for_status(
+        409, "exists", body={"reason": "AlreadyExists"})
+    assert type(err) is errors.AlreadyExists

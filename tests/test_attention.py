@@ -233,6 +233,30 @@ def test_default_blocks_heuristic():
     assert default_blocks(640, 640) == (256, 256)
 
 
+def test_default_blocks_respect_kernel_alignment_for_all_supported_seqs():
+    """Every length supported() admits must get kernel-legal auto blocks:
+    bq % 8 == 0, bk % 128 == 0 (TPU sublane/lane constraints), and both
+    dividing the sequence.  Regression for 2560/3584/4608-style lengths
+    where sk // 8 is a multiple of 32 but not of 128."""
+    from kubeflow_tpu.ops.pallas.flash_attention import default_blocks
+
+    # supported() requires sq % bq == 0 / sk % bk == 0 at the floor blocks,
+    # i.e. multiples of 256 (plus short seqs equal to smaller lane-legal
+    # sizes, which take the floor fallback anyway).
+    for s in range(256, 32768 + 1, 256):
+        bq, bk = default_blocks(s, s)
+        assert bq % 8 == 0, (s, bq)
+        assert bk % 128 == 0, (s, bk)
+        assert s % bq == 0 and s % bk == 0, (s, bq, bk)
+    # The specific advisor shapes: scaled-and-rounded when that divides,
+    # floor fallback otherwise — never an unaligned block.
+    assert default_blocks(2560, 2560) == (320, 256)
+    # Per-axis fallback: bq=448 is legal even though bk falls back (384
+    # does not divide 3584).
+    assert default_blocks(3584, 3584) == (448, 256)
+    assert default_blocks(4608, 4608) == (576, 512)
+
+
 @pytest.mark.slow
 def test_flash_matches_xla_at_auto_block_sizes():
     """Exactness at a length where the heuristic picks 512-wide tiles (the
