@@ -14,7 +14,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from kubeflow_tpu.models.layers import Attention, Mlp
+from kubeflow_tpu.models.layers import Attention, Embed, Mlp
 from kubeflow_tpu.models.registry import register_model
 
 
@@ -70,13 +70,13 @@ class Bert(nn.Module):
     ):
         cfg = self.cfg
         b, s = tokens.shape
-        x = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")(tokens)
-        pos = nn.Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, name="pos_embed")(
+        x = Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="tok_embed")(tokens)
+        pos = Embed(cfg.max_seq_len, cfg.dim, dtype=cfg.dtype, name="pos_embed")(
             jnp.broadcast_to(jnp.arange(s)[None], (b, s))
         )
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(tokens)
-        typ = nn.Embed(
+        typ = Embed(
             cfg.type_vocab_size, cfg.dim, dtype=cfg.dtype, name="type_embed"
         )(token_type_ids)
         x = nn.LayerNorm(dtype=cfg.dtype, name="embed_norm")(x + pos + typ)

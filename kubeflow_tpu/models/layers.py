@@ -11,6 +11,39 @@ import jax.numpy as jnp
 from kubeflow_tpu import ops
 
 
+class Embed(nn.Module):
+    """Token embedding with a use-site replication constraint.
+
+    The table is sharded at rest by the partition rules (vocab→tp,
+    dim→fsdp); constraining it replicated at the lookup makes XLA
+    all-gather the shards first (the ZeRO-3 use-site gather), so the
+    gather's output inherits the batch layout from the token indices.
+    Without this the output inherits the table's feature split, which the
+    SPMD partitioner can only reconcile with the batch layout through an
+    involuntary full rematerialization (replicate + repartition).
+
+    Drop-in for ``nn.Embed`` (same param name/init, no ``attend``).
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        table = self.param(
+            "embedding",
+            jax.nn.initializers.variance_scaling(
+                1.0, "fan_in", "normal", out_axis=0
+            ),
+            (self.num_embeddings, self.features),
+        )
+        from kubeflow_tpu.parallel.sharding import replicate_for_use
+
+        table = replicate_for_use(table.astype(self.dtype))
+        return jnp.take(table, tokens, axis=0)
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
     dtype: Any = jnp.bfloat16

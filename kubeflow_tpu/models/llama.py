@@ -155,7 +155,12 @@ class Llama(nn.Module):
             )
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-        x = nn.Embed(
+        from kubeflow_tpu.models.layers import Embed
+
+        # Embed's use-site replication is what keeps the multichip dryrun
+        # free of involuntary full remats: the gather output inherits the
+        # batch layout from the tokens, not the table's feature split.
+        x = Embed(
             cfg.vocab_size, cfg.dim, dtype=cfg.dtype, name="embed"
         )(tokens)
         if cfg.scan_layers:

@@ -21,7 +21,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
-from kubeflow_tpu.models.layers import Attention, RMSNorm
+from kubeflow_tpu.models.layers import Attention, Embed, RMSNorm
 from kubeflow_tpu.models.registry import register_model
 
 
@@ -194,7 +194,7 @@ class T5(nn.Module):
         cfg = self.cfg
         # Attribute names double as param-tree names: identical to the
         # previous @nn.compact layout (embed, encoder_i, decoder_i, ...).
-        self.embed = nn.Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
+        self.embed = Embed(cfg.vocab_size, cfg.dim, dtype=cfg.dtype)
         self.encoder_rel_bias = RelativeBias(cfg, bidirectional=True)
         self.encoder_blocks = [
             T5EncoderBlock(cfg, name=f"encoder_{i}")

@@ -224,6 +224,24 @@ def test_graft_entry_dryrun(devices8):
     g.dryrun_multichip(8)
 
 
+def test_spmd_full_remat_gate_trips():
+    """The dryrun's stderr gate must fail when the partitioner reports an
+    involuntary full rematerialization (and pass when it doesn't)."""
+    import os
+
+    import __graft_entry__ as g
+
+    with g._fail_on_spmd_full_remat():
+        os.write(2, b"benign compiler chatter\n")
+    with pytest.raises(AssertionError, match="full rematerialization"):
+        with g._fail_on_spmd_full_remat():
+            os.write(
+                2,
+                b"W0000 spmd_partitioner.cc:652 [SPMD] Involuntary full "
+                b"rematerialization. ...\n",
+            )
+
+
 @pytest.mark.slow
 def test_graft_entry_forward():
     import __graft_entry__ as g
