@@ -22,7 +22,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from kubeflow_tpu.models.layers import Attention, RMSNorm, SwiGLU
+from kubeflow_tpu.models.layers import Attention, Embed, RMSNorm, SwiGLU
 from kubeflow_tpu.models.registry import register_model
 
 
@@ -155,8 +155,6 @@ class Llama(nn.Module):
             )
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
-        from kubeflow_tpu.models.layers import Embed
-
         # Embed's use-site replication is what keeps the multichip dryrun
         # free of involuntary full remats: the gather output inherits the
         # batch layout from the tokens, not the table's feature split.

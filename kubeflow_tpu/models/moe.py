@@ -32,15 +32,15 @@ from jax.sharding import PartitionSpec as P
 
 
 def _constrain(x: jax.Array, spec: P) -> jax.Array:
-    """Best-effort sharding constraint via the ambient mesh (no-op without)."""
+    """Best-effort sharding constraint via the ambient mesh (no-op without
+    one, or on meshes with no ep axis to dispatch over)."""
     from kubeflow_tpu.parallel.context import get_global_mesh
+    from kubeflow_tpu.parallel.sharding import constrain
 
     mesh = get_global_mesh()
     if mesh is None or "ep" not in mesh.axis_names:
         return x
-    from jax.sharding import NamedSharding
-
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return constrain(x, spec)
 
 
 class MoeMlp(nn.Module):

@@ -42,23 +42,26 @@ def slice_id() -> int:
     return int(worker_env()["slice_id"] or 0)
 
 
-def initialize_from_env(*, coordinator_port: int = DEFAULT_COORDINATOR_PORT) -> bool:
-    """Join the deployment's jax.distributed cluster if this is a multi-host
-    (or multislice) pod.
+def process_grid(
+    env: Optional[dict] = None, *,
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT,
+) -> Optional[tuple]:
+    """Pure computation of the jax.distributed join parameters from the
+    injected worker env: ``(coordinator_address, num_processes,
+    process_id)``, or ``None`` for a single-host deployment.
 
-    Returns True if distributed init ran, False for single-host (no-op).
     ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` are *per-slice* (the libtpu
     ICI contract); the global process id folds in ``MEGASCALE_SLICE_ID`` so
-    one barrier spans every slice, with worker 0 of slice 0 (the
-    ``<name>-0`` pod routed by the headless service) as coordinator.
+    one barrier spans every slice — slice-major, matching the slice-major
+    device blocks ``make_hybrid_mesh`` assumes for its DCN axes.
     """
-    env = worker_env()
+    env = env if env is not None else worker_env()
     if not env["hostnames"]:
-        return False
+        return None
     hosts = [h.strip() for h in env["hostnames"].split(",") if h.strip()]
-    slices = num_slices()
+    slices = int(env["num_slices"] or 1)
     if len(hosts) * slices <= 1:
-        return False
+        return None
     worker_id = int(env["worker_id"] or 0)
     if slices > 1 and not env["coordinator"]:
         # hosts[0] is only the coordinator within ONE slice; without the
@@ -69,9 +72,28 @@ def initialize_from_env(*, coordinator_port: int = DEFAULT_COORDINATOR_PORT) -> 
             "unset; multislice needs the global coordinator address"
         )
     coordinator_host = env["coordinator"] or hosts[0]
+    sid = int(env["slice_id"] or 0)
+    return (
+        f"{coordinator_host}:{coordinator_port}",
+        len(hosts) * slices,
+        sid * len(hosts) + worker_id,
+    )
+
+
+def initialize_from_env(*, coordinator_port: int = DEFAULT_COORDINATOR_PORT) -> bool:
+    """Join the deployment's jax.distributed cluster if this is a multi-host
+    (or multislice) pod.
+
+    Returns True if distributed init ran, False for single-host (no-op).
+    See ``process_grid`` for the id layout.
+    """
+    grid = process_grid(coordinator_port=coordinator_port)
+    if grid is None:
+        return False
+    coordinator_address, num_processes, process_id = grid
     jax.distributed.initialize(
-        coordinator_address=f"{coordinator_host}:{coordinator_port}",
-        num_processes=len(hosts) * slices,
-        process_id=slice_id() * len(hosts) + worker_id,
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
     )
     return True

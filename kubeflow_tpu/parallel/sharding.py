@@ -189,18 +189,25 @@ def data_axes(mesh: Mesh) -> tuple:
     return tuple(a for a in ("dp", "fsdp", "ep") if a in mesh.axis_names)
 
 
+def constrain(x, spec: P):
+    """``with_sharding_constraint`` against the ambient global mesh; no-op
+    without one, so models stay mesh-agnostic (single-chip jit, CPU tests)."""
+    from kubeflow_tpu.parallel.context import get_global_mesh
+
+    mesh = get_global_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
 def replicate_for_use(x):
     """ZeRO-3 use-site gather: constrain a sharded param replicated where it
     is consumed, so XLA all-gathers the shards right before the consuming op
     instead of letting the param's at-rest split leak into the activation
     shardings.  No-op without an ambient mesh."""
-    from kubeflow_tpu.parallel.context import get_global_mesh
-
-    mesh = get_global_mesh()
-    if mesh is None or getattr(x, "ndim", 0) == 0:
+    if getattr(x, "ndim", 0) == 0:
         return x
-    spec = P(*([None] * x.ndim))
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return constrain(x, P(*([None] * x.ndim)))
 
 
 def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:

@@ -117,6 +117,30 @@ def test_dist_initialize_multislice_process_grid(monkeypatch):
         dist.initialize_from_env()
 
 
+def test_dist_process_grid_pure():
+    """process_grid computes the join parameters without touching
+    jax.distributed — the multislice dryrun certifies against it."""
+    from kubeflow_tpu.parallel import dist
+
+    env = {
+        "worker_id": "1",
+        "hostnames": "a,b",
+        "num_slices": "3",
+        "slice_id": "2",
+        "coordinator": "coord",
+    }
+    addr, n, pid = dist.process_grid(env)
+    assert (n, pid) == (6, 5)  # slice-major: 2*2 + 1
+    assert addr.startswith("coord:")
+    assert dist.process_grid({"hostnames": "", "num_slices": "",
+                              "worker_id": "", "slice_id": "",
+                              "coordinator": ""}) is None
+    with pytest.raises(RuntimeError, match="MEGASCALE_COORDINATOR_ADDRESS"):
+        dist.process_grid({"worker_id": "0", "hostnames": "a,b",
+                           "num_slices": "2", "slice_id": "0",
+                           "coordinator": ""})
+
+
 def test_t5_and_bert_rules_cover_every_matmul_weight():
     """Every kernel/embedding leaf must get a non-replicated spec — a rule
     gap would silently serve 'tensor parallel' with replicated weights."""
