@@ -1,14 +1,18 @@
 #!/usr/bin/env python3
 """Headline benchmarks (one JSON line per metric, primary metric LAST).
 
-1. llama8k_train_tokens_per_sec — long-context Llama train step (seq 8192,
-   bf16, remat) with the Pallas flash-attention kernel, measured end-to-end
-   against the identical model with XLA attention.  ``vs_baseline`` IS the
-   flash/XLA ratio: the round-1 kernel table showed 11.9x at the op level
-   (BASELINE.md); this metric is that win carried to a whole train step
-   (VERDICT r1 item 3).
-2. resnet50_images_per_sec_per_chip — the original BASELINE.md compute
-   metric; vs_baseline tracks the round-1 hardware measurement.
+1. resnet50_images_per_sec_per_chip — the original BASELINE.md compute
+   metric; vs_baseline tracks the round-1 hardware measurement.  Profiled
+   to its HBM-bandwidth roofline in round 3 (``--profile``, BASELINE.md):
+   parity is this metric's ceiling on a single v5e chip.
+2. llama8k_train_tokens_per_sec (PRIMARY since round 3) — long-context
+   Llama train step (seq 8192, bf16, remat) with the Pallas flash-attention
+   kernel, measured end-to-end against the identical model with XLA
+   attention.  ``vs_baseline`` IS the flash/XLA ratio: ~21.6x mean-window
+   on v5e-1.
+
+``--profile`` instead captures a per-op device trace of the ResNet step
+and prints the per-category roofline breakdown.
 
 The reference platform publishes no numbers (BASELINE.md) — baselines are
 the ones this repo established on first measurement on a TPU v5e chip.
@@ -144,7 +148,8 @@ LLAMA_WINDOWS = 3
 LLAMA_WARMUP = 2
 
 
-def resnet50_bench() -> None:
+def _resnet_setup():
+    """Model/state/step shared by the throughput bench and --profile."""
     import optax
 
     from kubeflow_tpu.models import create_model
@@ -157,14 +162,55 @@ def resnet50_bench() -> None:
     rng = jax.random.key(0)
     images = jax.random.normal(rng, (BATCH, IMAGE, IMAGE, 3), jnp.float32)
     labels = jax.random.randint(jax.random.fold_in(rng, 1), (BATCH,), 0, 1000)
-
     tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
     state = create_train_state(rng, model, images, tx, init_kwargs={"train": False})
     step = jax.jit(
         make_classification_train_step(has_batch_stats=True), donate_argnums=(0,)
     )
+    return state, step, (images, labels)
 
-    batch = (images, labels)
+
+def resnet50_profile() -> None:
+    """Per-op device profile of the ResNet train step (VERDICT r2 item 1).
+
+    Captures a real device trace (works through the axon tunnel) and prints
+    the per-HLO-category breakdown plus a roofline summary.  The round-3
+    analysis this produced is recorded in BASELINE.md: the step is
+    HBM-bandwidth-bound, not MXU- or tunnel-bound, and runs at ~92% of its
+    bandwidth roofline — which is why parity, not a win, is the ceiling for
+    this metric, and why llama8k (where the kernel design changes the
+    bandwidth picture) is the primary metric.
+    """
+    import tempfile
+
+    from kubeflow_tpu.train.profiling import profile_steps, trace_summary
+
+    steps = 5
+    state, step, batch = _resnet_setup()
+    with tempfile.TemporaryDirectory(prefix="rn50prof") as td:
+        _, logdir = profile_steps(td, step, state, batch, warmup=3, steps=steps)
+        s = trace_summary(logdir)
+    out = {
+        "metric": "resnet50_profile",
+        "device_ms_per_step": round(s["total_ms"] / steps, 2),
+        "gb_per_step": round(s["total_gb"] / steps, 2),
+        "tf_per_step": round(s["total_tf"] / steps, 3),
+        "categories": {
+            cat: {
+                "ms_per_step": round(v["ms"] / steps, 2),
+                "pct": round(v["ms"] / s["total_ms"] * 100, 1),
+                "achieved_gb_per_s": round(v["gb_per_s"], 1),
+                "achieved_tf_per_s": round(v["tf_per_s"], 2),
+            }
+            for cat, v in s["categories"].items()
+            if v["ms"] / s["total_ms"] >= 0.005
+        },
+    }
+    print(json.dumps(out), flush=True)
+
+
+def resnet50_bench() -> None:
+    state, step, batch = _resnet_setup()
     for _ in range(WARMUP):
         state, metrics = step(state, batch)
     # A scalar device→host fetch, not block_until_ready: on tunneled/async
@@ -207,9 +253,18 @@ def resnet50_bench() -> None:
     )
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--profile" in argv:
+        resnet50_profile()
+        return 0
+    resnet50_bench()
+    # Primary metric: printed last, parsed by the driver.  llama8k was
+    # promoted in round 3 (VERDICT r2 item 1): the ResNet step is
+    # HBM-bandwidth-bound at ~92% of its roofline (BASELINE.md profile
+    # analysis), so parity is its ceiling, while the llama8k flash-vs-XLA
+    # ratio measures a design win this framework actually controls.
     llama_8k_bench()
-    resnet50_bench()  # primary metric: printed last, parsed by the driver
     return 0
 
 

@@ -75,3 +75,77 @@ def _block(out: Any) -> None:
     for leaf in jax.tree_util.tree_leaves(out):
         if isinstance(leaf, jax.Array):
             leaf.block_until_ready()
+
+
+def trace_summary(logdir: str) -> dict:
+    """Aggregate the newest device trace under ``logdir`` by HLO category.
+
+    Parses the Chrome-trace JSON the profiler writes (each XLA-op event
+    carries ``hlo_category``, ``bytes_accessed`` and ``model_flops``) and
+    returns, per category: total device milliseconds, gigabytes accessed,
+    and the achieved GB/s / TF/s — the inputs to a roofline argument.
+    Host-side events are excluded; only ``/device:*`` "XLA Ops" rows count.
+    """
+    import collections
+    import glob
+    import gzip
+    import json
+
+    traces = sorted(
+        glob.glob(
+            os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz")
+        )
+    )
+    if not traces:
+        raise FileNotFoundError(f"no trace.json.gz under {logdir}")
+    with gzip.open(traces[-1]) as f:
+        tr = json.load(f)
+    events = tr.get("traceEvents", [])
+    pids, tids = {}, {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"]["name"]
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tids[(e["pid"], e["tid"])] = e["args"]["name"]
+    agg = collections.defaultdict(lambda: [0.0, 0, 0])  # us, bytes, flops
+    for e in events:
+        if (
+            e.get("ph") != "X"
+            or not pids.get(e.get("pid"), "").startswith("/device:")
+            or tids.get((e.get("pid"), e.get("tid"))) != "XLA Ops"
+            or "args" not in e
+        ):
+            continue
+        a = e["args"]
+        cat = a.get("hlo_category", "other")
+        if cat.endswith("-start"):
+            # async-start/copy-start carry the transfer's bytes with ~zero
+            # duration; the device time AND the same bytes appear again on
+            # the paired -done event — counting both double-books traffic.
+            continue
+        row = agg[cat]
+        row[0] += e["dur"]
+        row[1] += int(a.get("bytes_accessed", 0) or 0)
+        row[2] += int(a.get("model_flops", 0) or 0)
+    if not agg:
+        raise ValueError(
+            f"trace under {logdir} has no device-side XLA-op events "
+            "(non-TPU backend?); refusing to report a zero profile"
+        )
+    categories = {}
+    for cat, (us, byt, fl) in agg.items():
+        sec = us / 1e6
+        categories[cat] = {
+            "ms": us / 1e3,
+            "gb": byt / 1e9,
+            "gb_per_s": byt / sec / 1e9 if sec else 0.0,
+            "tf_per_s": fl / sec / 1e12 if sec else 0.0,
+        }
+    return {
+        "total_ms": sum(v[0] for v in agg.values()) / 1e3,
+        "total_gb": sum(v[1] for v in agg.values()) / 1e9,
+        "total_tf": sum(v[2] for v in agg.values()) / 1e12,
+        "categories": dict(
+            sorted(categories.items(), key=lambda kv: -kv[1]["ms"])
+        ),
+    }

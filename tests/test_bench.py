@@ -35,3 +35,84 @@ def test_bench_resnet_emits_metric(capsys, monkeypatch):
     assert {"value", "vs_baseline", "value_mean_window",
             "vs_baseline_mean"} <= set(out)
     assert out["value"] >= out["value_mean_window"] > 0
+
+
+def test_trace_summary_parses_device_ops(tmp_path):
+    """trace_summary aggregates XLA-op events by hlo_category and ignores
+    host-side rows (the bench --profile contract)."""
+    import gzip
+    import json as _json
+
+    from kubeflow_tpu.train.profiling import trace_summary
+
+    d = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 7, "tid": 1, "name": "thread_name",
+         "args": {"name": "python3"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "conv.1", "dur": 2000.0,
+         "args": {"hlo_category": "convolution fusion",
+                  "bytes_accessed": "2000000", "model_flops": "4000000"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "fus.1", "dur": 1000.0,
+         "args": {"hlo_category": "loop fusion",
+                  "bytes_accessed": "1000000", "model_flops": "0"}},
+        # host event must be excluded
+        {"ph": "X", "pid": 7, "tid": 1, "name": "py", "dur": 9999.0,
+         "args": {"hlo_category": "host", "bytes_accessed": "1"}},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        _json.dump({"traceEvents": events}, f)
+    s = trace_summary(str(tmp_path))
+    assert round(s["total_ms"], 3) == 3.0
+    cats = s["categories"]
+    assert set(cats) == {"convolution fusion", "loop fusion"}
+    assert round(cats["convolution fusion"]["gb_per_s"], 1) == 1.0
+    assert round(cats["convolution fusion"]["tf_per_s"], 3) == 0.002
+    # sorted by time, conv first
+    assert list(cats) == ["convolution fusion", "loop fusion"]
+
+
+def test_trace_summary_excludes_start_events_and_rejects_empty(tmp_path):
+    import gzip
+    import json as _json
+
+    import pytest as _pytest
+
+    from kubeflow_tpu.train.profiling import trace_summary
+
+    d = tmp_path / "plugins" / "profile" / "x"
+    d.mkdir(parents=True)
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 3, "tid": 3, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "cs", "dur": 0.1,
+         "args": {"hlo_category": "copy-start",
+                  "bytes_accessed": "5000000"}},
+        {"ph": "X", "pid": 3, "tid": 3, "name": "cd", "dur": 100.0,
+         "args": {"hlo_category": "copy-done",
+                  "bytes_accessed": "5000000"}},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        _json.dump({"traceEvents": events}, f)
+    s = trace_summary(str(tmp_path))
+    assert set(s["categories"]) == {"copy-done"}
+    assert round(s["total_gb"], 4) == 0.005  # not double-booked
+
+    e = tmp_path / "empty"
+    (e / "plugins" / "profile" / "y").mkdir(parents=True)
+    with gzip.open(e / "plugins" / "profile" / "y" / "vm.trace.json.gz",
+                   "wt") as f:
+        _json.dump({"traceEvents": [
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/host:CPU"}},
+        ]}, f)
+    with _pytest.raises(ValueError, match="no device-side"):
+        trace_summary(str(e))
