@@ -66,6 +66,13 @@ CONFIGS = {
         vocab_size=32000, dim=768, n_layers=12, n_heads=12, n_kv_heads=12,
         ffn_dim=2048,
     ),
+    # ~1.36B params: the single-16GB-chip scale where weight-only int8
+    # serving can actually pay (BASELINE.md int8 A/B) — 125M decode is
+    # latency-bound, 7B doesn't fit a bf16 A/B arm.
+    "llama_1b4": LlamaConfig(
+        vocab_size=32000, dim=2048, n_layers=24, n_heads=16, n_kv_heads=16,
+        ffn_dim=5632,
+    ),
     "llama2_7b": LlamaConfig(),
     "llama2_13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
                               ffn_dim=13824),

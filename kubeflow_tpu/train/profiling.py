@@ -124,7 +124,7 @@ def trace_summary(logdir: str) -> dict:
             # the paired -done event — counting both double-books traffic.
             continue
         row = agg[cat]
-        row[0] += e["dur"]
+        row[0] += float(e.get("dur", 0.0) or 0.0)
         row[1] += int(a.get("bytes_accessed", 0) or 0)
         row[2] += int(a.get("model_flops", 0) or 0)
     if not agg:

@@ -41,7 +41,6 @@ def test_trace_summary_parses_device_ops(tmp_path):
     """trace_summary aggregates XLA-op events by hlo_category and ignores
     host-side rows (the bench --profile contract)."""
     import gzip
-    import json as _json
 
     from kubeflow_tpu.train.profiling import trace_summary
 
@@ -67,7 +66,7 @@ def test_trace_summary_parses_device_ops(tmp_path):
          "args": {"hlo_category": "host", "bytes_accessed": "1"}},
     ]
     with gzip.open(d / "vm.trace.json.gz", "wt") as f:
-        _json.dump({"traceEvents": events}, f)
+        json.dump({"traceEvents": events}, f)
     s = trace_summary(str(tmp_path))
     assert round(s["total_ms"], 3) == 3.0
     cats = s["categories"]
@@ -80,9 +79,6 @@ def test_trace_summary_parses_device_ops(tmp_path):
 
 def test_trace_summary_excludes_start_events_and_rejects_empty(tmp_path):
     import gzip
-    import json as _json
-
-    import pytest as _pytest
 
     from kubeflow_tpu.train.profiling import trace_summary
 
@@ -101,7 +97,7 @@ def test_trace_summary_excludes_start_events_and_rejects_empty(tmp_path):
                   "bytes_accessed": "5000000"}},
     ]
     with gzip.open(d / "vm.trace.json.gz", "wt") as f:
-        _json.dump({"traceEvents": events}, f)
+        json.dump({"traceEvents": events}, f)
     s = trace_summary(str(tmp_path))
     assert set(s["categories"]) == {"copy-done"}
     assert round(s["total_gb"], 4) == 0.005  # not double-booked
@@ -110,9 +106,9 @@ def test_trace_summary_excludes_start_events_and_rejects_empty(tmp_path):
     (e / "plugins" / "profile" / "y").mkdir(parents=True)
     with gzip.open(e / "plugins" / "profile" / "y" / "vm.trace.json.gz",
                    "wt") as f:
-        _json.dump({"traceEvents": [
+        json.dump({"traceEvents": [
             {"ph": "M", "pid": 7, "name": "process_name",
              "args": {"name": "/host:CPU"}},
         ]}, f)
-    with _pytest.raises(ValueError, match="no device-side"):
+    with pytest.raises(ValueError, match="no device-side"):
         trace_summary(str(e))

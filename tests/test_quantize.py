@@ -123,3 +123,21 @@ def test_serve_rejects_unknown_quantization():
 
     with pytest.raises(ValueError, match="unsupported quantization"):
         load_service("llama_debug", max_seq_len=64, quantize="int4")
+
+
+def test_llama_1b4_config_is_ab_scale():
+    """The int8 A/B config (BASELINE.md round 3): ~1.36B params, so the
+    bf16 arm (2.7 GB) and int8 arm (1.4 GB) both fit one 16 GB chip."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+
+    cfg = CONFIGS["llama_1b4"]
+    model = Llama(cfg)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))
+    )["params"]
+    n = sum(int(jnp.prod(jnp.array(x.shape))) for x in jax.tree.leaves(shapes))
+    assert 1.2e9 < n < 1.6e9, n
+    assert cfg.head_dim == 128  # flash-kernel-ready
