@@ -122,7 +122,8 @@ class E2E:
         assert resp.status_code == 200, resp.get_data(as_text=True)
 
         sts = self._wait(
-            lambda: self._get(STATEFULSET, name, ns), "StatefulSet creation"
+            lambda: self._get(STATEFULSET, name, ns), "StatefulSet creation",
+            poll=0.002,
         )
         replicas = deep_get(sts, "spec", "replicas")
         assert replicas == 1, f"2x4 is single-host (8 chips): replicas={replicas}"
@@ -133,7 +134,8 @@ class E2E:
 
         if self.hosts_sim:
             self._kubelet_sim(ns, name, replicas)
-        self._wait(lambda: self._phase(ns, name) == "running", "notebook Ready")
+        self._wait(lambda: self._phase(ns, name) == "running",
+                   "notebook Ready", poll=0.002)
         return time.perf_counter() - t0
 
     def _kubelet_sim(self, ns: str, name: str, replicas: int):
@@ -232,13 +234,17 @@ class E2E:
                 return row["status"]["phase"]
         return None
 
-    def _wait(self, fn, what: str, timeout: float = 20.0):
+    def _wait(self, fn, what: str, timeout: float = 20.0,
+              poll: float = 0.02):
+        """``poll`` is the probe interval; bench_spawn's timed waits pass
+        2 ms so the measurement isn't quantized by its own poller, while
+        untimed waits keep the cheap 20 ms default."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             out = fn()
             if out:
                 return out
-            time.sleep(0.02)
+            time.sleep(poll)
         raise TimeoutError(f"e2e: timed out waiting for {what}")
 
     def _delete_pods(self, ns, name):
