@@ -86,6 +86,7 @@ def build_notebook(body: dict, defaults: dict) -> tuple[dict, List[dict]]:
     container = spec["containers"][0]
 
     container["image"] = _image(body, defaults)
+    _set_image_pull_policy(container, body, defaults)
     _set_cpu_ram(container, body, defaults)
     _set_tpu(nb, body, defaults)
     pvcs = _set_volumes(nb, body, defaults)
@@ -105,9 +106,29 @@ def _image(body, defaults) -> str:
         "group-three": "imageGroupThree",
     }.get(server_type, "image")
     custom = body.get("customImage")
-    if custom and body.get("customImageCheck") and not defaults.get(field, {}).get("readOnly"):
-        return str(custom).strip()
+    if custom and body.get("customImageCheck"):
+        # allowCustomImage is the admin gate (reference
+        # spawner_ui_config.yaml:14); the group's readOnly additionally
+        # pins the whole picker.
+        if not defaults.get("allowCustomImage", True):
+            raise HttpError(400, "custom images are disabled by the admin")
+        if not defaults.get(field, {}).get("readOnly"):
+            return str(custom).strip()
     return get_form_value(body, defaults, field)
+
+
+def _set_image_pull_policy(container, body, defaults) -> None:
+    if "imagePullPolicy" not in defaults:
+        # Knob absent from the admin config: the control is disabled, so a
+        # body-supplied value is ignored too (the SPA hiding a control is
+        # not a gate) and kubelet's default applies.
+        return
+    policy = get_form_value(body, defaults, "imagePullPolicy")
+    if not policy:
+        return
+    if policy not in ("Always", "IfNotPresent", "Never"):
+        raise HttpError(400, f"invalid imagePullPolicy {policy!r}")
+    container["imagePullPolicy"] = str(policy)
 
 
 def _set_cpu_ram(container, body, defaults) -> None:

@@ -30,17 +30,40 @@ function serverType() {
   return checked ? checked.value : "jupyter";
 }
 
+function displayImageName(image) {
+  // hideRegistry/hideTag rewrite only what the user SEES; option values
+  // (and the submitted body) always carry the full reference.
+  let name = String(image);
+  if (config.hideRegistry) {
+    // Docker's registry heuristic: the first segment is a registry host
+    // only if it contains "." or ":" or is exactly "localhost".
+    const parts = name.split("/");
+    if (parts.length > 1 && (parts[0].includes(".") || parts[0].includes(":")
+        || parts[0] === "localhost")) {
+      parts.shift();
+    }
+    name = parts.join("/");
+  }
+  if (config.hideTag && !name.includes("@")) {
+    // Digest references (repo@sha256:...) keep their digest verbatim.
+    const slash = name.lastIndexOf("/");
+    const colon = name.lastIndexOf(":");
+    if (colon > slash) name = name.slice(0, colon);
+  }
+  return name;
+}
+
 function fillImageSelect() {
   const field = IMAGE_GROUPS[serverType()] || "image";
   const group = config[field] || {};
   const select = document.getElementById("image-select");
   select.replaceChildren();
   for (const image of group.options || [group.value]) {
-    const opt = el("option", { value: image }, image.split("/").pop());
+    const opt = el("option", { value: image }, displayImageName(image));
     if (image === group.value) opt.setAttribute("selected", "");
     select.append(opt);
   }
-  if (!group.readOnly) {
+  if (!group.readOnly && config.allowCustomImage !== false) {
     select.append(el("option", { value: "__custom__" }, "custom image…"));
   }
   select.disabled = !!group.readOnly;
@@ -70,6 +93,13 @@ async function loadConfig() {
   const shm = document.getElementById("shm-check");
   shm.checked = !!(config.shm && config.shm.value);
   applyReadOnly("shm", shm);
+  const pullPolicy = document.getElementById("image-pull-policy");
+  const pullCfg = config.imagePullPolicy || {};
+  if (pullCfg.value) {
+    document.getElementById("image-pull-policy-row").hidden = false;
+    pullPolicy.value = pullCfg.value;
+    applyReadOnly("imagePullPolicy", pullPolicy);
+  }
   const affinity = document.getElementById("affinity-select");
   for (const opt of (config.affinityConfig && config.affinityConfig.options) || []) {
     affinity.append(el("option", { value: opt.configKey }, opt.displayName || opt.configKey));
@@ -215,6 +245,10 @@ function spawnBody(form) {
     const field = IMAGE_GROUPS[body.serverType] || "image";
     body[field] = data.get("image");
   }
+  if (config.imagePullPolicy && config.imagePullPolicy.value
+      && data.get("imagePullPolicy")) {
+    body.imagePullPolicy = data.get("imagePullPolicy");
+  }
   const accelerator = data.get("tpuAccelerator");
   if (accelerator) {
     body.tpus = { accelerator, topology: data.get("tpuTopology") || "" };
@@ -283,6 +317,10 @@ function wireSpawner() {
     const memory = document.querySelector("[name=memory]");
     if (!cpu.disabled) cpu.value = config.cpu.value;
     if (!memory.disabled) memory.value = config.memory.value;
+    const pullPolicy = document.getElementById("image-pull-policy");
+    if (config.imagePullPolicy && config.imagePullPolicy.value && !pullPolicy.disabled) {
+      pullPolicy.value = config.imagePullPolicy.value;
+    }
     dialog.showModal();
   });
   document.getElementById("spawn-cancel").addEventListener("click", () => dialog.close());
