@@ -1,7 +1,7 @@
 /* Central dashboard shell: namespace selector, sidebar navigation that
    iframes the child apps (reference main-page.js + iframe-container.js),
    overview cards, activity feed, contributor management. */
-import { api, el, toast, age } from "./shared/common.js";
+import { api, el, svgEl, toast, age } from "./shared/common.js";
 
 let envInfo = null;
 let currentNs = null;
@@ -101,6 +101,87 @@ async function refreshHome() {
   } catch (e) { /* no access yet */ }
 }
 
+/* Time-series chart over /api/metrics/<type> (reference
+   resource-chart.js): one polyline per label (node/pod), min/max y-axis
+   labels, legend.  Hidden entirely when no metrics service is wired
+   (the backend 405s). */
+const SERIES_COLORS = ["#1967d2", "#d93025", "#188038", "#f9ab00",
+                       "#9334e6", "#12a4af"];
+let metricsAvailable = true;
+let metricsProbed = false;
+
+async function loadMetrics() {
+  if (!metricsAvailable) return;
+  const card = document.getElementById("metrics-card");
+  const type = document.getElementById("metric-type").value;
+  const interval = document.getElementById("metric-interval").value;
+  let points = [];
+  try {
+    points = (await api(`/api/metrics/${type}?interval=${interval}`)).points;
+  } catch (e) {
+    if (!metricsProbed) {
+      // Initial probe failed: no metrics service wired — hide the card.
+      metricsAvailable = false;
+      card.hidden = true;
+    } else {
+      // A later per-type 405 or transient error must not latch the whole
+      // card hidden; show the empty state so other types stay reachable.
+      renderChart([]);
+      toast(e.message, true);
+    }
+    return;
+  }
+  metricsProbed = true;
+  card.hidden = false;
+  renderChart(points || []);
+}
+
+function renderChart(points) {
+  const svg = document.getElementById("metric-chart");
+  const legend = document.getElementById("metric-legend");
+  svg.replaceChildren();
+  legend.replaceChildren();
+  document.getElementById("metrics-empty").hidden = points.length > 0;
+  if (!points.length) return;
+  const W = 600, H = 200, PAD = 36;
+  let t0 = points[0].timestamp, t1 = t0, v0 = points[0].value, v1 = v0;
+  for (const p of points) {
+    if (p.timestamp < t0) t0 = p.timestamp;
+    if (p.timestamp > t1) t1 = p.timestamp;
+    if (p.value < v0) v0 = p.value;
+    if (p.value > v1) v1 = p.value;
+  }
+  if (v1 === v0) v1 = v0 + 1;
+  const x = (t) => PAD + (t1 === t0 ? 0 : (t - t0) / (t1 - t0)) * (W - 2 * PAD);
+  const y = (v) => (H - PAD) - (v - v0) / (v1 - v0) * (H - 2 * PAD);
+  svg.append(
+    svgEl("line", { x1: PAD, y1: H - PAD, x2: W - PAD, y2: H - PAD,
+                    stroke: "#999" }),
+    svgEl("line", { x1: PAD, y1: PAD, x2: PAD, y2: H - PAD, stroke: "#999" }),
+    svgEl("text", { x: 2, y: PAD + 4, class: "axis-label" }, v1.toFixed(2)),
+    svgEl("text", { x: 2, y: H - PAD, class: "axis-label" }, v0.toFixed(2)),
+  );
+  const series = {};
+  for (const p of points) {
+    (series[p.label] = series[p.label] || []).push(p);
+  }
+  Object.keys(series).forEach((label, i) => {
+    const color = SERIES_COLORS[i % SERIES_COLORS.length];
+    const path = series[label]
+      .slice()
+      .sort((a, b) => a.timestamp - b.timestamp)
+      .map((p) => `${x(p.timestamp).toFixed(1)},${y(p.value).toFixed(1)}`)
+      .join(" ");
+    svg.append(svgEl("polyline", {
+      points: path, fill: "none", stroke: color, "stroke-width": 1.5,
+      "data-series": label,
+    }));
+    legend.append(el("span", { class: "legend-item" },
+      el("span", { class: "legend-swatch", style: `background:${color}` }),
+      label));
+  });
+}
+
 async function loadContributors() {
   document.getElementById("contrib-ns").textContent = currentNs || "—";
   const tbody = document.querySelector("#contrib-table tbody");
@@ -173,6 +254,9 @@ for (const a of document.querySelectorAll("nav.sidebar a[data-view]")) {
   });
 }
 
+document.getElementById("metric-type").addEventListener("change", loadMetrics);
+document.getElementById("metric-interval").addEventListener("change", loadMetrics);
+
 loadEnvInfo()
-  .then(() => Promise.all([loadLinks(), refreshHome()]))
+  .then(() => Promise.all([loadLinks(), refreshHome(), loadMetrics()]))
   .catch((e) => toast(e.message, true));

@@ -7,7 +7,8 @@
    view is the reference's notebook-page (overview/logs/events/yaml tabs,
    reference jupyter/frontend/src/app/pages/notebook-page/). */
 import {
-  api, namespace, el, toast, statusDot, age, poll, confirmDialog,
+  api, namespace, el, toast, statusDot, age, poll, confirmDialog, tableView,
+  parseQuantity,
 } from "./shared/common.js";
 
 const ns = namespace();
@@ -345,6 +346,63 @@ function wireSpawner() {
 
 /* -- table ---------------------------------------------------------------- */
 
+function renderNbRow(nb) {
+  const stopped = nb.status && nb.status.phase === "stopped";
+  const tpuText = nb.tpu
+    ? `${nb.tpu.accelerator}${nb.tpu.topology ? " " + nb.tpu.topology : ""}`
+    : "—";
+  return el("tr", {},
+    el("td", {}, statusDot((nb.status && nb.status.phase) || "waiting")),
+    el("td", {}, el("a", {
+      href: `?ns=${ns}&nb=${nb.name}`,
+      class: "nb-name",
+      onclick: (ev) => { ev.preventDefault(); showDetail(nb.name); },
+    }, nb.name)),
+    el("td", { class: "mono", title: nb.image }, nb.shortImage),
+    el("td", {}, tpuText),
+    el("td", {}, nb.cpu || "—"),
+    el("td", {}, nb.memory || "—"),
+    el("td", {}, age(nb.age)),
+    el("td", {},
+      el("a", { class: "button ghost", href: connectUrl(nb), target: "_blank" }, "Connect"),
+      el("button", {
+        class: "ghost",
+        onclick: () => toggleStop(nb, !stopped),
+      }, stopped ? "Start" : "Stop"),
+      el("button", {
+        class: "danger",
+        onclick: () => removeNotebook(nb),
+      }, "Delete"),
+    ),
+  );
+}
+
+let nbTable = null;
+
+function ensureNbTable() {
+  if (!nbTable) {
+    nbTable = tableView({
+      table: document.getElementById("nb-table"),
+      filterInput: document.getElementById("nb-filter"),
+      pager: document.getElementById("nb-pager"),
+      renderRow: renderNbRow,
+      filterText: (nb) => [nb.name, nb.image,
+                           (nb.status && nb.status.phase) || ""].join(" "),
+      columns: {
+        status: (nb) => (nb.status && nb.status.phase) || "",
+        name: (nb) => nb.name || "",
+        image: (nb) => nb.shortImage || nb.image || "",
+        tpu: (nb) => nb.tpu
+          ? `${nb.tpu.accelerator} ${nb.tpu.topology || ""}` : "",
+        cpu: (nb) => parseQuantity(nb.cpu),
+        memory: (nb) => parseQuantity(nb.memory),
+        age: (nb) => nb.age || "",
+      },
+    });
+  }
+  return nbTable;
+}
+
 async function refreshTable() {
   let notebooks = [];
   try {
@@ -353,39 +411,8 @@ async function refreshTable() {
     toast(e.message, true);
     return;
   }
-  const tbody = document.querySelector("#nb-table tbody");
   document.getElementById("nb-empty").hidden = notebooks.length > 0;
-  tbody.replaceChildren();
-  for (const nb of notebooks) {
-    const stopped = nb.status && nb.status.phase === "stopped";
-    const tpuText = nb.tpu
-      ? `${nb.tpu.accelerator}${nb.tpu.topology ? " " + nb.tpu.topology : ""}`
-      : "—";
-    tbody.append(el("tr", {},
-      el("td", {}, statusDot((nb.status && nb.status.phase) || "waiting")),
-      el("td", {}, el("a", {
-        href: `?ns=${ns}&nb=${nb.name}`,
-        class: "nb-name",
-        onclick: (ev) => { ev.preventDefault(); showDetail(nb.name); },
-      }, nb.name)),
-      el("td", { class: "mono", title: nb.image }, nb.shortImage),
-      el("td", {}, tpuText),
-      el("td", {}, nb.cpu || "—"),
-      el("td", {}, nb.memory || "—"),
-      el("td", {}, age(nb.age)),
-      el("td", {},
-        el("a", { class: "button ghost", href: connectUrl(nb), target: "_blank" }, "Connect"),
-        el("button", {
-          class: "ghost",
-          onclick: () => toggleStop(nb, !stopped),
-        }, stopped ? "Start" : "Stop"),
-        el("button", {
-          class: "danger",
-          onclick: () => removeNotebook(nb),
-        }, "Delete"),
-      ),
-    ));
-  }
+  ensureNbTable().setRows(notebooks);
 }
 
 async function toggleStop(nb, stop) {

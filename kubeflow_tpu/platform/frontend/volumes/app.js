@@ -1,12 +1,57 @@
 /* Volumes SPA: PVC table with mount usage, create + guarded delete. */
 import {
-  api, namespace, el, toast, statusDot, age, poll, confirmDialog,
+  api, namespace, el, toast, statusDot, age, poll, confirmDialog, tableView,
+  parseQuantity,
 } from "./shared/common.js";
 
 const ns = namespace();
 document.getElementById("ns-label").textContent = "namespace: " + ns;
 
 const PHASES = { Bound: "ready", Pending: "waiting", Lost: "warning" };
+
+function renderPvcRow(pvc) {
+  return el("tr", {},
+    el("td", {}, statusDot(PHASES[pvc.status] || "waiting")),
+    el("td", {}, el("a", {
+      href: `?ns=${ns}&pvc=${pvc.name}`,
+      class: "pvc-name",
+      onclick: (ev) => { ev.preventDefault(); showDetail(pvc.name); },
+    }, pvc.name)),
+    el("td", {}, pvc.capacity),
+    el("td", {}, (pvc.modes || []).join(", ")),
+    el("td", {}, pvc.class || "default"),
+    el("td", { class: "mono" }, (pvc.usedBy || []).join(", ") || "—"),
+    el("td", {}, age(pvc.age)),
+    el("td", {}, el("button", {
+      class: "danger",
+      disabled: (pvc.usedBy || []).length ? "" : null,
+      title: (pvc.usedBy || []).length ? "mounted by a pod" : "",
+      onclick: () => remove(pvc),
+    }, "Delete")),
+  );
+}
+
+let pvcTable = null;
+
+function ensurePvcTable() {
+  if (!pvcTable) {
+    pvcTable = tableView({
+      table: document.getElementById("pvc-table"),
+      filterInput: document.getElementById("pvc-filter"),
+      pager: document.getElementById("pvc-pager"),
+      renderRow: renderPvcRow,
+      filterText: (pvc) => [pvc.name, pvc.status || "",
+                            (pvc.usedBy || []).join(" ")].join(" "),
+      columns: {
+        status: (pvc) => pvc.status || "",
+        name: (pvc) => pvc.name || "",
+        size: (pvc) => parseQuantity(pvc.capacity),
+        age: (pvc) => pvc.age || "",
+      },
+    });
+  }
+  return pvcTable;
+}
 
 async function refresh() {
   let pvcs = [];
@@ -16,30 +61,8 @@ async function refresh() {
     toast(e.message, true);
     return;
   }
-  const tbody = document.querySelector("#pvc-table tbody");
   document.getElementById("pvc-empty").hidden = pvcs.length > 0;
-  tbody.replaceChildren();
-  for (const pvc of pvcs) {
-    tbody.append(el("tr", {},
-      el("td", {}, statusDot(PHASES[pvc.status] || "waiting")),
-      el("td", {}, el("a", {
-        href: `?ns=${ns}&pvc=${pvc.name}`,
-        class: "pvc-name",
-        onclick: (ev) => { ev.preventDefault(); showDetail(pvc.name); },
-      }, pvc.name)),
-      el("td", {}, pvc.capacity),
-      el("td", {}, (pvc.modes || []).join(", ")),
-      el("td", {}, pvc.class || "default"),
-      el("td", { class: "mono" }, (pvc.usedBy || []).join(", ") || "—"),
-      el("td", {}, age(pvc.age)),
-      el("td", {}, el("button", {
-        class: "danger",
-        disabled: (pvc.usedBy || []).length ? "" : null,
-        title: (pvc.usedBy || []).length ? "mounted by a pod" : "",
-        onclick: () => remove(pvc),
-      }, "Delete")),
-    ));
-  }
+  ensurePvcTable().setRows(pvcs);
 }
 
 async function remove(pvc) {

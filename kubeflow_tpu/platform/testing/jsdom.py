@@ -552,6 +552,14 @@ class Document(Element):
     def createElement(self, tag):
         return Element(js_to_string(tag), self)
 
+    def createElementNS(self, namespace, tag):
+        """SVG et al.: the shim doesn't render, so the namespaced create is
+        the plain one with namespaceURI recorded (real browsers require
+        createElementNS for SVG to paint — the SPAs must use it)."""
+        node = Element(js_to_string(tag), self)
+        node.namespaceURI = js_to_string(namespace)
+        return node
+
     def createTextNode(self, text):
         return TextNode(js_to_string(text))
 

@@ -467,9 +467,10 @@ def _harness_with_config(kube, tmp_path, overrides, *, drop=()):
 
 
 def test_image_pull_policy_round_trips_to_container(kube, jupyter):
-    """Default config carries imagePullPolicy IfNotPresent; the SPA shows
-    the select and the chosen policy lands on the container (VERDICT r2
-    item 7; reference spawner_ui_config.yaml:14-29)."""
+    """Default config carries imagePullPolicy Always (the option images
+    are :latest tags); the SPA shows the select and a user override lands
+    on the container (VERDICT r2 item 7; reference
+    spawner_ui_config.yaml:14-29)."""
     jupyter.click("#new-notebook")
     assert not jupyter.get("image-pull-policy-row").hidden
     assert jupyter.query("#image-pull-policy").value == "Always"  # admin default
@@ -578,6 +579,156 @@ def test_hide_registry_false_shows_full_reference(kube, tmp_path):
     h.click("#new-notebook")
     labels = [o.textContent for o in h.query_all("#image-select option")]
     assert "ghcr.io/kubeflow-tpu/jupyter-jax-tpu:latest" in labels
+
+
+# -- table ergonomics: sort / filter / pagination (VERDICT r2 item 6) --------
+
+
+def _mk_nb(kube, name, image="img"):
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": name, "namespace": "user1"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": image}]}}},
+    })
+
+
+def test_notebook_table_pagination(kube, jupyter):
+    """12 notebooks: page 1 shows 10 with a pager, next shows the rest."""
+    for i in range(12):
+        _mk_nb(kube, f"nb-{i:02d}")
+    jupyter.fire_timers()  # poll() refresh
+    assert len(jupyter.query_all("#nb-table tbody tr")) == 10
+    assert "1–10 of 12" in jupyter.query("#nb-pager .pager-label").textContent
+    assert jupyter.query("#nb-pager .pager-prev").disabled
+    jupyter.query("#nb-pager .pager-next").click()
+    assert len(jupyter.query_all("#nb-table tbody tr")) == 2
+    assert "11–12 of 12" in jupyter.query("#nb-pager .pager-label").textContent
+    assert jupyter.query("#nb-pager .pager-next").disabled
+    jupyter.query("#nb-pager .pager-prev").click()
+    assert len(jupyter.query_all("#nb-table tbody tr")) == 10
+
+
+def test_notebook_table_sort_toggles(kube, jupyter):
+    for name in ("charlie", "alpha", "bravo"):
+        _mk_nb(kube, name)
+    jupyter.fire_timers()
+
+    def names():
+        return [a.textContent
+                for a in jupyter.query_all("#nb-table tbody a.nb-name")]
+
+    th = jupyter.query('th[data-sort="name"]')
+    th.click()
+    assert names() == ["alpha", "bravo", "charlie"]
+    assert "sort-asc" in th.className
+    th.click()
+    assert names() == ["charlie", "bravo", "alpha"]
+    assert "sort-desc" in th.className
+
+
+def test_notebook_table_memory_sorts_as_quantity(kube, jupyter):
+    """512Mi must rank below 1Gi — quantities sort numerically, not
+    lexicographically."""
+    for name, mem in (("small", "512Mi"), ("big", "2Gi"), ("mid", "1Gi")):
+        kube.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "user1"},
+            "spec": {"template": {"spec": {"containers": [{
+                "name": name, "image": "img",
+                "resources": {"requests": {"memory": mem}},
+            }]}}},
+        })
+    jupyter.fire_timers()
+    jupyter.query('th[data-sort="memory"]').click()
+    names = [a.textContent
+             for a in jupyter.query_all("#nb-table tbody a.nb-name")]
+    assert names == ["small", "mid", "big"]
+
+
+def test_notebook_table_filter(kube, jupyter):
+    _mk_nb(kube, "train-1")
+    _mk_nb(kube, "train-2")
+    _mk_nb(kube, "serve-1")
+    jupyter.fire_timers()
+    jupyter.set_value("#nb-filter", "train", event="input")
+    rows = jupyter.query_all("#nb-table tbody tr")
+    assert len(rows) == 2
+    assert all("train" in r.textContent for r in rows)
+    jupyter.set_value("#nb-filter", "", event="input")
+    assert len(jupyter.query_all("#nb-table tbody tr")) == 3
+
+
+def test_volumes_table_sort_and_filter(kube):
+    from kubeflow_tpu.platform.apps.volumes.app import create_app
+
+    for name, size in (("zeta-vol", "5Gi"), ("alpha-vol", "10Gi")):
+        kube.create({
+            "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+            "metadata": {"name": name, "namespace": "user1"},
+            "spec": {"resources": {"requests": {"storage": size}},
+                     "accessModes": ["ReadWriteOnce"]},
+            "status": {"phase": "Bound"},
+        })
+    h = harness("volumes", create_app, kube)
+    h.query('th[data-sort="name"]').click()
+    names = [a.textContent for a in h.query_all("#pvc-table tbody a.pvc-name")]
+    assert names == ["alpha-vol", "zeta-vol"]
+    h.set_value("#pvc-filter", "zeta", event="input")
+    assert len(h.query_all("#pvc-table tbody tr")) == 1
+
+
+# -- dashboard resource chart (VERDICT r2 item 6) ----------------------------
+
+
+def test_dashboard_chart_renders_series_from_metrics(kube):
+    """A wired metrics service renders one SVG polyline per label plus a
+    legend, driven by the executed JS against /api/metrics/<type>."""
+    from kubeflow_tpu.platform.dashboard.app import create_app
+    from kubeflow_tpu.platform.dashboard.metrics_service import (
+        MetricsService,
+        TimeSeriesPoint,
+    )
+
+    class FakeMetrics(MetricsService):
+        def node_cpu_utilization(self, interval):
+            return [TimeSeriesPoint(1000 + 60 * i, label, 0.1 * i + bias)
+                    for label, bias in (("node-a", 0.0), ("node-b", 0.3))
+                    for i in range(5)]
+
+        def tpu_duty_cycle(self, interval):
+            return []
+
+    kube.add_namespace("kubeflow")
+
+    def make(k, **kw):
+        return create_app(k, metrics_service=FakeMetrics(), **kw)
+
+    h = harness("dashboard", make, kube, user="owner@x.io")
+    assert not h.get("metrics-card").hidden
+    lines = h.query_all("#metric-chart polyline")
+    assert len(lines) == 2
+    assert {l.getAttribute("data-series") for l in lines} == {"node-a", "node-b"}
+    # Points are "x,y x,y ..." pairs inside the padded viewport.
+    pts = lines[0].getAttribute("points").split(" ")
+    assert len(pts) == 5
+    legend = [s.textContent for s in h.query_all("#metric-legend .legend-item")]
+    assert legend == ["node-a", "node-b"]
+    # Switching to an empty metric type shows the empty note.
+    h.set_value("#metric-type", "tpu")
+    assert h.get("metrics-empty").hidden is False
+    assert len(h.query_all("#metric-chart polyline")) == 0
+    # A per-type 405 (podcpu not implemented by this service) must NOT
+    # latch the card hidden — node CPU stays reachable.
+    h.set_value("#metric-type", "podcpu")
+    assert not h.get("metrics-card").hidden
+    h.set_value("#metric-type", "node")
+    assert len(h.query_all("#metric-chart polyline")) == 2
+
+
+def test_dashboard_chart_hidden_without_metrics_service(dashboard_env):
+    h, _ = dashboard_env
+    assert h.get("metrics-card").hidden
 
 
 # -- notebook detail page (VERDICT r1 item 1) --------------------------------
