@@ -450,7 +450,11 @@ class Parser:
                 if self.eat("punct", "..."):
                     elts.append(("Rest", self.parse_binding_target()))
                 else:
-                    elts.append(self.parse_binding_target())
+                    target = self.parse_binding_target()
+                    if self.eat("punct", "="):
+                        # Default applies only when the slot is undefined.
+                        target = ("Default", target, self.parse_assignment())
+                    elts.append(target)
                 if not self.at("punct", "]"):
                     self.expect("punct", ",")
             self.expect("punct", "]")
@@ -459,11 +463,21 @@ class Parser:
             self.next()
             props = []
             while not self.at("punct", "}"):
+                if self.eat("punct", "..."):
+                    # Object rest: collect unconsumed own keys.
+                    props.append(("...", self.next().value, None))
+                    if not self.eat("punct", ","):
+                        break
+                    continue
                 key = self.next().value
                 local = key
                 default = None
                 if self.eat("punct", ":"):
-                    local = self.next().value
+                    # The value side may itself be a pattern ({p: {q}}).
+                    if self.at("punct", "[") or self.at("punct", "{"):
+                        local = self.parse_binding_target()
+                    else:
+                        local = self.next().value
                 if self.eat("punct", "="):
                     default = self.parse_assignment()
                 props.append((key, local, default))
@@ -807,6 +821,14 @@ class Parser:
                             "kv", ("Const", kt.value),
                             ("Function", kt.value, params, body, False),
                         ))
+                    elif self.at("punct", "="):
+                        # CoverInitializedName: `({a = 1} = obj)` shorthand
+                        # default — only meaningful in destructuring, where
+                        # _expr_to_pattern consumes the Assign node.
+                        self.next()
+                        props.append(("kv", ("Const", kt.value),
+                                      ("Assign", "=", ("Name", kt.value),
+                                       self.parse_assignment())))
                     else:
                         props.append(("kv", ("Const", kt.value),
                                       ("Name", kt.value)))
@@ -995,6 +1017,41 @@ def js_number(v):
     return float("nan")
 
 
+def _js_float_str(v: float) -> str:
+    """ECMAScript Number::toString (spec 6.1.6.1.20): decimal notation for
+    exponents in (-7, 21], exponent notation outside — NOT Python's repr,
+    whose thresholds differ ('1e-06' vs JS '0.000001', exponents padded to
+    two digits vs JS '1e-7')."""
+    if v == 0:
+        return "0"  # covers -0
+    sign = "-" if v < 0 else ""
+    s = repr(abs(v))  # shortest round-trip digits, like JS
+    if "e" in s:
+        mant, exp = s.split("e")
+        exp = int(exp)
+    else:
+        mant, exp = s, 0
+    int_part, _, frac = mant.partition(".")
+    all_digits = int_part + frac
+    stripped = all_digits.lstrip("0")
+    lead = len(all_digits) - len(stripped)
+    digits = (stripped.rstrip("0") or "0")
+    # value = 0.<digits> * 10**n
+    n = len(int_part) - lead + exp
+    k = len(digits)
+    if k <= n <= 21:
+        return sign + digits + "0" * (n - k)
+    if 0 < n <= 21:
+        return sign + digits[:n] + "." + digits[n:]
+    if -6 < n <= 0:
+        return sign + "0." + "0" * (-n) + digits
+    e = n - 1
+    estr = ("+" if e >= 0 else "-") + str(abs(e))
+    if k == 1:
+        return sign + digits + "e" + estr
+    return sign + digits[0] + "." + digits[1:] + "e" + estr
+
+
 def js_to_string(v) -> str:
     if v is UNDEF:
         return "undefined"
@@ -1007,9 +1064,7 @@ def js_to_string(v) -> str:
             return "NaN"
         if math.isinf(v):
             return "Infinity" if v > 0 else "-Infinity"
-        if v.is_integer():
-            return str(int(v))
-        return repr(v)
+        return _js_float_str(v)
     if isinstance(v, (int, str)):
         return str(v)
     if isinstance(v, JSArray):
@@ -1128,8 +1183,12 @@ def _arr_method(arr: JSArray, name: str):
         "indexOf": lambda x, s=0: next(
             (i for i in range(int(js_number(s)), len(arr))
              if js_equals_strict(arr[i], x)), -1),
+        # SameValueZero: unlike indexOf, includes(NaN) finds NaN.
         "includes": lambda x, s=0: any(
-            js_equals_strict(v, x) for v in arr[int(js_number(s)):]),
+            js_equals_strict(v, x)
+            or (isinstance(v, float) and isinstance(x, float)
+                and math.isnan(v) and math.isnan(x))
+            for v in arr[int(js_number(s)):]),
         "join": lambda sep=",": sep.join(
             "" if x is None or x is UNDEF else js_to_string(x) for x in arr),
         "map": lambda fn: JSArray(
@@ -1205,8 +1264,11 @@ def _str_method(s: str, name: str):
         "split": split,
         "slice": lambda a=0, b=None: s[int(js_number(a)):(
             None if b is None else int(js_number(b)))],
-        "substring": lambda a=0, b=None: s[max(0, int(js_number(a))):(
-            None if b is None else max(0, int(js_number(b))))],
+        # substring clamps negatives to 0 AND swaps start/end if reversed.
+        "substring": lambda a=0, b=None: (lambda lo, hi: s[min(lo, hi):max(lo, hi)])(
+            max(0, min(len(s), int(js_number(a)))),
+            len(s) if b is None or b is UNDEF
+            else max(0, min(len(s), int(js_number(b))))),
         "indexOf": lambda x, start=0: s.find(js_to_string(x),
                                              int(js_number(start))),
         "lastIndexOf": lambda x: s.rfind(js_to_string(x)),
@@ -1492,6 +1554,10 @@ class Interpreter:
         kind = target[0]
         if kind == "Name":
             env.declare(target[1], value)
+        elif kind == "Default":
+            if value is UNDEF:
+                value = self.eval(target[2], env)
+            self.bind_pattern(target[1], value, env)
         elif kind == "ArrayPat":
             seq = list(self.js_iter(value)) if value not in (None, UNDEF) else []
             i = 0
@@ -1505,11 +1571,23 @@ class Interpreter:
                 self.bind_pattern(elt, seq[i] if i < len(seq) else UNDEF, env)
                 i += 1
         elif kind == "ObjectPat":
+            consumed = []
             for key, local, default in target[1]:
+                if key == "...":
+                    rest = JSObject(
+                        {k: v for k, v in value.items() if k not in consumed}
+                        if isinstance(value, dict) else {}
+                    )
+                    env.declare(local, rest)
+                    continue
+                consumed.append(key)
                 v = js_get(value, key)
                 if v is UNDEF and default is not None:
                     v = self.eval(default, env)
-                env.declare(local, v)
+                if isinstance(local, tuple):
+                    self.bind_pattern(local, v, env)  # nested pattern
+                else:
+                    env.declare(local, v)
         else:
             raise RuntimeError(f"unhandled pattern {kind}")
 
@@ -1659,8 +1737,95 @@ class Interpreter:
             obj = self.eval(target[1], env)
             key = target[2][1] if not target[3] else self.eval(target[2], env)
             js_set(obj, key, value)
+        elif kind in ("ArrayLit", "ObjectLit"):
+            # Assignment destructuring: [a, b] = pair / ({k} = obj).
+            self.assign_pattern(self._expr_to_pattern(target), value, env)
         else:
             raise RuntimeError(f"bad assignment target {kind}")
+
+    def _expr_to_pattern(self, node):
+        """Re-interpret an already-parsed literal as a binding pattern (the
+        parser can't know `[a, b] = ...` is a pattern until the `=`)."""
+        kind = node[0]
+        if kind in ("Name", "Member"):
+            return node  # assign_pattern routes both through assign_to
+        if kind == "ArrayLit":
+            elts = []
+            for e in node[1]:
+                if e is None:
+                    elts.append(None)
+                elif e[0] == "Spread":
+                    elts.append(("Rest", self._expr_to_pattern(e[1])))
+                elif e[0] == "Assign" and e[1] == "=":
+                    elts.append(
+                        ("Default", self._expr_to_pattern(e[2]), e[3]))
+                else:
+                    elts.append(self._expr_to_pattern(e))
+            return ("ArrayPat", elts)
+        if kind == "ObjectLit":
+            props = []
+            for ptype, key, val in node[1]:
+                if ptype == "spread" and key[0] == "Name":
+                    props.append(("...", key[1], None))
+                    continue
+                if ptype != "kv" or key[0] != "Const":
+                    throw("Invalid destructuring assignment target",
+                          "SyntaxError")
+                if val[0] == "Name":
+                    props.append((key[1], val[1], None))
+                elif val[0] == "Assign" and val[1] == "=":
+                    props.append((key[1],
+                                  self._expr_to_pattern(val[2])[1]
+                                  if val[2][0] == "Name"
+                                  else self._expr_to_pattern(val[2]),
+                                  val[3]))
+                else:
+                    props.append((key[1], self._expr_to_pattern(val), None))
+            return ("ObjectPat", props)
+        raise RuntimeError(f"cannot destructure onto {kind}")
+
+    def assign_pattern(self, target, value, env: Env):
+        """bind_pattern, but assigning to EXISTING bindings (no declare)."""
+        kind = target[0]
+        if kind in ("Name", "Member"):
+            self.assign_to(target, value, env)
+        elif kind == "Default":
+            if value is UNDEF:
+                value = self.eval(target[2], env)
+            self.assign_pattern(target[1], value, env)
+        elif kind == "ArrayPat":
+            seq = list(self.js_iter(value)) if value not in (None, UNDEF) else []
+            i = 0
+            for elt in target[1]:
+                if elt is None:
+                    i += 1
+                    continue
+                if elt[0] == "Rest":
+                    self.assign_pattern(elt[1], JSArray(seq[i:]), env)
+                    break
+                self.assign_pattern(
+                    elt, seq[i] if i < len(seq) else UNDEF, env)
+                i += 1
+        elif kind == "ObjectPat":
+            consumed = []
+            for key, local, default in target[1]:
+                if key == "...":
+                    rest = JSObject(
+                        {k: v for k, v in value.items() if k not in consumed}
+                        if isinstance(value, dict) else {}
+                    )
+                    self.assign_to(("Name", local), rest, env)
+                    continue
+                consumed.append(key)
+                v = js_get(value, key)
+                if v is UNDEF and default is not None:
+                    v = self.eval(default, env)
+                if isinstance(local, tuple):
+                    self.assign_pattern(local, v, env)
+                else:
+                    self.assign_to(("Name", local), v, env)
+        else:
+            raise RuntimeError(f"unhandled assign pattern {kind}")
 
     def eval_binary(self, node, env):
         _, op, le, re_ = node
@@ -1706,6 +1871,14 @@ class Interpreter:
         if op in ("<", ">", "<=", ">="):
             return js_compare(op, a, b)
         if op == "instanceof":
+            err_name = getattr(b, "_error_name", None)
+            if err_name is not None:
+                # Error-shaped objects: every concrete error is an
+                # `instanceof Error`; subclasses match by name.
+                if not (isinstance(a, JSObject) and "name" in a
+                        and "message" in a):
+                    return False
+                return err_name == "Error" or a.get("name") == err_name
             if isinstance(b, type):
                 return isinstance(a, b)
             if isinstance(b, JSFunction):

@@ -1,0 +1,33 @@
+// Defaults apply ONLY for undefined, never for null/0/"".
+const { a = 1, b = 2, c = 3 } = { a: 0, b: undefined };
+print(a, b, c);
+const [x = 5, y = 6, z = 7] = [undefined, null];
+print(x, y, z);
+const { p: { q = 9 } = {} } = {};
+print(q);
+const { m: renamed = "dflt" } = { m: "val" };
+print(renamed);
+const [first, ...rest] = [1, 2, 3, 4];
+print(first, rest.length, rest[0]);
+const { u, ...others } = { u: 1, v: 2, w: 3 };
+print(u, Object.keys(others).join("|"));
+let s1 = "a", s2 = "b";
+[s1, s2] = [s2, s1];
+print(s1, s2);
+function f({ k = "kd" } = {}) { return k; }
+print(f(), f({}), f({ k: "x" }), f({ k: undefined }));
+const [, second] = ["skip", "take"];
+print(second);
+// Assignment (non-declaration) forms: member targets, object rest,
+// shorthand defaults.
+const obj = {};
+[obj.a, obj.b] = [1, 2];
+print(obj.a, obj.b);
+let r1, r2;
+({ r1, ...r2 } = { r1: "x", k1: 1, k2: 2 });
+print(r1, Object.keys(r2).join("|"));
+let d1 = null;
+({ d1 = "dflt" } = {});
+print(d1);
+({ d1 = "dflt" } = { d1: "set" });
+print(d1);

@@ -1,0 +1,44 @@
+// try/catch/finally ordering and typed errors.
+const log = [];
+function t1() {
+  try {
+    log.push("try");
+    throw new Error("boom");
+  } catch (e) {
+    log.push("catch:" + e.message);
+    return "from-catch";
+  } finally {
+    log.push("finally");
+  }
+}
+print(t1(), log.join(","));
+try {
+  null.foo;
+} catch (e) {
+  print(e instanceof TypeError, typeof e.message === "string");
+}
+try {
+  undefinedFunction();
+} catch (e) {
+  print(e instanceof Error);
+}
+function t2() {
+  try {
+    return "a";
+  } finally {
+    log.push("fin2");
+  }
+}
+print(t2(), log.includes("fin2"));
+let caught = "";
+try {
+  try {
+    throw new TypeError("inner");
+  } finally {
+    caught += "F";
+  }
+} catch (e) {
+  caught += "C:" + e.name;
+}
+print(caught);
+try { JSON.parse("{bad"); } catch (e) { print("parse-error", e instanceof Error); }

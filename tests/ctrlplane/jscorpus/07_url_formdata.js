@@ -1,0 +1,27 @@
+// URL / URLSearchParams / FormData / encodeURIComponent semantics.
+const u = new URL("http://host:8080/path/to?a=1&b=two#frag");
+print(u.origin);
+print(u.pathname);
+print(u.search);
+print(u.searchParams.get("a"), u.searchParams.get("b"), u.searchParams.get("zz"));
+const rel = new URL("/other?x=9", "http://base.example");
+print(rel.href);
+const sp = new URLSearchParams("a=1&b=2");
+sp.set("a", "10");
+sp.append("c", "three");
+print(sp.toString());
+print(sp.get("a"), sp.has("b"), sp.has("z"));
+const sp2 = new URLSearchParams();
+sp2.set("q", "hello world");
+sp2.set("amp", "a&b");
+print(sp2.toString());
+print(encodeURIComponent("a b&c=d"));
+print(encodeURIComponent("safe-._~"));
+const url2 = new URL("http://h/p");
+url2.searchParams.set("ns", "user1");
+print(url2.searchParams.get("ns"));
+// WHATWG origin normalization: lowercase host, default port elided.
+print(new URL("http://Host.Example:80/p").origin);
+print(new URL("https://h.example:443/p").origin);
+print(new URL("https://h.example:8443/p").origin);
+print(new URL("http://h.example/p#x").hash);
