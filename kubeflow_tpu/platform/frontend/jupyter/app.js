@@ -403,7 +403,12 @@ function ensureNbTable() {
   return nbTable;
 }
 
+let refreshSeq = 0;
+
 async function refreshTable() {
+  // Stale-response guard: a poll refresh can overlap a user-triggered one
+  // and arrive LAST with OLDER data; only the newest request may render.
+  const seq = ++refreshSeq;
   let notebooks = [];
   try {
     notebooks = (await api(`/api/namespaces/${ns}/notebooks`)).notebooks;
@@ -411,6 +416,7 @@ async function refreshTable() {
     toast(e.message, true);
     return;
   }
+  if (seq !== refreshSeq) return;
   document.getElementById("nb-empty").hidden = notebooks.length > 0;
   ensureNbTable().setRows(notebooks);
 }

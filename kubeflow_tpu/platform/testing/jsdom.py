@@ -45,13 +45,16 @@ from kubeflow_tpu.platform.testing.jsengine import (
 )
 
 def _json_sanitize(v):
-    """JSON.stringify semantics for non-finite numbers: null."""
+    """JSON.stringify semantics: non-finite numbers -> null; functions and
+    undefined are OMITTED from objects and null'd in arrays."""
     if isinstance(v, float) and not math.isfinite(v):
         return None
     if isinstance(v, list):
-        return [_json_sanitize(x) for x in v]
+        return [None if callable(x) or x is UNDEF else _json_sanitize(x)
+                for x in v]
     if isinstance(v, dict):
-        return {k: _json_sanitize(x) for k, x in v.items()}
+        return {k: _json_sanitize(x) for k, x in v.items()
+                if not callable(x) and x is not UNDEF}
     return v
 
 
@@ -993,6 +996,8 @@ class BrowserHarness:
         self.console: List[str] = []
         self.requests: List[dict] = []
         self.timers = Timers()
+        self.deferred = None  # DeferredRuntime when async-ordering is on
+        self.pending_fetches: List[dict] = []
 
         with open(os.path.join(frontend_dir, index)) as f:
             self.document = parse_html(f.read())
@@ -1042,10 +1047,86 @@ class BrowserHarness:
         resp = client.open(path, method=method, data=data, headers=headers)
         for cookie in resp.headers.getlist("Set-Cookie"):
             self.document.cookie = cookie
-        return JSPromise.resolve(Response(
+        response = Response(
             resp.status_code, resp.get_data(as_text=True),
             resp.status.split(" ", 1)[-1] if " " in resp.status else resp.status,
-        ))
+        )
+        if self.deferred is not None:
+            # Async-ordering mode: the request EXECUTED eagerly (the
+            # response above is the state snapshot at send time, like a
+            # network capture), but delivery waits for resolve_fetch() —
+            # so tests can deliver responses out of order.
+            promise = JSPromise("pending", UNDEF)
+            self.pending_fetches.append(
+                {"method": method, "path": path, "promise": promise,
+                 "response": response}
+            )
+            return promise
+        return JSPromise.resolve(response)
+
+    # -- async-ordering mode (VERDICT r2 item 4) -----------------------------
+
+    def enable_deferred(self):
+        """Switch fetch to deferred delivery and awaits to true suspension.
+        Pair with disable_deferred() (or use `with h.deferred_mode():`)."""
+        from kubeflow_tpu.platform.testing.jsengine import (
+            DeferredRuntime,
+            set_deferred_runtime,
+        )
+
+        self.deferred = DeferredRuntime()
+        set_deferred_runtime(self.deferred)
+        return self.deferred
+
+    def disable_deferred(self):
+        from kubeflow_tpu.platform.testing.jsengine import (
+            make_error,
+            set_deferred_runtime,
+        )
+
+        rt = self.deferred
+        if rt is not None and self.pending_fetches:
+            # Fail abandoned fetches fast so suspended async threads unwind
+            # NOW instead of timing out 30s later in a daemon thread.
+            abandoned, self.pending_fetches = self.pending_fetches, []
+            rt.enter()
+            try:
+                for entry in abandoned:
+                    entry["promise"]._settle("rejected", make_error(
+                        f"fetch abandoned (deferred mode disabled): "
+                        f"{entry['method']} {entry['path']}"
+                    ))
+            finally:
+                rt.leave()
+            rt.drain()
+        set_deferred_runtime(None)
+        self.deferred = None
+
+    def deferred_mode(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            self.enable_deferred()
+            try:
+                yield self
+            finally:
+                self.disable_deferred()
+
+        return cm()
+
+    def resolve_fetch(self, index: int = 0):
+        """Deliver pending fetch #index (any order), run every continuation
+        it unblocks, and return once the JS world is idle again."""
+        entry = self.pending_fetches.pop(index)
+        rt = self.deferred
+        rt.enter()
+        try:
+            entry["promise"]._settle("fulfilled", entry["response"])
+        finally:
+            rt.leave()
+        rt.drain()
+        return entry["response"]
 
     # -- globals -------------------------------------------------------------
 
@@ -1263,8 +1344,35 @@ def _promise_from_executor(executor):
 
 
 def _promise_all(arr):
+    items = list(arr)
+    if any(isinstance(p, JSPromise) and p.state == "pending" for p in items):
+        result = JSPromise("pending", UNDEF)
+        remaining = {"n": 0}
+        out = [UNDEF] * len(items)
+
+        def settle_slot(i, v):
+            out[i] = v
+            remaining["n"] -= 1
+            if remaining["n"] == 0:
+                result._settle("fulfilled", JSArray(out))
+
+        for i, p in enumerate(items):
+            if isinstance(p, JSPromise) and p.state == "pending":
+                remaining["n"] += 1
+                p._callbacks.append((
+                    (lambda i: lambda v: settle_slot(i, v))(i),
+                    lambda e: result._settle("rejected", e),
+                    JSPromise("pending", UNDEF),
+                ))
+            elif isinstance(p, JSPromise):
+                if p.state == "rejected":
+                    return p
+                out[i] = p.value
+            else:
+                out[i] = p
+        return result
     out = JSArray()
-    for p in list(arr):
+    for p in items:
         if isinstance(p, JSPromise):
             if p.state == "rejected":
                 return p

@@ -79,12 +79,100 @@ def throw(message: str, name: str = "Error"):
     raise JSException(make_error(message, name))
 
 
+class DeferredRuntime:
+    """Opt-in async-ordering mode (VERDICT r2 item 4).
+
+    The default execution model is deliberately synchronous (fetch settles
+    eagerly, ``await`` forces).  When a harness enables this runtime, each
+    async-function call runs on its own Python thread, serialized by one
+    JS lock (so JS stays single-threaded), and ``await`` on a PENDING
+    promise truly suspends: it releases the lock and blocks until the
+    promise settles — letting tests interleave two in-flight flows (a slow
+    fetch racing a second click, a poll overlapping a submit) in any order
+    by choosing when each pending fetch resolves.
+    """
+
+    def __init__(self):
+        import threading
+
+        self.threading = threading
+        self.lock = threading.Lock()
+        self.tls = threading.local()
+        self._runnable = 0
+        self._idle = threading.Condition()
+
+    # -- accounting: drain() returns when no JS thread is runnable ----------
+
+    def _mark_runnable(self, delta: int):
+        with self._idle:
+            self._runnable += delta
+            if self._runnable == 0:
+                self._idle.notify_all()
+
+    def drain(self, timeout: float = 10.0):
+        """Block until every JS thread has completed or suspended."""
+        deadline = __import__("time").monotonic() + timeout
+        with self._idle:
+            while self._runnable > 0:
+                remaining = deadline - __import__("time").monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"deferred runtime: {self._runnable} JS thread(s) "
+                        "still runnable"
+                    )
+                self._idle.wait(remaining)
+
+    # -- entry/suspend protocol ----------------------------------------------
+
+    def enter(self):
+        self._mark_runnable(1)
+        self.lock.acquire()
+        self.tls.inside = True
+
+    def leave(self):
+        self.tls.inside = False
+        self._mark_runnable(-1)
+        self.lock.release()
+
+    def inside(self) -> bool:
+        return getattr(self.tls, "inside", False)
+
+    def suspend_until(self, event):
+        """Release the JS lock until ``event`` is set (promise settled)."""
+        sig = getattr(self.tls, "first_suspend", None)
+        if sig is not None:
+            self.tls.first_suspend = None
+            sig.set()
+        self._mark_runnable(-1)
+        self.lock.release()
+        if not event.wait(timeout=30):
+            # Keep accounting balanced: the thread becomes runnable again
+            # to unwind (run()'s finally will decrement once more).
+            self._mark_runnable(1)
+            self.lock.acquire()
+            raise TimeoutError("await on a promise that never settled")
+        # The settler marked us runnable before setting the event.
+        self.lock.acquire()
+
+
+DEFERRED: Optional[DeferredRuntime] = None
+
+
+def set_deferred_runtime(rt: Optional[DeferredRuntime]):
+    global DEFERRED
+    DEFERRED = rt
+
+
 class JSPromise:
-    """Settled-only promise: the harness's fetch resolves synchronously."""
+    """Promise.  Default model: settled at construction (the harness's
+    fetch resolves synchronously).  Under the DeferredRuntime a promise may
+    be 'pending'; ``_settle`` wakes awaiters and runs queued callbacks."""
 
     def __init__(self, state: str, value):
-        self.state = state  # "fulfilled" | "rejected"
+        self.state = state  # "pending" | "fulfilled" | "rejected"
         self.value = value
+        self._callbacks: list = []  # (on_ok, on_err, chained)
+        self._waiters: list = []  # threading.Events of suspended awaits
 
     @staticmethod
     def resolve(value):
@@ -96,7 +184,54 @@ class JSPromise:
     def reject(value):
         return JSPromise("rejected", value)
 
+    def _settle(self, state: str, value):
+        """Settle a pending promise; caller must be inside the JS lock when
+        a DeferredRuntime is active."""
+        if self.state != "pending":
+            return
+        if state == "fulfilled" and isinstance(value, JSPromise):
+            # Adopt the inner promise (A+ flattening): an async body that
+            # returns a promise settles its result with THAT outcome.
+            if value.state == "pending":
+                value._callbacks.append((
+                    lambda v: self._settle("fulfilled", v),
+                    lambda e: self._settle("rejected", e),
+                    JSPromise("pending", UNDEF),
+                ))
+                return
+            state, value = value.state, value.value
+        self.state = state
+        self.value = value
+        rt = DEFERRED
+        for ev in self._waiters:
+            if rt is not None:
+                rt._mark_runnable(1)  # the woken thread becomes runnable
+            ev.set()
+        self._waiters.clear()
+        callbacks, self._callbacks = self._callbacks, []
+        for on_ok, on_err, chained in callbacks:
+            self._run_callback(on_ok, on_err, chained)
+
+    def _run_callback(self, on_ok, on_err, chained):
+        try:
+            if self.state == "fulfilled":
+                out = (call_function(on_ok, [self.value])
+                       if callable(on_ok) else self.value)
+                _chain_result(chained, "fulfilled", out)
+            else:
+                if callable(on_err):
+                    out = call_function(on_err, [self.value])
+                    _chain_result(chained, "fulfilled", out)
+                else:
+                    _chain_result(chained, "rejected", self.value)
+        except JSException as e:
+            _chain_result(chained, "rejected", e.value)
+
     def then(self, on_ok=UNDEF, on_err=UNDEF):
+        if self.state == "pending":
+            chained = JSPromise("pending", UNDEF)
+            self._callbacks.append((on_ok, on_err, chained))
+            return chained
         try:
             if self.state == "fulfilled":
                 if callable(on_ok):
@@ -112,12 +247,47 @@ class JSPromise:
         return self.then(UNDEF, on_err)
 
     def finally_(self, cb=UNDEF):
+        if self.state == "pending":
+            def on_ok(v):
+                if callable(cb):
+                    call_function(cb, [])
+                return v
+
+            def on_err(e):
+                if callable(cb):
+                    call_function(cb, [])
+                raise JSException(e)
+
+            chained = JSPromise("pending", UNDEF)
+            self._callbacks.append((on_ok, on_err, chained))
+            return chained
         if callable(cb):
             call_function(cb, [])
         return self
 
 
+def _chain_result(chained: "JSPromise", state: str, value):
+    """Settle a .then() result promise; _settle owns the A+ flattening."""
+    chained._settle(state, value)
+
+
 def call_function(fn, args: list, this=UNDEF):
+    rt = DEFERRED
+    if rt is not None and not rt.inside():
+        # Python-side entry (event dispatch, timers, harness): take the JS
+        # lock for the duration so worker threads stay serialized with us,
+        # then drain so every woken continuation finishes before the test
+        # regains control — deterministic interleaving.
+        rt.enter()
+        try:
+            return _call_function_locked(fn, args, this)
+        finally:
+            rt.leave()
+            rt.drain()
+    return _call_function_locked(fn, args, this)
+
+
+def _call_function_locked(fn, args: list, this=UNDEF):
     if isinstance(fn, JSFunction):
         return fn.invoke(this, args)
     if callable(fn):
@@ -823,11 +993,12 @@ class Parser:
                         ))
                     elif self.at("punct", "="):
                         # CoverInitializedName: `({a = 1} = obj)` shorthand
-                        # default — only meaningful in destructuring, where
-                        # _expr_to_pattern consumes the Assign node.
+                        # default — only legal in destructuring.  A distinct
+                        # node kind so plain evaluation can reject it like a
+                        # real parser would.
                         self.next()
                         props.append(("kv", ("Const", kt.value),
-                                      ("Assign", "=", ("Name", kt.value),
+                                      ("CoverInit", kt.value,
                                        self.parse_assignment())))
                     else:
                         props.append(("kv", ("Const", kt.value),
@@ -915,6 +1086,19 @@ class JSFunction:
         self.lexical_this = this
 
     def invoke(self, this, args: list):
+        if self.is_async and DEFERRED is not None:
+            return self._invoke_async_deferred(this, args)
+        try:
+            result = self._invoke_body(this, args)
+        except JSException as e:
+            if self.is_async:
+                return JSPromise.reject(e.value)
+            raise
+        if self.is_async:
+            return JSPromise.resolve(result)
+        return result
+
+    def _invoke_body(self, this, args: list):
         env = Env(self.env)
         env.declare("this", self.lexical_this if self.capture_this else this)
         i = 0
@@ -931,26 +1115,63 @@ class JSFunction:
             i += 1
         try:
             if self.body[0] == "Return":  # expression-bodied arrow
-                result = (
+                return (
                     self.interp.eval(self.body[1], env)
                     if self.body[1] is not None else UNDEF
                 )
-            else:
-                self.interp.exec_block(self.body[1], Env(env))
-                result = UNDEF
+            self.interp.exec_block(self.body[1], Env(env))
+            return UNDEF
         except ReturnSignal as r:
-            result = r.value
-        except JSException as e:
-            if self.is_async:
-                return JSPromise.reject(e.value)
-            raise
-        if self.is_async:
-            return JSPromise.resolve(result)
+            return r.value
+
+    def _invoke_async_deferred(self, this, args: list):
+        """Run the async body on its own thread (deferred mode): the caller
+        resumes as soon as the body completes OR first suspends, receiving
+        a promise that settles when the body finishes."""
+        rt = DEFERRED
+        result = JSPromise("pending", UNDEF)
+        first = rt.threading.Event()
+
+        def run():
+            rt.lock.acquire()
+            rt.tls.inside = True
+            rt.tls.first_suspend = first
+            try:
+                out = self._invoke_body(this, args)
+                result._settle("fulfilled", out)
+            except JSException as e:
+                result._settle("rejected", e.value)
+            finally:
+                if rt.tls.first_suspend is not None:
+                    rt.tls.first_suspend = None
+                    first.set()
+                rt.tls.inside = False
+                rt._mark_runnable(-1)
+                rt.lock.release()
+
+        rt._mark_runnable(1)
+        thread = rt.threading.Thread(
+            target=run, name=f"js-async-{self.name}", daemon=True
+        )
+        # The caller holds the JS lock; hand it over until the body's first
+        # suspension (or completion), then take it back.
+        caller_inside = rt.inside()
+        if caller_inside:
+            rt.tls.inside = False
+            rt.lock.release()
+        thread.start()
+        if not first.wait(timeout=30):
+            raise TimeoutError(f"async {self.name} neither finished nor "
+                               "suspended")
+        if caller_inside:
+            rt.lock.acquire()
+            rt.tls.inside = True
         return result
 
     def __call__(self, *args):
-        """Python-side calls (DOM event dispatch, shim callbacks)."""
-        return self.invoke(UNDEF, list(args))
+        """Python-side calls (DOM event dispatch, shim callbacks) — routed
+        through call_function so the deferred runtime's lock is taken."""
+        return call_function(self, list(args))
 
     def __repr__(self):
         return f"<JSFunction {self.name}>"
@@ -1621,6 +1842,13 @@ class Interpreter:
                 elif ptype == "computed":
                     obj[js_to_string(self.eval(k, env))] = self.eval(v, env)
                 else:
+                    if v[0] == "CoverInit":
+                        # `({a = 1})` outside destructuring is a parse error
+                        # in real JS; fail like the browser would.
+                        throw(
+                            "Invalid shorthand property initializer",
+                            "SyntaxError",
+                        )
                     key = k[1]
                     obj[js_to_string(key)] = self.eval(v, env)
             return obj
@@ -1773,6 +2001,8 @@ class Interpreter:
                           "SyntaxError")
                 if val[0] == "Name":
                     props.append((key[1], val[1], None))
+                elif val[0] == "CoverInit":
+                    props.append((key[1], val[1], val[2]))
                 elif val[0] == "Assign" and val[1] == "=":
                     props.append((key[1],
                                   self._expr_to_pattern(val[2])[1]
@@ -1920,6 +2150,17 @@ class Interpreter:
             return UNDEF
         if op == "await":
             if isinstance(v, JSPromise):
+                if v.state == "pending":
+                    rt = DEFERRED
+                    if rt is None:
+                        throw(
+                            "await on a pending promise requires the "
+                            "deferred runtime (harness.enable_deferred())",
+                            "TypeError",
+                        )
+                    event = rt.threading.Event()
+                    v._waiters.append(event)
+                    rt.suspend_until(event)
                 if v.state == "fulfilled":
                     return v.value
                 raise JSException(v.value)

@@ -42,3 +42,10 @@ try {
 }
 print(caught);
 try { JSON.parse("{bad"); } catch (e) { print("parse-error", e instanceof Error); }
+// CoverInitializedName outside destructuring is a SyntaxError.
+try {
+  const bad = { x = 5 };
+  print("no-error");
+} catch (e) {
+  print("cover-init", e.name);
+}

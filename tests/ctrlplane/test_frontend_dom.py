@@ -896,3 +896,65 @@ def test_volume_details_deep_link(kube):
                        url="http://spa.test/?ns=user1&pvc=linked")
     assert not h.get("view-detail").hidden
     assert h.text("#detail-title") == "linked"
+
+
+# -- async-ordering mode: races under deferred scheduling (VERDICT r2 #4) ----
+
+
+def test_deferred_out_of_order_fetch_basics(kube, jupyter):
+    """Mechanics: with deferred mode on, fetches pend; awaits suspend; the
+    test delivers responses in ANY order and continuations run then."""
+    _mk_nb(kube, "seed-nb")
+    with jupyter.deferred_mode():
+        jupyter.fire_timers()  # poll -> refreshTable suspends on its fetch
+        assert len(jupyter.pending_fetches) == 1
+        # Nothing rendered yet: the async flow is suspended mid-await.
+        assert len(jupyter.query_all("#nb-table tbody tr")) == 0
+        jupyter.resolve_fetch(0)
+        rows = jupyter.query_all("#nb-table tbody tr")
+        assert len(rows) == 1 and "seed-nb" in rows[0].textContent
+    assert jupyter.pending_fetches == []
+
+
+def test_stale_refresh_cannot_clobber_newer_data(kube, jupyter):
+    """The race the synchronous tier could never exercise: refresh A is
+    dispatched, a notebook appears, refresh B is dispatched and its
+    response arrives FIRST; stale A arrives last and must NOT overwrite
+    B's newer table (refreshSeq guard in app.js)."""
+    with jupyter.deferred_mode():
+        jupyter.fire_timers()          # refresh A: captures EMPTY list
+        _mk_nb(kube, "fresh-nb")
+        jupyter.fire_timers()          # refresh B: captures fresh-nb
+        assert len(jupyter.pending_fetches) == 2
+        jupyter.resolve_fetch(1)       # B's response lands first
+        rows = jupyter.query_all("#nb-table tbody tr")
+        assert len(rows) == 1 and "fresh-nb" in rows[0].textContent
+        jupyter.resolve_fetch(0)       # stale A lands last
+        rows = jupyter.query_all("#nb-table tbody tr")
+        assert len(rows) == 1, "stale refresh clobbered newer data"
+        assert "fresh-nb" in rows[0].textContent
+
+
+def test_submit_races_inflight_refresh(kube, jupyter):
+    """A spawn submitted while a refresh is in flight: the POST completes,
+    the old refresh's stale (pre-spawn) response cannot blank the row the
+    follow-up refresh rendered."""
+    with jupyter.deferred_mode():
+        jupyter.fire_timers()                      # refresh A (empty)
+        jupyter.click("#new-notebook")
+        jupyter.set_value("[name=name]", "race-nb", event="input")
+        jupyter.submit("#spawn-form")              # POST pends
+        # POST is pending_fetches[1]; deliver it -> spawn handler resumes
+        # and fires refresh C.
+        posts = [i for i, f in enumerate(jupyter.pending_fetches)
+                 if f["method"] == "POST"]
+        jupyter.resolve_fetch(posts[0])
+        assert kube.get(NOTEBOOK, "race-nb", "user1") is not None
+        refreshes = [i for i, f in enumerate(jupyter.pending_fetches)
+                     if f["method"] == "GET" and "notebooks" in f["path"]]
+        # Deliver the NEWEST refresh first, then the stale pre-spawn one.
+        jupyter.resolve_fetch(refreshes[-1])
+        assert len(jupyter.query_all("#nb-table tbody tr")) == 1
+        jupyter.resolve_fetch(0)                   # stale refresh A
+        rows = jupyter.query_all("#nb-table tbody tr")
+        assert len(rows) == 1 and "race-nb" in rows[0].textContent
