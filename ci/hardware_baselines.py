@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Hardware measurement lane for BASELINE.md configs 2-3 (VERDICT r2 #10).
+
+The dev/CI image ships no TensorFlow and no torch_xla (and has no network
+egress to install them), so the throughput numbers for
+
+  config 2: jupyter-tensorflow-full single-device notebook (ResNet50 CIFAR)
+  config 3: jupyter-pytorch-full -> PyTorch/XLA notebook (BERT fine-tune)
+
+have never been measured.  This script IS the measurement: run it on any
+TF- or torch-XLA-capable TPU VM (one command, emitted as the
+``hardware-baselines`` workflow by ci/workflows.py) and it
+
+  * measures whichever runtimes are importable at the scales the example
+    notebooks (examples/08, examples/03) define,
+  * prints one JSON line per config (measured or skipped+reason), and
+  * appends measured numbers to BASELINE.md with the date, closing the
+    standing gap the moment such an environment exists.
+
+Exit codes: 0 = every config measured; 3 = at least one config skipped
+because its runtime is absent (the expected result on the dev image —
+loud, not silent).
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The example notebooks' training scales (examples/08_resnet_cifar_tensorflow
+# and examples/03_bert_finetune_pytorch_xla "real" branches).
+TF_BATCH = 256
+TF_STEPS = 50
+TF_WARMUP = 5
+BERT_BATCH = 32
+BERT_SEQ = 128
+BERT_STEPS = 30
+BERT_WARMUP = 3
+
+
+def measure_tf_resnet() -> dict:
+    """Config 2: ResNet50 on CIFAR-shaped synthetic data under TPUStrategy
+    when a TPU is attached, mirroring examples/08."""
+    try:
+        import tensorflow as tf
+    except ImportError:
+        return {"config": 2, "metric": "tf_resnet50_cifar_images_per_sec",
+                "skipped": "tensorflow not installed"}
+
+    try:
+        resolver = tf.distribute.cluster_resolver.TPUClusterResolver(tpu="")
+        tf.config.experimental_connect_to_cluster(resolver)
+        tf.tpu.experimental.initialize_tpu_system(resolver)
+        strategy = tf.distribute.TPUStrategy(resolver)
+        device = "tpu"
+    except Exception:
+        strategy = tf.distribute.get_strategy()
+        device = "cpu/gpu"
+
+    with strategy.scope():
+        model = tf.keras.applications.ResNet50(
+            weights=None, input_shape=(32, 32, 3), classes=10
+        )
+        model.compile(
+            optimizer=tf.keras.optimizers.SGD(0.1, momentum=0.9),
+            loss=tf.keras.losses.SparseCategoricalCrossentropy(
+                from_logits=False
+            ),
+        )
+    images = tf.random.uniform((TF_BATCH, 32, 32, 3))
+    labels = tf.random.uniform((TF_BATCH,), maxval=10, dtype=tf.int32)
+    ds = tf.data.Dataset.from_tensors((images, labels)).repeat()
+    it = iter(strategy.experimental_distribute_dataset(ds))
+
+    @tf.function
+    def step(batch):
+        # Keras train_step(data) under strategy.run: the canonical custom
+        # TPUStrategy loop (same shape as examples/08).
+        return strategy.run(model.train_step, args=(batch,))
+
+    def force(out):
+        # Under TPUStrategy the per-replica values need a cross-replica
+        # reduce before they are host-readable tensors.
+        loss = out["loss"] if isinstance(out, dict) else out
+        loss = strategy.reduce(tf.distribute.ReduceOp.MEAN, loss, axis=None)
+        return float(loss)
+
+    for _ in range(TF_WARMUP):
+        out = step(next(it))
+    force(out)
+    t0 = time.perf_counter()
+    for _ in range(TF_STEPS):
+        out = step(next(it))
+    force(out)  # device sync closes the timed window
+    dt = time.perf_counter() - t0
+    return {"config": 2, "metric": "tf_resnet50_cifar_images_per_sec",
+            "value": round(TF_BATCH * TF_STEPS / dt, 1), "device": device,
+            "batch": TF_BATCH, "steps": TF_STEPS}
+
+
+def measure_torch_xla_bert() -> dict:
+    """Config 3: tiny-BERT-config fine-tune step loop on the XLA device,
+    mirroring examples/03's real branch (transformers BERT-base when the
+    weights are reachable, random-init config otherwise)."""
+    try:
+        import torch
+        import torch_xla.core.xla_model as xm
+        from transformers import BertConfig, BertForSequenceClassification
+    except ImportError as e:
+        return {"config": 3, "metric": "torch_xla_bert_examples_per_sec",
+                "skipped": f"runtime not installed ({e})"}
+
+    device = xm.xla_device()
+    cfg = BertConfig(num_labels=2)
+    model = BertForSequenceClassification(cfg).to(device).train()
+    optim = torch.optim.AdamW(model.parameters(), lr=2e-5)
+    ids = torch.randint(0, cfg.vocab_size, (BERT_BATCH, BERT_SEQ),
+                        device=device)
+    labels = torch.randint(0, 2, (BERT_BATCH,), device=device)
+
+    def step():
+        optim.zero_grad()
+        out = model(input_ids=ids, labels=labels)
+        out.loss.backward()
+        xm.optimizer_step(optim)
+        return out.loss
+
+    for _ in range(BERT_WARMUP):
+        step()
+    xm.mark_step()
+    t0 = time.perf_counter()
+    for _ in range(BERT_STEPS):
+        loss = step()
+    xm.mark_step()
+    loss.item()  # device sync
+    dt = time.perf_counter() - t0
+    return {"config": 3, "metric": "torch_xla_bert_examples_per_sec",
+            "value": round(BERT_BATCH * BERT_STEPS / dt, 1),
+            "batch": BERT_BATCH, "seq": BERT_SEQ, "steps": BERT_STEPS}
+
+
+def append_to_baseline(results) -> None:
+    measured = [r for r in results if "value" in r]
+    if not measured:
+        return
+    stamp = datetime.date.today().isoformat()
+    lines = ["", f"Hardware lane measurements ({stamp}, "
+                 "ci/hardware_baselines.py):", ""]
+    for r in measured:
+        lines.append(f"- config {r['config']}: {r['metric']} = "
+                     f"{r['value']} ({json.dumps({k: v for k, v in r.items() if k not in ('config', 'metric', 'value')})})")
+    with open(os.path.join(REPO, "BASELINE.md"), "a") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def main() -> int:
+    results = [measure_tf_resnet(), measure_torch_xla_bert()]
+    for r in results:
+        print(json.dumps(r), flush=True)
+    append_to_baseline(results)
+    return 3 if any("skipped" in r for r in results) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

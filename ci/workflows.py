@@ -206,6 +206,20 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # The standing BASELINE.md gap for configs 2-3 (TF / torch-XLA
+    # throughput): this lane runs on a TF- or torch-XLA-capable TPU VM and
+    # appends the measured numbers to BASELINE.md in one command:
+    #   python ci/workflows.py run hardware-baselines
+    # On the dev image (no TF, no torch_xla, no egress) it exits 3 with a
+    # loud per-config skip report instead of pretending to measure.
+    name="hardware-baselines",
+    include_dirs=["images/*", "examples/*", "ci/hardware_baselines.py",
+                  "releasing/*"],
+    job_types=["hardware"],
+    steps=[Step("measure", [sys.executable, "ci/hardware_baselines.py"])],
+))
+
+_register(ComponentWorkflow(
     name="conformance",
     include_dirs=["kubeflow_tpu/*", "conformance/*", "releasing/*"],
     job_types=["postsubmit"],

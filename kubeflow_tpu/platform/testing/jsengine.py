@@ -1160,12 +1160,15 @@ class JSFunction:
             rt.tls.inside = False
             rt.lock.release()
         thread.start()
-        if not first.wait(timeout=30):
-            raise TimeoutError(f"async {self.name} neither finished nor "
-                               "suspended")
+        timed_out = not first.wait(timeout=30)
         if caller_inside:
+            # Reacquire BEFORE raising so the enclosing call_function's
+            # rt.leave() releases a lock this thread actually holds.
             rt.lock.acquire()
             rt.tls.inside = True
+        if timed_out:
+            raise TimeoutError(f"async {self.name} neither finished nor "
+                               "suspended")
         return result
 
     def __call__(self, *args):

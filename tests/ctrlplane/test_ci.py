@@ -97,3 +97,39 @@ def test_image_chain_consistency():
                 parent == "base", f"images/{d} builds FROM unbuilt {parent}"
     # The TF chain exists as BASELINE config 2 names it.
     assert "jupyter-tensorflow-tpu-full" in dirs
+
+
+def test_hardware_baselines_lane_emits_and_reports(tmp_path):
+    """The hardware lane (BASELINE configs 2-3): workflow emits an Argo
+    manifest, is hardware-job-typed (never presubmit), and the script's
+    skip path is loud — per-config JSON with a reason, exit 3."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    from ci.workflows import WORKFLOWS
+
+    wf = WORKFLOWS["hardware-baselines"]
+    assert wf.job_types == ["hardware"]
+    manifest = wf.to_argo()
+    assert manifest["kind"] == "Workflow"
+
+    # Force both runtimes absent so the test is hermetic and fast even on
+    # images that DO ship tensorflow.
+    repo = os.path.join(os.path.dirname(__file__), "..", "..")
+    shim = tmp_path / "shim"
+    shim.mkdir()
+    for mod in ("tensorflow", "torch_xla"):
+        (shim / mod).mkdir()
+        (shim / mod / "__init__.py").write_text(
+            "raise ImportError('hermetically absent')\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{shim}:{repo}"
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "ci", "hardware_baselines.py")],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 3, proc.stderr
+    lines = [_json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    assert {r["config"] for r in lines} == {2, 3}
+    assert all("skipped" in r for r in lines)
