@@ -327,8 +327,14 @@ function wireSpawner() {
   document.getElementById("spawn-cancel").addEventListener("click", () => dialog.close());
   document.getElementById("spawn-form").addEventListener("submit", async (ev) => {
     ev.preventDefault();
-    const body = spawnBody(ev.target);
+    // Double-submit guard: a second Launch click while the POST is in
+    // flight would create a duplicate-name conflict (reference disables
+    // the submit button the same way).
+    const launch = document.getElementById("spawn-submit");
+    if (launch.disabled) return;
+    launch.disabled = true;
     try {
+      const body = spawnBody(ev.target);
       await api(`/api/namespaces/${ns}/notebooks`, {
         method: "POST",
         body: JSON.stringify(body),
@@ -340,6 +346,8 @@ function wireSpawner() {
       refreshTable();
     } catch (e) {
       toast(e.message, true);
+    } finally {
+      launch.disabled = false;
     }
   });
 }

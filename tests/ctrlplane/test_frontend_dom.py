@@ -958,3 +958,20 @@ def test_submit_races_inflight_refresh(kube, jupyter):
         jupyter.resolve_fetch(0)                   # stale refresh A
         rows = jupyter.query_all("#nb-table tbody tr")
         assert len(rows) == 1 and "race-nb" in rows[0].textContent
+
+
+def test_double_submit_guard_under_deferred_fetch(kube, jupyter):
+    """Two rapid Launch clicks while the first POST is still in flight must
+    produce exactly ONE notebook (submit button disables for the duration)."""
+    with jupyter.deferred_mode():
+        jupyter.click("#new-notebook")
+        jupyter.set_value("[name=name]", "once-nb", event="input")
+        jupyter.submit("#spawn-form")          # POST #1 pends
+        assert jupyter.query("#spawn-submit").disabled
+        jupyter.submit("#spawn-form")          # rapid second click: guarded
+        posts = [f for f in jupyter.pending_fetches if f["method"] == "POST"]
+        assert len(posts) == 1, "second submit fired a duplicate POST"
+        idx = jupyter.pending_fetches.index(posts[0])
+        jupyter.resolve_fetch(idx)
+        assert not jupyter.query("#spawn-submit").disabled
+    assert len(kube.list(NOTEBOOK, "user1")) == 1
