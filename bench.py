@@ -8,8 +8,10 @@
 2. llama8k_train_tokens_per_sec (PRIMARY since round 3) — long-context
    Llama train step (seq 8192, bf16, remat) with the Pallas flash-attention
    kernel, measured end-to-end against the identical model with XLA
-   attention.  ``vs_baseline`` IS the flash/XLA ratio: ~21.6x mean-window
-   on v5e-1.
+   attention.  ``vs_baseline`` = flash best / XLA best; ``vs_baseline_mean``
+   = flash mean / XLA best (the denominator always uses the XLA arm's
+   stable estimator — see the in-function comment).  ~31x on v5e-1 with
+   the round-3 fused cross-entropy.
 
 ``--profile`` instead captures a per-op device trace of the ResNet step
 and prints the per-category roofline breakdown.
@@ -121,10 +123,15 @@ def llama_8k_bench() -> None:
                 "value": round(flash_tps, 1),
                 "unit": "tokens/sec",
                 # The baseline for the flash arm is the XLA arm, same
-                # protocol, same process: >= 1.5 is the VERDICT bar.
+                # protocol, same process.  BOTH ratios divide by the XLA
+                # arm's BEST window: the denominator must use its stable
+                # estimator, or one tunnel-interference spike in an XLA
+                # window inflates the mean ratio (observed: a single slow
+                # window turned 31x into a bogus 67x).  flash mean over
+                # XLA best is the conservative pairing.
                 "vs_baseline": round(flash_tps / xla_tps, 4),
                 "value_mean_window": round(flash_mean, 1),
-                "vs_baseline_mean": round(flash_mean / xla_mean, 4),
+                "vs_baseline_mean": round(flash_mean / xla_tps, 4),
                 "xla_tokens_per_sec": round(xla_tps, 1),
                 "xla_tokens_per_sec_mean": round(xla_mean, 1),
                 "seq_len": seq,

@@ -54,6 +54,43 @@ def create_train_state(
     )
 
 
+@jax.custom_vjp
+def _token_nll(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-token negative log-likelihood [..., ] from f32 logits [..., V].
+
+    Custom VJP so the forward never materializes log_softmax over the
+    vocabulary: standard AD saves the full [batch, seq, vocab] f32
+    log-probs as a residual — at seq 8192 / vocab 8192 that is a 536 MB
+    tensor whose transposed-layout write alone took 54.5 ms/step, 32% of
+    the llama-8k flash train step (round-3 profile, BASELINE.md).  Here
+    the forward reduces on the fly (max + logsumexp, [batch, seq]
+    residuals only) and the backward recomputes softmax fused directly
+    into d_logits = (probs - onehot) * g — one vocab-sized write, which
+    the lm_head gradient matmul needs anyway.
+    """
+    return _token_nll_fwd(logits, labels)[0]
+
+
+def _token_nll_fwd(logits, labels):
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(
+        jnp.sum(jnp.exp(logits - m[..., None]), axis=-1)
+    )
+    ll = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    # logits are the live lm_head output — saving them adds no copy.
+    return lse - ll, (logits, labels, lse)
+
+
+def _token_nll_bwd(res, g):
+    logits, labels, lse = res
+    probs = jnp.exp(logits - lse[..., None])
+    d = probs - jax.nn.one_hot(labels, logits.shape[-1], dtype=logits.dtype)
+    return d * g[..., None], None
+
+
+_token_nll.defvjp(_token_nll_fwd, _token_nll_bwd)
+
+
 def cross_entropy(
     logits: jax.Array, labels: jax.Array,
     weights: Optional[jax.Array] = None,
@@ -61,12 +98,11 @@ def cross_entropy(
     """Mean softmax cross-entropy over integer labels, f32.  ``weights``
     (same shape as labels) turns it into a weighted mean — the packed-
     sequence path zeroes pad and cross-document targets."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    nll = _token_nll(logits.astype(jnp.float32), labels)
     if weights is None:
-        return -jnp.mean(ll)
+        return jnp.mean(nll)
     w = weights.astype(jnp.float32)
-    return -jnp.sum(ll * w) / jnp.maximum(jnp.sum(w), 1.0)
+    return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def make_classification_grad_fn(*, has_batch_stats: bool, has_dropout: bool = False):
