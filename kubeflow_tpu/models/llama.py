@@ -39,6 +39,14 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # With remat=True: "block" recomputes the whole layer in backward (the
+    # classic memory-min setting); "mlp" recomputes only the FFN — the
+    # hidden [b, s, ffn_dim] pair is the dominant activation — while the
+    # attention residuals stay saved, so the flash kernel's forward never
+    # re-runs in backward.  Measured on the llama-8k bench config: "mlp"
+    # recovers most of the no-remat throughput at a fraction of its
+    # memory (BASELINE.md round 3).
+    remat_mode: str = "block"  # "block" | "mlp"
     attn_impl: str = "auto"
     # Stack the identical blocks into one lax.scan (nn.scan): one compiled
     # block body instead of n_layers inlined copies — compile time drops
@@ -50,6 +58,15 @@ class LlamaConfig:
     n_experts: int = 0
     top_k: int = 2
     capacity_factor: float = 1.25
+
+    def __post_init__(self):
+        if self.remat_mode not in ("block", "mlp"):
+            # All remat sites gate on exact equality; an unknown value
+            # would silently disable remat and blow the memory budget.
+            raise ValueError(
+                f"remat_mode must be 'block' or 'mlp', got "
+                f"{self.remat_mode!r}"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -113,10 +130,15 @@ class LlamaBlock(nn.Module):
           max_decode_len=cache_len or cfg.max_seq_len, mask_bias=mask_bias)
         x = x + h
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
+        # remat_mode="mlp": recompute only the FFN hiddens in backward (the
+        # wrapped class keeps the "mlp" param path, so sharding rules and
+        # checkpoints are unchanged).
+        ffn_remat = cfg.remat and cfg.remat_mode == "mlp"
         if cfg.n_experts > 0:
             from kubeflow_tpu.models.moe import MoeMlp
 
-            h = MoeMlp(
+            moe_cls = nn.remat(MoeMlp) if ffn_remat else MoeMlp
+            h = moe_cls(
                 n_experts=cfg.n_experts,
                 hidden_dim=cfg.ffn_dim,
                 top_k=cfg.top_k,
@@ -125,7 +147,9 @@ class LlamaBlock(nn.Module):
                 name="mlp",
             )(h, token_mask=token_mask)
         else:
-            h = SwiGLU(hidden_dim=cfg.ffn_dim, dtype=cfg.dtype, name="mlp")(h)
+            swiglu_cls = nn.remat(SwiGLU) if ffn_remat else SwiGLU
+            h = swiglu_cls(hidden_dim=cfg.ffn_dim, dtype=cfg.dtype,
+                           name="mlp")(h)
         return x + h
 
 
@@ -138,7 +162,7 @@ class LlamaScanBody(nn.Module):
     def __call__(self, x, positions, segment_ids, decode, mask_bias,
                  token_mask, cache_len):
         block = LlamaBlock
-        if self.cfg.remat:
+        if self.cfg.remat and self.cfg.remat_mode == "block":
             block = nn.remat(LlamaBlock, static_argnums=(4, 7))
         x = block(self.cfg, name="block")(
             x, positions, segment_ids, decode, mask_bias, token_mask,
@@ -182,7 +206,7 @@ class Llama(nn.Module):
             )
         else:
             block = LlamaBlock
-            if cfg.remat:
+            if cfg.remat and cfg.remat_mode == "block":
                 # static: decode flag (4) and cache bucket size (7).
                 block = nn.remat(LlamaBlock, static_argnums=(4, 7))
             for i in range(cfg.n_layers):

@@ -124,9 +124,10 @@ def test_pytorch_xla_notebook_one_step_cpu():
 
 
 def test_tensorflow_notebook_structure():
-    """BASELINE config 2 (jupyter-tensorflow-tpu-full): this image ships no
-    TF, so the notebook must at least carry the TPUStrategy + CPU-fallback
-    structure (the image chain exists — images/jupyter-tensorflow-tpu*)."""
+    """BASELINE config 2 (jupyter-tensorflow-tpu-full): the notebook must
+    carry the TPUStrategy + CPU-fallback structure (the image chain exists
+    — images/jupyter-tensorflow-tpu*); where TF is importable the slow
+    tier below also EXECUTES a tiny run of it."""
     src = _code("08_resnet_cifar_tensorflow.ipynb")
     for needle in ("TPUClusterResolver", "TPUStrategy",
                    "get_strategy()", "ResNet50"):

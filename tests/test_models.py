@@ -187,3 +187,36 @@ def test_llama_scan_layers_sharded_step(devices8):
     batch = jax.device_put(tokens, data_sh)
     state, metrics = step(state, batch)
     assert jnp.isfinite(metrics["loss"])
+
+
+def test_llama_remat_mlp_matches_block_mode():
+    """remat_mode='mlp' (FFN-only recompute, BASELINE.md round 3) must be a
+    pure scheduling change: same params tree (the wrapped class keeps the
+    'mlp' path), same loss, same gradients as full-block remat."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.train import create_train_state
+    from kubeflow_tpu.train.steps import make_lm_grad_fn
+
+    tokens = jax.random.randint(jax.random.key(0), (2, 32), 0, 256)
+    losses, grads = [], []
+    for mode in ("block", "mlp"):
+        cfg = dataclasses.replace(CONFIGS["llama_debug"], remat=True,
+                                  remat_mode=mode)
+        state = create_train_state(
+            jax.random.key(1), Llama(cfg), tokens, optax.sgd(1e-2)
+        )
+        g, _, m = make_lm_grad_fn()(state, tokens)
+        losses.append(float(m["loss"]))
+        grads.append(g)
+    assert abs(losses[0] - losses[1]) < 1e-5
+    flat0 = jax.tree_util.tree_leaves_with_path(grads[0])
+    flat1 = jax.tree_util.tree_leaves_with_path(grads[1])
+    assert [p for p, _ in flat0] == [p for p, _ in flat1]  # same tree/paths
+    for (_, a), (_, b) in zip(flat0, flat1):
+        assert float(jnp.max(jnp.abs(a - b))) < 1e-5
