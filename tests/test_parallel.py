@@ -319,3 +319,47 @@ def test_ring_attention_flash_rejects_unsupported(devices8):
     q = jnp.ones((2, 128, 4, 32))  # head_dim 32: below the kernel's gate
     with pytest.raises(ValueError, match="unsupported"):
         ring_attention(q, q, q, mesh=mesh, block_impl="flash")
+
+
+def test_hybrid_mesh_real_multislice_branch_keeps_ici_inside_slices():
+    """On real multislice hardware (devices carry slice_index) the hybrid
+    mesh goes through mesh_utils.create_hybrid_device_mesh; every ICI axis
+    must stay inside one slice and the DCN axis must stride across slices.
+    Exercised hermetically with mock sliced devices (the virtual-CPU path
+    can never reach this branch)."""
+    from dataclasses import dataclass
+
+    from kubeflow_tpu.parallel.mesh import MeshConfig, make_hybrid_mesh
+
+    @dataclass(frozen=True, eq=True)
+    class FakeDev:
+        id: int
+        slice_index: int
+        process_index: int = 0
+        platform: str = "tpu"
+        device_kind: str = "fake-tpu"
+
+        @property
+        def coords(self):
+            local = self.id % 4
+            return (local % 2, local // 2, 0)
+
+        @property
+        def core_on_chip(self):
+            return 0
+
+    devs = [FakeDev(id=i, slice_index=i // 4) for i in range(8)]
+    mesh = make_hybrid_mesh(
+        MeshConfig(fsdp=2, tp=2), MeshConfig(dp=2), devices=devs
+    )
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "tp": 2, "sp": 1,
+    }
+    for dp in (0, 1):
+        slices = {d.slice_index
+                  for d in mesh.devices[:, dp].flatten().tolist()}
+        assert len(slices) == 1, (dp, slices)  # ICI axes inside ONE slice
+    assert (
+        {d.slice_index for d in mesh.devices[:, 0].flatten().tolist()}
+        != {d.slice_index for d in mesh.devices[:, 1].flatten().tolist()}
+    )  # the DCN axis is what crosses slices
