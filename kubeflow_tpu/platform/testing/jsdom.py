@@ -649,9 +649,70 @@ def parse_html(src: str) -> Document:
 # ---------------------------------------------------------------------------
 
 
-class FormData:
-    def __init__(self, form: Element):
+class _EntryList:
+    """Ordered multimap shared by URLSearchParams and FormData — both
+    expose the same get/getAll/has/append/set/delete over (name, value)
+    pairs (the WHATWG spec defines them identically)."""
+
+    def __init__(self):
         self._entries: List[tuple] = []
+
+    def get(self, name):
+        name = js_to_string(name)
+        for k, v in self._entries:
+            if k == name:
+                return v
+        return None
+
+    def set(self, name, value):
+        # Replaces the FIRST occurrence in place (position preserved) and
+        # drops the rest; appends only when the key was absent.
+        name, value = js_to_string(name), js_to_string(value)
+        out, replaced = [], False
+        for k, v in self._entries:
+            if k == name:
+                if not replaced:
+                    out.append((name, value))
+                    replaced = True
+            else:
+                out.append((k, v))
+        if not replaced:
+            out.append((name, value))
+        self._entries = out
+        return UNDEF
+
+    def append(self, name, value):
+        self._entries.append((js_to_string(name), js_to_string(value)))
+        return UNDEF
+
+    def has(self, name):
+        name = js_to_string(name)
+        return any(k == name for k, _ in self._entries)
+
+    def getAll(self, name):
+        name = js_to_string(name)
+        return JSArray(v for k, v in self._entries if k == name)
+
+    def delete(self, name):
+        name = js_to_string(name)
+        self._entries = [(k, v) for k, v in self._entries if k != name]
+        return UNDEF
+
+    def urlencoded(self) -> str:
+        # application/x-www-form-urlencoded: space -> "+", like the browser.
+        return urllib.parse.urlencode(self._entries)
+
+
+class FormData(_EntryList):
+    def __init__(self, form: Optional[Element] = None):
+        super().__init__()
+        if form is None or form is UNDEF:
+            return  # `new FormData()` / (undefined) are valid JS
+        if not isinstance(form, Element):
+            from kubeflow_tpu.platform.testing.jsengine import throw
+
+            throw("FormData constructor: argument is not a form element",
+                  "TypeError")
         for n in form._descendants():
             tag = n._tag
             name = n.attributes.get("name")
@@ -668,19 +729,6 @@ class FormData:
             elif tag in ("select", "textarea"):
                 self._entries.append((name, n.value))
 
-    def get(self, name):
-        name = js_to_string(name)
-        for k, v in self._entries:
-            if k == name:
-                return v
-        return None
-
-    def getAll(self, name):
-        name = js_to_string(name)
-        return JSArray(v for k, v in self._entries if k == name)
-
-    def has(self, name):
-        return any(k == js_to_string(name) for k, _ in self._entries)
 
     def entries(self):
         return JSArray(JSArray(kv) for kv in self._entries)
@@ -758,57 +806,16 @@ class JSDate:
     toLocaleDateString = toLocaleString
 
 
-class URLSearchParams:
+class URLSearchParams(_EntryList):
     def __init__(self, init=""):
+        super().__init__()
         s = js_to_string(init)
         if s.startswith("?"):
             s = s[1:]
-        self._params = urllib.parse.parse_qsl(s, keep_blank_values=True)
-
-    def get(self, name):
-        name = js_to_string(name)
-        for k, v in self._params:
-            if k == name:
-                return v
-        return None
-
-    def set(self, name, value):
-        # Replaces the FIRST occurrence in place (position preserved) and
-        # drops the rest; appends only when the key was absent.
-        name, value = js_to_string(name), js_to_string(value)
-        out, replaced = [], False
-        for k, v in self._params:
-            if k == name:
-                if not replaced:
-                    out.append((name, value))
-                    replaced = True
-            else:
-                out.append((k, v))
-        if not replaced:
-            out.append((name, value))
-        self._params = out
-        return UNDEF
-
-    def append(self, name, value):
-        self._params.append((js_to_string(name), js_to_string(value)))
-        return UNDEF
-
-    def has(self, name):
-        name = js_to_string(name)
-        return any(k == name for k, _ in self._params)
-
-    def getAll(self, name):
-        name = js_to_string(name)
-        return JSArray(v for k, v in self._params if k == name)
-
-    def delete(self, name):
-        name = js_to_string(name)
-        self._params = [(k, v) for k, v in self._params if k != name]
-        return UNDEF
+        self._entries = urllib.parse.parse_qsl(s, keep_blank_values=True)
 
     def toString(self):
-        # application/x-www-form-urlencoded: space -> "+", like the browser.
-        return urllib.parse.urlencode(self._params)
+        return self.urlencoded()
 
 
 class JSURL:
@@ -1038,7 +1045,17 @@ class BrowserHarness:
         if self.document.cookie:
             headers["Cookie"] = self.document.cookie
         body = opts.get("body")
-        data = js_to_string(body) if body not in (None, UNDEF) else None
+        if isinstance(body, FormData):
+            # A FormData body posts urlencoded entries, not a Python repr.
+            data = body.urlencoded()
+            headers.setdefault(
+                "Content-Type", "application/x-www-form-urlencoded")
+        elif isinstance(body, URLSearchParams):
+            data = body.urlencoded()
+            headers.setdefault(
+                "Content-Type", "application/x-www-form-urlencoded")
+        else:
+            data = js_to_string(body) if body not in (None, UNDEF) else None
         client = self._client_for(path)
         if client is None:
             return JSPromise.reject(make_error(

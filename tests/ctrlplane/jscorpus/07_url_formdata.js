@@ -25,3 +25,18 @@ print(new URL("http://Host.Example:80/p").origin);
 print(new URL("https://h.example:443/p").origin);
 print(new URL("https://h.example:8443/p").origin);
 print(new URL("http://h.example/p#x").hash);
+// FormData standalone construction + entry semantics.
+const fd = new FormData();
+fd.append("a", "1");
+fd.append("a", "2");
+fd.append("b", "x");
+print(fd.get("a"));
+print(fd.getAll("a").join("|"));
+print(fd.get("missing"));
+print(fd.has("b"), fd.has("zz"));
+fd.set("a", "9");
+print(fd.getAll("a").join("|"), fd.get("b"));
+fd.delete("b");
+print(fd.has("b"));
+print(new FormData(undefined).has("x"));
+try { new FormData("not-a-form"); } catch (e) { print("fd-ctor", e.name); }
