@@ -179,6 +179,52 @@ class E2E:
             self.kube.create(pod)
             self.kube.set_pod_phase(ns, f"{name}-{i}", "Running", ready=True)
 
+    def quota_denial(self, ns: str):
+        """TPU quota enforcement, end-to-end in the active transport.
+
+        Assumes spawn(ns) already ran: caps the namespace at the 8 chips
+        that notebook's worker holds,
+        then proves (a) the spawner pre-flight 403s a further spawn with the
+        user-facing "TPU quota exceeded" message, and (b) the apiserver's
+        own pod admission 403s a direct over-quota pod create — the two
+        layers the reference gets from kube-apiserver quota admission
+        (reference nb_controller_intergration_test.yaml:27-58).
+        """
+        from kubeflow_tpu.platform.k8s import errors
+        from kubeflow_tpu.platform.k8s.types import RESOURCEQUOTA
+
+        quota = {
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "e2e-tpu-quota", "namespace": ns},
+            "spec": {"hard": {"google.com/tpu": "8"}},
+        }
+        self.api_client.create(quota)
+        try:
+            # The running 2x4 notebook holds all 8 chips: remaining is 0.
+            resp = self.jupyter.post(
+                f"/api/namespaces/{ns}/notebooks",
+                json={"name": "denied-nb",
+                      "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                headers=self.user,
+            )
+            body = resp.get_data(as_text=True)
+            assert resp.status_code == 403, (resp.status_code, body)
+            assert "TPU quota exceeded" in body, body
+            # Raw pod admission (what denies a scaling StatefulSet).
+            try:
+                self.api_client.create({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": "greedy", "namespace": ns},
+                    "spec": {"containers": [{"name": "c", "resources": {
+                        "limits": {"google.com/tpu": "64"}}}]},
+                })
+            except errors.Forbidden as e:
+                assert "exceeded quota" in str(e), e
+            else:
+                raise AssertionError("over-quota pod was admitted")
+        finally:
+            self.api_client.delete(RESOURCEQUOTA, "e2e-tpu-quota", ns)
+
     def stop_start(self, ns: str, name: str = "e2e-nb"):
         resp = self.jupyter.patch(
             f"/api/namespaces/{ns}/notebooks/{name}",
@@ -268,6 +314,7 @@ def main(argv=None) -> int:
     try:
         ns = e2e.register()
         spawn_s = e2e.spawn(ns)
+        e2e.quota_denial(ns)
         e2e.stop_start(ns)
         e2e.delete(ns)
     finally:

@@ -195,6 +195,68 @@ def profile_rbac_quota():
     assert deep_get(rq, "spec", "hard", "google.com/tpu") == "32"
 
 
+@check("tpu-quota-enforced")
+def tpu_quota_enforced():
+    """Per-namespace TPU chip quotas actually deny: an over-quota multi-host
+    spawn is rejected with a user-facing 403, and succeeds once capacity is
+    freed — the full Profile → ResourceQuota → admission chain the reference
+    delegates to kube-apiserver (profile_controller.go:253-280 + KinD CI)."""
+    from kubeflow_tpu.platform.k8s.types import (
+        PROFILE, RESOURCEQUOTA, STATEFULSET, deep_get,
+    )
+
+    e2e = _e2e()
+    try:
+        e2e.kube.add_tpu_node("tpu-quota-1", topology="4x4")
+        ns = e2e.register()
+        # Admin caps the workspace at 16 chips through the Profile — the
+        # platform's quota API — and the profile controller materializes it.
+        profile = e2e.api_client.get(PROFILE, ns)
+        profile["spec"]["resourceQuotaSpec"] = {
+            "hard": {"google.com/tpu": "16"}}
+        e2e.api_client.update(profile)
+        rq = e2e._wait(
+            lambda: e2e._get(RESOURCEQUOTA, "kf-resource-quota", ns), "quota"
+        )
+        assert deep_get(rq, "spec", "hard", "google.com/tpu") == "16"
+
+        # An 8-chip notebook comes up and holds its chips.
+        e2e.spawn(ns, "small-nb")
+        # A 16-chip multi-host spawn now exceeds the 16-chip cap (8 used).
+        resp = e2e.jupyter.post(
+            f"/api/namespaces/{ns}/notebooks",
+            json={"name": "big-nb",
+                  "tpus": {"accelerator": "v5e", "topology": "4x4"}},
+            headers=e2e.user,
+        )
+        body = resp.get_data(as_text=True)
+        assert resp.status_code == 403, (resp.status_code, body)
+        assert "TPU quota exceeded" in body, body
+        assert "requested 16" in body and "remaining 8" in body, body
+
+        # Free the capacity (delete the notebook AND its pods, as the
+        # cluster would) — the same spawn must now succeed and go Ready.
+        e2e.delete(ns, "small-nb")
+        e2e._delete_pods(ns, "small-nb")
+        resp = e2e.jupyter.post(
+            f"/api/namespaces/{ns}/notebooks",
+            json={"name": "big-nb",
+                  "tpus": {"accelerator": "v5e", "topology": "4x4"}},
+            headers=e2e.user,
+        )
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        sts = e2e._wait(lambda: e2e._get(STATEFULSET, "big-nb", ns), "sts")
+        assert deep_get(sts, "spec", "replicas") == 2
+        e2e._kubelet_sim(ns, "big-nb", 2)  # pod admission passes at 16/16
+        e2e._wait(lambda: e2e._phase(ns, "big-nb") == "running", "ready")
+        used = deep_get(
+            e2e.kube.get(RESOURCEQUOTA, "kf-resource-quota", ns),
+            "status", "used", "google.com/tpu")
+        assert used == "16", used
+    finally:
+        e2e.close()
+
+
 @check("crd-version-conversion")
 def crd_conversion():
     """Notebooks round-trip across every served version pair losslessly

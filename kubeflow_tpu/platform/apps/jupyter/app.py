@@ -17,6 +17,7 @@ from kubeflow_tpu.platform.apis import notebook as nbapi
 from kubeflow_tpu.platform.apps.jupyter import form as form_mod
 from kubeflow_tpu.platform.apps.jupyter.status import process_status
 from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s import quota as quota_mod
 from kubeflow_tpu.platform.k8s.types import (
     EVENT,
     NODE,
@@ -24,10 +25,11 @@ from kubeflow_tpu.platform.k8s.types import (
     POD,
     PODDEFAULT,
     PVC,
+    RESOURCEQUOTA,
     deep_get,
     name_of,
 )
-from kubeflow_tpu.platform.tpu import topologies_on_nodes
+from kubeflow_tpu.platform.tpu import slice_spec, topologies_on_nodes
 from kubeflow_tpu.platform.web.crud_backend import (
     CrudBackend,
     current_user,
@@ -75,7 +77,17 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
                           if t in set(present[acc])]
             if topologies:
                 out.append({"accelerator": acc, "topologies": topologies})
-        return success({"tpus": out})
+        # Per-namespace chip budget (hard − used) so the picker can disable
+        # over-quota topologies and show "N chips remaining".  Read with the
+        # app's own client, not the user's SAR: this reflects what quota
+        # admission will do to the spawn regardless of whether the user may
+        # list ResourceQuota objects.  Uses the same max(status.used,
+        # declared) accounting as the pre-flight so the picker never
+        # enables a topology the submit would 403.
+        quotas = client.list(RESOURCEQUOTA, ns)
+        remaining = quota_mod.tpu_remaining(
+            quotas, declared=_declared_tpu_chips(ns)) if quotas else None
+        return success({"tpus": out, "quota": remaining})
 
     # -- notebooks ------------------------------------------------------------
 
@@ -143,6 +155,12 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         defaults = form_mod.load_spawner_config(cfg_path)
         nb, pvcs = form_mod.build_notebook(body, defaults)
         nbapi.validate(nb)
+        # Quota pre-flight: the real denial happens at pod admission when
+        # the StatefulSet scales up, which would strand the user with a
+        # notebook that never starts.  Evaluate the notebook's aggregate
+        # worker footprint against the namespace quotas up front and turn
+        # it into a 403 the form can show.
+        _quota_preflight(ns, nb)
         # Dry-run first (reference post.py:48-54): catch quota/validation
         # rejections before any PVC is created.
         backend.create_resource(user, nb, dry_run=True)
@@ -168,6 +186,14 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
                 ).strftime("%Y-%m-%dT%H:%M:%SZ"),
             }}}
         else:
+            # Restart re-claims the notebook's chips: run the same quota
+            # pre-flight as a fresh spawn (the stopped CR is excluded from
+            # the declared tally, so it only checks against OTHERS' usage)
+            # — otherwise the StatefulSet scales up into a pod-admission
+            # 403 and strands with no user-facing error.
+            current = backend.get_resource(user, NOTEBOOK, name, ns)
+            if nbapi.is_stopped(current):
+                _quota_preflight(ns, current)
             patch = {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}}
         out = backend.patch_resource(user, NOTEBOOK, name, patch, ns)
         return success({"notebook": out})
@@ -197,6 +223,96 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         return success({"poddefaults": out})
 
     # -- helpers --------------------------------------------------------------
+
+    def _notebook_usage(nb) -> dict:
+        """A notebook's declared aggregate footprint: total_chips across
+        every host of every slice, cpu/memory per worker × worker count —
+        the same math quota admission will apply to its pods."""
+        template_pod = {"spec": deep_get(nb, "spec", "template", "spec",
+                                         default={}) or {}}
+        try:
+            usage = quota_mod.pod_quota_usage(template_pod)
+        except ValueError as e:
+            # User-typed quantity ("cpu": "abc") — a form error, not a 500.
+            raise HttpError(400, f"invalid resource quantity: {e}")
+        tpu = deep_get(nb, "spec", "tpu", default=None)
+        if not tpu:
+            return usage
+        try:
+            spec = slice_spec(tpu.get("accelerator"), tpu.get("topology"),
+                              tpu.get("slices"))
+        except ValueError:
+            return usage  # validate() rejects it; don't double-report
+        # spec.tpu is authoritative for chips: drop any (redundant) template
+        # limit so a CR carrying both never counts double.
+        usage.pop("requests.google.com/tpu", None)
+        usage.pop("limits.google.com/tpu", None)
+        usage = quota_mod.scale_usage(usage, spec.total_hosts)
+        return quota_mod.add_usage(usage, {
+            "requests.google.com/tpu": float(spec.total_chips),
+            "limits.google.com/tpu": float(spec.total_chips),
+        })
+
+    def _stored_usage(nb) -> dict:
+        """_notebook_usage for an already-stored CR: junk quantities in
+        someone else's object must not fail THIS user's request."""
+        try:
+            return _notebook_usage(nb)
+        except HttpError:
+            return {}
+
+    def _declared_tpu_chips(ns: str) -> float:
+        """Chips declared by running (non-stopped) notebook CRs — counted
+        even before their worker pods materialize."""
+        return sum(
+            _stored_usage(nb).get("requests.google.com/tpu", 0.0)
+            for nb in client.list(NOTEBOOK, ns) if not nbapi.is_stopped(nb)
+        )
+
+    def _quota_preflight(ns: str, nb) -> None:
+        """403 if the notebook's worker pods would exceed a namespace quota.
+
+        Counts against the LARGER of the cluster's live usage (status.used)
+        and the declared footprint of every running notebook CR — a just-
+        accepted notebook claims its chips here before its pods exist, so
+        back-to-back spawns can't both slip under the quota and strand the
+        second one at pod admission.
+        """
+        quotas = client.list(RESOURCEQUOTA, ns)
+        if not quotas:
+            return
+        usage = _notebook_usage(nb)
+        declared: dict = {}
+        for other in client.list(NOTEBOOK, ns):
+            if not nbapi.is_stopped(other):
+                declared = quota_mod.add_usage(declared,
+                                               _stored_usage(other))
+        override = {}
+        for q in quotas:
+            hard = deep_get(q, "spec", "hard", default={}) or {}
+            used_map = deep_get(q, "status", "used", default={}) or {}
+            effective = {}
+            for key in hard:
+                ukey = quota_mod.usage_key(key)
+                try:
+                    stored = quota_mod.parse_quantity(
+                        used_map.get(key, 0.0) or 0.0)
+                except ValueError:
+                    stored = 0.0
+                effective[ukey] = max(stored, declared.get(ukey, 0.0))
+            override[name_of(q)] = effective
+        violation = quota_mod.find_violation(quotas, usage,
+                                             used_override=override)
+        if violation is None:
+            return
+        if quota_mod.usage_key(violation.hard_key) == "requests.google.com/tpu":
+            msg = (f"TPU quota exceeded (requested "
+                   f"{int(violation.requested)}, remaining "
+                   f"{int(violation.remaining)} of "
+                   f"{int(violation.hard)} chips in {ns})")
+        else:
+            msg = f"namespace quota exceeded: {violation.message()}"
+        raise HttpError(403, msg)
 
     def _warning_events(user, ns):
         out: dict = {}

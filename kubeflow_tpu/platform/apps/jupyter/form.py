@@ -134,6 +134,16 @@ def _set_image_pull_policy(container, body, defaults) -> None:
 def _set_cpu_ram(container, body, defaults) -> None:
     cpu = str(get_form_value(body, defaults, "cpu"))
     mem = str(get_form_value(body, defaults, "memory"))
+    # Validate before anything consumes them: a typo'd quantity must be a
+    # form 400, not a 500 out of limit scaling or the quota pre-flight.
+    from kubeflow_tpu.platform.k8s import quota as quota_mod
+
+    for field, value in (("cpu", cpu), ("memory", mem)):
+        try:
+            quota_mod.parse_quantity(value)
+        except (ValueError, TypeError):
+            raise HttpError(
+                400, f"invalid {field} quantity {value!r}") from None
     requests = container["resources"]["requests"]
     limits = container["resources"]["limits"]
     requests["cpu"], requests["memory"] = cpu, mem

@@ -16,6 +16,7 @@ document.getElementById("ns-label").textContent = "namespace: " + ns;
 
 let config = null;
 let offeredTpus = [];
+let tpuQuota = null; /* {hard, used, remaining} chips, or null: no quota */
 let existingPvcs = [];
 let volumeRows = [];
 let detailName = null;
@@ -114,24 +115,58 @@ async function loadConfig() {
   applyReadOnly("workspaceVolume", document.getElementById("workspace-select"));
 }
 
+function topologyChips(t) {
+  /* "4x4" -> 16; matches the backend's parse_topology product. */
+  return t.split("x").reduce((n, d) => n * (parseInt(d, 10) || 0), 1);
+}
+
 function syncTopologies() {
   const acc = document.getElementById("tpu-acc");
   const topo = document.getElementById("tpu-topo");
   const sel = offeredTpus.find((o) => o.accelerator === acc.value);
   topo.disabled = !sel;
+  const previous = topo.value; /* survive the rebuild (slice-count changes) */
   topo.replaceChildren();
+  const slices = parseInt(document.getElementById("tpu-slices").value, 10) || 1;
   for (const t of (sel ? sel.topologies : [])) {
-    topo.append(el("option", { value: t }, t));
+    const opt = el("option", { value: t }, t);
+    /* Disable picks the namespace quota can't admit: the backend would
+       403 them at the pre-flight anyway (quota-aware spawner UX). */
+    if (tpuQuota && topologyChips(t) * slices > tpuQuota.remaining) {
+      opt.disabled = true;
+      opt.textContent = `${t} (over quota)`;
+    }
+    topo.append(opt);
+  }
+  /* Rebuilding dropped the selection: keep the user's pick if it's still
+     offered and admissible, else the first enabled option (a disabled
+     default would submit anyway). */
+  const options = [...topo.options];
+  const keep = options.find((o) => o.getAttribute("value") === previous && !o.disabled);
+  const firstOk = options.find((o) => !o.disabled);
+  if (keep) {
+    topo.value = keep.getAttribute("value");
+  } else if (firstOk) {
+    topo.value = firstOk.getAttribute("value");
+  }
+  const label = document.getElementById("tpu-quota-label");
+  label.hidden = !tpuQuota;
+  if (tpuQuota) {
+    label.textContent =
+      `${tpuQuota.remaining} of ${tpuQuota.hard} TPU chips remaining`;
   }
 }
 
 async function loadTpus() {
   const acc = document.getElementById("tpu-acc");
   try {
-    offeredTpus = (await api(`/api/namespaces/${ns}/tpus`)).tpus;
+    const resp = await api(`/api/namespaces/${ns}/tpus`);
+    offeredTpus = resp.tpus;
+    tpuQuota = resp.quota || null;
   } catch (e) {
     /* no nodes visible: fall back to the admin-offered list */
     offeredTpus = (config && config.tpus && config.tpus.options) || [];
+    tpuQuota = null;
   }
   acc.replaceChildren(el("option", { value: "" }, "none"));
   for (const option of offeredTpus) {
@@ -304,6 +339,8 @@ function spawnBody(form) {
 function wireSpawner() {
   const dialog = document.getElementById("spawner");
   document.getElementById("tpu-acc").addEventListener("change", syncTopologies);
+  /* Slice count changes the aggregate chip ask: re-derive over-quota state. */
+  document.getElementById("tpu-slices").addEventListener("change", syncTopologies);
   document.getElementById("workspace-select").addEventListener("change", (ev) => {
     document.getElementById("workspace-custom-row").hidden = ev.target.value !== "custom";
   });

@@ -1,0 +1,269 @@
+"""ResourceQuota accounting: quantity math + pod usage + admission checks.
+
+The reference platform gets quota enforcement for free from the real API
+server its KinD CI spins up (the profile controller only *creates* the
+ResourceQuota — reference profile_controller.go:253-280 — and kube-apiserver's
+quota admission plugin does the denying).  This platform's test universe is
+the in-memory API server in ``testing/fake.py``, so the admission plugin has
+to exist here too — otherwise "per-namespace TPU chip quotas" is a spec-only
+feature that never actually denies anything.
+
+This module is the single source of truth for the quota *math*; consumers:
+
+* ``testing/fake.py`` / ``testing/httpkube.py`` — pod-creation admission
+  (403 on exceed) and ``status.used`` bookkeeping,
+* the Jupyter spawner backend — the pre-flight that turns an over-quota
+  notebook POST into a user-visible "TPU quota exceeded" instead of a
+  StatefulSet that silently never scales up,
+* the spawner UI — "chips remaining" next to the TPU picker.
+
+Semantics follow the real quota plugin with one documented deviation: a pod
+that does not request a constrained resource counts 0 toward it (the real
+plugin *rejects* such pods outright; that rule would make every CPU-only
+sidecar in a TPU-quota'd namespace undeployable, so we relax it the way
+``scopeSelector``-scoped quotas do).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from kubeflow_tpu.platform.k8s.types import Resource, deep_get
+
+_BINARY = {"Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+           "Pi": 2**50, "Ei": 2**60}
+_DECIMAL = {"n": 1e-9, "u": 1e-6, "m": 1e-3, "k": 1e3, "M": 1e6,
+            "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18}
+
+
+def parse_quantity(q) -> float:
+    """Kubernetes quantity → float in base units ("500m"→0.5, "2Gi"→2**31).
+
+    Rejects non-finite values: "nan"/"inf" would defeat every comparison
+    gate downstream (NaN compares False against any hard limit) and poison
+    the formatted status.used."""
+    def finite(v: float) -> float:
+        if not math.isfinite(v):
+            raise ValueError(f"non-finite quantity {q!r}")
+        return v
+
+    if isinstance(q, (int, float)):
+        return finite(float(q))
+    s = str(q).strip()
+    if not s:
+        raise ValueError("empty quantity")
+    for suffix, mult in _BINARY.items():
+        if s.endswith(suffix):
+            return finite(float(s[: -len(suffix)]) * mult)
+    # Longest decimal suffixes are single-char; guard against bare numbers
+    # in scientific notation ("1e3" is valid k8s and NOT an 'E' suffix).
+    if s[-1] in _DECIMAL and not s[-1].isdigit():
+        try:
+            value = float(s[:-1])
+        except ValueError:
+            pass  # not "<number><suffix>": fall through to the bare parse
+        else:
+            return finite(value * _DECIMAL[s[-1]])
+    return finite(float(s))
+
+
+def _memory_like(key: str) -> bool:
+    return key.rsplit(".", 1)[-1] in ("memory", "storage", "ephemeral-storage")
+
+
+def format_quantity(v: float, key: str = "") -> str:
+    """Render a base-unit float back to a canonical quantity string.
+
+    Integers stay plain ("16"); memory-like resources (pass the quota key)
+    render exact binary multiples as Ki/Mi/Gi; sub-unit values use millis
+    ("500m") as the apiserver does for CPU.  Counted resources (TPU chips,
+    pods) always stay decimal — the apiserver never writes "1Ki" chips.
+    """
+    if _memory_like(key) and v >= 2**10 and v == int(v):
+        for suffix in ("Ei", "Pi", "Ti", "Gi", "Mi", "Ki"):
+            mult = _BINARY[suffix]
+            if int(v) % mult == 0:
+                return f"{int(v) // mult}{suffix}"
+    if v == int(v):
+        return str(int(v))
+    return f"{int(round(v * 1000))}m"
+
+
+def validate_hard(hard: Dict[str, object]) -> None:
+    """Reject malformed spec.hard quantities the way the real apiserver
+    does at ResourceQuota create time — otherwise a typo'd quota turns
+    every later pod admission into an unhandled parse error."""
+    for key, val in (hard or {}).items():
+        try:
+            parse_quantity(val)
+        except (ValueError, TypeError):
+            raise ValueError(
+                f"invalid quantity {val!r} for {key} in spec.hard"
+            ) from None
+
+
+def usage_key(hard_key: str) -> str:
+    """Normalize a spec.hard key to its canonical usage key.
+
+    Bare resource names count requests ("cpu" ≡ "requests.cpu",
+    "google.com/tpu" ≡ "requests.google.com/tpu" — the GKE-documented
+    spelling for TPU chip quotas); "limits.*" and object counts ("pods")
+    pass through.
+    """
+    if hard_key == "pods" or hard_key.startswith(("requests.", "limits.")):
+        return hard_key
+    return f"requests.{hard_key}"
+
+
+def pod_quota_usage(pod: Resource) -> Dict[str, float]:
+    """One pod's quota footprint: {"pods": 1, "requests.cpu": …, …}.
+
+    Follows the quota plugin's effective-resources rule: a container's
+    request defaults to its limit when only the limit is set; init
+    containers run sequentially, so they contribute the per-resource MAX
+    across init containers (not their sum), and the pod's footprint is
+    max(that, sum(main containers)).
+    """
+    def tally(containers, combine) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {"requests": {}, "limits": {}}
+        for c in containers or []:
+            res = c.get("resources") or {}
+            requests, limits = res.get("requests") or {}, res.get("limits") or {}
+            for name, val in limits.items():
+                out["limits"][name] = combine(
+                    out["limits"].get(name, 0.0), parse_quantity(val))
+            for name in set(requests) | set(limits):
+                eff = requests.get(name, limits.get(name))
+                out["requests"][name] = combine(
+                    out["requests"].get(name, 0.0), parse_quantity(eff))
+        return out
+
+    main = tally(deep_get(pod, "spec", "containers", default=[]),
+                 lambda a, b: a + b)
+    init = tally(deep_get(pod, "spec", "initContainers", default=[]), max)
+    usage: Dict[str, float] = {"pods": 1.0}
+    for flavor in ("requests", "limits"):
+        for name in set(main[flavor]) | set(init[flavor]):
+            usage[f"{flavor}.{name}"] = max(
+                main[flavor].get(name, 0.0), init[flavor].get(name, 0.0)
+            )
+    return usage
+
+
+def scale_usage(usage: Dict[str, float], n: int) -> Dict[str, float]:
+    """Footprint of n identical pods (a slice's worth of workers)."""
+    return {k: v * n for k, v in usage.items()}
+
+
+def add_usage(*usages: Dict[str, float]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for usage in usages:
+        for k, v in usage.items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+class Violation(Exception):
+    """One quota constraint the incoming workload would exceed."""
+
+    def __init__(self, quota_name: str, hard_key: str, requested: float,
+                 used: float, hard: float):
+        self.quota_name, self.hard_key = quota_name, hard_key
+        self.requested, self.used, self.hard = requested, used, hard
+        super().__init__(self.message())
+
+    @property
+    def remaining(self) -> float:
+        return max(0.0, self.hard - self.used)
+
+    def message(self) -> str:
+        """The real apiserver's denial phrasing, byte-compatible enough for
+        clients that string-match on 'exceeded quota:'."""
+        k = self.hard_key
+        return (
+            f"exceeded quota: {self.quota_name}, "
+            f"requested: {k}={format_quantity(self.requested, k)}, "
+            f"used: {k}={format_quantity(self.used, k)}, "
+            f"limited: {k}={format_quantity(self.hard, k)}"
+        )
+
+
+def find_violation(
+    quotas: Iterable[Resource], usage: Dict[str, float],
+    used_override: Optional[Dict[str, Dict[str, float]]] = None,
+) -> Optional[Violation]:
+    """First constraint `usage` would exceed across `quotas`, else None.
+
+    ``used`` comes from each quota's ``status.used`` (maintained by the
+    store's bookkeeping); ``used_override`` maps quota name → usage for
+    callers that recompute live.
+    """
+    for q in quotas:
+        qname = deep_get(q, "metadata", "name", default="") or ""
+        hard = deep_get(q, "spec", "hard", default={}) or {}
+        used_map = deep_get(q, "status", "used", default={}) or {}
+        if used_override and qname in used_override:
+            live = used_override[qname]
+            used_map = {k: live.get(usage_key(k), 0.0) for k in hard}
+        for hard_key, hard_val in hard.items():
+            delta = usage.get(usage_key(hard_key), 0.0)
+            if delta <= 0:
+                continue
+            used = parse_quantity(used_map.get(hard_key, 0.0) or 0.0)
+            limit = parse_quantity(hard_val)
+            if used + delta > limit:
+                return Violation(qname, hard_key, delta, used, limit)
+    return None
+
+
+def live_usage(pods: Iterable[Resource]) -> Dict[str, float]:
+    """Aggregate footprint of the non-terminal pods in a namespace."""
+    live = [p for p in pods
+            if deep_get(p, "status", "phase", default="")
+            not in ("Succeeded", "Failed")]
+    return add_usage(*[pod_quota_usage(p) for p in live]) if live else {}
+
+
+def quota_status(quotas: Iterable[Resource], pods: Iterable[Resource] = (),
+                 *, totals: Optional[Dict[str, float]] = None
+                 ) -> List[Tuple[Resource, Dict[str, str]]]:
+    """(quota, fresh status.used) pairs from the live non-terminal pod set
+    (or from a precomputed ``totals`` usage map)."""
+    total = live_usage(pods) if totals is None else totals
+    out = []
+    for q in quotas:
+        hard = deep_get(q, "spec", "hard", default={}) or {}
+        used = {k: format_quantity(total.get(usage_key(k), 0.0), k)
+                for k in hard}
+        out.append((q, used))
+    return out
+
+
+def tpu_remaining(quotas: Iterable[Resource], *, declared: float = 0.0
+                  ) -> Optional[Dict[str, int]]:
+    """Tightest google.com/tpu chip budget across quotas, for the spawner UI.
+
+    ``declared`` is the chip total claimed by not-yet-materialized
+    workloads (running notebook CRs whose pods don't exist yet); the
+    effective used is max(status.used, declared) — the same accounting the
+    spawn pre-flight applies, so the picker and the 403 can't disagree.
+    Returns {"hard": H, "used": U, "remaining": R} or None when no quota
+    constrains TPU chips in the namespace.
+    """
+    best = None
+    for q in quotas:
+        hard = deep_get(q, "spec", "hard", default={}) or {}
+        used_map = deep_get(q, "status", "used", default={}) or {}
+        for key, hard_val in hard.items():
+            if usage_key(key) != "requests.google.com/tpu":
+                continue
+            try:
+                h = parse_quantity(hard_val)
+                u = parse_quantity(used_map.get(key, 0.0) or 0.0)
+            except ValueError:
+                continue  # malformed quota must not 500 the spawner UI
+            u = max(u, declared)
+            r = max(0.0, h - u)
+            if best is None or r < best["remaining"]:
+                best = {"hard": int(h), "used": int(u), "remaining": int(r)}
+    return best

@@ -13,6 +13,13 @@ protocol with the API-server semantics the controllers rely on:
 * watch streams with sequenced events per (gvk, namespace)
 * ownerReference cascade deletion (synchronous — deterministic for tests)
 * namespace existence checks and a pluggable SubjectAccessReview policy
+* ResourceQuota admission: pod creation exceeding a namespace quota's
+  ``spec.hard`` (``google.com/tpu`` chips, cpu, memory, pods) is rejected
+  with the apiserver's 403 phrasing, ``status.used`` is kept current, and
+  capacity is released on delete / terminal phase — the quota plugin the
+  reference inherits from the real apiserver its KinD CI runs
+  (reference profile_controller.go:253-280 creates the object; kube-
+  apiserver enforces it).  Math lives in ``k8s/quota.py``.
 
 Plus test-only helpers: ``set_pod_phase`` to simulate kubelet, and node
 fixtures with TPU capacity (``add_tpu_node``) — the "fake TPU node" fixture
@@ -28,6 +35,7 @@ import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s import quota as quota_mod
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
     NAMESPACE,
@@ -139,6 +147,18 @@ class FakeKube:
             key = _key(gvk, ns if gvk.namespaced else None, name)
             if key in self._objects:
                 raise errors.AlreadyExists(f'{gvk.plural} "{name}" already exists')
+            # Quota admission runs for dry-run too (the real apiserver's
+            # dry-run executes admission plugins without persisting), so any
+            # client that dry-run-creates a POD sees the denial.  NB: the
+            # spawner dry-runs a Notebook CR, which this plugin ignores —
+            # its user-facing quota 403 comes from _quota_preflight in
+            # apps/jupyter/app.py, not from here.
+            totals = None
+            if gvk.kind == "Pod" and gvk.api_version == "v1":
+                self._validate_pod_quantities(obj)
+                totals = self._admit_pod_quota(obj, ns)
+            if gvk.kind == "ResourceQuota":
+                self._validate_quota(obj)
             if dry_run:
                 return obj
             m = meta(obj)
@@ -149,6 +169,15 @@ class FakeKube:
             self._bump(obj)
             self._objects[key] = obj
             self._emit("ADDED", obj)
+            if gvk.kind == "Pod":
+                # Admission already summed the namespace: reuse its totals
+                # (plus this pod) instead of re-listing.
+                if totals is not None:
+                    totals = quota_mod.add_usage(
+                        totals, quota_mod.pod_quota_usage(obj))
+                self._requota(ns, totals=totals)
+            elif gvk.kind == "ResourceQuota":
+                self._requota(ns)
             return copy.deepcopy(obj)
 
     def update(self, obj: Resource) -> Resource:
@@ -157,6 +186,11 @@ class FakeKube:
             current = self._get_ref(gvk, name_of(obj), namespace_of(obj))
             self._check_rv(obj, current)
             obj = copy.deepcopy(obj)
+            if gvk.kind == "ResourceQuota":
+                self._validate_quota(obj)
+            if gvk.kind == "Pod" and gvk.api_version == "v1":
+                self._validate_pod_quantities(obj)
+                self._admit_pod_change(obj, current)
             # status is a subresource: PUT on the main resource keeps it.
             if "status" in current:
                 obj["status"] = copy.deepcopy(current["status"])
@@ -175,9 +209,13 @@ class FakeKube:
                 del self._objects[key]
                 self._emit("DELETED", obj)
                 self._cascade(meta(obj).get("uid"))
+                if gvk.kind == "Pod":
+                    self._requota(namespace_of(obj))
                 return copy.deepcopy(obj)
             self._objects[key] = obj
             self._emit("MODIFIED", obj)
+            if gvk.kind in ("Pod", "ResourceQuota"):
+                self._requota(namespace_of(obj))
             return copy.deepcopy(obj)
 
     def update_status(self, obj: Resource) -> Resource:
@@ -188,11 +226,20 @@ class FakeKube:
             current["status"] = copy.deepcopy(obj.get("status", {}))
             self._bump(current)
             self._emit("MODIFIED", current)
+            if gvk.kind == "Pod":
+                # Terminal phases (Succeeded/Failed) release quota.
+                self._requota(namespace_of(current))
             return copy.deepcopy(current)
 
     def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge") -> Resource:
         with self._lock:
             current = self._get_ref(gvk, name, namespace)
+            # The merge below mutates the stored object in place; keep a
+            # rollback copy so a post-merge validation failure (malformed
+            # quota or pod quantities, over-quota resize) leaves the store
+            # untouched.
+            rollback = copy.deepcopy(current) \
+                if gvk.kind in ("ResourceQuota", "Pod") else None
             if patch_type == "merge" or patch_type == "strategic":
                 from kubeflow_tpu.platform import native
 
@@ -213,6 +260,17 @@ class FakeKube:
                 current.update(patched)
             else:
                 raise errors.BadRequest(f"unsupported patch type {patch_type}")
+            if rollback is not None:
+                try:
+                    if gvk.kind == "ResourceQuota":
+                        self._validate_quota(current)
+                    else:
+                        self._validate_pod_quantities(current)
+                        self._admit_pod_change(current, rollback)
+                except errors.ApiError:
+                    current.clear()
+                    current.update(rollback)
+                    raise
             self._bump(current)
             # Same terminating-object rule as update(): stripping the last
             # finalizer from a deletionTimestamp'd object deletes it.
@@ -221,8 +279,12 @@ class FakeKube:
                 del self._objects[key]
                 self._emit("DELETED", current)
                 self._cascade(meta(current).get("uid"))
+                if gvk.kind == "Pod":
+                    self._requota(namespace)
                 return copy.deepcopy(current)
             self._emit("MODIFIED", current)
+            if gvk.kind in ("Pod", "ResourceQuota"):
+                self._requota(namespace)
             return copy.deepcopy(current)
 
     def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:
@@ -240,6 +302,8 @@ class FakeKube:
             del self._objects[key]
             self._emit("DELETED", obj)
             self._cascade(meta(obj).get("uid"))
+            if gvk.kind == "Pod":
+                self._requota(namespace)
 
     def _cascade(self, owner_uid: Optional[str]) -> None:
         if not owner_uid:
@@ -255,6 +319,8 @@ class FakeKube:
                 del self._objects[key]
                 self._emit("DELETED", obj)
                 self._cascade(meta(obj).get("uid"))
+                if gvk_of(obj).kind == "Pod":
+                    self._requota(namespace_of(obj))
 
     def watch(self, gvk, namespace=None, *, resource_version=None,
               label_selector=None, stop: Optional[threading.Event] = None
@@ -297,6 +363,106 @@ class FakeKube:
             self._pod_logs.get((namespace, name, None), "")
 
     # -- internals -----------------------------------------------------------
+
+    def _quota_refs(self, ns: str) -> List[Resource]:
+        return [obj for (av, kind, objns, _), obj in self._objects.items()
+                if av == "v1" and kind == "ResourceQuota" and objns == ns]
+
+    def _pod_refs(self, ns: str) -> List[Resource]:
+        return [obj for (av, kind, objns, _), obj in self._objects.items()
+                if av == "v1" and kind == "Pod" and objns == ns]
+
+    def _admit_pod_quota(self, pod: Resource, ns: str):
+        """Quota admission plugin: deny a pod that would exceed any
+        ResourceQuota in its namespace, with the apiserver's phrasing.
+        Returns the namespace's live usage totals (pre-pod) so create()
+        can reuse them for the status refresh, or None if no quotas."""
+        quotas = self._quota_refs(ns)
+        if not quotas:
+            return None
+        # Recompute live usage rather than trusting status.used, exactly as
+        # the real plugin re-lists on admission — a quota created a moment
+        # ago must enforce against pods that predate it.
+        totals = quota_mod.live_usage(self._pod_refs(ns))
+        violation = quota_mod.find_violation(
+            quotas, quota_mod.pod_quota_usage(pod),
+            used_override={name_of(q): totals for q in quotas},
+        )
+        if violation is not None:
+            raise errors.Forbidden(
+                f'pods "{name_of(pod)}" is forbidden: {violation.message()}'
+            )
+        return totals
+
+    def _validate_pod_quantities(self, pod: Resource) -> None:
+        """Typed rejection for malformed container quantities (the real
+        apiserver validates at create) — one stored junk pod must never
+        poison every later quota computation in its namespace."""
+        for section in ("containers", "initContainers"):
+            for c in deep_get(pod, "spec", section, default=[]) or []:
+                res = c.get("resources") or {}
+                for flavor in ("requests", "limits"):
+                    for key, val in (res.get(flavor) or {}).items():
+                        try:
+                            quota_mod.parse_quantity(val)
+                        except (ValueError, TypeError):
+                            raise errors.Invalid(
+                                f'pods "{name_of(pod)}" is invalid: '
+                                f'{flavor}.{key}: invalid quantity {val!r}'
+                            ) from None
+
+    def _admit_pod_change(self, new_pod: Resource, old_pod: Resource) -> None:
+        """Quota admission for a pod UPDATE/PATCH (in-place resize): only
+        the usage delta vs the stored pod is charged."""
+        ns = namespace_of(new_pod)
+        quotas = self._quota_refs(ns)
+        if not quotas:
+            return
+        old = quota_mod.pod_quota_usage(old_pod)
+        new = quota_mod.pod_quota_usage(new_pod)
+        delta = {k: v - old.get(k, 0.0) for k, v in new.items()
+                 if v - old.get(k, 0.0) > 0}
+        if not delta:
+            return
+        totals = quota_mod.live_usage(self._pod_refs(ns))
+        violation = quota_mod.find_violation(
+            quotas, delta,
+            used_override={name_of(q): totals for q in quotas},
+        )
+        if violation is not None:
+            raise errors.Forbidden(
+                f'pods "{name_of(new_pod)}" is forbidden: '
+                f'{violation.message()}'
+            )
+
+    def _validate_quota(self, obj: Resource) -> None:
+        """Reject malformed spec.hard at write time (the real apiserver
+        does) — a typo'd quantity must not crash later pod admissions."""
+        try:
+            quota_mod.validate_hard(
+                deep_get(obj, "spec", "hard", default={}) or {})
+        except ValueError as e:
+            raise errors.Invalid(
+                f'ResourceQuota "{name_of(obj)}" is invalid: {e}'
+            ) from None
+
+    def _requota(self, ns: str, *,
+                 totals: Optional[Dict[str, float]] = None) -> None:
+        """Refresh status.used/hard on every ResourceQuota in `ns`."""
+        quotas = self._quota_refs(ns)
+        if not quotas:
+            return
+        for q, used in quota_mod.quota_status(
+                quotas, self._pod_refs(ns) if totals is None else (),
+                totals=totals):
+            fresh = {
+                "hard": dict(deep_get(q, "spec", "hard", default={}) or {}),
+                "used": used,
+            }
+            if q.get("status") != fresh:
+                q["status"] = fresh
+                self._bump(q)
+                self._emit("MODIFIED", q)
 
     def _check_rv(self, incoming: Resource, current: Resource) -> None:
         rv = meta(incoming).get("resourceVersion")

@@ -901,6 +901,84 @@ def test_volume_details_deep_link(kube):
 # -- async-ordering mode: races under deferred scheduling (VERDICT r2 #4) ----
 
 
+def test_spawner_quota_disables_over_budget_topologies(kube):
+    """Quota-aware spawner UX (VERDICT r3 item 7): the picker shows the
+    namespace chip budget and disables topologies it can't admit."""
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app
+
+    kube.add_tpu_node("tpu-node-2", topology="4x4")
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": "user1"},
+        "spec": {"hard": {"google.com/tpu": "8"}},
+    })
+    jupyter = harness("jupyter", create_app, kube)
+    jupyter.click("#new-notebook")
+    label = jupyter.get("tpu-quota-label")
+    assert not label.hidden
+    assert label.textContent == "8 of 8 TPU chips remaining"
+    jupyter.set_value("#tpu-acc", "v5e")
+    opts = {o.attributes.get("value"): o
+            for o in jupyter.query_all("#tpu-topo option")}
+    assert set(opts) == {"2x4", "4x4"}
+    assert not opts["2x4"].disabled          # 8 chips: exactly fits
+    assert opts["4x4"].disabled              # 16 chips: over the 8 budget
+    assert "(over quota)" in opts["4x4"].textContent
+
+
+def test_spawner_quota_counts_used_chips(kube):
+    """used=8 of hard=8 leaves nothing: every topology is disabled and the
+    remaining-chips label says 0."""
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app
+
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": "user1"},
+        "spec": {"hard": {"google.com/tpu": "8"}},
+    })
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "holder-0", "namespace": "user1"},
+        "spec": {"containers": [{"name": "c", "resources": {
+            "limits": {"google.com/tpu": "8"}}}]},
+    })
+    jupyter = harness("jupyter", create_app, kube)
+    jupyter.click("#new-notebook")
+    assert jupyter.text("#tpu-quota-label") == \
+        "0 of 8 TPU chips remaining"
+    jupyter.set_value("#tpu-acc", "v5e")
+    opts = jupyter.query_all("#tpu-topo option")
+    assert opts and all(o.disabled for o in opts)
+
+
+def test_spawner_slice_change_preserves_topology_pick(kube):
+    """Changing the slice count rebuilds the topology list; the user's pick
+    must survive while it stays admissible, and fall to the first enabled
+    option only when it doesn't."""
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app
+
+    kube.add_tpu_node("tpu-node-2", topology="4x4")
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": "user1"},
+        "spec": {"hard": {"google.com/tpu": "32"}},
+    })
+    jupyter = harness("jupyter", create_app, kube)
+    jupyter.click("#new-notebook")
+    jupyter.set_value("#tpu-acc", "v5e")
+    jupyter.set_value("#tpu-topo", "4x4")
+    # 2 slices of 4x4 = 32 chips: still fits, pick must survive.
+    jupyter.set_value("#tpu-slices", "2")
+    assert jupyter.get("tpu-topo").value == "4x4"
+    # 3 slices of 4x4 = 48 chips: over budget -> falls to 2x4 (24 fits).
+    jupyter.set_value("#tpu-slices", "3")
+    topo = jupyter.get("tpu-topo")
+    assert topo.value == "2x4"
+    opts = {o.attributes.get("value"): o
+            for o in jupyter.query_all("#tpu-topo option")}
+    assert opts["4x4"].disabled and not opts["2x4"].disabled
+
+
 def test_deferred_out_of_order_fetch_basics(kube, jupyter):
     """Mechanics: with deferred mode on, fetches pend; awaits suspend; the
     test delivers responses in ANY order and continuations run then."""

@@ -1,0 +1,539 @@
+"""ResourceQuota enforcement: the TPU-chip-quota north star, made real.
+
+The reference gets quota admission from the real apiserver its KinD CI runs
+(reference profile_controller.go:253-280 creates the object; kube-apiserver
+denies).  Here ``testing/fake.py`` plays the apiserver, so these tests pin
+the admission plugin's contract: 403 on exceed (dry-run included),
+status.used bookkeeping, release on delete and on terminal phase, and the
+wire transport (httpkube) carrying the denial as a typed Forbidden.
+"""
+import pytest
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s import quota as quota_mod
+from kubeflow_tpu.platform.k8s.types import POD, RESOURCEQUOTA
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_quota(ns, hard, name="kf-resource-quota"):
+    return {
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"hard": hard},
+    }
+
+
+def make_pod(ns, name, *, tpu=None, cpu=None, memory=None, limits_only=False):
+    res = {}
+    if tpu is not None:
+        res["google.com/tpu"] = str(tpu)
+    if cpu is not None:
+        res["cpu"] = cpu
+    if memory is not None:
+        res["memory"] = memory
+    resources = {"limits": res} if limits_only else {"requests": res,
+                                                    "limits": dict(res)}
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"containers": [{"name": "main", "resources": resources}]},
+    }
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("u")
+    return k
+
+
+# -- quantity math -----------------------------------------------------------
+
+@pytest.mark.parametrize("q,want", [
+    ("8", 8.0), (8, 8.0), ("500m", 0.5), ("1500m", 1.5),
+    ("2Gi", 2 * 2**30), ("128Mi", 128 * 2**20), ("1k", 1000.0),
+    ("0.5", 0.5), ("1e3", 1000.0),
+])
+def test_parse_quantity(q, want):
+    assert quota_mod.parse_quantity(q) == want
+
+
+@pytest.mark.parametrize("v,key,want", [
+    (8.0, "google.com/tpu", "8"), (0.5, "cpu", "500m"),
+    (1.5, "cpu", "1500m"),
+    (2 * 2**30, "memory", "2Gi"), (3 * 2**20, "requests.memory", "3Mi"),
+    (1000.0, "cpu", "1000"),
+    # Counted resources stay decimal even at exact binary multiples —
+    # the apiserver never writes "1Ki" chips or pods.
+    (1024.0, "google.com/tpu", "1024"), (2048.0, "pods", "2048"),
+])
+def test_format_quantity(v, key, want):
+    assert quota_mod.format_quantity(v, key) == want
+
+
+def test_pod_usage_requests_default_from_limits():
+    pod = make_pod("u", "p", tpu=8, limits_only=True)
+    usage = quota_mod.pod_quota_usage(pod)
+    assert usage["requests.google.com/tpu"] == 8.0
+    assert usage["limits.google.com/tpu"] == 8.0
+    assert usage["pods"] == 1.0
+
+
+def test_init_containers_contribute_max_not_sum():
+    """Init containers run sequentially: two 2Gi inits + a 2Gi main is a
+    2Gi pod (the real plugin's rule), not 4Gi — a sum would falsely deny
+    pods the real cluster runs."""
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "u"},
+        "spec": {
+            "initContainers": [
+                {"name": "i1", "resources": {"requests": {"memory": "2Gi"}}},
+                {"name": "i2", "resources": {"requests": {"memory": "2Gi"}}},
+            ],
+            "containers": [
+                {"name": "m", "resources": {"requests": {"memory": "2Gi"}}},
+            ],
+        },
+    }
+    usage = quota_mod.pod_quota_usage(pod)
+    assert usage["requests.memory"] == 2 * 2**30
+    # A big init and a small main: the init phase dominates.
+    pod["spec"]["initContainers"][0]["resources"]["requests"]["memory"] = "8Gi"
+    assert quota_mod.pod_quota_usage(pod)["requests.memory"] == 8 * 2**30
+
+
+@pytest.mark.parametrize("bad", ["nan", "inf", "-inf", "NaN", "", "abc"])
+def test_parse_quantity_rejects_junk(bad):
+    """Non-finite values would defeat every comparison gate (NaN compares
+    False against any limit) — they must fail parse, not slip through."""
+    with pytest.raises(ValueError):
+        quota_mod.parse_quantity(bad)
+
+
+def test_pod_update_quota_admission_and_rollback(kube):
+    """In-place resize charges the delta; junk quantities on update/patch
+    roll back without poisoning the namespace."""
+    kube.create(make_quota("u", {"cpu": "4"}))
+    kube.create(make_pod("u", "p", cpu="2"))
+    p = kube.get(POD, "p", "u")
+    # Resize 2 -> 5 exceeds hard=4 (delta 3 vs remaining 2): Forbidden.
+    p["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "5"
+    p["spec"]["containers"][0]["resources"]["limits"]["cpu"] = "5"
+    with pytest.raises(errors.Forbidden):
+        kube.update(p)
+    # Junk via patch: typed Invalid, store rolled back, namespace healthy.
+    with pytest.raises(errors.Invalid):
+        kube.patch(POD, "p", {"spec": {"containers": [
+            {"name": "main", "resources": {"requests": {"cpu": "abc"}}}]}},
+            "u", patch_type="merge")
+    kube.create(make_pod("u", "p2", cpu="2"))  # still admits fine
+    # A within-quota resize works and is re-accounted.
+    p = kube.get(POD, "p", "u")
+    p["spec"]["containers"][0]["resources"]["requests"]["cpu"] = "1"
+    p["spec"]["containers"][0]["resources"]["limits"]["cpu"] = "1"
+    kube.update(p)
+    rq = kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")
+    assert rq["status"]["used"]["cpu"] == "3"
+
+
+def test_nan_quota_rejected_at_write(kube):
+    with pytest.raises(errors.Invalid):
+        kube.create(make_quota("u", {"google.com/tpu": "nan"}))
+    with pytest.raises(errors.Invalid):
+        kube.create(make_pod("u", "p", cpu="inf"))
+
+
+def test_usage_key_spellings():
+    # Bare and requests.-prefixed spellings hit the same usage bucket —
+    # both appear in the wild for extended resources.
+    assert quota_mod.usage_key("google.com/tpu") == "requests.google.com/tpu"
+    assert quota_mod.usage_key("requests.google.com/tpu") == \
+        "requests.google.com/tpu"
+    assert quota_mod.usage_key("cpu") == "requests.cpu"
+    assert quota_mod.usage_key("limits.memory") == "limits.memory"
+    assert quota_mod.usage_key("pods") == "pods"
+
+
+# -- admission ---------------------------------------------------------------
+
+def test_over_quota_pod_denied_with_apiserver_phrasing(kube):
+    """The VERDICT's exact complaint scenario: 64 chips into an 8-chip
+    quota must be forbidden, not admitted."""
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    with pytest.raises(errors.Forbidden) as exc:
+        kube.create(make_pod("u", "greedy", tpu=64))
+    msg = str(exc.value)
+    assert 'pods "greedy" is forbidden' in msg
+    assert "exceeded quota: kf-resource-quota" in msg
+    assert "requested: google.com/tpu=64" in msg
+    assert "limited: google.com/tpu=8" in msg
+
+
+def test_dry_run_create_also_denied(kube):
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    with pytest.raises(errors.Forbidden):
+        kube.create(make_pod("u", "greedy", tpu=16), dry_run=True)
+    # And a dry-run denial persists nothing.
+    assert kube.list(POD, "u") == []
+
+
+def test_within_quota_admitted_and_used_tracked(kube):
+    kube.create(make_quota("u", {"google.com/tpu": "16", "pods": "10"}))
+    kube.create(make_pod("u", "w0", tpu=8))
+    rq = kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")
+    assert rq["status"]["used"]["google.com/tpu"] == "8"
+    assert rq["status"]["used"]["pods"] == "1"
+    assert rq["status"]["hard"]["google.com/tpu"] == "16"
+    kube.create(make_pod("u", "w1", tpu=8))
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "status"]["used"]["google.com/tpu"] == "16"
+    # Quota full: one more chip is over.
+    with pytest.raises(errors.Forbidden):
+        kube.create(make_pod("u", "w2", tpu=1))
+
+
+def test_delete_releases_quota(kube):
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    kube.create(make_pod("u", "w0", tpu=8))
+    with pytest.raises(errors.Forbidden):
+        kube.create(make_pod("u", "w1", tpu=8))
+    kube.delete(POD, "w0", "u")
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "status"]["used"]["google.com/tpu"] == "0"
+    kube.create(make_pod("u", "w1", tpu=8))  # fits again
+
+
+def test_terminal_phase_releases_quota(kube):
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    kube.create(make_pod("u", "w0", tpu=8))
+    kube.set_pod_phase("u", "w0", "Succeeded")
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "status"]["used"]["google.com/tpu"] == "0"
+    kube.create(make_pod("u", "w1", tpu=8))
+
+
+def test_quota_created_after_pods_sees_existing_usage(kube):
+    kube.create(make_pod("u", "w0", tpu=8))
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    rq = kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")
+    assert rq["status"]["used"]["google.com/tpu"] == "8"
+    with pytest.raises(errors.Forbidden):
+        kube.create(make_pod("u", "w1", tpu=1))
+
+
+def test_cpu_memory_quantities_enforced(kube):
+    kube.create(make_quota("u", {"cpu": "2", "memory": "4Gi"}))
+    kube.create(make_pod("u", "a", cpu="1500m", memory="2Gi"))
+    with pytest.raises(errors.Forbidden) as exc:
+        kube.create(make_pod("u", "b", cpu="600m", memory="1Gi"))
+    assert "requested: cpu=600m, used: cpu=1500m, limited: cpu=2" in \
+        str(exc.value)
+    kube.create(make_pod("u", "c", cpu="500m", memory="2Gi"))
+
+
+def test_pod_without_constrained_resource_counts_zero(kube):
+    # Documented deviation from the strict plugin: CPU-only sidecars stay
+    # deployable in a TPU-quota'd namespace.
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    kube.create(make_pod("u", "sidecar", cpu="1"))
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "status"]["used"]["google.com/tpu"] == "0"
+
+
+def test_unquota_namespace_unaffected(kube):
+    kube.add_namespace("free")
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    kube.create(make_pod("free", "big", tpu=256))  # no quota there
+
+
+def test_cascade_delete_releases_quota(kube):
+    kube.create(make_quota("u", {"google.com/tpu": "16"}))
+    owner = kube.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "nb", "namespace": "u"}, "spec": {},
+    })
+    pod = make_pod("u", "nb-0", tpu=8)
+    pod["metadata"]["ownerReferences"] = [{
+        "apiVersion": "apps/v1", "kind": "StatefulSet", "name": "nb",
+        "uid": owner["metadata"]["uid"],
+    }]
+    kube.create(pod)
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "status"]["used"]["google.com/tpu"] == "8"
+    from kubeflow_tpu.platform.k8s.types import STATEFULSET
+
+    kube.delete(STATEFULSET, "nb", "u")
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "status"]["used"]["google.com/tpu"] == "0"
+
+
+def test_tpu_remaining_helper(kube):
+    kube.create(make_quota("u", {"google.com/tpu": "32"}))
+    kube.create(make_pod("u", "w0", tpu=8))
+    quotas = kube.list(RESOURCEQUOTA, "u")
+    assert quota_mod.tpu_remaining(quotas) == {
+        "hard": 32, "used": 8, "remaining": 24}
+    assert quota_mod.tpu_remaining([]) is None
+
+
+def test_malformed_hard_rejected_at_write_time(kube):
+    """A typo'd quantity must fail the quota WRITE (as the real apiserver
+    does), not crash every later pod admission."""
+    with pytest.raises(errors.Invalid):
+        kube.create(make_quota("u", {"google.com/tpu": "abc"}))
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    rq = kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")
+    rq["spec"]["hard"]["google.com/tpu"] = "lots"
+    with pytest.raises(errors.Invalid):
+        kube.update(rq)
+    with pytest.raises(errors.Invalid):
+        kube.patch(RESOURCEQUOTA, "kf-resource-quota",
+                   {"spec": {"hard": {"cpu": "many"}}}, "u")
+    # The failed patch rolled back: the stored object is still valid and
+    # admission still works.
+    assert kube.get(RESOURCEQUOTA, "kf-resource-quota", "u")[
+        "spec"]["hard"] == {"google.com/tpu": "8"}
+    kube.create(make_pod("u", "ok", tpu=8))
+
+
+# -- the spawner surface -----------------------------------------------------
+
+@pytest.fixture
+def jwa_kube():
+    k = FakeKube()
+    k.add_namespace("user1")
+    k.add_tpu_node("tpu-1", topology="2x4")
+    k.add_tpu_node("tpu-2", topology="4x4")
+    return k
+
+
+@pytest.fixture
+def jwa(jwa_kube):
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app
+
+    return Client(create_app(jwa_kube, secure_cookies=False))
+
+
+USER = {"kubeflow-userid": "alice@example.com"}
+
+
+def test_spawn_preflight_denies_over_quota_with_remaining(jwa, jwa_kube):
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "8"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "big",
+                       "tpus": {"accelerator": "v5e", "topology": "4x4"}},
+                 headers=USER)
+    assert r.status_code == 403, r.get_data(as_text=True)
+    msg = r.get_json()["log"] if "log" in (r.get_json() or {}) else \
+        r.get_data(as_text=True)
+    assert "TPU quota exceeded" in msg
+    assert "requested 16" in msg and "remaining 8" in msg
+
+
+def test_spawn_preflight_counts_existing_usage(jwa, jwa_kube):
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "16"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "first",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+    # The notebook exists but its pods don't yet — simulate the worker pod
+    # so used=8, then a 16-chip spawn must be denied with remaining 8.
+    jwa_kube.create(make_pod("user1", "first-0", tpu=8))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "second",
+                       "tpus": {"accelerator": "v5e", "topology": "4x4"}},
+                 headers=USER)
+    assert r.status_code == 403
+    assert "remaining 8" in r.get_data(as_text=True)
+    # An 8-chip spawn still fits.
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "third",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+
+
+def test_back_to_back_spawns_cannot_both_slip_under_quota(jwa, jwa_kube):
+    """The second of two quick spawns must be denied even though the first
+    notebook's pods don't exist yet — the preflight counts declared
+    notebook footprints, not just materialized pods (review finding: the
+    stranding the preflight exists to prevent)."""
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "8"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "first",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+    # No pods created; status.used is still 0.  The declared 8 chips of
+    # "first" must still block another 8-chip ask.
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "second",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 403
+    assert "TPU quota exceeded" in r.get_data(as_text=True)
+
+
+def test_stopped_notebooks_release_their_declared_claim(jwa, jwa_kube):
+    """A stopped notebook holds no pods; its declared chips must not block
+    a new spawn (mirrors the real cluster, where quota frees on scale-0)."""
+    from kubeflow_tpu.platform.apis import notebook as nbapi
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "8"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "first",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200
+    r = jwa.patch("/api/namespaces/user1/notebooks/first",
+                  json={"stopped": True}, headers=USER)
+    assert r.status_code == 200
+    assert nbapi.is_stopped(jwa_kube.get(NOTEBOOK, "first", "user1"))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "second",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+
+
+def test_spawn_preflight_multislice_counts_all_slices(jwa, jwa_kube):
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "24"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "ms",
+                       "tpus": {"accelerator": "v5e", "topology": "4x4",
+                                "slices": 2}},
+                 headers=USER)
+    assert r.status_code == 403
+    assert "requested 32" in r.get_data(as_text=True)
+
+
+def test_spawn_preflight_cpu_quota(jwa, jwa_kube):
+    jwa_kube.create(make_quota("user1", {"cpu": "1"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "fat", "cpu": "4",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 403
+    assert "namespace quota exceeded" in r.get_data(as_text=True)
+
+
+def test_restart_runs_quota_preflight(jwa, jwa_kube):
+    """PATCH stopped=false re-claims chips: with the budget now held by
+    another notebook, the restart must 403 with the user-facing message
+    instead of stranding at pod admission (review finding)."""
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "8"}))
+    assert jwa.post("/api/namespaces/user1/notebooks",
+                    json={"name": "first",
+                          "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                    headers=USER).status_code == 200
+    assert jwa.patch("/api/namespaces/user1/notebooks/first",
+                     json={"stopped": True}, headers=USER).status_code == 200
+    assert jwa.post("/api/namespaces/user1/notebooks",
+                    json={"name": "second",
+                          "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                    headers=USER).status_code == 200
+    r = jwa.patch("/api/namespaces/user1/notebooks/first",
+                  json={"stopped": False}, headers=USER)
+    assert r.status_code == 403
+    assert "TPU quota exceeded" in r.get_data(as_text=True)
+    # Free the budget: stopping "second" lets "first" restart.
+    assert jwa.patch("/api/namespaces/user1/notebooks/second",
+                     json={"stopped": True}, headers=USER).status_code == 200
+    assert jwa.patch("/api/namespaces/user1/notebooks/first",
+                     json={"stopped": False}, headers=USER).status_code == 200
+
+
+def test_malformed_user_quantity_is_400_not_500(jwa, jwa_kube):
+    jwa_kube.create(make_quota("user1", {"cpu": "8"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "bad", "cpu": "abc",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 400, r.get_data(as_text=True)
+    assert "invalid cpu quantity" in r.get_data(as_text=True)
+
+
+def test_malformed_pod_quantities_rejected_typed(kube):
+    """Raw pod create with junk quantities gets a typed Invalid (422), and
+    never enters the store to poison later admissions."""
+    kube.create(make_quota("u", {"cpu": "8"}))
+    with pytest.raises(errors.Invalid) as exc:
+        kube.create({
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "junk", "namespace": "u"},
+            "spec": {"containers": [{"name": "c", "resources": {
+                "requests": {"cpu": "abc"}}}]},
+        })
+    assert "invalid quantity 'abc'" in str(exc.value)
+    kube.create(make_pod("u", "fine", cpu="1"))  # namespace not poisoned
+
+
+def test_tpus_budget_counts_declared_notebooks(jwa, jwa_kube):
+    """The picker budget must use the same declared-notebook accounting as
+    the preflight, so the UI never enables a pick the submit 403s."""
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "16"}))
+    assert jwa.post("/api/namespaces/user1/notebooks",
+                    json={"name": "first",
+                          "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                    headers=USER).status_code == 200
+    # No pods yet (status.used == 0) — but 8 chips are declared.
+    r = jwa.get("/api/namespaces/user1/tpus", headers=USER)
+    assert r.get_json()["quota"] == {"hard": 16, "used": 8, "remaining": 8}
+
+
+def test_declared_chips_not_double_counted(jwa, jwa_kube):
+    """A CR carrying BOTH spec.tpu and a redundant template chip limit
+    declares its chips once: spec.tpu is authoritative."""
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "16"}))
+    jwa_kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "redundant", "namespace": "user1"},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4"},
+                 "template": {"spec": {"containers": [{
+                     "name": "redundant", "resources": {
+                         "limits": {"google.com/tpu": "8"}}}]}}},
+    })
+    r = jwa.get("/api/namespaces/user1/tpus", headers=USER)
+    assert r.get_json()["quota"] == {"hard": 16, "used": 8, "remaining": 8}
+    # And a second legitimate 8-chip spawn is NOT falsely denied.
+    assert jwa.post("/api/namespaces/user1/notebooks",
+                    json={"name": "ok",
+                          "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                    headers=USER).status_code == 200
+
+
+def test_tpus_endpoint_reports_chip_budget(jwa, jwa_kube):
+    r = jwa.get("/api/namespaces/user1/tpus", headers=USER)
+    assert r.get_json()["quota"] is None
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "32"}))
+    jwa_kube.create(make_pod("user1", "w0", tpu=8))
+    r = jwa.get("/api/namespaces/user1/tpus", headers=USER)
+    assert r.get_json()["quota"] == {"hard": 32, "used": 8, "remaining": 24}
+
+
+# -- the wire transport ------------------------------------------------------
+
+def test_denial_crosses_httpkube_as_typed_forbidden():
+    from kubeflow_tpu.platform.k8s.client import RestKubeClient
+    from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+
+    kube = FakeKube()
+    kube.add_namespace("u")
+    kube.create(make_quota("u", {"google.com/tpu": "8"}))
+    server = HttpKubeServer(kube).start()
+    try:
+        client = RestKubeClient(server.base_url)
+        with pytest.raises(errors.Forbidden) as exc:
+            client.create(make_pod("u", "greedy", tpu=64))
+        assert "exceeded quota" in str(exc.value)
+        # Within-quota create works and status.used crosses back.
+        client.create(make_pod("u", "ok", tpu=8))
+        rq = client.get(RESOURCEQUOTA, "kf-resource-quota", "u")
+        assert rq["status"]["used"]["google.com/tpu"] == "8"
+    finally:
+        server.stop()
