@@ -22,6 +22,78 @@ def test_bench_llama_smoke_emits_metric(capsys, monkeypatch):
 
 
 @pytest.mark.slow
+def test_bench_llama_1b4_smoke_emits_metric(capsys, monkeypatch):
+    monkeypatch.setenv("KFT_BENCH_SMOKE", "1")
+    import bench
+
+    bench.llama_1b4_bench()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] == "llama1b4_8k_train_tokens_per_sec"
+    assert out["value"] > 0 and out["xla_tokens_per_sec"] > 0
+    assert {"mfu", "model_tflops_per_sec", "mfu_mean",
+            "model_gflops_per_token"} <= set(out)
+
+
+@pytest.mark.slow
+def test_bench_main_emits_primary_first_and_last(capsys, monkeypatch):
+    """The driver parses the LAST line: main() must print the primary
+    metric first (so a truncated run still computed it) AND re-print it
+    last (so the final line is always the primary)."""
+    monkeypatch.setenv("KFT_BENCH_SMOKE", "1")
+    import bench
+
+    for name, val in (("BATCH", 4), ("IMAGE", 32), ("WARMUP", 1),
+                      ("STEPS", 1), ("WINDOWS", 1)):
+        monkeypatch.setattr(bench, name, val)
+    bench.main([])
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    metrics = [l["metric"] for l in lines]
+    assert metrics[0] == "llama8k_train_tokens_per_sec"
+    assert metrics[-1] == "llama8k_train_tokens_per_sec"
+    assert lines[0] == lines[-1]
+    assert "llama1b4_8k_train_tokens_per_sec" in metrics
+    assert "resnet50_images_per_sec_per_chip" in metrics
+
+
+def test_lm_train_flops_per_token_accounting():
+    """The MFU accounting matches a by-hand computation of the bench's own
+    8k config (the number written down in BASELINE.md)."""
+    import bench
+    from kubeflow_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig(vocab_size=8192, dim=1024, n_layers=4, n_heads=8,
+                      n_kv_heads=8, ffn_dim=4096, max_seq_len=8192)
+    # fwd, per token: proj 8d^2 + causal attn 2sd + swiglu 6*d*ffn per
+    # layer, plus the vocab head; train = 3x fwd.
+    d, s = 1024, 8192
+    per_layer = 8 * d * d + 2 * s * d + 6 * d * 4096
+    fwd = 4 * per_layer + 2 * d * 8192
+    assert bench.lm_train_flops_per_token(cfg, s) == 3.0 * fwd
+    # ~0.654 GFLOPs/token — the VERDICT r3 back-of-envelope.
+    assert 0.6e9 < bench.lm_train_flops_per_token(cfg, s) < 0.7e9
+    # GQA: fewer kv heads shrink only the k/v projections.
+    gqa = LlamaConfig(vocab_size=8192, dim=1024, n_layers=4, n_heads=8,
+                      n_kv_heads=2, ffn_dim=4096, max_seq_len=8192)
+    assert bench.lm_train_flops_per_token(gqa, s) < \
+        bench.lm_train_flops_per_token(cfg, s)
+
+
+def test_bench_resnet_band_tripwire():
+    """The regression tripwire flags a mean ratio below the band floor
+    (pure check — the field's presence on the emitted line is asserted by
+    the slow resnet smoke)."""
+    import bench
+
+    assert bench.resnet_band(1.0) == "pass"
+    assert bench.resnet_band(bench.RESNET_REGRESSION_BAND) == "pass"
+    assert bench.resnet_band(bench.RESNET_REGRESSION_BAND - 1e-9) == \
+        "REGRESSION"
+    assert bench.resnet_band(0.3) == "REGRESSION"
+
+
+@pytest.mark.slow
 def test_bench_resnet_emits_metric(capsys, monkeypatch):
     import bench
 
@@ -33,7 +105,7 @@ def test_bench_resnet_emits_metric(capsys, monkeypatch):
     out = json.loads(line)
     assert out["metric"] == "resnet50_images_per_sec_per_chip"
     assert {"value", "vs_baseline", "value_mean_window",
-            "vs_baseline_mean"} <= set(out)
+            "vs_baseline_mean", "band"} <= set(out)
     assert out["value"] >= out["value_mean_window"] > 0
 
 
