@@ -1,21 +1,27 @@
 #!/usr/bin/env python3
-"""Hardware measurement lane for BASELINE.md configs 2-3 (VERDICT r2 #10).
+"""Hardware measurement lane for BASELINE.md configs 2-4 (VERDICT r2 #10,
+r3 #3).
 
-The dev/CI image ships no TensorFlow and no torch_xla (and has no network
-egress to install them), so the throughput numbers for
+The dev/CI image ships no TensorFlow-TPU runtime and no torch_xla (and has
+no network egress to install them), so the throughput numbers for
 
   config 2: jupyter-tensorflow-full single-device notebook (ResNet50 CIFAR)
   config 3: jupyter-pytorch-full -> PyTorch/XLA notebook (BERT fine-tune)
+  config 4: codeserver-python image with JAX + Flax (ViT-B/16 training)
 
-have never been measured.  This script IS the measurement: run it on any
-TF- or torch-XLA-capable TPU VM (one command, emitted as the
-``hardware-baselines`` workflow by ci/workflows.py) and it
+were unmeasured.  This script IS the measurement: run it on any capable
+TPU VM (one command, emitted as the ``hardware-baselines`` workflow by
+ci/workflows.py) and it
 
   * measures whichever runtimes are importable at the scales the example
-    notebooks (examples/08, examples/03) define,
+    notebooks (examples/08, examples/03, examples/04) define,
   * prints one JSON line per config (measured or skipped+reason), and
-  * appends measured numbers to BASELINE.md with the date, closing the
-    standing gap the moment such an environment exists.
+  * records measured numbers in BASELINE.md with the date — REPLACING any
+    prior row for the same config (re-running the lane must not grow the
+    file; VERDICT r3 item 6).
+
+Config 4 runs on the same JAX stack bench.py already drives through the
+tunnel, so on THIS dev image it measures TPU-attached.
 
 Exit codes: 0 = every config measured; 3 = at least one config skipped
 because its runtime is absent (the expected result on the dev image —
@@ -26,10 +32,14 @@ from __future__ import annotations
 import datetime
 import json
 import os
+import re
 import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # config 4 imports the kubeflow_tpu model zoo
+    sys.path.insert(0, REPO)
+BLOCK_HEADER = "Hardware lane measurements"
 
 # The example notebooks' training scales (examples/08_resnet_cifar_tensorflow
 # and examples/03_bert_finetune_pytorch_xla "real" branches).
@@ -40,6 +50,9 @@ BERT_BATCH = 32
 BERT_SEQ = 128
 BERT_STEPS = 30
 BERT_WARMUP = 3
+VIT_BATCH = 64
+VIT_STEPS = 20
+VIT_WARMUP = 3
 
 
 def measure_tf_resnet() -> dict:
@@ -143,25 +156,121 @@ def measure_torch_xla_bert() -> dict:
             "batch": BERT_BATCH, "seq": BERT_SEQ, "steps": BERT_STEPS}
 
 
-def append_to_baseline(results) -> None:
+def measure_jax_vit() -> dict:
+    """Config 4: ViT-B/16 training step (JAX + Flax, bf16, adamw) at
+    examples/04's batch, single device.  Reports the analytic-matmul
+    roofline position too: achieved model TF/s (2*M*N*K accounting over
+    the patch-embed conv, qkvo, attention and MLP matmuls; train = 3x
+    fwd) against the 197 TF/s v5e bf16 peak."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from kubeflow_tpu.models import create_model
+        from kubeflow_tpu.train import (
+            create_train_state,
+            make_classification_train_step,
+        )
+    except ImportError as e:
+        return {"config": 4, "metric": "jax_vit_b16_images_per_sec",
+                "skipped": f"runtime not installed ({e})"}
+
+    device = jax.devices()[0].platform
+    smoke = bool(int(os.environ.get("KFT_HWLANE_SMOKE", "0")))
+    name, image, batch, steps, warmup = (
+        ("vit_debug", 32, 8, 2, 1) if smoke
+        else ("vit_b16", 224, VIT_BATCH, VIT_STEPS, VIT_WARMUP)
+    )
+    model = create_model(name, dtype=jnp.bfloat16) if not smoke \
+        else create_model(name)
+    rng = jax.random.key(0)
+    images = jax.random.normal(rng, (batch, image, image, 3), jnp.float32)
+    labels = jax.random.randint(
+        jax.random.fold_in(rng, 1), (batch,), 0, model.cfg.num_classes
+    )
+    state = create_train_state(rng, model, images, optax.adamw(3e-4))
+    step = jax.jit(
+        make_classification_train_step(has_batch_stats=False),
+        donate_argnums=(0,),
+    )
+    data = (images, labels)
+    for _ in range(warmup):
+        state, m = step(state, data)
+    float(m["loss"])  # scalar fetch: full device sync through the tunnel
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, data)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    ips = batch * steps / dt
+
+    cfg = model.cfg
+    n_patches = (cfg.image_size // cfg.patch_size) ** 2
+    s = n_patches + 1  # cls token
+    d = cfg.dim
+    patch_embed = 2 * n_patches * d * (cfg.patch_size ** 2 * 3)
+    per_layer = (4 * 2 * s * d * d            # qkvo projections
+                 + 2 * 2 * s * s * d          # scores + values (full)
+                 + 2 * 2 * s * d * cfg.mlp_dim)  # MLP in + out
+    head = 2 * d * cfg.num_classes
+    train_flops = 3 * (patch_embed + cfg.n_layers * per_layer + head)
+    tfs = ips * train_flops / 1e12
+    return {"config": 4, "metric": "jax_vit_b16_images_per_sec",
+            "value": round(ips, 1), "device": device, "batch": batch,
+            "steps": steps,
+            "model_gflops_per_image": round(train_flops / 1e9, 1),
+            "model_tflops_per_sec": round(tfs, 1),
+            "mfu_vs_197tf": round(tfs / 197.0, 4)}
+
+
+def record_in_baseline(results, path=None) -> None:
+    """Replace-not-append (VERDICT r3 item 6): BASELINE.md keeps exactly
+    ONE "Hardware lane measurements" block with one row per config.  Rows
+    for configs not re-measured this run are carried over unchanged (their
+    own measurement date stays in the row); same-config rows are replaced.
+    Running the lane twice yields one identical block."""
     measured = [r for r in results if "value" in r]
     if not measured:
         return
+    path = path or os.path.join(REPO, "BASELINE.md")
+    text = open(path).read()
+
+    # Collect rows from every existing block (the r3 file carried two
+    # near-duplicate blocks; later blocks win), then remove all blocks.
+    block_re = re.compile(
+        rf"\n*^{BLOCK_HEADER}[^\n]*\n\n(?:- config [^\n]*\n)*",
+        re.M,
+    )
+    rows: dict[int, str] = {}
+    for m in block_re.finditer(text):
+        for line in m.group(0).splitlines():
+            lm = re.match(r"- config (\d+):", line)
+            if lm:
+                rows[int(lm.group(1))] = line
+    text = block_re.sub("\n", text)
+
     stamp = datetime.date.today().isoformat()
-    lines = ["", f"Hardware lane measurements ({stamp}, "
-                 "ci/hardware_baselines.py):", ""]
     for r in measured:
-        lines.append(f"- config {r['config']}: {r['metric']} = "
-                     f"{r['value']} ({json.dumps({k: v for k, v in r.items() if k not in ('config', 'metric', 'value')})})")
-    with open(os.path.join(REPO, "BASELINE.md"), "a") as f:
-        f.write("\n".join(lines) + "\n")
+        extras = {k: v for k, v in r.items()
+                  if k not in ("config", "metric", "value")}
+        rows[r["config"]] = (
+            f"- config {r['config']}: {r['metric']} = {r['value']} "
+            f"({json.dumps(extras)}) [measured {stamp}]"
+        )
+    block = [f"{BLOCK_HEADER} (ci/hardware_baselines.py; same-config "
+             "rows are replaced on re-run, date per row):", ""]
+    block += [rows[k] for k in sorted(rows)]
+    with open(path, "w") as f:
+        f.write(text.rstrip("\n") + "\n\n" + "\n".join(block) + "\n")
 
 
 def main() -> int:
-    results = [measure_tf_resnet(), measure_torch_xla_bert()]
+    results = [measure_tf_resnet(), measure_torch_xla_bert(),
+               measure_jax_vit()]
     for r in results:
         print(json.dumps(r), flush=True)
-    append_to_baseline(results)
+    record_in_baseline(results, path=os.environ.get("KFT_BASELINE_MD"))
     return 3 if any("skipped" in r for r in results) else 0
 
 

@@ -114,12 +114,12 @@ def test_hardware_baselines_lane_emits_and_reports(tmp_path):
     manifest = wf.to_argo()
     assert manifest["kind"] == "Workflow"
 
-    # Force both runtimes absent so the test is hermetic and fast even on
-    # images that DO ship tensorflow.
+    # Force all three runtimes absent so the test is hermetic and fast even
+    # on images that DO ship tensorflow/jax.
     repo = os.path.join(os.path.dirname(__file__), "..", "..")
     shim = tmp_path / "shim"
     shim.mkdir()
-    for mod in ("tensorflow", "torch_xla"):
+    for mod in ("tensorflow", "torch_xla", "jax"):
         (shim / mod).mkdir()
         (shim / mod / "__init__.py").write_text(
             "raise ImportError('hermetically absent')\n")
@@ -131,5 +131,82 @@ def test_hardware_baselines_lane_emits_and_reports(tmp_path):
     )
     assert proc.returncode == 3, proc.stderr
     lines = [_json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
-    assert {r["config"] for r in lines} == {2, 3}
+    assert {r["config"] for r in lines} == {2, 3, 4}
     assert all("skipped" in r for r in lines)
+
+
+def test_hardware_baselines_record_replaces_not_appends(tmp_path):
+    """VERDICT r3 item 6: re-running the lane must yield ONE measurement
+    block — same-config rows replace prior ones (old blocks, including the
+    r3 duplicated pair, are collapsed), other configs' rows carry over."""
+    from ci.hardware_baselines import record_in_baseline
+
+    md = tmp_path / "BASELINE.md"
+    md.write_text(
+        "# Baselines\n\nprose stays\n\n"
+        "Hardware lane measurements (2026-07-31, ci/hardware_baselines.py):\n\n"
+        '- config 2: tf_resnet50_cifar_images_per_sec = 77.7 ({"device": "cpu/gpu"})\n'
+        "\n"
+        "Hardware lane measurements (2026-07-31, ci/hardware_baselines.py):\n\n"
+        '- config 2: tf_resnet50_cifar_images_per_sec = 77.5 ({"device": "cpu/gpu"})\n'
+    )
+    record_in_baseline(
+        [{"config": 4, "metric": "jax_vit_b16_images_per_sec",
+          "value": 900.0, "device": "tpu"}],
+        path=str(md),
+    )
+    text = md.read_text()
+    assert text.count("Hardware lane measurements") == 1
+    assert "prose stays" in text
+    # later duplicate block won; config-2 row carried over exactly once
+    assert text.count("config 2:") == 1 and "77.5" in text and "77.7" not in text
+    assert "config 4: jax_vit_b16_images_per_sec = 900.0" in text
+
+    # Re-measuring config 4 replaces its row, still one block.
+    record_in_baseline(
+        [{"config": 4, "metric": "jax_vit_b16_images_per_sec",
+          "value": 950.0, "device": "tpu"}],
+        path=str(md),
+    )
+    text = md.read_text()
+    assert text.count("Hardware lane measurements") == 1
+    assert "950.0" in text and "900.0" not in text
+    assert text.count("config 2:") == 1
+
+    # Skip-only runs leave the file untouched.
+    before = md.read_text()
+    record_in_baseline([{"config": 3, "skipped": "absent"}], path=str(md))
+    assert md.read_text() == before
+
+
+def test_hardware_baselines_vit_smoke_executes(tmp_path):
+    """The config-4 JAX lane actually executes (KFT_HWLANE_SMOKE shrinks to
+    vit_debug on CPU) and lands a roofline-annotated row in the target
+    BASELINE.md."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.join(os.path.dirname(__file__), "..", "..")
+    md = tmp_path / "BASELINE.md"
+    md.write_text("# Baselines\n")
+    shim = tmp_path / "shim"
+    shim.mkdir()
+    for mod in ("tensorflow", "torch_xla"):
+        (shim / mod).mkdir()
+        (shim / mod / "__init__.py").write_text(
+            "raise ImportError('hermetically absent')\n")
+    env = dict(os.environ)
+    env.update(PYTHONPATH=f"{shim}:{repo}", KFT_HWLANE_SMOKE="1",
+               KFT_BASELINE_MD=str(md), JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "ci", "hardware_baselines.py")],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 3, proc.stderr  # tf/torch_xla still skipped
+    rows = [_json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    vit = next(r for r in rows if r["config"] == 4)
+    assert vit["value"] > 0
+    assert {"model_tflops_per_sec", "mfu_vs_197tf",
+            "model_gflops_per_image"} <= set(vit)
+    assert "config 4: jax_vit_b16_images_per_sec" in md.read_text()
