@@ -81,12 +81,19 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         # over-quota topologies and show "N chips remaining".  Read with the
         # app's own client, not the user's SAR: this reflects what quota
         # admission will do to the spawn regardless of whether the user may
-        # list ResourceQuota objects.  Uses the same max(status.used,
-        # declared) accounting as the pre-flight so the picker never
-        # enables a topology the submit would 403.
+        # list ResourceQuota objects.  Uses the same effective_used
+        # accounting as the pre-flight so the picker never enables a
+        # topology the submit would 403.
         quotas = client.list(RESOURCEQUOTA, ns)
-        remaining = quota_mod.tpu_remaining(
-            quotas, declared=_declared_tpu_chips(ns)) if quotas else None
+        if quotas:
+            running = _running_notebooks(ns)
+            remaining = quota_mod.tpu_remaining(
+                quotas, declared=_declared_tpu_chips(running),
+                workload_pod_used=_notebook_pod_usage(ns, running).get(
+                    "requests.google.com/tpu", 0.0),
+            )
+        else:
+            remaining = None
         return success({"tpus": out, "quota": remaining})
 
     # -- notebooks ------------------------------------------------------------
@@ -261,32 +268,60 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         except HttpError:
             return {}
 
-    def _declared_tpu_chips(ns: str) -> float:
+    def _running_notebooks(ns: str) -> list:
+        """One NOTEBOOK list shared by the declared-usage and pod-usage
+        accounting — the spawn/pre-flight hot path must not pay two
+        O(namespace) LISTs (and two lists could disagree mid-flight)."""
+        return [nb for nb in client.list(NOTEBOOK, ns)
+                if not nbapi.is_stopped(nb)]
+
+    def _declared_tpu_chips(running: list) -> float:
         """Chips declared by running (non-stopped) notebook CRs — counted
         even before their worker pods materialize."""
         return sum(
             _stored_usage(nb).get("requests.google.com/tpu", 0.0)
-            for nb in client.list(NOTEBOOK, ns) if not nbapi.is_stopped(nb)
+            for nb in running
         )
+
+    def _notebook_pod_usage(ns: str, running: list) -> dict:
+        """Aggregate quota footprint of live pods that belong to RUNNING
+        (non-stopped) notebooks — exactly the slice of status.used that
+        the declared CR totals already cover (quota.effective_used).  A
+        just-stopped notebook's still-terminating pods must NOT be
+        subtracted: their CR is excluded from the declared tally, so
+        subtracting the pods too would free chips the apiserver's own
+        admission still counts, and a respawn would pass pre-flight only
+        to strand at pod admission."""
+        running_names = {name_of(nb) for nb in running}
+        usage: dict = {}
+        for pod in client.list(POD, ns):
+            labels = deep_get(pod, "metadata", "labels", default={}) or {}
+            phase = deep_get(pod, "status", "phase", default="")
+            if labels.get(nbapi.LABEL_NOTEBOOK_NAME) in running_names and \
+                    phase not in ("Succeeded", "Failed"):
+                usage = quota_mod.add_usage(
+                    usage, quota_mod.pod_quota_usage(pod))
+        return usage
 
     def _quota_preflight(ns: str, nb) -> None:
         """403 if the notebook's worker pods would exceed a namespace quota.
 
-        Counts against the LARGER of the cluster's live usage (status.used)
-        and the declared footprint of every running notebook CR — a just-
-        accepted notebook claims its chips here before its pods exist, so
-        back-to-back spawns can't both slip under the quota and strand the
-        second one at pod admission.
+        Counts the declared footprint of every running notebook CR (a
+        just-accepted notebook claims its chips here before its pods
+        exist, so back-to-back spawns can't both slip under the quota and
+        strand the second one at pod admission) PLUS live usage by
+        non-notebook pods — see quota.effective_used for why neither a
+        plain status.used nor max(status.used, declared) is enough.
         """
         quotas = client.list(RESOURCEQUOTA, ns)
         if not quotas:
             return
         usage = _notebook_usage(nb)
+        running = _running_notebooks(ns)
         declared: dict = {}
-        for other in client.list(NOTEBOOK, ns):
-            if not nbapi.is_stopped(other):
-                declared = quota_mod.add_usage(declared,
-                                               _stored_usage(other))
+        for other in running:
+            declared = quota_mod.add_usage(declared, _stored_usage(other))
+        nb_pod_used = _notebook_pod_usage(ns, running)
         override = {}
         for q in quotas:
             hard = deep_get(q, "spec", "hard", default={}) or {}
@@ -299,7 +334,9 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
                         used_map.get(key, 0.0) or 0.0)
                 except ValueError:
                     stored = 0.0
-                effective[ukey] = max(stored, declared.get(ukey, 0.0))
+                effective[ukey] = quota_mod.effective_used(
+                    stored, declared.get(ukey, 0.0),
+                    nb_pod_used.get(ukey, 0.0))
             override[name_of(q)] = effective
         violation = quota_mod.find_violation(quotas, usage,
                                              used_override=override)

@@ -239,16 +239,33 @@ def quota_status(quotas: Iterable[Resource], pods: Iterable[Resource] = (),
     return out
 
 
-def tpu_remaining(quotas: Iterable[Resource], *, declared: float = 0.0
+def effective_used(stored: float, declared: float,
+                   workload_pod_used: float) -> float:
+    """Commitment accounting shared by the spawn pre-flight and the picker.
+
+    ``stored`` is the quota's live status.used; ``declared`` is the total
+    claimed by workload CRs (running notebooks) whether or not their pods
+    exist yet; ``workload_pod_used`` is the portion of ``stored``
+    attributable to those CRs' pods.  The effective commitment is
+    ``declared + (stored - workload_pod_used)``: declared CRs count in
+    full (so back-to-back spawns can't both slip under the quota), live
+    pods of OTHER workloads (jobs, bare pods) count on top, and a
+    materialized notebook isn't double-counted through both its CR and its
+    pods.  A plain max(stored, declared) undercounts when chips are held
+    both by non-notebook pods and by a not-yet-materialized notebook.
+    """
+    return declared + max(0.0, stored - workload_pod_used)
+
+
+def tpu_remaining(quotas: Iterable[Resource], *, declared: float = 0.0,
+                  workload_pod_used: float = 0.0
                   ) -> Optional[Dict[str, int]]:
     """Tightest google.com/tpu chip budget across quotas, for the spawner UI.
 
-    ``declared`` is the chip total claimed by not-yet-materialized
-    workloads (running notebook CRs whose pods don't exist yet); the
-    effective used is max(status.used, declared) — the same accounting the
-    spawn pre-flight applies, so the picker and the 403 can't disagree.
-    Returns {"hard": H, "used": U, "remaining": R} or None when no quota
-    constrains TPU chips in the namespace.
+    ``declared``/``workload_pod_used`` feed ``effective_used`` — the same
+    accounting the spawn pre-flight applies, so the picker and the 403
+    can't disagree.  Returns {"hard": H, "used": U, "remaining": R} or
+    None when no quota constrains TPU chips in the namespace.
     """
     best = None
     for q in quotas:
@@ -262,7 +279,7 @@ def tpu_remaining(quotas: Iterable[Resource], *, declared: float = 0.0
                 u = parse_quantity(used_map.get(key, 0.0) or 0.0)
             except ValueError:
                 continue  # malformed quota must not 500 the spawner UI
-            u = max(u, declared)
+            u = effective_used(u, declared, workload_pod_used)
             r = max(0.0, h - u)
             if best is None or r < best["remaining"]:
                 best = {"hard": int(h), "used": int(u), "remaining": int(r)}

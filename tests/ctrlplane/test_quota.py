@@ -23,7 +23,8 @@ def make_quota(ns, hard, name="kf-resource-quota"):
     }
 
 
-def make_pod(ns, name, *, tpu=None, cpu=None, memory=None, limits_only=False):
+def make_pod(ns, name, *, tpu=None, cpu=None, memory=None, limits_only=False,
+             labels=None):
     res = {}
     if tpu is not None:
         res["google.com/tpu"] = str(tpu)
@@ -33,9 +34,12 @@ def make_pod(ns, name, *, tpu=None, cpu=None, memory=None, limits_only=False):
         res["memory"] = memory
     resources = {"limits": res} if limits_only else {"requests": res,
                                                     "limits": dict(res)}
+    meta = {"name": name, "namespace": ns}
+    if labels:
+        meta["labels"] = labels
     return {
         "apiVersion": "v1", "kind": "Pod",
-        "metadata": {"name": name, "namespace": ns},
+        "metadata": meta,
         "spec": {"containers": [{"name": "main", "resources": resources}]},
     }
 
@@ -341,8 +345,11 @@ def test_spawn_preflight_counts_existing_usage(jwa, jwa_kube):
                  headers=USER)
     assert r.status_code == 200, r.get_data(as_text=True)
     # The notebook exists but its pods don't yet — simulate the worker pod
-    # so used=8, then a 16-chip spawn must be denied with remaining 8.
-    jwa_kube.create(make_pod("user1", "first-0", tpu=8))
+    # (carrying the notebook-name label a real worker has, so it isn't
+    # double-counted on top of the CR's declared chips) so used=8, then a
+    # 16-chip spawn must be denied with remaining 8.
+    jwa_kube.create(make_pod("user1", "first-0", tpu=8,
+                             labels={"notebook-name": "first"}))
     r = jwa.post("/api/namespaces/user1/notebooks",
                  json={"name": "second",
                        "tpus": {"accelerator": "v5e", "topology": "4x4"}},
@@ -505,6 +512,69 @@ def test_declared_chips_not_double_counted(jwa, jwa_kube):
                     json={"name": "ok",
                           "tpus": {"accelerator": "v5e", "topology": "2x4"}},
                     headers=USER).status_code == 200
+
+
+def test_preflight_sums_foreign_pods_and_declared_notebooks(jwa, jwa_kube):
+    """Chips held by NON-notebook pods (a training Job, a bare pod) and by
+    a not-yet-materialized notebook CR must ADD, not max: hard=16 with a
+    foreign 8-chip pod plus a declared-8 notebook is fully committed, so
+    another 8-chip spawn must 403 (it would otherwise strand at pod
+    admission — the exact failure the preflight exists to prevent)."""
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "16"}))
+    jwa_kube.create(make_pod("user1", "training-job-0", tpu=8))  # no label
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "a",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+    # Notebook "a" declares 8 but has no pods yet; foreign pod holds 8.
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "b",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 403, r.get_data(as_text=True)
+    assert "remaining 0" in r.get_data(as_text=True)
+    # The picker agrees with the preflight.
+    r = jwa.get("/api/namespaces/user1/tpus", headers=USER)
+    assert r.get_json()["quota"] == {"hard": 16, "used": 16, "remaining": 0}
+    # Once "a" materializes its labeled worker pod, nothing double-counts:
+    # still exactly 16 used.
+    jwa_kube.create(make_pod("user1", "a-0", tpu=8,
+                             labels={"notebook-name": "a"}))
+    r = jwa.get("/api/namespaces/user1/tpus", headers=USER)
+    assert r.get_json()["quota"] == {"hard": 16, "used": 16, "remaining": 0}
+
+
+def test_stopped_notebook_terminating_pods_still_hold_quota(jwa, jwa_kube):
+    """A just-stopped notebook's pods may still be terminating: its CR no
+    longer declares chips, but the live pods DO still count (pod admission
+    counts them) — a respawn that ignored them would pass pre-flight and
+    strand at pod admission."""
+    jwa_kube.create(make_quota("user1", {"google.com/tpu": "8"}))
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "a",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+    jwa_kube.create(make_pod("user1", "a-0", tpu=8,
+                             labels={"notebook-name": "a"}))
+    # Stop the notebook; its worker pod is still Running (terminating).
+    r = jwa.patch("/api/namespaces/user1/notebooks/a",
+                  json={"stopped": True}, headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "b",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 403, r.get_data(as_text=True)
+    # Once the pod is actually gone, the chips free up and b spawns.
+    from kubeflow_tpu.platform.k8s.types import POD as _POD
+    jwa_kube.delete(_POD, "a-0", "user1")
+    r = jwa.post("/api/namespaces/user1/notebooks",
+                 json={"name": "b",
+                       "tpus": {"accelerator": "v5e", "topology": "2x4"}},
+                 headers=USER)
+    assert r.status_code == 200, r.get_data(as_text=True)
 
 
 def test_tpus_endpoint_reports_chip_budget(jwa, jwa_kube):
