@@ -111,7 +111,12 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
         try:
             points = fn(interval)
         except NotImplementedError:
-            raise HttpError(405, f"metrics type {mtype!r} not supported") from None
+            # 501, NOT 405: the service IS wired, this one type isn't
+            # supported by it — the SPA must only hide the whole card on
+            # the unambiguous nothing-configured 405 above.
+            raise HttpError(
+                501, f"metrics type {mtype!r} not supported by this service"
+            ) from None
         return success({"points": [p.to_dict() for p in points]})
 
     @app.route("/api/tpu-overview")

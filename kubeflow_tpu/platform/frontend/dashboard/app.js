@@ -119,16 +119,23 @@ async function loadMetrics() {
   try {
     points = (await api(`/api/metrics/${type}?interval=${interval}`)).points;
   } catch (e) {
-    if (!metricsProbed) {
-      // Initial probe failed: no metrics service wired — hide the card.
+    if (!metricsProbed && e.status === 405) {
+      // Initial probe says no metrics service is wired — only the 405 the
+      // backend reserves for that may hide the card for the session.  Any
+      // OTHER initial failure (transient 500, a 501 type-unsupported from
+      // a wired service, network blip) must not latch: show the empty
+      // state and let the next poll/selector change retry (advisor r3).
       metricsAvailable = false;
       card.hidden = true;
     } else {
-      // A later per-type 405 or transient error must not latch the whole
-      // card hidden; show the empty state so other types stay reachable.
+      card.hidden = false;
       renderChart([]);
       toast(e.message, true);
     }
+    // Any settled request completes the probe: a LATER per-type 404/405
+    // (e.g. after a transient first failure) means "this type is
+    // unsupported", never "no service" — it must not latch the card.
+    metricsProbed = true;
     return;
   }
   metricsProbed = true;

@@ -26,7 +26,9 @@ export async function api(path, opts = {}) {
   }
   if (!resp.ok || (body && body.success === false)) {
     const msg = (body && (body.user_action || body.log)) || resp.statusText;
-    throw new Error(msg);
+    const err = new Error(msg);
+    err.status = resp.status;  // callers branch on 404/405 vs transient
+    throw err;
   }
   return body;
 }

@@ -1083,15 +1083,17 @@ class BrowserHarness:
 
     # -- async-ordering mode (VERDICT r2 item 4) -----------------------------
 
-    def enable_deferred(self):
+    def enable_deferred(self, timeout: float = 5.0):
         """Switch fetch to deferred delivery and awaits to true suspension.
-        Pair with disable_deferred() (or use `with h.deferred_mode():`)."""
+        Pair with disable_deferred() (or use `with h.deferred_mode():`).
+        ``timeout`` caps any single suspension; on expiry the stuck promise
+        is rejected so the whole await chain unwinds at once."""
         from kubeflow_tpu.platform.testing.jsengine import (
             DeferredRuntime,
             set_deferred_runtime,
         )
 
-        self.deferred = DeferredRuntime()
+        self.deferred = DeferredRuntime(timeout=timeout)
         set_deferred_runtime(self.deferred)
         return self.deferred
 

@@ -92,9 +92,16 @@ class DeferredRuntime:
     by choosing when each pending fetch resolves.
     """
 
-    def __init__(self):
+    def __init__(self, timeout: float = 5.0):
         import threading
 
+        # Cap on any single suspension (an await whose promise never
+        # settles, an async body that never yields).  Short by default
+        # (advisor r3): the old hard-coded 30 s meant one abandoned fetch
+        # cascaded into a multi-minute hang as every downstream awaiter ate
+        # its own timeout; now the first timeout REJECTS the promise so the
+        # chain unwinds immediately.
+        self.timeout = timeout
         self.threading = threading
         self.lock = threading.Lock()
         self.tls = threading.local()
@@ -137,22 +144,45 @@ class DeferredRuntime:
     def inside(self) -> bool:
         return getattr(self.tls, "inside", False)
 
-    def suspend_until(self, event):
-        """Release the JS lock until ``event`` is set (promise settled)."""
+    def suspend_until(self, event, promise=None):
+        """Release the JS lock until ``event`` is set (promise settled).
+
+        On timeout the awaited ``promise`` is REJECTED (not merely raised
+        past): every other awaiter of the same promise is woken with the
+        rejection instead of each eating its own full timeout, so an
+        abandoned fetch fails the test in one ``timeout`` instead of a
+        multi-minute cascade (advisor r3).
+        """
         sig = getattr(self.tls, "first_suspend", None)
         if sig is not None:
             self.tls.first_suspend = None
             sig.set()
         self._mark_runnable(-1)
         self.lock.release()
-        if not event.wait(timeout=30):
-            # Keep accounting balanced: the thread becomes runnable again
-            # to unwind (run()'s finally will decrement once more).
-            self._mark_runnable(1)
-            self.lock.acquire()
-            raise TimeoutError("await on a promise that never settled")
-        # The settler marked us runnable before setting the event.
+        settled = event.wait(timeout=self.timeout)
         self.lock.acquire()
+        if settled or event.is_set():
+            # The settler marked us runnable before setting the event.
+            # (is_set catches a settle racing the timeout — e.g. a sibling
+            # awaiter of the same promise timed out first and rejected it,
+            # waking us between our wait expiry and lock acquisition.)
+            return
+        # Keep accounting balanced: the thread becomes runnable again to
+        # unwind (run()'s finally / leave() will decrement once more).
+        self._mark_runnable(1)
+        if promise is not None and promise.state == "pending":
+            # Drop our own waiter first: _settle marks each remaining
+            # waiter runnable, and this thread already re-counted itself.
+            try:
+                promise._waiters.remove(event)
+            except ValueError:
+                pass
+            promise._settle("rejected", make_error(
+                f"await timed out after {self.timeout}s: promise "
+                "never settled (abandoned fetch?)"
+            ))
+        else:
+            raise TimeoutError("await on a promise that never settled")
 
 
 DEFERRED: Optional[DeferredRuntime] = None
@@ -1160,13 +1190,28 @@ class JSFunction:
             rt.tls.inside = False
             rt.lock.release()
         thread.start()
-        timed_out = not first.wait(timeout=30)
+        timed_out = not first.wait(timeout=rt.timeout)
         if caller_inside:
             # Reacquire BEFORE raising so the enclosing call_function's
             # rt.leave() releases a lock this thread actually holds.
             rt.lock.acquire()
             rt.tls.inside = True
         if timed_out:
+            # Reject the caller-visible promise too: anything awaiting the
+            # async call's result unwinds now instead of timing out again.
+            # _settle requires the JS lock (it races the still-running
+            # body's own settle otherwise) — a Python-side caller doesn't
+            # hold it, so take it here.
+            if not caller_inside:
+                rt.lock.acquire()
+            try:
+                result._settle("rejected", make_error(
+                    f"async {self.name} neither finished nor suspended "
+                    f"within {rt.timeout}s"
+                ))
+            finally:
+                if not caller_inside:
+                    rt.lock.release()
             raise TimeoutError(f"async {self.name} neither finished nor "
                                "suspended")
         return result
@@ -1450,11 +1495,49 @@ def _arr_method(arr: JSArray, name: str):
 
 
 def _str_method(s: str, name: str):
+    def _sub_groups(template: str, m) -> str:
+        # ECMAScript replacement patterns: $1..$99, $& (whole match),
+        # $$ (literal dollar).  Caught by the differential corpus: the
+        # template used to pass through verbatim.
+        out, i = [], 0
+        while i < len(template):
+            c = template[i]
+            if c == "$" and i + 1 < len(template):
+                nxt = template[i + 1]
+                if nxt == "$":
+                    out.append("$")
+                    i += 2
+                    continue
+                if nxt == "&":
+                    out.append(m.group(0))
+                    i += 2
+                    continue
+                if nxt.isdigit():
+                    j = i + 2
+                    if j < len(template) and template[j].isdigit() and \
+                            int(template[i + 1:j + 1]) <= len(m.groups()):
+                        j += 1
+                    n = int(template[i + 1:j])
+                    if 1 <= n <= len(m.groups()):
+                        out.append(m.group(n) or "")
+                        i = j
+                        continue
+            out.append(c)
+            i += 1
+        return "".join(out)
+
     def replace(pat, repl):
         if isinstance(pat, JSRegExp):
-            return pat.rx.sub(lambda m: repl if isinstance(repl, str)
-                              else js_to_string(call_function(repl, [m.group(0)])),
-                              s, count=0 if "g" in pat.flags else 1)
+            if isinstance(repl, str):
+                fn = lambda m: _sub_groups(repl, m)  # noqa: E731
+            else:
+                # Unmatched groups are undefined (spec), never null —
+                # exec()/match() already convert; callbacks must match.
+                fn = lambda m: js_to_string(  # noqa: E731
+                    call_function(repl, [m.group(0)] + [
+                        g if g is not None else UNDEF for g in m.groups()
+                    ]))
+            return pat.rx.sub(fn, s, count=0 if "g" in pat.flags else 1)
         if callable(repl):
             return s.replace(js_to_string(pat),
                              js_to_string(call_function(repl, [pat])), 1)
@@ -1463,6 +1546,12 @@ def _str_method(s: str, name: str):
     def match(rx):
         if isinstance(rx, str):
             rx = JSRegExp(rx, "")
+        if "g" in rx.flags:
+            # Global match: ALL matched substrings, no capture groups
+            # (spec), null when nothing matches.  Caught by the corpus:
+            # only the first match was returned.
+            hits = [m.group(0) for m in rx.rx.finditer(s)]
+            return JSArray(hits) if hits else None
         m = rx.rx.search(s)
         if not m:
             return None
@@ -1538,7 +1627,16 @@ class JSRegExp:
         return self.rx.search(js_to_string(s)) is not None
 
     def exec(self, s):
-        return _str_method(js_to_string(s), "match")(self)
+        # Always the ECMAScript single-match array [match, ...groups] —
+        # including for /g regexes, where String.match returns all full
+        # matches instead (so exec must NOT delegate to it).  lastIndex
+        # statefulness is not modeled (the SPAs don't loop exec).
+        m = self.rx.search(js_to_string(s))
+        if not m:
+            return None
+        return JSArray([m.group(0)] + [
+            g if g is not None else UNDEF for g in m.groups()
+        ])
 
 
 def js_get(obj, key):
@@ -2163,7 +2261,7 @@ class Interpreter:
                         )
                     event = rt.threading.Event()
                     v._waiters.append(event)
-                    rt.suspend_until(event)
+                    rt.suspend_until(event, v)
                 if v.state == "fulfilled":
                     return v.value
                 raise JSException(v.value)

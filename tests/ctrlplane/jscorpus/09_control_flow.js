@@ -1,0 +1,28 @@
+// Control flow: ternary (right-assoc), if/else-if chains, for-of with
+// continue, and ++/-- value semantics — expectations written to the spec.
+let total = 0;
+for (const n of [1, 2, 3, 4, 5]) {
+  if (n % 2 === 0) continue;
+  total += n;
+}
+print(total);
+let i = 0;
+print(i++);
+print(i);
+print(++i);
+let j = 2;
+print(j--, --j);
+print(1 > 2 ? "a" : "b");
+print(true ? false ? "x" : "y" : "z");
+let s = "";
+for (const [k, v] of Object.entries({ a: 1, b: 2 })) { s += k + v; }
+print(s);
+if (0) { print("no"); } else if ("") { print("no2"); } else { print("yes"); }
+let count = 0;
+for (const ch of "hello") count++;
+print(count);
+let cls = "";
+for (const n of [1, 2, 3]) {
+  cls = n === 2 ? cls : cls + n;
+}
+print(cls);

@@ -1,0 +1,13 @@
+// Date: epoch-ms construction, ISO-string construction, getTime,
+// toISOString formatting, and a monotonic Date.now sanity bound.
+const epoch = new Date(0);
+print(epoch.toISOString());
+print(epoch.getTime());
+print(new Date(1722470400000).toISOString());
+print(new Date("2026-07-31T12:30:00Z").getTime());
+print(new Date(86400000).toISOString());
+print(new Date(1500).getTime());
+const t0 = Date.now();
+print(t0 > new Date("2026-01-01T00:00:00Z").getTime());
+print(Date.now() >= t0);
+print(new Date("2026-07-31T12:30:00.250Z").toISOString());

@@ -1,0 +1,17 @@
+// RegExp via string construction (the engine's supported form — regex
+// LITERALS are deliberately outside the SPA subset): test/match/exec,
+// flags (g, i), null on no match, $n group substitution in replace.
+const re = new RegExp("a+", "g");
+print(re.test("baaa"));
+print(re.test("zzz"));
+print("baaa banana".match(new RegExp("a+", "g")).join(","));
+print("no".match(new RegExp("x")));
+print("a-b-c".replace(new RegExp("-", "g"), "+"));
+print(new RegExp("^\\d{2}$").test("42"));
+print(new RegExp("^\\d{2}$").test("426"));
+print("CaSe sensitivity".match(new RegExp("case", "i"))[0]);
+print("v5e-16".match(new RegExp("^(\\w+)-(\\d+)$")).join("|"));
+print("2026-07-31".replace(new RegExp("(\\d+)-(\\d+)-(\\d+)"), "$3/$2/$1"));
+print(new RegExp("(?:^|; )tok=([^;]*)").exec("a=1; tok=xyz")[1]);
+print(new RegExp("(\\w)x", "g").exec("axbx").join(","));
+

@@ -1,0 +1,18 @@
+// Math statics, Object.assign/entries/values ordering, forEach,
+// decodeURIComponent round-trips, and synchronous-settling promise
+// chains (then/catch, Promise.all).
+print(Math.ceil(1.1), Math.ceil(-1.1), Math.max(1, 5, 3), Math.min(2, -2));
+const merged = Object.assign({}, { a: 1 }, { b: 2 }, { a: 3 });
+print(JSON.stringify(merged));
+print(Object.entries({ x: 1, y: 2 }).map(([k, v]) => k + "=" + v).join("&"));
+print(Object.values({ x: 1, y: 2 }).join(","));
+const out = [];
+[3, 1].forEach((v, i) => out.push(i + ":" + v));
+print(out.join(" "));
+print(decodeURIComponent("a%20b%2Fc"));
+print(decodeURIComponent(encodeURIComponent("ns/notebook name")));
+Promise.all([Promise.resolve(1), Promise.resolve(2)])
+  .then((vals) => print("all", vals.join(",")));
+Promise.resolve(7).then((v) => v + 1).then((v) => print("chain", v));
+Promise.reject(new Error("boom")).catch((e) => print("caught", e.message));
+Promise.resolve("v").catch(() => print("skipped")).then((v) => print("kept", v));

@@ -731,6 +731,76 @@ def test_dashboard_chart_hidden_without_metrics_service(dashboard_env):
     assert h.get("metrics-card").hidden
 
 
+def test_dashboard_chart_transient_initial_error_does_not_latch(kube):
+    """Advisor r3: only a 404/405 on the initial probe (= no metrics
+    service wired) may hide the card for the session.  A transient 500 on
+    first load must leave the card retryable — the next selector change
+    succeeds and renders."""
+    from kubeflow_tpu.platform.dashboard.app import create_app
+    from kubeflow_tpu.platform.dashboard.metrics_service import (
+        MetricsService,
+        TimeSeriesPoint,
+    )
+
+    class FlakyMetrics(MetricsService):
+        calls = 0
+
+        def node_cpu_utilization(self, interval):
+            FlakyMetrics.calls += 1
+            if FlakyMetrics.calls == 1:
+                raise RuntimeError("transient blip")
+            return [TimeSeriesPoint(1000 + 60 * i, "node-a", 0.1 * i)
+                    for i in range(3)]
+
+        def tpu_duty_cycle(self, interval):
+            return []
+
+    kube.add_namespace("kubeflow")
+
+    def make(k, **kw):
+        return create_app(k, metrics_service=FlakyMetrics(), **kw)
+
+    h = harness("dashboard", make, kube, user="owner@x.io")
+    # Initial probe hit the 500: card NOT latched hidden.
+    assert not h.get("metrics-card").hidden
+    # A per-type 501 after the failed initial probe is "type unsupported",
+    # not "no service" — it must not latch the card either.
+    h.set_value("#metric-type", "podcpu")
+    assert not h.get("metrics-card").hidden
+    # Retry via selector change renders the series.
+    h.set_value("#metric-type", "node")
+    assert len(h.query_all("#metric-chart polyline")) == 1
+
+
+def test_dashboard_chart_unsupported_default_type_does_not_latch(kube):
+    """A WIRED metrics service whose DEFAULT selector type is unsupported
+    (501) must not hide the card: the other types stay reachable.  Only
+    the unambiguous nothing-configured 405 may latch."""
+    from kubeflow_tpu.platform.dashboard.app import create_app
+    from kubeflow_tpu.platform.dashboard.metrics_service import (
+        MetricsService,
+        TimeSeriesPoint,
+    )
+
+    class TpuOnlyMetrics(MetricsService):
+        def node_cpu_utilization(self, interval):
+            raise NotImplementedError
+
+        def tpu_duty_cycle(self, interval):
+            return [TimeSeriesPoint(1000 + 60 * i, "chip-0", 0.2 * i)
+                    for i in range(3)]
+
+    kube.add_namespace("kubeflow")
+
+    def make(k, **kw):
+        return create_app(k, metrics_service=TpuOnlyMetrics(), **kw)
+
+    h = harness("dashboard", make, kube, user="owner@x.io")
+    assert not h.get("metrics-card").hidden  # 501 on first load: no latch
+    h.set_value("#metric-type", "tpu")
+    assert len(h.query_all("#metric-chart polyline")) == 1
+
+
 # -- notebook detail page (VERDICT r1 item 1) --------------------------------
 
 
@@ -977,6 +1047,67 @@ def test_spawner_slice_change_preserves_topology_pick(kube):
     opts = {o.attributes.get("value"): o
             for o in jupyter.query_all("#tpu-topo option")}
     assert opts["4x4"].disabled and not opts["2x4"].disabled
+
+
+def test_deferred_timeout_rejects_promise_and_chain_unwinds(tmp_path):
+    """Advisor r3: a suspension that never settles must fail ONCE, fast.
+    The stuck promise is rejected at the (configurable) timeout, so a JS
+    try/catch sees a real rejection and every transitive awaiter resumes
+    immediately — under the old hard-coded behavior the first timeout was
+    a Python error invisible to JS, the async result stayed pending, and
+    each downstream awaiter serially ate its own 30 s."""
+    import time as _time
+
+    from kubeflow_tpu.platform.testing.jsdom import BrowserHarness
+    from kubeflow_tpu.platform.testing.jsengine import (
+        Env,
+        JSPromise,
+        Parser,
+        tokenize,
+    )
+
+    (tmp_path / "index.html").write_text("<html><body></body></html>")
+    h = BrowserHarness(str(tmp_path), client=None, url="http://t.test/")
+    seen, grabbed = [], []
+    h.interp.globals.declare("report", lambda *a: seen.append(tuple(a)))
+    h.interp.globals.declare("grab", lambda f: grabbed.append(f))
+    h.interp.globals.declare("stuck", JSPromise("pending", None))
+    src = """
+    async function waiter(tag) {
+      try { await stuck; report(tag, "settled"); }
+      catch (e) { report(tag, "caught"); }
+    }
+    async function chain() { await waiter("a"); report("chain", "done"); }
+    grab(chain); grab(waiter);
+    """
+    ast = Parser(tokenize(src, "<test>"), "<test>").parse_program()
+    env = Env(h.interp.globals)
+    h.interp.hoist(ast, env)
+    for stmt in ast:
+        h.interp.exec(stmt, env)
+    chain_fn, waiter_fn = grabbed
+
+    rt = h.enable_deferred(timeout=0.8)
+    try:
+        t0 = _time.monotonic()
+        chain_p = chain_fn()  # awaits waiter("a") which awaits stuck
+        waiter_fn("b")        # sibling awaiter of the SAME stuck promise
+        while _time.monotonic() - t0 < 6 and (
+            chain_p.state == "pending" or len(seen) < 2
+        ):
+            _time.sleep(0.02)
+        elapsed = _time.monotonic() - t0
+    finally:
+        h.disable_deferred()
+    # Whichever awaiter's deadline fires first rejects a stuck promise; the
+    # sibling unwinds from that one rejection, and the transitive chain
+    # settles (fulfilled if `a` unwound before chain's own deadline,
+    # rejected if chain's fired first — all three deadlines race, so the
+    # guaranteed contract is SETTLED-fast, not which message each saw).
+    assert ("a", "caught") in seen and ("b", "caught") in seen
+    assert chain_p.state != "pending"
+    # One timeout total, not one per awaiter.
+    assert elapsed < 2.4, f"unwind took {elapsed:.1f}s — serial timeouts?"
 
 
 def test_deferred_out_of_order_fetch_basics(kube, jupyter):

@@ -33,6 +33,69 @@ def test_corpus_is_present():
     assert len(FIXTURES) >= 8
 
 
+def test_corpus_covers_bundle_language_surface():
+    """The coverage contract (VERDICT r3 item 4): every language-level
+    construct the five shipped SPA bundles use — syntax node types,
+    builtin method calls, builtin-global calls — must be exercised by at
+    least one corpus fixture.  A SPA adopting an uncovered construct
+    (a new Date format, a URLSearchParams edge, a RegExp method) FAILS
+    this test until a spec-written fixture covers it, so the corpus can
+    never silently lag the apps it certifies."""
+    from kubeflow_tpu.platform.testing.jsinventory import coverage_gaps
+
+    gaps = coverage_gaps(CORPUS)
+    assert all(not v for v in gaps.values()), {
+        k: sorted(v) for k, v in gaps.items() if v
+    }
+
+
+def test_inventory_extraction_mechanics():
+    """inventory() attributes calls correctly: builtin statics, instance
+    method names, global constructors, and app-defined names (which must
+    NOT create corpus obligations)."""
+    from kubeflow_tpu.platform.testing.jsinventory import (
+        BUILTIN_GLOBALS,
+        inventory,
+    )
+
+    inv = inventory("""
+      function mine(x) { return x; }
+      const helper = (v) => v;
+      const obj = { render: mine, fmt(v) { return v; } };
+      mine(1); helper(2); obj.render(3); obj.fmt(4);
+      JSON.stringify({}); Math.max(1, 2); Date.now();
+      const d = new Date(0);
+      "abc".includes("a"); [1].map(helper);
+      const { a, b: renamed = 1, ...rest } = {};
+      for (const [k, v] of Object.entries({})) { if (k) continue; }
+    """)
+    assert {"JSON.stringify", "Math.max", "Date.now",
+            "Object.entries"} <= inv["static_calls"]
+    assert {"includes", "map"} <= inv["method_calls"]
+    assert "Date" in inv["global_calls"]
+    assert {"mine", "helper", "render", "fmt", "obj",
+            "a", "renamed", "rest", "d"} <= inv["defined"]
+    assert {"ForOf", "If", "Continue", "ObjectPat"} <= inv["node_types"]
+    assert "Date" in BUILTIN_GLOBALS
+
+
+def test_coverage_contract_detects_new_construct(tmp_path):
+    """A bundle construct with no fixture must surface as a gap: run the
+    gap computation against a corpus copy MISSING the regexp fixture and
+    assert the contract would fail."""
+    import shutil
+
+    from kubeflow_tpu.platform.testing.jsinventory import coverage_gaps
+
+    reduced = tmp_path / "jscorpus"
+    reduced.mkdir()
+    for f in FIXTURES:
+        if "regexp" not in f:
+            shutil.copy(f, reduced / os.path.basename(f))
+    gaps = coverage_gaps(str(reduced))
+    assert "test" in gaps["method_calls"] or "RegExp" in gaps["global_calls"]
+
+
 @pytest.mark.parametrize("fixture", FIXTURES, ids=_ids())
 def test_corpus_fixture_matches_ecmascript(fixture):
     with open(fixture) as f:
