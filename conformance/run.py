@@ -135,6 +135,87 @@ def multislice_dcn():
         e2e.close()
 
 
+@check("multislice-stop-cull")
+def multislice_stop_cull():
+    """Multislice lifecycle contracts (VERDICT r3 item 8), end-user level:
+    the UI Service routes to slice-0 worker-0 only (pod-name selector), a
+    culler pass probes exactly that Service URL and culls the notebook
+    WHOLE — the stop annotation scales EVERY slice StatefulSet to 0 in one
+    reconcile — and start restores every slice (reference stop semantics
+    notebook_controller.go:362-365, extended to slices)."""
+    from kubeflow_tpu.platform.controllers.culling import CullingReconciler
+    from kubeflow_tpu.platform.k8s.types import (
+        NOTEBOOK, SERVICE, STATEFULSET, deep_get,
+    )
+    from kubeflow_tpu.platform.runtime import Request
+
+    e2e = _e2e()
+    try:
+        e2e.kube.add_tpu_node("tpu-msc-1", topology="4x4")
+        ns = e2e.register()
+        resp = e2e.jupyter.post(
+            f"/api/namespaces/{ns}/notebooks",
+            json={"name": "msc-nb",
+                  "tpus": {"accelerator": "v5e", "topology": "4x4",
+                           "slices": 2}},
+            headers=e2e.user,
+        )
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        slice_stses = ("msc-nb", "msc-nb-s1")
+        for sts_name in slice_stses:
+            sts = e2e._wait(
+                lambda n=sts_name: e2e._get(STATEFULSET, n, ns), sts_name
+            )
+            assert deep_get(sts, "spec", "replicas") == 2, sts_name
+
+        # The UI Service pins slice-0 worker-0 — the pod the kernels API
+        # lives on — for multi-host AND multislice notebooks.
+        svc = e2e._wait(lambda: e2e._get(SERVICE, "msc-nb", ns), "service")
+        assert deep_get(svc, "spec", "selector") == {
+            "statefulset.kubernetes.io/pod-name": "msc-nb-0"
+        }, deep_get(svc, "spec", "selector")
+
+        # An idle culler pass probes THAT Service URL (slice-0 worker-0 by
+        # construction above) and stamps the stop annotation.
+        probed = []
+        culler = CullingReconciler(
+            e2e.api_client, idle_minutes=0,
+            prober=lambda url: probed.append(url) or [
+                {"execution_state": "idle",
+                 "last_activity": "2020-01-01T00:00:00Z"}],
+        )
+        culler.reconcile(Request(ns, "msc-nb"))
+        assert probed == [
+            f"http://msc-nb.{ns}.svc.cluster.local"
+            f"/notebook/{ns}/msc-nb/api/kernels"
+        ], probed
+        nb = e2e.kube.get(NOTEBOOK, "msc-nb", ns)
+        assert deep_get(nb, "metadata", "annotations",
+                        "kubeflow-resource-stopped"), "stop not stamped"
+
+        # Culling scales EVERY slice to zero...
+        for sts_name in slice_stses:
+            e2e._wait(
+                lambda n=sts_name: deep_get(
+                    e2e._get(STATEFULSET, n, ns), "spec", "replicas") == 0,
+                f"{sts_name} scaled to 0",
+            )
+        # ...and restart restores every slice.
+        resp = e2e.jupyter.patch(
+            f"/api/namespaces/{ns}/notebooks/msc-nb",
+            json={"stopped": False}, headers=e2e.user,
+        )
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        for sts_name in slice_stses:
+            e2e._wait(
+                lambda n=sts_name: deep_get(
+                    e2e._get(STATEFULSET, n, ns), "spec", "replicas") == 2,
+                f"{sts_name} restored",
+            )
+    finally:
+        e2e.close()
+
+
 @check("webhook-merge-semantics")
 def webhook_merge():
     """PodDefault merge: identical-or-error on name collisions, conflict
