@@ -206,12 +206,14 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
-    # The standing BASELINE.md gap for configs 2-3 (TF / torch-XLA
-    # throughput): this lane runs on a TF- or torch-XLA-capable TPU VM and
-    # appends the measured numbers to BASELINE.md in one command:
+    # The BASELINE.md throughput lane for configs 2-4.  Config 4 (ViT-B/16,
+    # JAX) measures TPU-attached on this very image (same stack bench.py
+    # drives); configs 2-3 (TF / torch-XLA) need a capable TPU VM.  One
+    # command either way:
     #   python ci/workflows.py run hardware-baselines
-    # On the dev image (no TF, no torch_xla, no egress) it exits 3 with a
-    # loud per-config skip report instead of pretending to measure.
+    # Measured rows REPLACE same-config rows in BASELINE.md; configs whose
+    # runtime is absent exit 3 with a loud per-config skip report instead
+    # of pretending to measure.
     name="hardware-baselines",
     include_dirs=["images/*", "examples/*", "ci/hardware_baselines.py",
                   "releasing/*"],
