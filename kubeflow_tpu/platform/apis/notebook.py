@@ -96,6 +96,76 @@ def is_stopped(notebook: Resource) -> bool:
     )
 
 
+def declared_tpu_chips(notebook: Resource) -> float:
+    """Chips a notebook CR commits, whether or not its pods exist yet:
+    spec.tpu is authoritative (aggregate over slices); a CR without it
+    (kubectl-created) falls back to its raw template chip limits."""
+    from kubeflow_tpu.platform.k8s import quota as quota_mod
+
+    s = tpu_slice_or_none(notebook)
+    if s is not None:
+        return float(s.total_chips)
+    tmpl = deep_get(notebook, "spec", "template", "spec", default={}) or {}
+    try:
+        usage = quota_mod.pod_quota_usage({"spec": tmpl})
+    except ValueError:
+        return 0.0
+    return usage.get("requests.google.com/tpu", 0.0)
+
+
+def running_notebook_pod_usage(client, ns: str, running: list) -> dict:
+    """Aggregate quota footprint of live pods that belong to RUNNING
+    (non-stopped) notebooks — exactly the slice of a quota's status.used
+    that the declared CR totals already cover (quota.effective_used).  A
+    just-stopped notebook's still-terminating pods are NOT included: their
+    CR has left the declared tally, so they must keep counting as live
+    usage or a respawn passes pre-flight and strands at pod admission.
+    Shared by the spawn pre-flight, the picker and the dashboard card —
+    ONE implementation so the surfaces cannot drift apart."""
+    from kubeflow_tpu.platform.k8s import quota as quota_mod
+    from kubeflow_tpu.platform.k8s.types import POD, name_of
+
+    running_names = {name_of(nb) for nb in running}
+    usage: dict = {}
+    for pod in client.list(POD, ns):
+        labels = deep_get(pod, "metadata", "labels", default={}) or {}
+        phase = deep_get(pod, "status", "phase", default="")
+        if labels.get(LABEL_NOTEBOOK_NAME) in running_names and \
+                phase not in ("Succeeded", "Failed"):
+            try:
+                usage = quota_mod.add_usage(
+                    usage, quota_mod.pod_quota_usage(pod))
+            except ValueError:
+                continue
+    return usage
+
+
+def namespace_tpu_budget(client, ns: str) -> Optional[dict]:
+    """Per-namespace TPU chip budget {hard, used, remaining} from the
+    tightest ResourceQuota, under the platform's commitment accounting
+    (quota.effective_used): chips declared by running notebook CRs (pods
+    or not) PLUS live usage by non-notebook pods — shared by the spawner
+    picker and the central dashboard card, so every surface agrees with
+    what quota admission will actually do.  None when no quota constrains
+    `google.com/tpu` in the namespace.
+    """
+    from kubeflow_tpu.platform.k8s import quota as quota_mod
+    from kubeflow_tpu.platform.k8s.types import RESOURCEQUOTA
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK as NOTEBOOK_GVK
+
+    quotas = client.list(RESOURCEQUOTA, ns)
+    if not quotas:
+        return None
+    running = [nb for nb in client.list(NOTEBOOK_GVK, ns)
+               if not is_stopped(nb)]
+    declared = sum(declared_tpu_chips(nb) for nb in running)
+    pod_used = running_notebook_pod_usage(client, ns, running).get(
+        "requests.google.com/tpu", 0.0)
+    return quota_mod.tpu_remaining(
+        quotas, declared=declared, workload_pod_used=pod_used
+    )
+
+
 def notebook_port(notebook: Resource) -> int:
     ports = deep_get(
         notebook, "spec", "template", "spec", "containers", default=[{}]

@@ -81,20 +81,14 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         # over-quota topologies and show "N chips remaining".  Read with the
         # app's own client, not the user's SAR: this reflects what quota
         # admission will do to the spawn regardless of whether the user may
-        # list ResourceQuota objects.  Uses the same effective_used
-        # accounting as the pre-flight so the picker never enables a
-        # topology the submit would 403.
-        quotas = client.list(RESOURCEQUOTA, ns)
-        if quotas:
-            running = _running_notebooks(ns)
-            remaining = quota_mod.tpu_remaining(
-                quotas, declared=_declared_tpu_chips(running),
-                workload_pod_used=_notebook_pod_usage(ns, running).get(
-                    "requests.google.com/tpu", 0.0),
-            )
-        else:
-            remaining = None
-        return success({"tpus": out, "quota": remaining})
+        # list ResourceQuota objects.  The shared helper applies the same
+        # effective_used accounting as the pre-flight (and the dashboard
+        # card) so the picker never enables a topology the submit
+        # would 403.
+        return success({
+            "tpus": out,
+            "quota": nbapi.namespace_tpu_budget(client, ns),
+        })
 
     # -- notebooks ------------------------------------------------------------
 
@@ -274,14 +268,6 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         O(namespace) LISTs (and two lists could disagree mid-flight)."""
         return [nb for nb in client.list(NOTEBOOK, ns)
                 if not nbapi.is_stopped(nb)]
-
-    def _declared_tpu_chips(running: list) -> float:
-        """Chips declared by running (non-stopped) notebook CRs — counted
-        even before their worker pods materialize."""
-        return sum(
-            _stored_usage(nb).get("requests.google.com/tpu", 0.0)
-            for nb in running
-        )
 
     def _notebook_pod_usage(ns: str, running: list) -> dict:
         """Aggregate quota footprint of live pods that belong to RUNNING

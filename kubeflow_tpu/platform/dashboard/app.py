@@ -142,10 +142,26 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
                     total += s.total_chips
             if total:
                 requested[ns_name] = total
-        return success({
+        out = {
             "clusterCapacityChips": capacity,
             "requestedChipsByNamespace": requested,
-        })
+        }
+        # Per-namespace chip budget for the home card (?ns=...): the SAME
+        # commitment accounting as the spawner picker and pre-flight
+        # (apis.notebook.namespace_tpu_budget), read with the app's own
+        # client — it reflects what quota admission will do regardless of
+        # whether the user may list ResourceQuota objects.
+        ns = request.args.get("ns")
+        if ns:
+            from kubeflow_tpu.platform.apis.notebook import (
+                namespace_tpu_budget,
+            )
+
+            try:
+                out["quota"] = namespace_tpu_budget(client, ns)
+            except errors.ApiError:
+                out["quota"] = None
+        return success(out)
 
     # -- /api/workgroup --------------------------------------------------------
 

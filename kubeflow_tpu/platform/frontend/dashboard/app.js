@@ -76,12 +76,25 @@ async function loadLinks() {
 
 async function refreshHome() {
   try {
-    const overview = await api("/api/tpu-overview");
+    const overview = await api(
+      `/api/tpu-overview?ns=${encodeURIComponent(currentNs || "")}`);
     document.getElementById("stat-capacity").textContent =
       String(overview.clusterCapacityChips);
     const requested = Object.values(overview.requestedChipsByNamespace || {})
       .reduce((a, b) => a + b, 0);
     document.getElementById("stat-requested").textContent = String(requested);
+    // Namespace chip budget: same accounting as the spawner picker, so
+    // the card and the picker can never disagree about "remaining".
+    const card = document.getElementById("quota-card");
+    if (overview.quota) {
+      card.hidden = false;
+      document.getElementById("quota-card-title").textContent =
+        `TPU quota (${currentNs})`;
+      document.getElementById("stat-quota").textContent =
+        `${overview.quota.remaining} of ${overview.quota.hard} chips free`;
+    } else {
+      card.hidden = true;
+    }
   } catch (e) { /* nodes may be unlistable for plain users */ }
   if (!currentNs) return;
   try {

@@ -287,6 +287,34 @@ def test_dashboard_register_then_contributors(dashboard_env):
     assert ns  # silences linters; ns asserted above
 
 
+def test_dashboard_home_shows_namespace_tpu_quota_card(dashboard_env):
+    """The home quota card shows the namespace's chips-remaining under the
+    SAME accounting as the spawner picker (a running notebook's declared
+    chips count), and hides when no quota constrains the namespace."""
+    h, kube = dashboard_env
+    h.click("#register-btn")
+    ns = h.get("ns-select").options[0].value
+    # No quota yet: the card stays hidden after a refresh.
+    h.set_value("#ns-select", ns)
+    assert h.get("quota-card").hidden
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "dash-quota", "namespace": ns},
+        "spec": {"hard": {"google.com/tpu": "16"}},
+    })
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "holder", "namespace": ns},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4"}},
+    })
+    # Re-select the namespace to trigger refreshHome through the UI.
+    h.set_value("#ns-select", ns)
+    card = h.get("quota-card")
+    assert not card.hidden
+    assert h.text("#stat-quota") == "8 of 16 chips free"
+    assert ns in h.text("#quota-card-title")
+
+
 def test_dashboard_activity_feed_renders_events(dashboard_env):
     h, kube = dashboard_env
     h.click("#register-btn")

@@ -337,6 +337,23 @@ def test_dashboard_tpu_overview(kube):
     assert overview["clusterCapacityChips"] == 16  # two 8-chip fake nodes
     # nb 4x4 = 16 + ms 2x4 x 2 slices = 16; 'bad' skipped.
     assert overview["requestedChipsByNamespace"] == {"user1": 32}
+    assert "quota" not in overview  # only present when ?ns= is asked
+
+    # ?ns= adds the namespace chip budget under the shared picker
+    # accounting (declared running CRs count; 'bad' parses to 0).
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "q", "namespace": "user1"},
+        "spec": {"hard": {"google.com/tpu": "48"}},
+    })
+    overview = http.get(f"{base}/api/tpu-overview?ns=user1",
+                        headers=USER_HEADER).json()
+    assert overview["quota"] == {"hard": 48, "used": 32, "remaining": 16}
+    # A quota-less namespace reports null.
+    kube.add_namespace("noquota")
+    overview = http.get(f"{base}/api/tpu-overview?ns=noquota",
+                        headers=USER_HEADER).json()
+    assert overview["quota"] is None
 
 
 def test_csrf_double_submit(kube):
