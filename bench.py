@@ -416,11 +416,12 @@ def main(argv=None) -> int:
     # item 1: the ResNet step is HBM-bandwidth-bound at ~92% of its
     # roofline, so parity is its ceiling, while the flash-vs-XLA ratio
     # measures a design win this framework actually controls), then the
-    # secondary lines, then the primary line RE-PRINTED last — the driver
-    # parses the final line, and this ordering keeps that line a valid
-    # primary metric even if an external wall-clock budget cuts the
-    # slower secondary arms short (the full run is ~14 min through the
-    # tunnel, most of it the 1.36B arm's compiles).
+    # secondary lines, then the primary line RE-PRINTED last so a full
+    # run's final line is the primary for the driver's last-line parse.
+    # Note (advisor r4): early printing only guarantees the primary was
+    # COMPUTED before any wall-clock cut — under truncation the last
+    # complete line is whichever secondary finished, so a truncated run's
+    # primary must be recovered from earlier output by metric name.
     primary = llama_8k_bench()
     resnet50_bench()
     # Real-model-scale arm of the long-context story (round 4): same

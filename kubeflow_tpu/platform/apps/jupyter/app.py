@@ -269,26 +269,6 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         return [nb for nb in client.list(NOTEBOOK, ns)
                 if not nbapi.is_stopped(nb)]
 
-    def _notebook_pod_usage(ns: str, running: list) -> dict:
-        """Aggregate quota footprint of live pods that belong to RUNNING
-        (non-stopped) notebooks — exactly the slice of status.used that
-        the declared CR totals already cover (quota.effective_used).  A
-        just-stopped notebook's still-terminating pods must NOT be
-        subtracted: their CR is excluded from the declared tally, so
-        subtracting the pods too would free chips the apiserver's own
-        admission still counts, and a respawn would pass pre-flight only
-        to strand at pod admission."""
-        running_names = {name_of(nb) for nb in running}
-        usage: dict = {}
-        for pod in client.list(POD, ns):
-            labels = deep_get(pod, "metadata", "labels", default={}) or {}
-            phase = deep_get(pod, "status", "phase", default="")
-            if labels.get(nbapi.LABEL_NOTEBOOK_NAME) in running_names and \
-                    phase not in ("Succeeded", "Failed"):
-                usage = quota_mod.add_usage(
-                    usage, quota_mod.pod_quota_usage(pod))
-        return usage
-
     def _quota_preflight(ns: str, nb) -> None:
         """403 if the notebook's worker pods would exceed a namespace quota.
 
@@ -307,7 +287,10 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         declared: dict = {}
         for other in running:
             declared = quota_mod.add_usage(declared, _stored_usage(other))
-        nb_pod_used = _notebook_pod_usage(ns, running)
+        # Shared with the picker and dashboard card (ONE implementation so
+        # the surfaces cannot drift apart); it also skips pods carrying
+        # malformed resource quantities, which must not 500 the spawner.
+        nb_pod_used = nbapi.running_notebook_pod_usage(client, ns, running)
         override = {}
         for q in quotas:
             hard = deep_get(q, "spec", "hard", default={}) or {}
