@@ -237,6 +237,25 @@ def llama_8k_bench() -> None:
     )
 
 
+def _llama_1b4_flash_cfg():
+    """The 1.36B flash arm's config — ONE construction shared by the
+    throughput bench and --profile (the _resnet_setup convention), so the
+    profile can never silently measure a different arm than the metric it
+    explains."""
+    import dataclasses
+
+    from kubeflow_tpu.models.llama import CONFIGS as LLAMA_CONFIGS
+
+    return dataclasses.replace(
+        LLAMA_CONFIGS["llama_1b4"], max_seq_len=LLAMA_SEQ,
+        dtype=jnp.bfloat16, remat=True, remat_mode="mlp",
+        # Pinned (not "auto"): the profile must never silently fall back
+        # to the XLA arm and print a breakdown of the wrong kernel; the
+        # bench's measure() overrides per arm anyway.
+        attn_impl="pallas",
+    )
+
+
 def llama_1b4_bench() -> None:
     """Real-scale arm of the primary metric (VERDICT r3 item 2): the
     llama_1b4 zoo config (dim 2048, 24 layers, h=16 d=128, ffn 5632,
@@ -266,10 +285,7 @@ def llama_1b4_bench() -> None:
         batch, steps, windows, warmup = 1, 1, 1, 1
         xla_protocol = (1, 1, 1)
     else:
-        flash_cfg = dataclasses.replace(
-            LLAMA_CONFIGS["llama_1b4"], max_seq_len=LLAMA_SEQ,
-            dtype=jnp.bfloat16, remat=True, remat_mode="mlp",
-        )
+        flash_cfg = _llama_1b4_flash_cfg()
         xla_cfg = dataclasses.replace(flash_cfg, remat_mode="block")
         batch, steps, windows, warmup = 1, 5, 2, 1
         xla_protocol = (3, 1, 1)
@@ -313,28 +329,21 @@ def _resnet_setup():
     return state, step, (images, labels)
 
 
-def resnet50_profile() -> None:
-    """Per-op device profile of the ResNet train step (VERDICT r2 item 1).
-
-    Captures a real device trace (works through the axon tunnel) and prints
-    the per-HLO-category breakdown plus a roofline summary.  The round-3
-    analysis this produced is recorded in BASELINE.md: the step is
-    HBM-bandwidth-bound, not MXU- or tunnel-bound, and runs at ~92% of its
-    bandwidth roofline — which is why parity, not a win, is the ceiling for
-    this metric, and why llama8k (where the kernel design changes the
-    bandwidth picture) is the primary metric.
-    """
+def _profile_step(metric: str, state, step, batch, *, steps: int = 5,
+                  warmup: int = 3, extra: dict = None) -> dict:
+    """Capture a device trace of ``steps`` executions of ``step`` and print
+    the per-HLO-category roofline breakdown (train/profiling.py machinery;
+    traces DO capture through the axon tunnel — round-3 finding)."""
     import tempfile
 
     from kubeflow_tpu.train.profiling import profile_steps, trace_summary
 
-    steps = 5
-    state, step, batch = _resnet_setup()
-    with tempfile.TemporaryDirectory(prefix="rn50prof") as td:
-        _, logdir = profile_steps(td, step, state, batch, warmup=3, steps=steps)
+    with tempfile.TemporaryDirectory(prefix="kftprof") as td:
+        _, logdir = profile_steps(td, step, state, batch,
+                                  warmup=warmup, steps=steps)
         s = trace_summary(logdir)
     out = {
-        "metric": "resnet50_profile",
+        "metric": metric,
         "device_ms_per_step": round(s["total_ms"] / steps, 2),
         "gb_per_step": round(s["total_gb"] / steps, 2),
         "tf_per_step": round(s["total_tf"] / steps, 3),
@@ -349,7 +358,51 @@ def resnet50_profile() -> None:
             if v["ms"] / s["total_ms"] >= 0.005
         },
     }
+    if extra:
+        out.update(extra)
     print(json.dumps(out), flush=True)
+    return out
+
+
+def resnet50_profile() -> None:
+    """Per-op device profile of the ResNet train step (VERDICT r2 item 1).
+
+    The round-3 analysis this produced is recorded in BASELINE.md: the step
+    is HBM-bandwidth-bound, not MXU- or tunnel-bound, and runs at ~92% of
+    its bandwidth roofline — which is why parity, not a win, is the ceiling
+    for this metric, and why llama8k (where the kernel design changes the
+    bandwidth picture) is the primary metric.
+    """
+    state, step, batch = _resnet_setup()
+    _profile_step("resnet50_profile", state, step, batch, steps=5, warmup=3)
+
+
+def llama_1b4_profile() -> None:
+    """Per-op device profile of the 1.36B flash train step (VERDICT r4
+    item 1): the scale anchor's 55.7% MFU needs a per-HLO breakdown —
+    remat recompute (uncredited by MFU), the vocab-32k CE path, optimizer
+    update and attention overhead — before anyone can say whether 0.56 is
+    the ceiling or leaves points on the table.  Identical arm construction
+    to llama_1b4_bench's flash arm (batch 1, seq 8192, remat "mlp", plain
+    SGD) via the shared _llama_1b4_flash_cfg."""
+    import optax
+
+    from kubeflow_tpu.models.llama import Llama
+    from kubeflow_tpu.train import create_train_state, make_lm_train_step
+
+    cfg = _llama_1b4_flash_cfg()
+    rng = jax.random.key(0)
+    tokens = jax.random.randint(
+        jax.random.fold_in(rng, 1), (1, LLAMA_SEQ), 0, cfg.vocab_size)
+    model = Llama(cfg)
+    state = create_train_state(rng, model, tokens, optax.sgd(1e-3))
+    step = jax.jit(make_lm_train_step(), donate_argnums=(0,))
+    fpt = lm_train_flops_per_token(cfg, LLAMA_SEQ)
+    _profile_step(
+        "llama1b4_profile", state, step, tokens, steps=5, warmup=2,
+        extra={"model_gflops_per_token": round(fpt / 1e9, 3),
+               "seq_len": LLAMA_SEQ, "batch": 1},
+    )
 
 
 def resnet50_bench() -> None:
@@ -410,7 +463,17 @@ def resnet_band(vs_baseline_mean: float) -> str:
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--profile" in argv:
-        resnet50_profile()
+        # --profile [resnet|llama1b4]; default resnet (the round-3 surface).
+        profiles = {"resnet": resnet50_profile,
+                    "llama1b4": llama_1b4_profile}
+        i = argv.index("--profile") + 1
+        target = argv[i] if i < len(argv) and not argv[i].startswith("-") \
+            else "resnet"
+        if target not in profiles:
+            print(f"unknown profile target {target!r}; "
+                  f"valid: {sorted(profiles)}", file=sys.stderr)
+            return 2
+        profiles[target]()
         return 0
     # Primary metric FIRST (llama8k — promoted in round 3, VERDICT r2
     # item 1: the ResNet step is HBM-bandwidth-bound at ~92% of its

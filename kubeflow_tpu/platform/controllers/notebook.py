@@ -68,8 +68,19 @@ class NotebookReconciler(Reconciler):
                  istio_gateway: Optional[str] = None,
                  cluster_domain: Optional[str] = None,
                  add_fsgroup: Optional[bool] = None,
-                 mirror_min_interval: Optional[float] = None):
+                 mirror_min_interval: Optional[float] = None,
+                 informers: Optional[dict] = None):
         self.client = client
+        # GVK -> Informer for the high-churn secondary reads (pods, events).
+        # When present (make_controller wires them), reconcile reads these
+        # kinds from the indexed cache — O(matches) instead of a per-
+        # reconcile apiserver LIST, which was quadratic across a fleet
+        # (bench_scale.py).  Absent (unit tests constructing the reconciler
+        # bare), reads fall back to client lists — same results, both paths
+        # covered.  Freshness: the cache is updated before the controller's
+        # informer-sourced mappers enqueue (runtime.Controller), so a
+        # reconcile triggered by a pod/event delta always sees it.
+        self.informers: dict = informers or {}
         self.recorder = EventRecorder(client, "notebook-controller")
         self.use_istio = (
             use_istio if use_istio is not None else config.env_bool("USE_ISTIO", True)
@@ -89,15 +100,77 @@ class NotebookReconciler(Reconciler):
             else self.MIRROR_MIN_INTERVAL_SECONDS
         )
 
+    # -- cache-backed reads ---------------------------------------------------
+
+    def _pods_of(self, ns: str, name: str) -> List[Resource]:
+        """This notebook's worker pods: indexed cache read when informers
+        are wired, label-selector LIST otherwise."""
+        inf = self.informers.get(POD)
+        if inf is not None:
+            return inf.index_list("notebook", f"{ns}/{name}")
+        return self.client.list(
+            POD, ns, label_selector={nbapi.LABEL_NOTEBOOK_NAME: name}
+        )
+
+    def _stses_of(self, ns: str, name: str) -> List[Resource]:
+        """This notebook's slice StatefulSets (for stale-slice GC):
+        indexed cache read when wired, label-selector LIST otherwise.  GC
+        from a cache is safe here: a just-created slice missing from a
+        stale cache merely skips this pass (it is never deleted for being
+        absent), and a lowered slice count re-triggers via the owned-STS
+        delta — level-triggered reconcile converges."""
+        inf = self.informers.get(STATEFULSET)
+        if inf is not None:
+            return inf.index_list("notebook", f"{ns}/{name}")
+        return self.client.list(
+            STATEFULSET, ns,
+            label_selector={nbapi.LABEL_NOTEBOOK_NAME: name})
+
+    def _events_involving(self, ns: str, kind: str, name: str) -> List[Resource]:
+        """Events on one involved object: indexed cache read, or a field-
+        selected LIST (involvedObject.* is apiserver-indexed for Events)."""
+        inf = self.informers.get(EVENT)
+        if inf is not None:
+            return inf.index_list("involved", f"{ns}/{kind}/{name}")
+        return self.client.list(
+            EVENT, ns,
+            field_selector={"involvedObject.kind": kind,
+                            "involvedObject.name": name})
+
+    def _pod_events_of_sts(self, ns: str, sts_name: str) -> List[Resource]:
+        """Events on ANY worker pod ``<sts>-<ordinal>`` of one StatefulSet,
+        including pods that no longer exist."""
+        inf = self.informers.get(EVENT)
+        if inf is not None:
+            return inf.index_list("involved", f"{ns}/Pod-of/{sts_name}")
+        out = []
+        for ev in self.client.list(EVENT, ns):
+            io = ev.get("involvedObject") or {}
+            if io.get("kind") != "Pod":
+                continue
+            prefix, _, ordinal = (io.get("name") or "").rpartition("-")
+            if prefix == sts_name and ordinal.isdigit():
+                out.append(ev)
+        return out
+
+    def _get_event(self, name: str, ns: str) -> Resource:
+        inf = self.informers.get(EVENT)
+        if inf is not None:
+            obj = inf.get(name, ns)
+            if obj is None:
+                raise errors.NotFound(f'events "{name}" not found in "{ns}"')
+            return obj
+        return self.client.get(EVENT, name, ns)
+
     # -- reconcile -----------------------------------------------------------
 
     def reconcile(self, req: Request) -> Optional[Result]:
         try:
             notebook = self.client.get(NOTEBOOK, req.name, req.namespace)
         except errors.NotFound:
-            # ownerReference GC tears down children; refresh the gauges so a
-            # deleted notebook's chips don't linger in the metrics.
-            self._update_namespace_gauges(req.namespace)
+            # ownerReference GC tears down children; the fleet gauges are
+            # scrape-time collectors (metrics.NotebookFleetCollector), so a
+            # deleted notebook's chips vanish at the next scrape.
             self._mirror_last.pop((req.namespace, req.name), None)
             # Unconditionally: a failed-over leader has no memory of the
             # key but the durable marker still exists — a leaked marker
@@ -139,23 +212,7 @@ class NotebookReconciler(Reconciler):
             self._reconcile_virtual_service(notebook)
         self._update_status(notebook, stses)
         self._mirror_events(notebook)
-        self._update_namespace_gauges(req.namespace)
         return None
-
-    def _update_namespace_gauges(self, ns: str) -> None:
-        """Aggregate per-namespace gauges over ALL notebooks in the
-        namespace (a per-reconcile set would reflect only the last one)."""
-        chips = 0
-        running = 0
-        for nb in self.client.list(NOTEBOOK, ns):
-            if nbapi.is_stopped(nb):
-                continue
-            s = nbapi.tpu_slice_or_none(nb)
-            if s:
-                chips += s.total_chips
-            running += 1
-        metrics.tpu_chips_requested.labels(namespace=ns).set(chips)
-        metrics.notebook_running.labels(namespace=ns).set(running)
 
     # -- statefulset ---------------------------------------------------------
 
@@ -308,9 +365,7 @@ class NotebookReconciler(Reconciler):
         # A transient list failure must raise (requeue with backoff) — a
         # silent skip would leave a scaled-down slice's pods holding TPUs
         # until the next unrelated event.
-        owned = self.client.list(
-            STATEFULSET, ns, label_selector={nbapi.LABEL_NOTEBOOK_NAME: name}
-        )
+        owned = self._stses_of(ns, name)
         for sts in owned:
             if name_of(sts) not in expected:
                 try:
@@ -562,11 +617,10 @@ class NotebookReconciler(Reconciler):
     # the notebook — user event feeds filter by involvedObject and must
     # not see bookkeeping.
     MIRROR_MARKER_SUFFIX = ".mirror-pass"
-    # Event mirroring lists every Event in the namespace; during the event
-    # storms it exists to surface (FailedScheduling on exhausted TPU
-    # capacity) each event also triggers a reconcile, which would make the
-    # listing O(events²) across the storm.  Bound it: at most one mirroring
-    # pass per notebook per window.
+    # During the event storms mirroring exists to surface (FailedScheduling
+    # on exhausted TPU capacity) each event also triggers a reconcile; even
+    # with indexed reads the mirror writes would churn.  Bound it: at most
+    # one mirroring pass per notebook per window.
     MIRROR_MIN_INTERVAL_SECONDS = 10.0
 
     def _mirror_events(self, notebook: Resource) -> None:
@@ -586,19 +640,29 @@ class NotebookReconciler(Reconciler):
             return  # the periodic resync guarantees a later pass
         self._mirror_last[(ns, name)] = now
         created_ts = deep_get(notebook, "metadata", "creationTimestamp")
+        sts_names = _notebook_sts_names(notebook)
+        # Field-selected lists per involved object, not one namespace-wide
+        # event list: the apiserver indexes Events on involvedObject.*, and
+        # an unselected list made every notebook's mirror pass O(all events
+        # in the namespace) — quadratic across a fleet wave (bench_scale.py;
+        # on 600 notebooks the cold-start passes alone copied 360k events).
+        events = []
         try:
-            events = self.client.list(EVENT, ns)
+            for sts in sorted(sts_names):
+                events.extend(self._events_involving(ns, "StatefulSet", sts))
+                # ALL worker-pod events of this STS, any ordinal, whether
+                # or not the pod still exists (deleted workers' Warnings
+                # must keep mirroring) — one prefix-indexed lookup; the
+                # client fallback filters a namespace event list exactly
+                # like _event_involves_notebook.
+                events.extend(self._pod_events_of_sts(ns, sts))
+            # Previously-created mirrors (they involve the Notebook) —
+            # dedup locally instead of a guaranteed-409 create per
+            # mirrored event on every reconcile.
+            mirrors = self._events_involving(ns, NOTEBOOK.kind, name)
         except errors.ApiError:
             return
-        # The listing already contains previously-created mirrors (they
-        # involve the Notebook) — dedup locally instead of a guaranteed-409
-        # create per mirrored event on every reconcile.
-        existing = {
-            name_of(e): e
-            for e in events
-            if (e.get("involvedObject") or {}).get("kind") == NOTEBOOK.kind
-        }
-        sts_names = _notebook_sts_names(notebook)
+        existing = {name_of(e): e for e in mirrors}
         for ev in events:
             if not _event_involves_notebook(ev, sts_names):
                 continue
@@ -684,9 +748,7 @@ class NotebookReconciler(Reconciler):
         one GET of the durable marker Event per cold key (then memory takes
         over), instead of an unthrottled full event list per notebook."""
         try:
-            marker = self.client.get(
-                EVENT, name + self.MIRROR_MARKER_SUFFIX, ns
-            )
+            marker = self._get_event(name + self.MIRROR_MARKER_SUFFIX, ns)
         except errors.ApiError:
             return None
         from kubeflow_tpu.platform.controllers.culling import _parse_time
@@ -738,9 +800,7 @@ class NotebookReconciler(Reconciler):
 
     def _update_status(self, notebook: Resource, stses: List[Resource]) -> None:
         ns, name = meta(notebook)["namespace"], name_of(notebook)
-        pods = self.client.list(
-            POD, ns, label_selector={nbapi.LABEL_NOTEBOOK_NAME: name}
-        )
+        pods = self._pods_of(ns, name)
         ready = sum(1 for p in pods if _pod_ready(p))
         worker0 = next(
             (p for p in pods if name_of(p) == f"{name}-0"), None
@@ -856,18 +916,66 @@ def events_to_notebook_requests(obj: Resource) -> List[Request]:
     return []
 
 
+def _pod_notebook_index(pod: Resource) -> List[str]:
+    labels = deep_get(pod, "metadata", "labels", default={}) or {}
+    nb = labels.get(nbapi.LABEL_NOTEBOOK_NAME)
+    ns = deep_get(pod, "metadata", "namespace", default="")
+    return [f"{ns}/{nb}"] if nb else []
+
+
+def _event_involved_index(ev: Resource) -> List[str]:
+    io = ev.get("involvedObject") or {}
+    kind, name = io.get("kind"), io.get("name")
+    ns = deep_get(ev, "metadata", "namespace", default="")
+    if not (kind and name):
+        return []
+    keys = [f"{ns}/{kind}/{name}"]
+    if kind == "Pod":
+        # Also file pod events under their StatefulSet prefix (name minus
+        # the trailing ordinal) so the mirror pass can fetch EVERY worker
+        # event of an STS in one lookup — including events whose pod has
+        # already been deleted (a scaled-down worker's OOMKilled Warning
+        # outlives the pod, and the mirror must not lose it).
+        prefix, _, ordinal = name.rpartition("-")
+        if prefix and ordinal.isdigit():
+            keys.append(f"{ns}/Pod-of/{prefix}")
+    return keys
+
+
 def make_controller(client, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
+    from kubeflow_tpu.platform.runtime.informer import Informer
 
+    # Pods and Events are the high-churn secondary reads: source their
+    # watch deltas from indexed informer caches and let reconcile read the
+    # same caches (controller-runtime's cache-backed client — reference
+    # notebook_controller.go:684-733 watches through the manager cache).
+    # The cache applies a delta BEFORE the mapper enqueues, so a reconcile
+    # triggered by an event always sees it.
+    informers = {
+        POD: Informer(client, POD,
+                      indexers={"notebook": _pod_notebook_index}),
+        STATEFULSET: Informer(client, STATEFULSET,
+                              indexers={"notebook": _pod_notebook_index}),
+        EVENT: Informer(client, EVENT,
+                        indexers={"involved": _event_involved_index}),
+    }
     return Controller(
         "notebook-controller",
-        NotebookReconciler(client, **kwargs),
+        NotebookReconciler(client, informers=informers, **kwargs),
         primary=NOTEBOOK,
         owns=[STATEFULSET, SERVICE, VIRTUALSERVICE, PODDISRUPTIONBUDGET],
         watches=[
             (POD, pods_to_notebook_requests),
             (EVENT, events_to_notebook_requests),
         ],
+        informers=informers,
+        # Fleet gauges (notebook_running, tpu_chips_requested) are computed
+        # at scrape time over this client — one list per scrape, not per
+        # reconcile; hooked/unhooked with the controller lifecycle so a
+        # stopped controller's client is never scraped.
+        on_start=lambda: metrics.register_fleet_collector(client),
+        on_stop=lambda: metrics.register_fleet_collector(None),
         # Safety net for drift no watch covers (and for the REST client's
         # bounded watch windows): re-list the primaries periodically.
         resync_period=300.0,

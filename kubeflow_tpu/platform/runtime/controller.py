@@ -132,6 +132,13 @@ class _WorkQueue:
                 heapq.heappush(self._heap, (when, self._seq, req))
                 self._cond.notify()
 
+    def pending(self) -> int:
+        """Backlog depth: pending + parked re-adds (same accounting as the
+        native queue's kfq_pending) — the fleet load test's saturation
+        signal."""
+        with self._cond:
+            return len(self._pending) + len(self._dirty)
+
     def shut_down(self) -> None:
         with self._cond:
             self._shutdown = True
@@ -175,6 +182,9 @@ class Controller:
         resync_period: Optional[float] = None,
         workers: int = 1,
         runnables: Optional[List[Callable[["Controller"], None]]] = None,
+        informers: Optional[dict] = None,
+        on_start: Optional[Callable[[], None]] = None,
+        on_stop: Optional[Callable[[], None]] = None,
     ):
         self.name = name
         self.reconciler = reconciler
@@ -184,6 +194,21 @@ class Controller:
         self.namespace = namespace
         self.resync_period = resync_period
         self.workers = workers
+        # GVK -> Informer: a watched kind with an informer here is sourced
+        # from the informer's delta stream instead of a raw client watch,
+        # and the cache is updated BEFORE the mapper enqueues — so a
+        # reconcile triggered by an event always sees a cache at least as
+        # fresh as that event (controller-runtime's source ordering; the
+        # reconciler reads the same cache via Informer.index_list).  The
+        # controller owns their lifecycle (started in start, stopped in
+        # stop).
+        self.informers: dict = informers or {}
+        # Lifecycle hooks for side effects that must live exactly as long
+        # as the controller (e.g. pointing the process-global fleet-metrics
+        # collector at this client, and unhooking it on stop so nothing
+        # scrapes a dead client).
+        self._on_start = on_start
+        self._on_stop = on_stop
         # Extra daemon loops sharing the controller's lifecycle (the
         # controller-runtime Runnable idea) — e.g. config-file watchers that
         # enqueue reconciles.  Each receives the controller and should exit
@@ -276,16 +301,40 @@ class Controller:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, client) -> None:
+        if self._on_start is not None:
+            self._on_start()
         pairs: List[Tuple[GVK, EventMapper]] = [(self.primary, self._primary_mapper)]
         pairs += [(g, self._owner_mapper) for g in self.owns]
         pairs += self.watches
         for gvk, mapper in pairs:
+            informer = self.informers.get(gvk)
+            if informer is not None:
+                def on_delta(_etype, obj, _mapper=mapper):
+                    for req in _mapper(obj):
+                        self.queue.add(req)
+
+                informer.add_handler(on_delta)
+                continue
             t = threading.Thread(
                 target=self._watch_loop, args=(client, gvk, mapper),
                 name=f"{self.name}-watch-{gvk.kind}", daemon=True,
             )
             t.start()
             self._threads.append(t)
+        for informer in self.informers.values():
+            informer.start()
+        for informer in self.informers.values():
+            # Block until caches sync before workers run (controller-
+            # runtime's WaitForCacheSync): a reconcile against an unsynced
+            # cache would see zero pods and write false status.  A sync
+            # failure is fatal, exactly as controller-runtime treats it —
+            # starting workers anyway would mass-write wrong status.
+            if not informer.wait_for_sync(30.0):
+                self.stop()
+                raise RuntimeError(
+                    f"{self.name}: informer cache for "
+                    f"{informer.gvk.kind} failed to sync within 30s; "
+                    "refusing to start workers against an unsynced cache")
         if self.resync_period:
             t = threading.Thread(
                 target=self._resync_loop, args=(client,),
@@ -310,6 +359,10 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         self.queue.shut_down()
+        for informer in self.informers.values():
+            informer.stop()
+        if self._on_stop is not None:
+            self._on_stop()
 
     # -- test helper ---------------------------------------------------------
 

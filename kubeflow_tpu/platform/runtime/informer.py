@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
     Resource,
+    copy_resource,
     deep_get,
     meta,
     name_of,
@@ -28,10 +29,16 @@ log = logging.getLogger("kubeflow_tpu.runtime.informer")
 
 Handler = Callable[[str, Resource], None]  # (event_type, object)
 
+# An indexer maps an object to the index values it files under (client-go
+# cache.Indexers) — e.g. pods by their notebook-name label.  Values should
+# embed the namespace (``f"{ns}/{...}"``) when the informer spans namespaces.
+IndexFunc = Callable[[Resource], List[str]]
+
 
 class Informer:
     def __init__(self, client, gvk: GVK, *, namespace: Optional[str] = None,
-                 resync_period: float = 3600.0):
+                 resync_period: float = 3600.0,
+                 indexers: Optional[Dict[str, IndexFunc]] = None):
         self.client = client
         self.gvk = gvk
         self.namespace = namespace
@@ -42,6 +49,17 @@ class Informer:
         self._stop = threading.Event()
         self._handlers: List[Handler] = []
         self._thread: Optional[threading.Thread] = None
+        self._indexers: Dict[str, IndexFunc] = dict(indexers or {})
+        # indexer name -> value -> {store key: object ref}; rebuilt on
+        # relist, maintained per delta in _apply.  Reads copy only matches —
+        # the point: an indexed lookup is O(result), not O(store)
+        # (bench_scale.py: per-reconcile label-selector LISTs were the
+        # control plane's last quadratic term at fleet scale).
+        self._indexes: Dict[str, Dict[str, Dict[Tuple[str, str], Resource]]] = {
+            name: {} for name in self._indexers
+        }
+        # (indexer, store key) -> values the key is currently filed under.
+        self._key_values: Dict[Tuple[str, Tuple[str, str]], List[str]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -74,29 +92,36 @@ class Informer:
     # -- read API ------------------------------------------------------------
 
     def get(self, name: str, namespace: Optional[str] = None) -> Optional[Resource]:
-        import copy
-
         with self._lock:
             obj = self._store.get((namespace or "", name))
-        # Deep-copy like every KubeClient.list/get: a caller mutating a
+        # Copy like every KubeClient.list/get: a caller mutating a
         # result must not corrupt the shared cache.
-        return copy.deepcopy(obj) if obj is not None else None
+        return copy_resource(obj) if obj is not None else None
 
     def list(self, namespace: Optional[str] = None, *,
              label_selector: Optional[Dict[str, str]] = None) -> List[Resource]:
-        import copy
-
         with self._lock:
-            items = [copy.deepcopy(o) for o in self._store.values()]
-        if namespace is not None:
-            items = [o for o in items if namespace_of(o) == namespace]
-        if label_selector:
-            def matches(o):
-                labels = deep_get(o, "metadata", "labels", default={}) or {}
-                return all(labels.get(k) == v for k, v in label_selector.items())
+            if namespace is not None:
+                refs = [o for (ns, _), o in self._store.items()
+                        if ns == namespace]
+            else:
+                refs = list(self._store.values())
+            if label_selector:
+                def matches(o):
+                    labels = deep_get(o, "metadata", "labels", default={}) or {}
+                    return all(labels.get(k) == v
+                               for k, v in label_selector.items())
 
-            items = [o for o in items if matches(o)]
-        return items
+                refs = [o for o in refs if matches(o)]
+            return [copy_resource(o) for o in refs]
+
+    def index_list(self, indexer: str, value: str) -> List[Resource]:
+        """Objects filed under ``value`` by ``indexer`` — O(matches), the
+        cache-backed read controller-runtime gives its reconcilers
+        (client-go cache.Indexer.ByIndex)."""
+        with self._lock:
+            bucket = self._indexes[indexer].get(value)
+            return [copy_resource(o) for o in bucket.values()] if bucket else []
 
     def __len__(self) -> int:
         with self._lock:
@@ -106,6 +131,35 @@ class Informer:
 
     def _key(self, obj: Resource) -> Tuple[str, str]:
         return (namespace_of(obj) or "", name_of(obj))
+
+    def _index_drop(self, key: Tuple[str, str]) -> None:
+        """Unfile ``key`` from every index (caller holds the lock)."""
+        for name in self._indexers:
+            vals = self._key_values.pop((name, key), None)
+            if not vals:
+                continue
+            idx = self._indexes[name]
+            for v in vals:
+                bucket = idx.get(v)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del idx[v]
+
+    def _index_set(self, key: Tuple[str, str], obj: Resource) -> None:
+        """(Re)file ``key`` under its current index values (lock held)."""
+        self._index_drop(key)
+        for name, fn in self._indexers.items():
+            try:
+                vals = fn(obj) or []
+            except Exception:
+                log.exception("indexer %s failed", name)
+                vals = []
+            if vals:
+                self._key_values[(name, key)] = vals
+                idx = self._indexes[name]
+                for v in vals:
+                    idx.setdefault(v, {})[key] = obj
 
     def _relist(self) -> Optional[str]:
         """Rebuild the store from a full LIST; returns the collection
@@ -119,6 +173,11 @@ class Informer:
         with self._lock:
             old = self._store
             self._store = fresh
+            if self._indexers:
+                self._indexes = {name: {} for name in self._indexers}
+                self._key_values.clear()
+                for key, obj in fresh.items():
+                    self._index_set(key, obj)
             handlers = list(self._handlers)
         for key, obj in fresh.items():
             prior = old.get(key)
@@ -146,6 +205,7 @@ class Informer:
             if etype == "DELETED":
                 if self._store.pop(key, None) is None:
                     return  # already gone; don't replay the delete
+                self._index_drop(key)
             elif etype in ("ADDED", "MODIFIED"):
                 prior = self._store.get(key)
                 if prior is not None and meta(prior).get(
@@ -157,6 +217,7 @@ class Informer:
                     # must not see duplicates.
                     return
                 self._store[key] = obj
+                self._index_set(key, obj)
             else:
                 return  # BOOKMARK etc.
         self._notify(handlers, etype, obj)

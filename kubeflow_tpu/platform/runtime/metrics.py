@@ -25,24 +25,80 @@ last_culling_timestamp = Gauge(
     "Timestamp of the last culling operation",
     registry=registry,
 )
-notebook_running = Gauge(
-    "notebook_running",
-    "Running notebooks by namespace",
-    ["namespace"],
-    registry=registry,
-)
+# notebook_running and tpu_chips_requested are scrape-time collectors, not
+# eager gauges — see NotebookFleetCollector below.  The reference computes
+# notebook_running the same way: by listing StatefulSets when scraped
+# (reference notebook-controller/pkg/metrics/metrics.go:22-64), not by
+# bookkeeping in the reconciler.  bench_scale.py measured the eager
+# per-reconcile aggregate as the control plane's largest O(N^2) term at
+# fleet scale (every reconcile re-listed the namespace).
 notebook_spawn_seconds = Histogram(
     "notebook_spawn_to_ready_seconds",
     "Seconds from Notebook creation to all workers Ready (the BASELINE.md metric)",
     buckets=(5, 10, 20, 30, 60, 120, 300, 600),
     registry=registry,
 )
-tpu_chips_requested = Gauge(
-    "tpu_chips_requested",
-    "google.com/tpu chips requested by notebooks, per namespace",
-    ["namespace"],
-    registry=registry,
-)
+
+
+class NotebookFleetCollector:
+    """Scrape-time ``notebook_running`` and ``tpu_chips_requested`` gauges:
+    ONE fleet-wide Notebook list per Prometheus scrape (15 s+ cadence)
+    instead of one namespace list per reconcile.  Single-slot: the client
+    is swappable so tests (and a restarted manager) re-point the existing
+    registered collector rather than stacking duplicates in the global
+    registry."""
+
+    def __init__(self):
+        self.client = None
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        chips = GaugeMetricFamily(
+            "tpu_chips_requested",
+            "google.com/tpu chips requested by notebooks, per namespace",
+            labels=["namespace"],
+        )
+        running = GaugeMetricFamily(
+            "notebook_running", "Running notebooks by namespace",
+            labels=["namespace"],
+        )
+        client = self.client
+        if client is not None:
+            from kubeflow_tpu.platform.apis import notebook as nbapi
+            from kubeflow_tpu.platform.k8s.types import NOTEBOOK, namespace_of
+
+            per_ns: dict = {}
+            try:
+                notebooks = client.list(NOTEBOOK, None)
+            except Exception:  # scrape must not take the /metrics page down
+                notebooks = []
+            for nb in notebooks:
+                if nbapi.is_stopped(nb):
+                    continue
+                ns = namespace_of(nb) or ""
+                n_chips, n_running = per_ns.get(ns, (0, 0))
+                s = nbapi.tpu_slice_or_none(nb)
+                per_ns[ns] = (n_chips + (s.total_chips if s else 0),
+                              n_running + 1)
+            for ns, (n_chips, n_running) in sorted(per_ns.items()):
+                chips.add_metric([ns], n_chips)
+                running.add_metric([ns], n_running)
+        yield chips
+        yield running
+
+
+_fleet_collector = NotebookFleetCollector()
+registry.register(_fleet_collector)
+
+
+def register_fleet_collector(client) -> None:
+    """Point the scrape-time fleet gauges at ``client`` (idempotent;
+    pass None to unhook — tests must do this in teardown so later scrapes
+    don't read a dead fixture)."""
+    _fleet_collector.client = client
+
+
 reconcile_errors_total = Counter(
     "reconcile_errors_total",
     "Reconcile errors by controller",

@@ -27,7 +27,7 @@ SURVEY.md §4 calls out as the thing the reference lacks.
 """
 from __future__ import annotations
 
-import copy
+import collections
 import itertools
 import queue
 import threading
@@ -42,6 +42,7 @@ from kubeflow_tpu.platform.k8s.types import (
     NODE,
     POD,
     Resource,
+    copy_resource as _copy_obj,
     deep_get,
     gvk_of,
     match_labels,
@@ -57,17 +58,48 @@ def _key(gvk: GVK, namespace: Optional[str], name: str) -> Key:
     return (gvk.api_version, gvk.kind, namespace or "", name)
 
 
+class _Store(Dict[Key, Resource]):
+    """Key→Resource dict with a per-(apiVersion, kind) secondary index so
+    list and watch-backlog scans touch only same-kind objects.  Without it
+    every LIST iterated every object of every kind — O(total store) per
+    call, which bench_scale.py measured as quadratic across a fleet wave."""
+
+    def __init__(self):
+        super().__init__()
+        self.by_kind: Dict[Tuple[str, str], Dict[Key, Resource]] = {}
+
+    def __setitem__(self, key: Key, value: Resource) -> None:
+        super().__setitem__(key, value)
+        self.by_kind.setdefault((key[0], key[1]), {})[key] = value
+
+    def __delitem__(self, key: Key) -> None:
+        super().__delitem__(key)
+        bucket = self.by_kind.get((key[0], key[1]))
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del self.by_kind[(key[0], key[1])]
+
+    def kind_items(self, gvk: GVK):
+        return self.by_kind.get((gvk.api_version, gvk.kind), {}).items()
+
+
 class FakeKube:
     """KubeClient backed by a dict.  Thread-safe."""
 
     def __init__(self, *, now: Optional[Callable[[], float]] = None):
-        self._objects: Dict[Key, Resource] = {}
+        self._objects: _Store = _Store()
         self._lock = threading.RLock()
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
         self._watchers: List[Tuple[GVK, Optional[str], Optional[dict], queue.Queue]] = []
         self._now = now or time.time
         self._latest_rv = "0"  # collection resourceVersion (see list_with_rv)
+        # Watch-event replay window: (rv, event_type, shared copy), oldest
+        # first; _history_floor is the newest rv already evicted (resumes
+        # at or below it answer 410-style ERROR, like a compacted etcd).
+        self._history: "collections.deque" = collections.deque()
+        self._history_floor = 0
         # SubjectAccessReview policy: (user, verb, gvk, namespace) -> bool.
         self.authz_policy: Optional[Callable[..., bool]] = None
         # (namespace, pod, container|None) -> log text (see set_pod_logs).
@@ -79,7 +111,26 @@ class FakeKube:
         self._latest_rv = str(next(self._rv))
         meta(obj)["resourceVersion"] = self._latest_rv
 
+    # Bounded watch-event history for resourceVersion resume (the etcd
+    # window a real apiserver replays from; older RVs get 410 Gone).  The
+    # size bounds memory; 8192 events cover multiple full reconcile passes
+    # of a 1000-notebook fleet (bench_scale.py).
+    WATCH_HISTORY = 8192
+
     def _emit(self, event_type: str, obj: Resource) -> None:
+        if event_type == "DELETED":
+            # A deletion is a store mutation: it gets its own RV, like the
+            # real apiserver — a watcher resuming at the pre-delete RV must
+            # be able to see the delete in the replay window.
+            self._bump(obj)
+        shared = _copy_obj(obj)
+        self._history.append(
+            (int(meta(shared).get("resourceVersion", 0) or 0),
+             event_type, shared)
+        )
+        while len(self._history) > self.WATCH_HISTORY:
+            rv_int, _, _ = self._history.popleft()
+            self._history_floor = rv_int
         gvk = gvk_of(obj)
         for (wgvk, wns, wsel, q) in list(self._watchers):
             if wgvk.kind != gvk.kind or wgvk.api_version != gvk.api_version:
@@ -88,7 +139,7 @@ class FakeKube:
                 continue
             if wsel and not match_labels(obj, wsel):
                 continue
-            q.put((event_type, copy.deepcopy(obj)))
+            q.put((event_type, _copy_obj(shared)))
 
     def _get_ref(self, gvk: GVK, name: str, namespace: Optional[str]) -> Resource:
         try:
@@ -103,22 +154,20 @@ class FakeKube:
 
     def get(self, gvk: GVK, name: str, namespace: Optional[str] = None) -> Resource:
         with self._lock:
-            return copy.deepcopy(self._get_ref(gvk, name, namespace))
+            return _copy_obj(self._get_ref(gvk, name, namespace))
 
     def list(self, gvk, namespace=None, *, label_selector=None,
              field_selector=None) -> List[Resource]:
         with self._lock:
             out = []
-            for (av, kind, ns, _), obj in self._objects.items():
-                if av != gvk.api_version or kind != gvk.kind:
-                    continue
+            for (_, _, ns, _), obj in self._objects.kind_items(gvk):
                 if gvk.namespaced and namespace and ns != namespace:
                     continue
                 if label_selector and not match_labels(obj, label_selector):
                     continue
                 if field_selector and not _match_fields(obj, field_selector):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(_copy_obj(obj))
             return out
 
     def list_with_rv(self, gvk, namespace=None):
@@ -129,7 +178,7 @@ class FakeKube:
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
         with self._lock:
-            obj = copy.deepcopy(obj)
+            obj = _copy_obj(obj)
             gvk = gvk_of(obj)
             name = name_of(obj)
             ns = namespace_of(obj)
@@ -178,14 +227,14 @@ class FakeKube:
                 self._requota(ns, totals=totals)
             elif gvk.kind == "ResourceQuota":
                 self._requota(ns)
-            return copy.deepcopy(obj)
+            return _copy_obj(obj)
 
     def update(self, obj: Resource) -> Resource:
         with self._lock:
             gvk = gvk_of(obj)
             current = self._get_ref(gvk, name_of(obj), namespace_of(obj))
             self._check_rv(obj, current)
-            obj = copy.deepcopy(obj)
+            obj = _copy_obj(obj)
             if gvk.kind == "ResourceQuota":
                 self._validate_quota(obj)
             if gvk.kind == "Pod" and gvk.api_version == "v1":
@@ -193,7 +242,7 @@ class FakeKube:
                 self._admit_pod_change(obj, current)
             # status is a subresource: PUT on the main resource keeps it.
             if "status" in current:
-                obj["status"] = copy.deepcopy(current["status"])
+                obj["status"] = _copy_obj(current["status"])
             if obj.get("spec") != current.get("spec"):
                 meta(obj)["generation"] = meta(current).get("generation", 1) + 1
             else:
@@ -211,25 +260,25 @@ class FakeKube:
                 self._cascade(meta(obj).get("uid"))
                 if gvk.kind == "Pod":
                     self._requota(namespace_of(obj))
-                return copy.deepcopy(obj)
+                return _copy_obj(obj)
             self._objects[key] = obj
             self._emit("MODIFIED", obj)
             if gvk.kind in ("Pod", "ResourceQuota"):
                 self._requota(namespace_of(obj))
-            return copy.deepcopy(obj)
+            return _copy_obj(obj)
 
     def update_status(self, obj: Resource) -> Resource:
         with self._lock:
             gvk = gvk_of(obj)
             current = self._get_ref(gvk, name_of(obj), namespace_of(obj))
             self._check_rv(obj, current)
-            current["status"] = copy.deepcopy(obj.get("status", {}))
+            current["status"] = _copy_obj(obj.get("status", {}))
             self._bump(current)
             self._emit("MODIFIED", current)
             if gvk.kind == "Pod":
                 # Terminal phases (Succeeded/Failed) release quota.
                 self._requota(namespace_of(current))
-            return copy.deepcopy(current)
+            return _copy_obj(current)
 
     def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge") -> Resource:
         with self._lock:
@@ -238,7 +287,7 @@ class FakeKube:
             # rollback copy so a post-merge validation failure (malformed
             # quota or pod quantities, over-quota resize) leaves the store
             # untouched.
-            rollback = copy.deepcopy(current) \
+            rollback = _copy_obj(current) \
                 if gvk.kind in ("ResourceQuota", "Pod") else None
             if patch_type == "merge" or patch_type == "strategic":
                 from kubeflow_tpu.platform import native
@@ -255,7 +304,7 @@ class FakeKube:
             elif patch_type == "json":
                 from kubeflow_tpu.platform.webhook.jsonpatch import apply_patch
 
-                patched = apply_patch(copy.deepcopy(current), patch)
+                patched = apply_patch(_copy_obj(current), patch)
                 current.clear()
                 current.update(patched)
             else:
@@ -281,11 +330,11 @@ class FakeKube:
                 self._cascade(meta(current).get("uid"))
                 if gvk.kind == "Pod":
                     self._requota(namespace)
-                return copy.deepcopy(current)
+                return _copy_obj(current)
             self._emit("MODIFIED", current)
             if gvk.kind in ("Pod", "ResourceQuota"):
                 self._requota(namespace)
-            return copy.deepcopy(current)
+            return _copy_obj(current)
 
     def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:
         with self._lock:
@@ -325,17 +374,59 @@ class FakeKube:
     def watch(self, gvk, namespace=None, *, resource_version=None,
               label_selector=None, stop: Optional[threading.Event] = None
               ) -> Iterator[Tuple[str, Resource]]:
+        """NOT a generator: the watcher registers at CALL time, atomically
+        (same lock) with the backlog snapshot — a lazy generator would only
+        register at first next(), and every event between the caller's LIST
+        and that first next() would be lost (the informer's relist→watch
+        gap; a real apiserver replays that window from etcd, which is what
+        ``resource_version`` resume does here via the event history).  A
+        resume older than the retained window yields a single 410-style
+        ERROR event and ends, like a compacted etcd — callers relist."""
         q: queue.Queue = queue.Queue()
         entry = (gvk, namespace, label_selector, q)
         with self._lock:
-            # List+watch semantics: emit current state first unless the
-            # caller resumes from a resourceVersion.
-            backlog = [] if resource_version else [
-                ("ADDED", obj) for obj in self.list(
-                    gvk, namespace, label_selector=label_selector
-                )
-            ]
+            if resource_version is None:
+                # List+watch semantics: current state first.
+                backlog = [
+                    ("ADDED", obj) for obj in self.list(
+                        gvk, namespace, label_selector=label_selector
+                    )
+                ]
+            else:
+                try:
+                    since = int(resource_version)
+                except (TypeError, ValueError):
+                    since = -1
+                if since < self._history_floor:
+                    def gone() -> Iterator[Tuple[str, Resource]]:
+                        yield ("ERROR", {
+                            "kind": "Status", "apiVersion": "v1",
+                            "status": "Failure", "reason": "Expired",
+                            "code": 410,
+                            "message": "too old resource version: "
+                                       f"{resource_version}",
+                        })
+                    return gone()
+                backlog = []
+                for rv_int, etype, ref in self._history:
+                    if rv_int <= since:
+                        continue
+                    ogvk = gvk_of(ref)
+                    if (ogvk.kind != gvk.kind
+                            or ogvk.api_version != gvk.api_version):
+                        continue
+                    if (gvk.namespaced and namespace
+                            and namespace_of(ref) != namespace):
+                        continue
+                    if label_selector and not match_labels(
+                            ref, label_selector):
+                        continue
+                    backlog.append((etype, _copy_obj(ref)))
             self._watchers.append(entry)
+        return self._watch_stream(entry, backlog, stop)
+
+    def _watch_stream(self, entry, backlog, stop) -> Iterator[Tuple[str, Resource]]:
+        q = entry[3]
         try:
             for evt in backlog:
                 yield evt
@@ -365,12 +456,14 @@ class FakeKube:
     # -- internals -----------------------------------------------------------
 
     def _quota_refs(self, ns: str) -> List[Resource]:
-        return [obj for (av, kind, objns, _), obj in self._objects.items()
-                if av == "v1" and kind == "ResourceQuota" and objns == ns]
+        from kubeflow_tpu.platform.k8s.types import RESOURCEQUOTA
+
+        return [obj for (_, _, objns, _), obj
+                in self._objects.kind_items(RESOURCEQUOTA) if objns == ns]
 
     def _pod_refs(self, ns: str) -> List[Resource]:
-        return [obj for (av, kind, objns, _), obj in self._objects.items()
-                if av == "v1" and kind == "Pod" and objns == ns]
+        return [obj for (_, _, objns, _), obj
+                in self._objects.kind_items(POD) if objns == ns]
 
     def _admit_pod_quota(self, pod: Resource, ns: str):
         """Quota admission plugin: deny a pod that would exceed any
@@ -544,7 +637,7 @@ def _merge_patch(target: Resource, patch: Any) -> None:
                 target[k] = {}
             _merge_patch(target[k], v)
         else:
-            target[k] = copy.deepcopy(v)
+            target[k] = _copy_obj(v)
 
 
 def _match_fields(obj: Resource, field_selector: Dict[str, str]) -> bool:

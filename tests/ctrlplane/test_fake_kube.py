@@ -142,3 +142,68 @@ def test_error_for_status_reasonless_409_is_generic_conflict():
     err = errors.error_for_status(
         409, "exists", body={"reason": "AlreadyExists"})
     assert type(err) is errors.AlreadyExists
+
+
+def test_watch_resume_replays_history_window(kube):
+    """A watch resuming from a resourceVersion replays the events that
+    happened after it (the etcd window a real apiserver serves) — without
+    this, every event between an informer's LIST and its WATCH was lost
+    until resync (round-5 fix)."""
+    import threading
+
+    nb = {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "user1"},
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+    }
+    created = kube.create(nb)
+    rv = created["metadata"]["resourceVersion"]
+    # Mutations AFTER the captured rv, BEFORE the watch starts:
+    cur = kube.get(NOTEBOOK, "nb", "user1")
+    cur["metadata"]["annotations"] = {"a": "1"}
+    kube.update(cur)
+    kube.delete(NOTEBOOK, "nb", "user1")
+
+    stop = threading.Event()
+    seen = []
+    for etype, obj in kube.watch(NOTEBOOK, "user1", resource_version=rv,
+                                 stop=stop):
+        seen.append((etype, obj["metadata"]["name"]))
+        if etype == "DELETED":
+            break
+    assert ("MODIFIED", "nb") in seen and ("DELETED", "nb") in seen
+    # and nothing from before/at the resume point:
+    assert ("ADDED", "nb") not in seen
+
+
+def test_watch_resume_too_old_gets_410_error(kube):
+    """A resume older than the retained history answers a single 410-style
+    ERROR event (compacted etcd semantics); informers relist on it."""
+    kube.WATCH_HISTORY = 4  # shrink the window for the test
+    for i in range(8):
+        kube.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": f"nb-{i}", "namespace": "user1"},
+            "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+        })
+    events = list(kube.watch(NOTEBOOK, "user1", resource_version="1"))
+    assert len(events) == 1
+    etype, obj = events[0]
+    assert etype == "ERROR" and obj.get("code") == 410
+
+
+def test_watch_registration_is_atomic_with_backlog(kube):
+    """No event can fall between the backlog snapshot and live delivery:
+    registration happens at watch() CALL time under the store lock."""
+    import threading
+
+    stream = kube.watch(NOTEBOOK, "user1", stop=threading.Event())
+    # An event fired AFTER watch() returned but BEFORE iteration begins
+    # must be delivered (the lazy-generator bug lost it).
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "late", "namespace": "user1"},
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+    })
+    etype, obj = next(iter(stream))
+    assert (etype, obj["metadata"]["name"]) == ("ADDED", "late")

@@ -195,3 +195,61 @@ def test_informer_error_event_forces_relist(kube):
     kube.create(rb("b2", "ns1"))
     assert _wait(lambda: inf.get("b2", "ns1") is not None)
     inf.stop()
+
+
+# -- indexers (round 5: cache-backed reconcile reads, client-go ByIndex) ----
+
+
+def _user_index(obj):
+    u = obj["metadata"].get("annotations", {}).get("user")
+    ns = obj["metadata"].get("namespace", "")
+    return [f"{ns}/{u}"] if u else []
+
+
+def test_index_list_tracks_adds_moves_and_deletes(kube):
+    inf = Informer(kube, ROLEBINDING,
+                   indexers={"user": _user_index}).start()
+    assert inf.wait_for_sync()
+    kube.create(rb("b1", "ns1", user="alice@x.org"))
+    kube.create(rb("b2", "ns1", user="alice@x.org"))
+    kube.create(rb("b3", "ns1", user="bob@x.org"))
+    assert _wait(lambda: len(inf.index_list("user", "ns1/alice@x.org")) == 2)
+    assert len(inf.index_list("user", "ns1/bob@x.org")) == 1
+    assert inf.index_list("user", "ns1/nobody@x.org") == []
+
+    # An update that MOVES the object between index values refiles it.
+    b1 = kube.get(ROLEBINDING, "b1", "ns1")
+    b1["metadata"]["annotations"]["user"] = "bob@x.org"
+    kube.update(b1)
+    assert _wait(lambda: len(inf.index_list("user", "ns1/bob@x.org")) == 2)
+    assert len(inf.index_list("user", "ns1/alice@x.org")) == 1
+
+    kube.delete(ROLEBINDING, "b2", "ns1")
+    assert _wait(lambda: inf.index_list("user", "ns1/alice@x.org") == [])
+    inf.stop()
+
+
+def test_index_survives_relist(kube):
+    kube.create(rb("b1", "ns1"))
+    inf = Informer(kube, ROLEBINDING,
+                   indexers={"user": _user_index}).start()
+    assert inf.wait_for_sync()
+    assert len(inf.index_list("user", "ns1/alice@x.org")) == 1
+    # Force a full relist (the resync/410 path rebuilds indexes from
+    # scratch); the index must reflect post-relist reality.
+    kube.create(rb("b2", "ns1"))
+    inf._relist()
+    assert len(inf.index_list("user", "ns1/alice@x.org")) == 2
+    inf.stop()
+
+
+def test_index_list_results_are_copies(kube):
+    kube.create(rb("b1", "ns1"))
+    inf = Informer(kube, ROLEBINDING,
+                   indexers={"user": _user_index}).start()
+    assert inf.wait_for_sync()
+    got = inf.index_list("user", "ns1/alice@x.org")[0]
+    got["metadata"]["annotations"]["user"] = "evil@x.org"
+    assert inf.index_list("user", "ns1/alice@x.org"), \
+        "cache corrupted by caller mutation"
+    inf.stop()

@@ -203,20 +203,38 @@ def test_validation():
 
 
 def test_namespace_chip_gauge_aggregates(kube, reconciler):
+    """Fleet gauges are scrape-time collectors (one list per Prometheus
+    scrape, reference metrics.go:22-64 computes notebook_running the same
+    way) — values follow the live store with no reconcile needed."""
+    from kubeflow_tpu.platform.runtime import metrics
+
+    metrics.register_fleet_collector(kube)
+    # The collector is process-global: unhook this test's store afterwards
+    # or every later scrape in the session reads a dead fixture.
+    try:
+        _gauge_test_body(kube)
+    finally:
+        metrics.register_fleet_collector(None)
+
+
+def _gauge_test_body(kube):
     from kubeflow_tpu.platform.runtime import metrics
 
     kube.create(make_notebook("nb-a", tpu={"accelerator": "v5e", "topology": "4x4"}))
     kube.create(make_notebook("nb-b", tpu={"accelerator": "v5e", "topology": "2x4"}))
-    reconcile(reconciler, "nb-a")
-    reconcile(reconciler, "nb-b")
-    gauge = metrics.tpu_chips_requested.labels(namespace="user1")
-    assert gauge._value.get() == 24  # 16 + 8
+
+    def chips():
+        return metrics.registry.get_sample_value(
+            "tpu_chips_requested", {"namespace": "user1"})
+
+    assert chips() == 24  # 16 + 8
+    assert metrics.registry.get_sample_value(
+        "notebook_running", {"namespace": "user1"}) == 2
     kube.delete(
         __import__("kubeflow_tpu.platform.k8s.types", fromlist=["NOTEBOOK"]).NOTEBOOK,
         "nb-a", "user1",
     )
-    reconcile(reconciler, "nb-a")  # NotFound path refreshes gauges
-    assert gauge._value.get() == 8
+    assert chips() == 8
 
 
 def test_invalid_topology_rejected_at_slice_math():
@@ -557,3 +575,62 @@ def test_mirror_marker_deleted_with_notebook(kube):
     reconcile(r)  # NotFound path cleans the marker
     with pytest.raises(errors.NotFound):
         kube.get(EVENT, "nb.mirror-pass", "user1")
+
+
+def test_deleted_high_ordinal_pod_event_still_mirrored(kube, reconciler):
+    """A scaled-down worker's Warning must keep mirroring even though its
+    pod is gone and its ordinal exceeds the current host count (review r5:
+    the per-ordinal event fetch dropped these; the STS-prefix lookup must
+    not)."""
+    from kubeflow_tpu.platform.k8s.types import EVENT
+
+    # 2x4 = single host: only ordinal 0 is expected to exist.
+    kube.create(make_notebook("nb", tpu={"accelerator": "v5e", "topology": "2x4"}))
+    reconcile(reconciler)
+    _pod_event(kube, "nb-5", reason="OOMKilled",
+               message="worker 5 OOMKilled during scale-down")
+    reconcile(reconciler)
+    mirrored = [
+        e for e in kube.list(EVENT, "user1")
+        if e["involvedObject"].get("kind") == "Notebook"
+        and e.get("reason") == "OOMKilled"
+    ]
+    assert len(mirrored) == 1, "deleted high-ordinal worker event lost"
+
+
+def test_mirror_via_informer_matches_client_fallback(kube):
+    """The informer-backed mirror path (prefix index) mirrors the same
+    events as the bare-client fallback path."""
+    from kubeflow_tpu.platform.k8s.types import EVENT, POD, STATEFULSET
+    from kubeflow_tpu.platform.runtime.informer import Informer
+    from kubeflow_tpu.platform.controllers.notebook import (
+        _event_involved_index,
+        _pod_notebook_index,
+    )
+
+    kube.create(make_notebook("nb", tpu={"accelerator": "v5e", "topology": "2x4"}))
+    _pod_event(kube, "nb-0")
+    _pod_event(kube, "nb-7", reason="OOMKilled", message="gone worker")
+    _pod_event(kube, "unrelated-1")
+    informers = {
+        POD: Informer(kube, POD,
+                      indexers={"notebook": _pod_notebook_index}),
+        STATEFULSET: Informer(kube, STATEFULSET,
+                              indexers={"notebook": _pod_notebook_index}),
+        EVENT: Informer(kube, EVENT,
+                        indexers={"involved": _event_involved_index}),
+    }
+    for inf in informers.values():
+        inf.start()
+        assert inf.wait_for_sync()
+    r = NotebookReconciler(kube, use_istio=True, mirror_min_interval=0,
+                           informers=informers)
+    reconcile(r)
+    for inf in informers.values():
+        inf.stop()
+    mirrored = {
+        e.get("reason")
+        for e in kube.list(EVENT, "user1")
+        if e["involvedObject"].get("kind") == "Notebook"
+    }
+    assert "FailedScheduling" in mirrored and "OOMKilled" in mirrored

@@ -283,3 +283,43 @@ def test_cross_entropy_custom_vjp_matches_log_softmax_reference():
             lambda l: ref(l, labels, weights))(logits)
         assert abs(float(v - vr)) < 1e-5
         assert float(jnp.max(jnp.abs(g - gr))) < 1e-6
+
+
+def test_bf16_grad_dtype_matches_f32_within_tolerance():
+    """grad_dtype=bf16 (mixed precision: bf16 grad storage + f32 master
+    weights) must produce the same training signal as f32 grads within
+    bf16 mantissa tolerance, and the stored grads must actually BE bf16
+    (the memory saving is the point — 2.73 GB at 1.36B params)."""
+    from kubeflow_tpu.train import make_lm_grad_fn
+
+    state, _ = tiny_state()
+    batch = next(batches(1))
+    g32, _, m32 = make_lm_grad_fn()(state, batch)
+    g16, _, m16 = make_lm_grad_fn(grad_dtype=jnp.bfloat16)(state, batch)
+
+    leaves16 = jax.tree.leaves(g16)
+    assert all(x.dtype == jnp.bfloat16 for x in leaves16)
+    assert abs(float(m32["loss"]) - float(m16["loss"])) < 1e-2
+    for a, b in zip(jax.tree.leaves(g32), leaves16):
+        a = a.astype(jnp.float32)
+        b = b.astype(jnp.float32)
+        denom = float(jnp.max(jnp.abs(a))) or 1.0
+        assert float(jnp.max(jnp.abs(a - b))) / denom < 0.05
+
+
+def test_bf16_grad_step_trains():
+    """An end-to-end bf16-grad step updates f32 master params (dtype
+    preserved) and the loss goes down over a few steps."""
+    state, _ = tiny_state()
+    step = jax.jit(make_lm_train_step(grad_dtype=jnp.bfloat16),
+                   donate_argnums=(0,))
+    batch = next(batches(1))
+    first = None
+    for _ in range(8):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert all(
+        x.dtype == jnp.float32 for x in jax.tree.leaves(state.params)
+    )
+    assert float(metrics["loss"]) < first
