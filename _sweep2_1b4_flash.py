@@ -1,0 +1,33 @@
+"""Focused interleaved A/B: 1024x1024 vs 512x1024 at the 1b4 shape."""
+import json, time
+import jax, jax.numpy as jnp
+from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
+
+B, H, S, D = 1, 16, 8192, 128
+rng = jax.random.key(0)
+q = jax.random.normal(jax.random.fold_in(rng, 0), (B, S, H, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, H, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.fold_in(rng, 2), (B, S, H, D), jnp.bfloat16)
+
+def make_step(bq, bk):
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+    return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+steps = {c: make_step(*c) for c in [(1024, 1024), (512, 1024)]}
+for g in steps.values():
+    out = g(q, k, v); float(jnp.sum(out[0].astype(jnp.float32)))
+times = {c: [] for c in steps}
+for r in range(14):
+    for c, g in steps.items():
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = g(q, k, v)
+        float(jnp.sum(out[0].astype(jnp.float32)))
+        times[c].append((time.perf_counter() - t0) / 10)
+for c, ts in times.items():
+    ts.sort()
+    print(json.dumps({"cfg": list(c), "min_ms": round(ts[0]*1e3, 2),
+                      "p25_ms": round(ts[len(ts)//4]*1e3, 2),
+                      "med_ms": round(ts[len(ts)//2]*1e3, 2)}), flush=True)

@@ -1,0 +1,48 @@
+"""Interleaved op-level flash block sweep at the 1.36B attention shape
+(b=1, h=16, s=8192, d=128, causal, fwd+bwd train grad).  Only interleaved
+same-process A/Bs resolve <15% differences through this tunnel
+(BASELINE.md method note)."""
+import functools, json, time
+import jax, jax.numpy as jnp
+from kubeflow_tpu.ops.pallas.flash_attention import flash_attention
+
+B, H, S, D = 1, 16, 8192, 128
+rng = jax.random.key(0)
+q = jax.random.normal(jax.random.fold_in(rng, 0), (B, S, H, D), jnp.bfloat16)
+k = jax.random.normal(jax.random.fold_in(rng, 1), (B, S, H, D), jnp.bfloat16)
+v = jax.random.normal(jax.random.fold_in(rng, 2), (B, S, H, D), jnp.bfloat16)
+
+CONFIGS = [(1024, 1024), (512, 1024), (1024, 512), (512, 512), (256, 1024)]
+
+def make_step(bq, bk):
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return g
+
+steps = {}
+for bq, bk in CONFIGS:
+    try:
+        g = make_step(bq, bk)
+        out = g(q, k, v)
+        float(jnp.sum(out[0].astype(jnp.float32)))
+        steps[(bq, bk)] = g
+    except Exception as e:
+        print(json.dumps({"cfg": [bq, bk], "ok": False,
+                          "err": str(e)[:120]}), flush=True)
+
+REPS, ROUNDS = 10, 6
+times = {c: [] for c in steps}
+for r in range(ROUNDS):
+    for c, g in steps.items():
+        t0 = time.perf_counter()
+        for _ in range(REPS):
+            out = g(q, k, v)
+        float(jnp.sum(out[0].astype(jnp.float32)))
+        times[c].append((time.perf_counter() - t0) / REPS)
+for c, ts in times.items():
+    ts.sort()
+    print(json.dumps({"cfg": list(c), "ok": True,
+                      "min_ms": round(ts[0] * 1e3, 2),
+                      "med_ms": round(ts[len(ts)//2] * 1e3, 2)}), flush=True)
