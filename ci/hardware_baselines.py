@@ -50,7 +50,7 @@ BERT_BATCH = 32
 BERT_SEQ = 128
 BERT_STEPS = 30
 BERT_WARMUP = 3
-VIT_BATCH = 64
+# ViT batch/model construction is owned by bench._vit_setup (shared arm).
 VIT_STEPS = 20
 VIT_WARMUP = 3
 
@@ -158,43 +158,23 @@ def measure_torch_xla_bert() -> dict:
 
 def measure_jax_vit() -> dict:
     """Config 4: ViT-B/16 training step (JAX + Flax, bf16, adamw) at
-    examples/04's batch, single device.  Reports the analytic-matmul
-    roofline position too: achieved model TF/s (2*M*N*K accounting over
-    the patch-embed conv, qkvo, attention and MLP matmuls; train = 3x
-    fwd) against the 197 TF/s v5e bf16 peak."""
+    examples/04's batch, single device — the arm construction and the
+    analytic-matmul flops accounting are SHARED with bench.py
+    (bench._vit_setup / bench.vit_train_flops_per_image), so this lane's
+    baseline and the bench's banded line can never measure different
+    arms (round-5 dedup; the band compares the two directly)."""
     try:
         import jax
-        import jax.numpy as jnp
-        import optax
 
-        from kubeflow_tpu.models import create_model
-        from kubeflow_tpu.train import (
-            create_train_state,
-            make_classification_train_step,
-        )
+        import bench as bench_mod
     except ImportError as e:
         return {"config": 4, "metric": "jax_vit_b16_images_per_sec",
                 "skipped": f"runtime not installed ({e})"}
 
     device = jax.devices()[0].platform
     smoke = bool(int(os.environ.get("KFT_HWLANE_SMOKE", "0")))
-    name, image, batch, steps, warmup = (
-        ("vit_debug", 32, 8, 2, 1) if smoke
-        else ("vit_b16", 224, VIT_BATCH, VIT_STEPS, VIT_WARMUP)
-    )
-    model = create_model(name, dtype=jnp.bfloat16) if not smoke \
-        else create_model(name)
-    rng = jax.random.key(0)
-    images = jax.random.normal(rng, (batch, image, image, 3), jnp.float32)
-    labels = jax.random.randint(
-        jax.random.fold_in(rng, 1), (batch,), 0, model.cfg.num_classes
-    )
-    state = create_train_state(rng, model, images, optax.adamw(3e-4))
-    step = jax.jit(
-        make_classification_train_step(has_batch_stats=False),
-        donate_argnums=(0,),
-    )
-    data = (images, labels)
+    model, state, step, data, batch, _ = bench_mod._vit_setup(smoke=smoke)
+    steps, warmup = (2, 1) if smoke else (VIT_STEPS, VIT_WARMUP)
     for _ in range(warmup):
         state, m = step(state, data)
     float(m["loss"])  # scalar fetch: full device sync through the tunnel
@@ -205,16 +185,7 @@ def measure_jax_vit() -> dict:
     dt = time.perf_counter() - t0
     ips = batch * steps / dt
 
-    cfg = model.cfg
-    n_patches = (cfg.image_size // cfg.patch_size) ** 2
-    s = n_patches + 1  # cls token
-    d = cfg.dim
-    patch_embed = 2 * n_patches * d * (cfg.patch_size ** 2 * 3)
-    per_layer = (4 * 2 * s * d * d            # qkvo projections
-                 + 2 * 2 * s * s * d          # scores + values (full)
-                 + 2 * 2 * s * d * cfg.mlp_dim)  # MLP in + out
-    head = 2 * d * cfg.num_classes
-    train_flops = 3 * (patch_embed + cfg.n_layers * per_layer + head)
+    train_flops = bench_mod.vit_train_flops_per_image(model.cfg)
     tfs = ips * train_flops / 1e12
     return {"config": 4, "metric": "jax_vit_b16_images_per_sec",
             "value": round(ips, 1), "device": device, "batch": batch,

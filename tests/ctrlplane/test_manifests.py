@@ -97,3 +97,65 @@ def test_main_entrypoint_parses():
     assert out.returncode == 0
     for service in ("controllers", "webhook", "dashboard"):
         assert service in out.stdout
+
+
+def test_deploy_runbook_matches_manifests():
+    """docs/deploy.md (VERDICT r4 item 9) cannot rot: every object name,
+    env knob and metric the runbook teaches must exist in the manifests /
+    code, and every env var the manifests set must be documented."""
+    runbook = (ROOT / "docs" / "deploy.md").read_text()
+
+    # Every metadata.name in the manifests that the runbook's tables walk
+    # through must appear verbatim.
+    for obj_name in [
+        "kubeflow-tpu-controller", "kubeflow-tpu-webhook",
+        "kubeflow-tpu-webhook-certs", "kubeflow-tpu-poddefaults",
+        "jupyter-web-app", "kubeflow-self-signing-issuer",
+        "kf-resource-quota",
+    ]:
+        assert obj_name in runbook, f"runbook lost object {obj_name}"
+    manifest_names = {
+        doc.get("metadata", {}).get("name", "") for _, doc in _docs()
+    }
+    for needed in ["kubeflow-tpu-controller", "kubeflow-tpu-webhook",
+                   "kubeflow-tpu-poddefaults", "jupyter-web-app"]:
+        assert needed in manifest_names, f"manifests lost {needed}"
+
+    # Every env var any manifest container sets is documented.
+    for name, doc in _docs():
+        for c in (doc.get("spec", {}).get("template", {}).get("spec", {})
+                  .get("containers", []) or []):
+            for env in c.get("env", []) or []:
+                assert env["name"] in runbook, (
+                    f"{name} sets {env['name']} but docs/deploy.md does "
+                    "not document it")
+
+    # Every knob the runbook documents exists in the code (reading it via
+    # config.env/env_bool/env_float or os.environ).
+    import re
+
+    documented = set(re.findall(r"^\| `([A-Z][A-Z0-9_/ `]*?)`", runbook,
+                                re.MULTILINE))
+    documented = {k.split("`")[0].strip() for k in documented}
+    code = "".join(
+        p.read_text() for p in (ROOT / "kubeflow_tpu" / "platform").rglob("*.py")
+    )
+    for knob in documented:
+        for part in knob.split("/"):
+            part = part.strip(" `")
+            if part:
+                assert f'"{part}"' in code, (
+                    f"docs/deploy.md documents {part} but no platform "
+                    "code reads it")
+
+    # The metrics section names real series.
+    metrics_src = (ROOT / "kubeflow_tpu" / "platform" / "runtime"
+                   / "metrics.py").read_text()
+    for series in ["notebook_spawn_to_ready_seconds", "notebook_running",
+                   "tpu_chips_requested", "reconcile_errors_total",
+                   "notebook_culling_total", "service_heartbeat"]:
+        assert series in runbook and series in metrics_src, series
+
+    # The apply command targets the kustomization that exists.
+    assert "kubectl apply -k manifests/" in runbook
+    assert (MANIFESTS / "kustomization.yaml").exists()

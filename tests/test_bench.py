@@ -55,6 +55,14 @@ def test_bench_main_emits_primary_first_and_last(capsys, monkeypatch):
     assert lines[0] == lines[-1]
     assert "llama1b4_8k_train_tokens_per_sec" in metrics
     assert "resnet50_images_per_sec_per_chip" in metrics
+    assert "vit_b16_images_per_sec" in metrics
+    # Band discipline (VERDICT r4 item 2): the non-smoke value bands are
+    # suppressed under KFT_BENCH_SMOKE (a debug model vs a hardware
+    # baseline is meaningless), but resnet's protocol band is always on.
+    resnet = next(l for l in lines
+                  if l["metric"] == "resnet50_images_per_sec_per_chip")
+    assert resnet["band"] in ("pass", "REGRESSION")
+    assert resnet["band_floor"] == bench.RESNET_REGRESSION_BAND
 
 
 def test_lm_train_flops_per_token_accounting():
@@ -78,6 +86,25 @@ def test_lm_train_flops_per_token_accounting():
                       n_kv_heads=2, ffn_dim=4096, max_seq_len=8192)
     assert bench.lm_train_flops_per_token(gqa, s) < \
         bench.lm_train_flops_per_token(cfg, s)
+
+
+def test_value_band_tripwire():
+    """Every banded line's pass/REGRESSION boundary (VERDICT r4 item 2)."""
+    import bench
+
+    base = 100.0
+    floor = bench.VALUE_BAND_FLOOR
+    assert bench.value_band(base, base) == "pass"
+    assert bench.value_band(base * floor, base) == "pass"
+    assert bench.value_band(base * floor - 1e-9, base) == "REGRESSION"
+    assert bench.value_band(0.0, base) == "REGRESSION"
+    # The baseline constants the bands compare against are the documented
+    # established readings (BASELINE.md) — pin their magnitudes so a
+    # fat-fingered constant cannot silently re-tune a tripwire.
+    assert 150_000 < bench.BASELINE_LLAMA8K_TPS < 160_000
+    assert 10_000 < bench.BASELINE_LLAMA1B4_TPS < 12_000
+    assert 900 < bench.BASELINE_VIT_IPS < 1_050
+    assert 2_400 < bench.BASELINE_IMAGES_PER_SEC < 2_550
 
 
 def test_bench_resnet_band_tripwire():
