@@ -25,7 +25,13 @@ ITERATIONS = 10
 # it was attributed to).  Round 3 sharpened the poller to 2 ms, showing
 # the actual path at 10-12 ms min across sessions, and re-baselined at
 # the upper edge of that band on the MIN estimator: vs_baseline < 1.0
-# means a real regression, 1.0-1.3 is the established band (BASELINE.md).
+# means a real regression, 1.0-1.3 the established band.  Round 5: the
+# fleet-scale informer work (cache-backed pod/STS/event reads in
+# reconcile — BASELINE.md "Control-plane fleet scale") measured 8.7 ms
+# on a quiet host (vs_baseline 1.5), but the same code reads 13 ms under
+# concurrent CPU load — the metric is host-contention-sensitive at this
+# scale, so the constant STAYS at the contention-tolerant 0.013 rather
+# than chasing the quiet-host best into false regressions.
 BASELINE_SPAWN_S = 0.013
 
 
