@@ -177,7 +177,7 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
                  decode=False, mask_bias=None, token_mask=None,
-                 cache_len=None):
+                 cache_len=None, return_hidden=False):
         cfg = self.cfg
         b, s = tokens.shape
         if cache_len is not None and cache_len > cfg.max_seq_len:
@@ -215,10 +215,20 @@ class Llama(nn.Module):
                     cache_len,
                 )
         x = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
-        logits = nn.Dense(
+        head = nn.Dense(
             cfg.vocab_size, use_bias=False, dtype=jnp.float32, name="lm_head"
-        )(x)
-        return logits
+        )
+        if return_hidden:
+            # Long-context path (train/steps.py chunked_cross_entropy): the
+            # caller applies the head per sequence chunk so the full
+            # [B, S, vocab] f32 logits never materialize — at 1.36B/32k
+            # that single tensor is 4.2 GB, the difference between
+            # compiling and not.  Applying the head to ONE position keeps
+            # the param tree identical on both paths (XLA drops the dead
+            # 1-position matmul when its output is unused).
+            _ = head(x[:, :1])
+            return x
+        return head(x)
 
 
 def _factory(name):

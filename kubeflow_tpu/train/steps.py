@@ -161,8 +161,57 @@ def make_classification_train_step(*, has_batch_stats: bool, has_dropout: bool =
     return step
 
 
+def chunked_cross_entropy(
+    hidden: jax.Array,
+    head_kernel: jax.Array,
+    labels: jax.Array,
+    weights: Optional[jax.Array] = None,
+    *,
+    chunk: int = 1024,
+) -> jax.Array:
+    """Mean next-token cross-entropy WITHOUT materializing full logits.
+
+    ``hidden`` [B, S, D] (post-final-norm, pre-head), ``head_kernel``
+    [D, V], ``labels`` [B, S].  A ``lax.scan`` over sequence chunks
+    applies the lm_head and the fused token-NLL per chunk under
+    ``jax.checkpoint``, so peak vocab-sized residency is one
+    [B, chunk, V] tile in each direction instead of [B, S, V] — at
+    1.36B/seq 32k the full f32 logits alone are 4.2 GB, more than the
+    chip has left.  The backward recomputes each chunk's head matmul
+    (2·d·vocab per token ≈ 1-2% extra model FLOPs); dW accumulates
+    across chunks through the scan's closure-gradient sum.  Values and
+    gradients match the unchunked ``cross_entropy`` path to bf16/f32
+    tolerance (tests/test_train_loop.py)."""
+    b, s, d = hidden.shape
+    if s % chunk:
+        raise ValueError(f"seq len {s} not divisible by ce chunk {chunk}")
+    n = s // chunk
+    h = hidden.reshape(b, n, chunk, d).swapaxes(0, 1)  # [n, B, C, D]
+    y = labels.reshape(b, n, chunk).swapaxes(0, 1)     # [n, B, C]
+    if weights is None:
+        w = jnp.ones((n, b, chunk), jnp.float32)
+    else:
+        w = weights.astype(jnp.float32).reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h_c, y_c, w_c = xs
+        # Same math as the unchunked head: nn.Dense(dtype=f32) casts the
+        # bf16 activations up and multiplies against the f32 kernel.
+        logits = jnp.einsum(
+            "bcd,dv->bcv", h_c.astype(jnp.float32),
+            head_kernel.astype(jnp.float32))
+        nll = _token_nll(logits, y_c)
+        loss_sum, w_sum = carry
+        return (loss_sum + jnp.sum(nll * w_c), w_sum + jnp.sum(w_c)), None
+
+    (loss_sum, w_sum), _ = jax.lax.scan(body, (0.0, 0.0), (h, y, w))
+    return loss_sum / jnp.maximum(w_sum, 1.0)
+
+
 def make_lm_grad_fn(*, aux_loss_weight: float = 0.0,
-                    grad_dtype: Optional[Any] = None):
+                    grad_dtype: Optional[Any] = None,
+                    ce_chunk: Optional[int] = None):
     """(state, batch, rng) → (grads, new_model_state, metrics) for
     next-token prediction; see make_lm_train_step for batch forms.
 
@@ -196,8 +245,10 @@ def make_lm_grad_fn(*, aux_loss_weight: float = 0.0,
 
         def loss_fn(params):
             kwargs = {} if segment_ids is None else {"segment_ids": segment_ids}
+            if ce_chunk is not None:
+                kwargs["return_hidden"] = True
             if aux_loss_weight:
-                logits, cols = state.apply_fn(
+                out, cols = state.apply_fn(
                     {"params": params}, tokens, mutable=["losses"], **kwargs
                 )
                 sowed = jax.tree.leaves(cols.get("losses", {}))
@@ -210,21 +261,39 @@ def make_lm_grad_fn(*, aux_loss_weight: float = 0.0,
                     if sowed else 0.0
                 )
             else:
-                logits = state.apply_fn({"params": params}, tokens, **kwargs)
+                out = state.apply_fn({"params": params}, tokens, **kwargs)
                 aux = 0.0
-            # Shift: predict token t+1 from prefix..t.
-            logits = logits[:, :-1]
-            targets = tokens[:, 1:]
-            weights = None
+            # Next-token targets: predict token t+1 from prefix..t.  The
+            # packed-row weights (data/packing.py) count a target only
+            # when it continues the SAME document and is not a pad slot.
+            shifted_valid = None
             if segment_ids is not None:
-                # Packed rows (data/packing.py): a target only counts when
-                # it continues the SAME document (no cross-document
-                # prediction) and is not a pad slot (segment 0).
-                weights = (
+                shifted_valid = (
                     (segment_ids[:, 1:] == segment_ids[:, :-1])
                     & (segment_ids[:, 1:] != 0)
                 )
-            loss = cross_entropy(logits, targets, weights=weights)
+            if ce_chunk is not None:
+                # Chunked head+CE over the FULL length (the chunk grid
+                # needs S % chunk == 0, which a [:, :-1] shift breaks):
+                # targets are tokens rolled left, with the wrapped final
+                # position weighted 0 — identical math to the shifted
+                # unchunked path.
+                hidden = out
+                b, s = tokens.shape
+                targets = jnp.concatenate(
+                    [tokens[:, 1:], tokens[:, :1]], axis=1)
+                valid = (jnp.ones((b, s - 1), jnp.float32)
+                         if shifted_valid is None
+                         else shifted_valid.astype(jnp.float32))
+                w = jnp.concatenate(
+                    [valid, jnp.zeros((b, 1), jnp.float32)], axis=1)
+                loss = chunked_cross_entropy(
+                    hidden, params["lm_head"]["kernel"], targets, w,
+                    chunk=ce_chunk)
+            else:
+                logits = out[:, :-1]
+                targets = tokens[:, 1:]
+                loss = cross_entropy(logits, targets, weights=shifted_valid)
             return loss + aux_loss_weight * aux, (loss, aux)
 
         (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -239,16 +308,20 @@ def make_lm_grad_fn(*, aux_loss_weight: float = 0.0,
 
 
 def make_lm_train_step(*, aux_loss_weight: float = 0.0,
-                       grad_dtype: Optional[Any] = None):
+                       grad_dtype: Optional[Any] = None,
+                       ce_chunk: Optional[int] = None):
     """Next-token-prediction step: batch = tokens[b,s] or (tokens, segment_ids)
     for packed sequences (segment_ids are threaded into attention masking).
 
     ``aux_loss_weight`` > 0 collects the ``"losses"`` collection sowed by MoE
     layers (``moe_aux_loss``) and adds the weighted sum to the objective.
     ``grad_dtype``: see make_lm_grad_fn (bf16 grads + f32 master weights).
+    ``ce_chunk``: chunked lm_head + cross-entropy (chunked_cross_entropy) —
+    the long-context memory lever; requires a model supporting
+    ``return_hidden=True`` with an ``lm_head`` Dense (models/llama.py).
     """
     grad_fn = make_lm_grad_fn(aux_loss_weight=aux_loss_weight,
-                              grad_dtype=grad_dtype)
+                              grad_dtype=grad_dtype, ce_chunk=ce_chunk)
 
     def step(state: TrainState, batch, rng: Optional[jax.Array] = None):
         grads, _, metrics = grad_fn(state, batch, rng)
