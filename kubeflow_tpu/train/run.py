@@ -65,12 +65,20 @@ def build_lm(args, mesh):
         jax.random.key(args.seed), model, tokens, optax.adamw(args.lr)
     )
     state = shard_train_state(state, mesh, llama_rules())
+    # Long-context memory levers (both measured in BASELINE.md): bf16
+    # gradient storage with f32 master weights, and the chunked
+    # lm_head+CE that keeps [B, S, vocab] logits from materializing.
+    step_kwargs = {
+        "grad_dtype": jnp.bfloat16 if args.grad_dtype == "bf16" else None,
+        "ce_chunk": args.ce_chunk,
+    }
     if args.grad_accum > 1:
         from kubeflow_tpu.train import make_grad_accum_step, make_lm_grad_fn
 
-        pure_step = make_grad_accum_step(make_lm_grad_fn(), args.grad_accum)
+        pure_step = make_grad_accum_step(
+            make_lm_grad_fn(**step_kwargs), args.grad_accum)
     else:
-        pure_step = make_lm_train_step()
+        pure_step = make_lm_train_step(**step_kwargs)
     step, data_sharding = make_sharded_train_step(
         pure_step, state, mesh, llama_rules()
     )
@@ -175,6 +183,16 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="microbatches accumulated per optimizer step "
                          "(scanned inside one jit; batch must divide evenly)")
+    ap.add_argument("--grad-dtype", choices=["f32", "bf16"], default="f32",
+                    help="lm task: gradient storage dtype; bf16 = mixed "
+                         "precision with f32 master weights (halves grad "
+                         "memory; under --grad-accum only the per-"
+                         "microbatch grads shrink — the accumulator stays "
+                         "f32 for summation precision)")
+    ap.add_argument("--ce-chunk", type=int, default=None,
+                    help="lm task: chunked lm_head+cross-entropy chunk "
+                         "size (long-context memory lever; seq must "
+                         "divide by it)")
     ap.add_argument("--packed", action="store_true",
                     help="lm task: pack variable-length documents into "
                          "padding-free rows with segment ids")
@@ -186,6 +204,12 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--distributed", action="store_true",
                     help="jax.distributed.initialize from platform-injected env")
     args = ap.parse_args(argv)
+
+    if args.task == "image" and (args.ce_chunk is not None
+                                 or args.grad_dtype != "f32"):
+        # Loud, not silent: a user expecting the memory levers on the
+        # image task would otherwise just OOM with no hint.
+        ap.error("--grad-dtype/--ce-chunk apply to the lm task only")
 
     if args.distributed:
         from kubeflow_tpu.parallel.dist import initialize_from_env

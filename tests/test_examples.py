@@ -147,3 +147,11 @@ def test_tensorflow_notebook_runs_tiny_when_tf_present():
            .replace("steps_per_epoch=10", "steps_per_epoch=1")
            .replace("steps_per_execution=10", "steps_per_execution=1"))
     exec(compile(src, "nb08", "exec"), {})
+
+
+def test_long_context_notebook_runs_tiny(devices8):
+    src = _code("09_long_context.ipynb")
+    src = src.replace("SEQ = 32768", "SEQ = 256")
+    src = src.replace("CE_CHUNK = 2048", "CE_CHUNK = 64")
+    src = src.replace('CONFIGS["llama_1b4"]', 'CONFIGS["llama_debug"]')
+    exec(compile(src, "nb09", "exec"), {})

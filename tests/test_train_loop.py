@@ -390,3 +390,15 @@ def test_chunked_ce_with_moe_aux_loss():
     for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_chk)):
         denom = float(jnp.max(jnp.abs(a))) or 1.0
         assert float(jnp.max(jnp.abs(a - b))) / denom < 2e-4
+
+
+def test_trainer_cli_long_context_levers(devices8):
+    """--grad-dtype bf16 and --ce-chunk through the sharded trainer CLI."""
+    from kubeflow_tpu.train import run as trainer
+
+    rc = trainer.main([
+        "--model", "llama_debug", "--task", "lm", "--steps", "3",
+        "--batch", "8", "--seq", "32", "--mesh", "dp=2,fsdp=2,tp=2",
+        "--grad-dtype", "bf16", "--ce-chunk", "8", "--log-every", "2",
+    ])
+    assert rc == 0
