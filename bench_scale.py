@@ -67,19 +67,33 @@ def _rss_mb() -> float:
 
 
 class FleetHarness:
-    """Notebook controller + kubelet sim against the in-memory apiserver."""
+    """Notebook controller + kubelet sim against the in-memory apiserver.
 
-    def __init__(self, *, workers: int = 4):
+    ``transport="http"`` interposes the real REST client against the fake
+    served over HTTP (the envtest analogue, as ci/e2e.py does) so the
+    controller, its informers and their resourceVersion-resumed watches
+    all cross a real wire; ``watch_window`` shrinks the client's bounded
+    watch windows so the resume/replay path (FakeKube event history, 410
+    on compaction) is exercised MANY times during a wave instead of once
+    per 300 s."""
+
+    def __init__(self, *, workers: int = 4, transport: str = "memory",
+                 watch_window: float = None):
         import logging
 
         from kubeflow_tpu.platform.controllers.notebook import make_controller
         from kubeflow_tpu.platform.testing import FakeKube
 
         logging.getLogger("kubeflow_tpu.runtime").setLevel(logging.ERROR)
+        logging.getLogger("werkzeug").setLevel(logging.ERROR)
         self.kube = FakeKube()
         self.kube.add_namespace("fleet")
         self.kube.add_tpu_node("tpu-node-1", topology="2x4")
-        self.ctrl = make_controller(self.kube, use_istio=False)
+        from kubeflow_tpu.platform.testing.httpkube import make_transport
+
+        self.api_client, self.http_server = make_transport(
+            self.kube, transport, watch_window=watch_window)
+        self.ctrl = make_controller(self.api_client, use_istio=False)
         self.ctrl.workers = workers
         self._stop = threading.Event()
         self._converged: set = set()
@@ -93,13 +107,15 @@ class FleetHarness:
             t = threading.Thread(target=fn, daemon=True)
             t.start()
             self._threads.append(t)
-        self.ctrl.start(self.kube)
+        self.ctrl.start(self.api_client)
 
     def close(self):
         self._stop.set()
         self.ctrl.stop()
         for t in self._threads:
             t.join(timeout=5)
+        if self.http_server is not None:
+            self.http_server.stop()
 
     # -- simulators ----------------------------------------------------------
 
@@ -162,16 +178,22 @@ class FleetHarness:
 
     # -- phases --------------------------------------------------------------
 
-    def wave(self, n: int, *, timeout: float = 300.0) -> dict:
-        """Create n notebooks back-to-back; wait for all to converge."""
+    def wave(self, n: int, *, timeout: float = 300.0,
+             prefix: str = "nb") -> dict:
+        """Create n notebooks back-to-back; wait for all to converge.
+        ``prefix`` lets successive waves in one harness coexist."""
         with self._converged_lock:
             self._target = n + len(self._converged)
+            # Same lock as _convergence_loop's set(): a stale event from
+            # the previous wave must not satisfy this wave's wait.
+            self._conv_event.clear()
         t0 = time.perf_counter()
         cpu0 = time.process_time()
         for i in range(n):
             self.kube.create({
                 "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
-                "metadata": {"name": f"nb-{i:04d}", "namespace": "fleet"},
+                "metadata": {"name": f"{prefix}-{i:04d}",
+                             "namespace": "fleet"},
                 "spec": {
                     "tpu": {"accelerator": "v5e", "topology": "2x4"},
                     "template": {"spec": {"containers": [
@@ -202,7 +224,7 @@ class FleetHarness:
         base = self.ctrl.reconcile_count
         t0 = time.perf_counter()
         cpu0 = time.process_time()
-        objs = self.kube.list(self.ctrl.primary, "fleet")
+        objs = self.api_client.list(self.ctrl.primary, "fleet")
         from kubeflow_tpu.platform.runtime import Request
 
         for obj in objs:

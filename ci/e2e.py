@@ -54,18 +54,11 @@ class E2E:
         # REST client against the FakeKube served over HTTP (the envtest
         # analogue: watches, RV conflicts, patch content types, SARs all
         # cross a real wire — reference suite_test.go:52-113).
-        self.kube = FakeKube()
-        self.http_server = None
-        if transport == "http":
-            from kubeflow_tpu.platform.k8s.client import RestKubeClient
-            from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+        from kubeflow_tpu.platform.testing.httpkube import make_transport
 
-            self.http_server = HttpKubeServer(self.kube).start()
-            self.api_client = RestKubeClient(self.http_server.base_url)
-        elif transport == "memory":
-            self.api_client = self.kube
-        else:
-            raise ValueError(f"unknown transport {transport!r}")
+        self.kube = FakeKube()
+        self.api_client, self.http_server = make_transport(
+            self.kube, transport)
         self.kube.add_namespace("kubeflow")
         self.kube.add_tpu_node("tpu-node-1", topology="2x4")
         self.kube.create(tpu_pod_default("kubeflow", "v5e", "2x4"))

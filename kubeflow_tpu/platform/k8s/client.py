@@ -302,7 +302,10 @@ class RestKubeClient:
               label_selector=None, stop: Optional[threading.Event] = None):
         params: Dict[str, Any] = {
             "watch": "true",
-            "timeoutSeconds": str(self.WATCH_TIMEOUT_SECONDS),
+            # int(): a real apiserver rejects fractional timeoutSeconds;
+            # tests overriding WATCH_TIMEOUT_SECONDS with a float must not
+            # bake a wire format only the fake accepts.
+            "timeoutSeconds": str(max(1, int(self.WATCH_TIMEOUT_SECONDS))),
         }
         if resource_version:
             params["resourceVersion"] = resource_version

@@ -288,3 +288,23 @@ class HttpKubeServer:
     def stop(self):
         self._server.shutdown()
         self._server.server_close()
+
+
+def make_transport(kube, transport: str, *, watch_window: float = None):
+    """The envtest-analogue transport switch shared by ci/e2e.py and
+    bench_scale.py: ``memory`` returns the store itself as the client;
+    ``http`` serves it over a real wire and returns a RestKubeClient
+    (``watch_window`` shrinks the client's bounded watch windows — the
+    resume-path stress knob).  Returns (api_client, http_server-or-None);
+    the caller owns http_server.stop()."""
+    if transport == "memory":
+        return kube, None
+    if transport == "http":
+        from kubeflow_tpu.platform.k8s.client import RestKubeClient
+
+        server = HttpKubeServer(kube).start()
+        client = RestKubeClient(server.base_url)
+        if watch_window is not None:
+            client.WATCH_TIMEOUT_SECONDS = watch_window
+        return client, server
+    raise ValueError(f"unknown transport {transport!r}")
