@@ -298,8 +298,9 @@ def _band(value: float, baseline: float) -> str:
     return "pass" if value <= baseline * BAND_FACTOR else "REGRESSION"
 
 
-def run_fleet(n: int, *, churn_s: float) -> dict:
-    h = FleetHarness()
+def run_fleet(n: int, *, churn_s: float, transport: str = "memory",
+              watch_window: float = None) -> dict:
+    h = FleetHarness(transport=transport, watch_window=watch_window)
     try:
         rss0 = _rss_mb()
         wave = h.wave(n)
@@ -317,10 +318,24 @@ def main(argv=None) -> int:
     p.add_argument("--small", type=int, default=150)
     p.add_argument("--large", type=int, default=600)
     p.add_argument("--churn-seconds", type=float, default=3.0)
+    p.add_argument("--transport", choices=["memory", "http"],
+                   default="memory",
+                   help="http = real REST client against the fake served "
+                        "over the wire (BASELINE.md wire numbers; NOTE "
+                        "the client QPS limiter dominates at default "
+                        "K8S_CLIENT_QPS — set it to 0 to measure the "
+                        "wire itself)")
+    p.add_argument("--watch-window", type=float, default=None,
+                   help="http transport: shrink the client's bounded "
+                        "watch windows (resume-path stress)")
     args = p.parse_args(argv)
 
-    small = run_fleet(args.small, churn_s=args.churn_seconds)
-    large = run_fleet(args.large, churn_s=args.churn_seconds)
+    small = run_fleet(args.small, churn_s=args.churn_seconds,
+                      transport=args.transport,
+                      watch_window=args.watch_window)
+    large = run_fleet(args.large, churn_s=args.churn_seconds,
+                      transport=args.transport,
+                      watch_window=args.watch_window)
 
     per_nb_small = small["wave"]["converge_s"] / args.small * 1e3
     per_nb_large = large["wave"]["converge_s"] / args.large * 1e3

@@ -946,25 +946,45 @@ def make_controller(client, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
     from kubeflow_tpu.platform.runtime.informer import Informer
 
-    # Pods and Events are the high-churn secondary reads: source their
-    # watch deltas from indexed informer caches and let reconcile read the
-    # same caches (controller-runtime's cache-backed client — reference
-    # notebook_controller.go:684-733 watches through the manager cache).
-    # The cache applies a delta BEFORE the mapper enqueues, so a reconcile
-    # triggered by an event always sees it.
+    # EVERY watched kind is sourced from an informer cache (controller-
+    # runtime's design: all sources go through the manager cache —
+    # reference notebook_controller.go:684-733), and reconcile reads
+    # pods/StatefulSets/events from the same indexed caches.  The cache
+    # applies a delta BEFORE the mapper enqueues, so a reconcile
+    # triggered by an event always sees it.  Informer-backed sources also
+    # resume watches by resourceVersion, so a bounded watch window's
+    # rollover (RestKubeClient closes at 300 s) replays only the missed
+    # deltas — a raw client watch re-listed the ENTIRE kind as ADDED
+    # every rollover, a full spurious reconcile sweep per kind per window
+    # at fleet scale (bench_scale.py --transport http).
     informers = {
+        NOTEBOOK: Informer(client, NOTEBOOK),
         POD: Informer(client, POD,
                       indexers={"notebook": _pod_notebook_index}),
         STATEFULSET: Informer(client, STATEFULSET,
                               indexers={"notebook": _pod_notebook_index}),
+        SERVICE: Informer(client, SERVICE),
+        PODDISRUPTIONBUDGET: Informer(client, PODDISRUPTIONBUDGET),
         EVENT: Informer(client, EVENT,
                         indexers={"involved": _event_involved_index}),
     }
+    # The VirtualService kind exists only on Istio clusters: its informer
+    # (whose failed cache sync is FATAL at start, unlike the old tolerant
+    # raw watch) and its owns-watch are gated exactly like the
+    # reconciler's VS writes — USE_ISTIO=false must keep working on a
+    # cluster without the CRD.
+    use_istio = kwargs.get("use_istio")
+    if use_istio is None:
+        use_istio = config.env_bool("USE_ISTIO", True)
+    owns = [STATEFULSET, SERVICE, PODDISRUPTIONBUDGET]
+    if use_istio:
+        informers[VIRTUALSERVICE] = Informer(client, VIRTUALSERVICE)
+        owns.append(VIRTUALSERVICE)
     return Controller(
         "notebook-controller",
         NotebookReconciler(client, informers=informers, **kwargs),
         primary=NOTEBOOK,
-        owns=[STATEFULSET, SERVICE, VIRTUALSERVICE, PODDISRUPTIONBUDGET],
+        owns=owns,
         watches=[
             (POD, pods_to_notebook_requests),
             (EVENT, events_to_notebook_requests),

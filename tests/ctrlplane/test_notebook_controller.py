@@ -634,3 +634,22 @@ def test_mirror_via_informer_matches_client_fallback(kube):
         if e["involvedObject"].get("kind") == "Notebook"
     }
     assert "FailedScheduling" in mirrored and "OOMKilled" in mirrored
+
+
+def test_istio_less_controller_has_no_virtualservice_machinery(kube):
+    """USE_ISTIO=false must not wire a VirtualService informer or watch:
+    the informer's failed cache sync is FATAL at start (unlike the old
+    tolerant raw watch), so on a cluster without the Istio CRD an
+    istio-less controller would refuse to boot (review r5)."""
+    from kubeflow_tpu.platform.controllers.notebook import make_controller
+    from kubeflow_tpu.platform.k8s.types import VIRTUALSERVICE
+
+    ctrl = make_controller(kube, use_istio=False)
+    assert VIRTUALSERVICE not in ctrl.informers
+    assert VIRTUALSERVICE not in ctrl.owns
+
+    ctrl_istio = make_controller(kube, use_istio=True)
+    assert VIRTUALSERVICE in ctrl_istio.informers
+    assert VIRTUALSERVICE in ctrl_istio.owns
+    # Neither controller was started, so the scrape-time collector was
+    # never hooked (that happens in Controller.start); nothing to unhook.
