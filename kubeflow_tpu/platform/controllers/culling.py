@@ -189,4 +189,11 @@ def make_controller(client, **kwargs):
         CullingReconciler(client, **kwargs),
         primary=NOTEBOOK,
         resync_period=60.0,
+        # Probes are blocking I/O (default_prober timeout 10 s): with one
+        # worker a single unreachable notebook stalls every other
+        # notebook's idleness check for the whole timeout, and a fleet of
+        # N notebooks needs N sequential probes per check period.  Eight
+        # workers probe concurrently; the workqueue's per-key exclusion
+        # keeps the single-reconciler-per-notebook guarantee.
+        workers=8,
     )

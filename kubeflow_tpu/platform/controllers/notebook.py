@@ -976,6 +976,9 @@ def make_controller(client, **kwargs):
     use_istio = kwargs.get("use_istio")
     if use_istio is None:
         use_istio = config.env_bool("USE_ISTIO", True)
+    # ONE resolution: forward it so the reconciler cannot re-resolve the
+    # env differently and split-brain against the informer wiring.
+    kwargs["use_istio"] = use_istio
     owns = [STATEFULSET, SERVICE, PODDISRUPTIONBUDGET]
     if use_istio:
         informers[VIRTUALSERVICE] = Informer(client, VIRTUALSERVICE)

@@ -108,3 +108,51 @@ def test_probe_url_dev_mode_uses_kubectl_proxy(monkeypatch):
     monkeypatch.delenv("DEV")
     r = CullingReconciler(FakeKube(), prober=lambda url: [])
     assert url != r.kernels_url("user1", "nb")
+
+
+def test_fleet_of_slow_probes_culls_within_budget(kube):
+    """Fleet-scale culling (round 5): with 8 probe workers, a fleet where
+    every probe takes 50 ms — and a few notebooks are unreachable-slow —
+    still completes a full idleness sweep in a bounded time; one worker
+    would serialize ~N x probe latency."""
+    import threading
+    import time as _time
+
+    from kubeflow_tpu.platform.controllers.culling import make_controller
+
+    N = 40
+    for i in range(N):
+        kube.create(make_notebook(f"fleet-{i}"))
+
+    probed = set()
+    lock = threading.Lock()
+
+    def slow_prober(url):
+        _time.sleep(0.25 if url.count("fleet-3") else 0.05)
+        with lock:
+            probed.add(url)
+        return [{"execution_state": "idle",
+                 "last_activity": "2000-01-01T00:00:00Z"}]
+
+    ctrl = make_controller(
+        kube, prober=slow_prober, idle_minutes=1.0,
+        check_period_minutes=0.01,
+    )
+    assert ctrl.workers == 8
+    ctrl.start(kube)
+    try:
+        deadline = _time.monotonic() + 20.0
+        while _time.monotonic() < deadline:
+            stopped = sum(
+                1 for nb in kube.list(NOTEBOOK, "user1")
+                if nb["metadata"]["name"].startswith("fleet-")
+                and nbapi.is_stopped(nb))
+            if stopped == N:
+                break
+            _time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"only {stopped}/{N} culled within budget "
+                f"({len(probed)} probed)")
+    finally:
+        ctrl.stop()
