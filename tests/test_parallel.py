@@ -218,18 +218,26 @@ def test_ring_attention_matches_reference(devices8, causal):
     assert jnp.max(jnp.abs(out - ref)) < 2e-5
 
 
-@pytest.mark.slow
-def test_sharded_train_step_matches_single_device(devices8):
-    """The same step on a dp/fsdp/tp mesh must produce the same loss as on
-    one device — sharding is an implementation detail, not math."""
+def _parity_setup():
+    """Model/tokens/reference-loss shared by the sharded-step parity
+    tests (one construction so the debug shape can't drift apart)."""
     model = create_model(
         "llama_debug", n_heads=4, n_kv_heads=4, dim=64, vocab_size=128
     )
     tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 128)
     tx = optax.adamw(1e-3)
     state = create_train_state(jax.random.key(0), model, tokens, tx)
+    _, ref_metrics = jax.jit(make_lm_train_step())(state, tokens)
+    return state, tokens, tx, ref_metrics
+
+
+@pytest.mark.slow
+def test_sharded_train_step_matches_single_device(devices8):
+    """The same step on a dp/fsdp/tp mesh must produce the same loss as on
+    one device — sharding is an implementation detail, not math."""
+    state, tokens, tx, ref_metrics = _parity_setup()
+    model = state.apply_fn.__self__
     step = make_lm_train_step()
-    _, ref_metrics = jax.jit(step)(state, tokens)
 
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2), devices=devices8)
     rules = llama_rules()
@@ -363,3 +371,28 @@ def test_hybrid_mesh_real_multislice_branch_keeps_ici_inside_slices():
         {d.slice_index for d in mesh.devices[:, 0].flatten().tolist()}
         != {d.slice_index for d in mesh.devices[:, 1].flatten().tolist()}
     )  # the DCN axis is what crosses slices
+
+
+@pytest.mark.slow
+def test_sharded_step_with_ce_chunk_matches_single_device(devices8):
+    """Chunked CE (ce_chunk, the long-context memory lever) under SPMD —
+    including a sequence-parallel mesh with the sequence axis ACTUALLY
+    sharded (shard_sequence=True), which the CE scan's [B, S, D] →
+    [n, B, C, D] reshape crosses — must stay math-identical to the
+    unsharded unchunked step."""
+    state, tokens, tx, ref_metrics = _parity_setup()
+    step = make_lm_train_step(ce_chunk=8)
+    rules = llama_rules()
+    for cfg_mesh, shard_seq in ((MeshConfig(dp=2, fsdp=2, tp=2), False),
+                                (MeshConfig(dp=2, sp=4), True)):
+        mesh = make_mesh(cfg_mesh, devices=devices8)
+        model = state.apply_fn.__self__
+        sharded = shard_train_state(
+            create_train_state(jax.random.key(0), model, tokens, tx),
+            mesh, rules,
+        )
+        sstep, data_sh = make_sharded_train_step(
+            step, sharded, mesh, rules, shard_sequence=shard_seq)
+        _, metrics = sstep(sharded, jax.device_put(tokens, data_sh))
+        assert abs(float(metrics["loss"]) - float(ref_metrics["loss"])) \
+            < 1e-4, cfg_mesh
