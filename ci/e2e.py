@@ -63,12 +63,16 @@ class E2E:
         self.kube.add_tpu_node("tpu-node-1", topology="2x4")
         self.kube.create(tpu_pod_default("kubeflow", "v5e", "2x4"))
 
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK as NB_GVK
+
         self.mgr = Manager(self.api_client)
-        self.mgr.add(make_controller(self.api_client, use_istio=True))
+        nb_ctrl = self.mgr.add(
+            make_controller(self.api_client, use_istio=True))
         self.mgr.add(profile.make_controller(self.api_client))
         self.mgr.add(tensorboard.make_controller(self.api_client))
         self.mgr.add(culling.make_controller(
-            self.api_client, prober=lambda url: None))
+            self.api_client, prober=lambda url: None,
+            notebook_informer=nb_ctrl.informers.get(NB_GVK)))
         self.mgr.start()
 
         import tempfile

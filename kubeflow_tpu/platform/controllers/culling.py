@@ -74,6 +74,17 @@ class CullingReconciler(Reconciler):
         self.cluster_domain = cluster_domain or config.env("CLUSTER_DOMAIN", "cluster.local")
         self.dev = config.env_bool("DEV", False)
         self._now = now or (lambda: datetime.datetime.now(datetime.timezone.utc))
+        # (ns, name) -> wall-clock datetime (self._now()) of the last
+        # probe — the injectable clock, so tests drive it; a backwards
+        # clock step is clamped in reconcile.  The probe schedule is
+        # the CHECK PERIOD, not the event rate: every
+        # reconcile of a busy notebook patches the last-activity
+        # annotation, whose MODIFIED delta re-enqueues the key — without
+        # this throttle that loop probes the user pod at ~probe-latency
+        # rate instead of once per period (review r5).  Resyncs and
+        # unrelated notebook updates are throttled identically, so an
+        # operator's IDLENESS_CHECK_PERIOD actually governs probe load.
+        self._last_probe: dict = {}
 
     # -- probe url -----------------------------------------------------------
 
@@ -97,20 +108,40 @@ class CullingReconciler(Reconciler):
     # -- reconcile -----------------------------------------------------------
 
     def reconcile(self, req: Request) -> Optional[Result]:
-        requeue = Result(requeue_after=self.check_period * 60.0)
+        now = self._now()
+        key = (req.namespace, req.name)
+        last_probe_at = self._last_probe.get(key)
+        period_s = self.check_period * 60.0
+        if last_probe_at is not None:
+            since = (now - last_probe_at).total_seconds()
+            # The throttle runs BEFORE any apiserver read, or an event
+            # storm still costs one GET per delta.  Negative `since` (a
+            # wall-clock step backwards — these are _now() datetimes, not
+            # monotonic) falls through and probes rather than extending
+            # the suppression by the skew.
+            if 0 <= since < period_s:
+                # Probed recently: don't let watch events / resyncs turn
+                # the check period into the event rate.  (A just-deleted/
+                # stopped notebook's throttle entry lingers at most one
+                # period before the cleanup below sees it.)
+                return Result(requeue_after=period_s - since)
+
+        requeue = Result(requeue_after=period_s)
         try:
             notebook = self.client.get(NOTEBOOK, req.name, req.namespace)
         except errors.NotFound:
+            self._last_probe.pop(key, None)
             return None
         if nbapi.is_stopped(notebook):
+            self._last_probe.pop(key, None)
             return None  # nothing to cull; notebook reconciler handles restart
+
+        self._last_probe[key] = now
 
         kernels = self.prober(self.kernels_url(req.namespace, req.name))
         if kernels is None:
             # Unreachable (starting, crashing, mid-scale) — don't cull blind.
             return requeue
-
-        now = self._now()
         if not self._all_idle(kernels):
             self._record_activity(notebook, now)
             return requeue
@@ -181,14 +212,38 @@ def _parse_time(value: Optional[str]):
     return None
 
 
-def make_controller(client, **kwargs):
+def make_controller(client, *, notebook_informer=None, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
+    from kubeflow_tpu.platform.runtime.informer import Informer
 
+    reconciler = CullingReconciler(client, **kwargs)
     return Controller(
         "culling-controller",
-        CullingReconciler(client, **kwargs),
+        reconciler,
         primary=NOTEBOOK,
-        resync_period=60.0,
+        # Informer-sourced like the notebook controller: a raw watch
+        # re-listed every notebook as ADDED on each bounded-window
+        # rollover, and for THIS controller every spurious reconcile is
+        # an HTTP probe into a user pod.  ``notebook_informer`` lets the
+        # manager process SHARE the notebook controller's informer (one
+        # LIST+WATCH stream and one cache for the kind — the
+        # controller-runtime shared-cache model; Informer.start is
+        # idempotent for exactly this).  The reconciler's per-key probe
+        # throttle keeps the probe rate at the check period regardless
+        # of delta rate.
+        # Explicit None check: Informer defines __len__, so an EMPTY
+        # shared informer is falsy and `or` would silently discard it.
+        # A passed-in informer goes in shared_informers — this controller
+        # must never stop the notebook controller's cache.
+        informers=(None if notebook_informer is not None
+                   else {NOTEBOOK: Informer(client, NOTEBOOK)}),
+        shared_informers=({NOTEBOOK: notebook_informer}
+                          if notebook_informer is not None else None),
+        # The resync re-seeds parked requeues after a restart; it runs at
+        # the operator's check period (not a hardcoded faster one, which
+        # silently overrode IDLENESS_CHECK_PERIOD > 1 min) and reads the
+        # informer cache, not the apiserver.
+        resync_period=max(60.0, reconciler.check_period * 60.0),
         # Probes are blocking I/O (default_prober timeout 10 s): with one
         # worker a single unreachable notebook stalls every other
         # notebook's idleness check for the whole timeout, and a fleet of

@@ -122,11 +122,15 @@ def serve_full_platform(args) -> int:
     # Seed the TPU runtime PodDefault so the webhook path is exercisable.
     kube.create(tpu_pod_default("kubeflow", "v5e", "2x4"))
 
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK as NB_GVK
+
     mgr = Manager(kube)
-    mgr.add(make_controller(kube, use_istio=True))
+    nb_ctrl = mgr.add(make_controller(kube, use_istio=True))
     mgr.add(profile.make_controller(kube))
     mgr.add(tensorboard.make_controller(kube))
-    mgr.add(culling.make_controller(kube, prober=lambda url: None))
+    mgr.add(culling.make_controller(
+        kube, prober=lambda url: None,
+        notebook_informer=nb_ctrl.informers.get(NB_GVK)))
     mgr.start()
 
     webhook = WebhookServer(kube, host="127.0.0.1", port=0)

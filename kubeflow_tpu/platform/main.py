@@ -67,7 +67,8 @@ def run_controllers(args) -> int:
         leader_election=config.env_bool("LEADER_ELECT", False),
         lease_namespace=config.env("POD_NAMESPACE", "kubeflow"),
     )
-    mgr.add(make_controller(client, use_istio=config.env_bool("USE_ISTIO", True)))
+    nb_ctrl = mgr.add(
+        make_controller(client, use_istio=config.env_bool("USE_ISTIO", True)))
     mgr.add(profile.make_controller(
         client,
         heartbeat=True,
@@ -77,7 +78,13 @@ def run_controllers(args) -> int:
     ))
     mgr.add(tensorboard.make_controller(client))
     if config.env_bool("ENABLE_CULLING", False):
-        mgr.add(culling.make_controller(client))
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+
+        # Share the notebook controller's Notebook informer (one
+        # LIST+WATCH stream and cache for the kind in this manager —
+        # the controller-runtime shared-cache model).
+        mgr.add(culling.make_controller(
+            client, notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
     mgr.start()
     _serve_health(mgr, args.health_port)
     logging.info("controllers running (health on :%d)", args.health_port)

@@ -183,6 +183,7 @@ class Controller:
         workers: int = 1,
         runnables: Optional[List[Callable[["Controller"], None]]] = None,
         informers: Optional[dict] = None,
+        shared_informers: Optional[dict] = None,
         on_start: Optional[Callable[[], None]] = None,
         on_stop: Optional[Callable[[], None]] = None,
     ):
@@ -199,10 +200,16 @@ class Controller:
         # and the cache is updated BEFORE the mapper enqueues — so a
         # reconcile triggered by an event always sees a cache at least as
         # fresh as that event (controller-runtime's source ordering; the
-        # reconciler reads the same cache via Informer.index_list).  The
-        # controller owns their lifecycle (started in start, stopped in
-        # stop).
-        self.informers: dict = informers or {}
+        # reconciler reads the same cache via Informer.index_list).
+        # ``informers`` are OWNED (started in start, stopped in stop);
+        # ``shared_informers`` belong to another controller in the same
+        # manager (the shared-cache model) — this controller starts them
+        # idempotently and waits for their sync, but NEVER stops them: the
+        # sharer that dies first must not freeze the survivor's cache.
+        self._owned_informers: dict = informers or {}
+        self._shared_informers: dict = shared_informers or {}
+        self.informers: dict = {**self._shared_informers,
+                                **self._owned_informers}
         # Lifecycle hooks for side effects that must live exactly as long
         # as the controller (e.g. pointing the process-global fleet-metrics
         # collector at this client, and unhooking it on stop so nothing
@@ -250,7 +257,16 @@ class Controller:
     def _resync_loop(self, client) -> None:
         while not self._stop.wait(self.resync_period):
             try:
-                for obj in client.list(self.primary, self.namespace):
+                informer = self.informers.get(self.primary)
+                if informer is not None and informer.has_synced:
+                    # Cache-backed resync: the informer already holds the
+                    # primaries (and its own relist guards against missed
+                    # deltas) — a raw LIST here would hit the apiserver
+                    # with the full kind every period.
+                    objs = informer.list(self.namespace)
+                else:
+                    objs = client.list(self.primary, self.namespace)
+                for obj in objs:
                     for req in self._primary_mapper(obj):
                         self.queue.add(req)
             except Exception:
@@ -321,6 +337,18 @@ class Controller:
             )
             t.start()
             self._threads.append(t)
+        primary_informer = self.informers.get(self.primary)
+        if (primary_informer is not None and self.resync_period
+                and primary_informer in self._owned_informers.values()):
+            # The controller's resync is its documented missed-delta
+            # safety net; now that the resync loop reads the CACHE, the
+            # true apiserver re-list moves into the informer — align its
+            # relist cadence so drift recovery keeps the controller's
+            # period instead of silently degrading to the informer's
+            # hourly default.  (Owned informers only: a shared one's
+            # cadence belongs to its owner.)
+            primary_informer.resync_period = min(
+                primary_informer.resync_period, self.resync_period)
         for informer in self.informers.values():
             informer.start()
         for informer in self.informers.values():
@@ -359,7 +387,7 @@ class Controller:
     def stop(self) -> None:
         self._stop.set()
         self.queue.shut_down()
-        for informer in self.informers.values():
+        for informer in self._owned_informers.values():
             informer.stop()
         if self._on_stop is not None:
             self._on_stop()

@@ -64,6 +64,19 @@ class Informer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "Informer":
+        """Idempotent while running: an informer SHARED between
+        controllers (the manager-cache model — e.g. the notebook and
+        culling controllers both sourcing Notebooks) is started by each
+        sharer; only the first call spawns the list+watch thread.  Loud
+        after stop(): a stopped informer still reports has_synced, so a
+        silent zombie restart (dead thread, frozen cache) would pass
+        wait_for_sync and starve its consumers forever."""
+        if self._stop.is_set():
+            raise RuntimeError(
+                f"informer for {self.gvk.kind} was stopped; informers are "
+                "not restartable — build a new one")
+        if self._thread is not None and self._thread.is_alive():
+            return self
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self.gvk.kind}", daemon=True
         )

@@ -156,3 +156,99 @@ def test_fleet_of_slow_probes_culls_within_budget(kube):
                 f"({len(probed)} probed)")
     finally:
         ctrl.stop()
+
+
+def test_probe_throttled_to_check_period(kube):
+    """Watch-event storms (each _record_activity PATCH re-enqueues the
+    key) must not probe faster than the check period — the probe rate is
+    the operator's knob, not the delta rate (review r5)."""
+    clock = Clock()
+    probes = []
+    r = CullingReconciler(
+        kube,
+        prober=lambda url: probes.append(url) or kernels(
+            "busy", "2026-07-29T11:59:00Z"),
+        idle_minutes=30, check_period_minutes=1.0, now=clock,
+    )
+    for _ in range(5):  # event storm within one period
+        res = r.reconcile(Request("user1", "nb"))
+    assert len(probes) == 1
+    assert res.requeue_after <= 60.0
+    clock.advance(1.01)  # next period: probe again
+    r.reconcile(Request("user1", "nb"))
+    assert len(probes) == 2
+    # A clock step BACKWARDS must not extend the suppression window.
+    clock.advance(-5)
+    r.reconcile(Request("user1", "nb"))
+    assert len(probes) == 3
+    # A stopped notebook drops its throttle entry once the period passes
+    # (the throttle runs before the GET, so cleanup lags one period).
+    nb = kube.get(NOTEBOOK, "nb", "user1")
+    nb["metadata"].setdefault("annotations", {})[nbapi.STOP_ANNOTATION] = "x"
+    kube.update(nb)
+    clock.advance(7)
+    r.reconcile(Request("user1", "nb"))
+    assert ("user1", "nb") not in r._last_probe
+    assert len(probes) == 3  # a stopped notebook is never probed
+
+
+def test_culler_shares_notebook_informer(kube):
+    """The manager wires ONE Notebook informer into both the notebook and
+    culling controllers (controller-runtime shared cache): one list+watch
+    stream, both controllers' mappers fed, idempotent start."""
+    import time as _time
+
+    from kubeflow_tpu.platform.controllers import culling as culling_mod
+    from kubeflow_tpu.platform.controllers.notebook import (
+        make_controller as make_nb,
+    )
+
+    nb_ctrl = make_nb(kube, use_istio=False)
+    shared = nb_ctrl.informers[NOTEBOOK]
+    cull_ctrl = culling_mod.make_controller(
+        kube, prober=lambda url: kernels("idle", "2000-01-01T00:00:00Z"),
+        idle_minutes=0.0, check_period_minutes=0.001,
+        notebook_informer=shared,
+    )
+    assert cull_ctrl.informers[NOTEBOOK] is shared
+    nb_ctrl.start(kube)
+    cull_ctrl.start(kube)  # second start of the shared informer: no-op
+    try:
+        kube.create(make_notebook("shared-nb"))
+        deadline = _time.monotonic() + 10.0
+        while _time.monotonic() < deadline:
+            if nbapi.is_stopped(kube.get(NOTEBOOK, "shared-nb", "user1")):
+                break
+            _time.sleep(0.02)
+        else:
+            raise AssertionError("culler never acted on the shared stream")
+    finally:
+        nb_ctrl.stop()
+        cull_ctrl.stop()
+
+
+def test_sharer_stopping_does_not_kill_shared_informer(kube):
+    """Lifecycle ownership (review r5): a controller handed a SHARED
+    informer must never stop it — the sharer that dies first must not
+    freeze the owner's cache."""
+    from kubeflow_tpu.platform.controllers import culling as culling_mod
+    from kubeflow_tpu.platform.controllers.notebook import (
+        make_controller as make_nb,
+    )
+
+    nb_ctrl = make_nb(kube, use_istio=False)
+    shared = nb_ctrl.informers[NOTEBOOK]
+    cull_ctrl = culling_mod.make_controller(
+        kube, prober=lambda url: None, notebook_informer=shared)
+    nb_ctrl.start(kube)
+    cull_ctrl.start(kube)
+    try:
+        cull_ctrl.stop()
+        assert not shared._stop.is_set(), \
+            "culler stopped the notebook controller's informer"
+    finally:
+        nb_ctrl.stop()
+    assert shared._stop.is_set()  # the OWNER's stop does stop it
+    # And a stopped informer refuses a zombie restart.
+    with pytest.raises(RuntimeError, match="not restartable"):
+        shared.start()

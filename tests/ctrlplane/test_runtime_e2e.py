@@ -53,3 +53,57 @@ def test_notebook_lifecycle_through_manager():
         assert nb["status"]["conditions"][0]["status"] == "True"
     finally:
         mgr.stop()
+
+
+def test_resync_reads_informer_cache_not_apiserver():
+    """A controller whose primary is informer-sourced resyncs from the
+    cache: the periodic re-list must not hit the apiserver with the full
+    kind every period (round 5 — at fleet scale the raw LIST per period
+    was the point of removing it)."""
+    import threading
+    import time
+
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+    from kubeflow_tpu.platform.runtime import Reconciler, Request
+    from kubeflow_tpu.platform.runtime.controller import Controller
+    from kubeflow_tpu.platform.runtime.informer import Informer
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns"},
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+    })
+
+    lists = []
+    orig_list = kube.list
+
+    def counting_list(gvk, namespace=None, **kw):
+        lists.append(gvk.kind)
+        return orig_list(gvk, namespace, **kw)
+
+    seen = []
+    done = threading.Event()
+
+    class Probe(Reconciler):
+        def reconcile(self, req):
+            seen.append(req)
+            if len(seen) >= 3:  # initial + >=2 resync passes
+                done.set()
+
+    informer = Informer(kube, NOTEBOOK)
+    ctrl = Controller("resync-probe", Probe(), primary=NOTEBOOK,
+                      informers={NOTEBOOK: informer}, resync_period=0.1)
+    ctrl.start(kube)
+    kube.list = counting_list  # count only POST-start lists
+    try:
+        assert done.wait(10.0), seen
+        time.sleep(0.25)  # a couple more resync ticks under the counter
+    finally:
+        ctrl.stop()
+        kube.list = orig_list
+    # The resync ticks fed from the informer cache; the apiserver saw no
+    # Notebook LISTs after startup (the informer's own resync is hourly).
+    assert "Notebook" not in lists, lists
