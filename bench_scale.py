@@ -300,10 +300,28 @@ def _band(value: float, baseline: float) -> str:
 
 def run_fleet(n: int, *, churn_s: float, transport: str = "memory",
               watch_window: float = None) -> dict:
+    from kubeflow_tpu.platform.runtime import metrics as rtmetrics
+
     h = FleetHarness(transport=transport, watch_window=watch_window)
     try:
         rss0 = _rss_mb()
+        # Reconcile latency comes from the controller_runtime histogram the
+        # runtime now exports; the pre-wave snapshot diffs out observations
+        # from earlier fleets in this process (the registry is
+        # process-global by design).
+        snap = rtmetrics.histogram_snapshot(
+            rtmetrics.controller_runtime_reconcile_time_seconds,
+            {"controller": h.ctrl.name},
+        )
         wave = h.wave(n)
+        quantiles = rtmetrics.reconcile_quantiles(
+            h.ctrl.name, (0.5, 0.99), since=snap)
+        wave["reconcile_p50_ms"] = (
+            round(quantiles[0.5] * 1e3, 3)
+            if quantiles[0.5] is not None else None)
+        wave["reconcile_p99_ms"] = (
+            round(quantiles[0.99] * 1e3, 3)
+            if quantiles[0.99] is not None else None)
         resync = h.resync_cycle()
         churn = h.churn(seconds=churn_s)
         rss1 = _rss_mb()
@@ -356,6 +374,11 @@ def main(argv=None) -> int:
         "peak_queue_depth": large["wave"]["peak_queue_depth"],
         "reconciles": large["wave"]["reconciles"],
         "reconcile_errors": large["wave"]["errors"],
+        # Histogram-derived control-plane latency (the new
+        # controller_runtime_reconcile_time_seconds series) — BENCH jsons
+        # track where reconcile time goes, not just wave wall time.
+        "reconcile_p50_ms": large["wave"]["reconcile_p50_ms"],
+        "reconcile_p99_ms": large["wave"]["reconcile_p99_ms"],
         "rss_mb_after": large["rss_mb_after"],
     }
     if banded:

@@ -477,12 +477,18 @@ def make_controller(client, *, heartbeat: bool = False, **kwargs):
     runnables = []
     if reconciler.labels_path:
         runnables.append(labels_file_watcher(reconciler.labels_path))
-    if heartbeat:
-        metrics.start_heartbeat("profile")
     return Controller(
         "profile-controller",
         reconciler,
         primary=PROFILE,
         resync_period=300.0,
         runnables=runnables,
+        # Heartbeat rides the controller lifecycle: stop_heartbeat on stop
+        # drops the ticker AND the registry entry, so a rebuilt controller
+        # (tests, leader-election restart) gets a fresh heartbeat instead
+        # of the pre-fix wedged Event.
+        on_start=(lambda: metrics.start_heartbeat("profile"))
+        if heartbeat else None,
+        on_stop=(lambda: metrics.stop_heartbeat("profile"))
+        if heartbeat else None,
     )

@@ -104,6 +104,8 @@ def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
             "podcpu": metrics_service.pod_cpu_utilization,
             "podmem": metrics_service.pod_memory_usage,
             "tpu": metrics_service.tpu_duty_cycle,
+            "reconcile": metrics_service.reconcile_latency,
+            "workqueue": metrics_service.workqueue_depth,
         }
         fn = fetchers.get(mtype)
         if fn is None:

@@ -69,17 +69,34 @@ class MetricsService:
         """TPU-native extension; optional for implementations."""
         raise NotImplementedError
 
+    def reconcile_latency(self, interval: Interval) -> List[TimeSeriesPoint]:
+        """Control-plane extension: p99 reconcile latency per controller
+        (controller_runtime_reconcile_time_seconds); optional."""
+        raise NotImplementedError
+
+    def workqueue_depth(self, interval: Interval) -> List[TimeSeriesPoint]:
+        """Control-plane extension: workqueue backlog per controller
+        (workqueue_depth); optional."""
+        raise NotImplementedError
+
 
 # PromQL for each series.  Rates over 5m windows, aggregated per node/pod —
-# the same shapes the Stackdriver impl queried from GCP monitoring.
+# the same shapes the Stackdriver impl queried from GCP monitoring.  The
+# reconcile/workqueue entries read the control-plane series runtime/metrics.py
+# exports, so the dashboard can show where spawn-to-ready time goes.
 QUERIES = {
     "node": 'sum by (instance) (rate(node_cpu_seconds_total{mode!="idle"}[5m]))',
     "podcpu": "sum by (pod) (rate(container_cpu_usage_seconds_total[5m]))",
     "podmem": "sum by (pod) (container_memory_working_set_bytes)",
     "tpu": "avg by (pod) (tpu_duty_cycle_percent)",
+    "reconcile": (
+        "histogram_quantile(0.99, sum by (controller, le) "
+        "(rate(controller_runtime_reconcile_time_seconds_bucket[5m])))"
+    ),
+    "workqueue": "sum by (name) (workqueue_depth)",
 }
 
-LABEL_KEYS = ("instance", "pod", "node")
+LABEL_KEYS = ("instance", "pod", "node", "controller", "name")
 
 Fetch = Callable[[str, dict], dict]  # (url, params) -> parsed JSON
 
@@ -146,3 +163,9 @@ class PrometheusMetricsService(MetricsService):
 
     def tpu_duty_cycle(self, interval: Interval) -> List[TimeSeriesPoint]:
         return self._query_range(QUERIES["tpu"], interval)
+
+    def reconcile_latency(self, interval: Interval) -> List[TimeSeriesPoint]:
+        return self._query_range(QUERIES["reconcile"], interval)
+
+    def workqueue_depth(self, interval: Interval) -> List[TimeSeriesPoint]:
+        return self._query_range(QUERIES["workqueue"], interval)

@@ -199,7 +199,15 @@ class RestKubeClient:
     # -- plumbing ------------------------------------------------------------
 
     def _request(self, method: str, path: str, *, params: Optional[dict] = None,
-                 body: Optional[Any] = None, stream: bool = False):
+                 body: Optional[Any] = None, stream: bool = False,
+                 verb: Optional[str] = None, kind: str = ""):
+        """``verb``/``kind`` label the client metrics (semantic verb —
+        list vs get both ride HTTP GET — and the resource kind), the same
+        surface the reference gets from client-go's rest_client_* series;
+        the call is also a span on the current reconcile trace."""
+        from kubeflow_tpu.platform.runtime import metrics, trace
+
+        verb = verb or method.lower()
         if self._limiter is not None:
             self._limiter.acquire()
         headers = {}
@@ -211,28 +219,42 @@ class RestKubeClient:
                 "strategic": "application/strategic-merge-patch+json",
                 "apply": "application/apply-patch+yaml",
             }[ptype]
-        resp = self._session.request(
-            method,
-            self.base_url + path,
-            params=params,
-            json=body,
-            headers=headers or None,
-            stream=stream,
-            timeout=None if stream else self.timeout,
-        )
-        if resp.status_code >= 400:
-            try:
-                status = resp.json()
-                message = status.get("message", resp.text)
-            except Exception:
-                status, message = None, resp.text
-            raise errors.error_for_status(resp.status_code, message, status)
-        return resp
+        code = "<error>"
+        t0 = time.perf_counter()
+        try:
+            with trace.span(f"k8s.{verb}", kind=kind) as sp:
+                resp = self._session.request(
+                    method,
+                    self.base_url + path,
+                    params=params,
+                    json=body,
+                    headers=headers or None,
+                    stream=stream,
+                    timeout=None if stream else self.timeout,
+                )
+                code = str(resp.status_code)
+                if sp is not None:
+                    sp.attrs["code"] = code
+                if resp.status_code >= 400:
+                    try:
+                        status = resp.json()
+                        message = status.get("message", resp.text)
+                    except Exception:
+                        status, message = None, resp.text
+                    raise errors.error_for_status(
+                        resp.status_code, message, status)
+                return resp
+        finally:
+            metrics.rest_client_request_duration_seconds.labels(
+                verb=verb, kind=kind).observe(time.perf_counter() - t0)
+            metrics.rest_client_requests_total.labels(
+                verb=verb, kind=kind, code=code).inc()
 
     # -- verbs ---------------------------------------------------------------
 
     def get(self, gvk: GVK, name: str, namespace: Optional[str] = None) -> Resource:
-        return self._request("GET", gvk.path(namespace, name)).json()
+        return self._request("GET", gvk.path(namespace, name),
+                             verb="get", kind=gvk.kind).json()
 
     def list(self, gvk, namespace=None, *, label_selector=None,
              field_selector=None) -> List[Resource]:
@@ -247,14 +269,16 @@ class RestKubeClient:
         fsel = _selector_string(field_selector)
         if fsel:
             params["fieldSelector"] = fsel
-        data = self._request("GET", gvk.path(namespace), params=params).json()
+        data = self._request("GET", gvk.path(namespace), params=params,
+                             verb="list", kind=gvk.kind).json()
         return data.get("items", [])
 
     def list_with_rv(self, gvk, namespace=None):
         """List plus the collection resourceVersion — the correct point to
         resume a watch from (object RVs miss deletions; informers need the
         snapshot RV)."""
-        data = self._request("GET", gvk.path(namespace)).json()
+        data = self._request("GET", gvk.path(namespace),
+                             verb="list", kind=gvk.kind).json()
         rv = ((data.get("metadata") or {}).get("resourceVersion"))
         return data.get("items", []), rv
 
@@ -262,19 +286,22 @@ class RestKubeClient:
         gvk = gvk_of(obj)
         params = {"dryRun": "All"} if dry_run else None
         return self._request(
-            "POST", gvk.path(namespace_of(obj)), params=params, body=obj
+            "POST", gvk.path(namespace_of(obj)), params=params, body=obj,
+            verb="create", kind=gvk.kind,
         ).json()
 
     def update(self, obj: Resource) -> Resource:
         gvk = gvk_of(obj)
         return self._request(
-            "PUT", gvk.path(namespace_of(obj), name_of(obj)), body=obj
+            "PUT", gvk.path(namespace_of(obj), name_of(obj)), body=obj,
+            verb="update", kind=gvk.kind,
         ).json()
 
     def update_status(self, obj: Resource) -> Resource:
         gvk = gvk_of(obj)
         path = gvk.path(namespace_of(obj), name_of(obj)) + "/status"
-        return self._request("PUT", path, body=obj).json()
+        return self._request("PUT", path, body=obj,
+                             verb="update_status", kind=gvk.kind).json()
 
     def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge") -> Resource:
         return self._request(
@@ -282,6 +309,7 @@ class RestKubeClient:
             gvk.path(namespace, name),
             params={"_patch_type": patch_type},
             body=patch,
+            verb="patch", kind=gvk.kind,
         ).json()
 
     def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:
@@ -289,6 +317,7 @@ class RestKubeClient:
             "DELETE",
             gvk.path(namespace, name),
             body={"propagationPolicy": propagation},
+            verb="delete", kind=gvk.kind,
         )
 
     # Watch streams are bounded server-side so a half-dead connection can't
@@ -312,13 +341,24 @@ class RestKubeClient:
         sel = _selector_string(label_selector)
         if sel:
             params["labelSelector"] = sel
-        resp = self._session.request(
-            "GET",
-            self.base_url + gvk.path(namespace),
-            params=params,
-            stream=True,
-            timeout=(10, self.WATCH_TIMEOUT_SECONDS + 30),
-        )
+        from kubeflow_tpu.platform.runtime import metrics
+
+        try:
+            resp = self._session.request(
+                "GET",
+                self.base_url + gvk.path(namespace),
+                params=params,
+                stream=True,
+                timeout=(10, self.WATCH_TIMEOUT_SECONDS + 30),
+            )
+        except Exception:
+            metrics.rest_client_requests_total.labels(
+                verb="watch", kind=gvk.kind, code="<error>").inc()
+            raise
+        # Establishment only — a watch holds a connection for minutes, so
+        # its duration histogram would only measure the bounded window.
+        metrics.rest_client_requests_total.labels(
+            verb="watch", kind=gvk.kind, code=str(resp.status_code)).inc()
         if resp.status_code >= 400:
             raise errors.error_for_status(resp.status_code, resp.text)
         try:
@@ -337,7 +377,8 @@ class RestKubeClient:
         backing call (reference crud_backend/api/pod.py:11-15)."""
         params = {"container": container} if container else None
         path = f"/api/v1/namespaces/{namespace}/pods/{name}/log"
-        return self._request("GET", path, params=params).text
+        return self._request("GET", path, params=params,
+                             verb="logs", kind="Pod").text
 
     def can_i(self, user, verb, gvk, namespace=None, *, groups=None, subresource="") -> bool:
         review = {
@@ -356,6 +397,7 @@ class RestKubeClient:
             },
         }
         resp = self._request(
-            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews", body=review
+            "POST", "/apis/authorization.k8s.io/v1/subjectaccessreviews",
+            body=review, verb="create", kind="SubjectAccessReview",
         ).json()
         return bool(resp.get("status", {}).get("allowed"))

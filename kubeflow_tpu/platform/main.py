@@ -33,8 +33,20 @@ class _QuietHandler(WSGIRequestHandler):
         pass
 
 
-def _serve_health(manager, port: int) -> None:
-    """/healthz + /metrics for the controller deployment's probes."""
+def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
+                  debug_traces: bool = None):
+    """/healthz + /metrics + /debug/traces for the controller deployment.
+
+    /metrics carries the whole control-plane surface (workqueue_*,
+    controller_runtime_reconcile_time_seconds, rest_client_*, informer_*);
+    /debug/traces returns the last N reconcile span trees as JSON —
+    ``?n=5`` limits to the newest 5.  The health port is unauthenticated
+    (probes and Prometheus need it), so ``DEBUG_TRACES=false`` turns the
+    traces endpoint into a 404 for fleets where per-reconcile
+    namespace/name pairs are more than /metrics already reveals.
+    Returns the WSGIServer (tests bind port 0 and shut it down)."""
+    if debug_traces is None:
+        debug_traces = config.env_bool("DEBUG_TRACES", True)
 
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "")
@@ -48,11 +60,24 @@ def _serve_health(manager, port: int) -> None:
 
             start_response("200 OK", [("Content-Type", "text/plain; version=0.0.4")])
             return [metrics.render()]
+        if path == "/debug/traces" and debug_traces:
+            from urllib.parse import parse_qs
+
+            from kubeflow_tpu.platform.runtime import trace
+
+            qs = parse_qs(environ.get("QUERY_STRING", ""))
+            try:
+                n = int(qs["n"][0]) if "n" in qs else None
+            except (ValueError, IndexError):
+                n = None
+            start_response("200 OK", [("Content-Type", "application/json")])
+            return [json.dumps({"traces": trace.recent(n)}).encode()]
         start_response("404 Not Found", [("Content-Type", "text/plain")])
         return [b"not found"]
 
-    server = make_server("0.0.0.0", port, app, handler_class=_QuietHandler)
+    server = make_server(host, port, app, handler_class=_QuietHandler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
 
 
 def run_controllers(args) -> int:

@@ -220,15 +220,28 @@ class NativeWorkQueue:
     """ctypes wrapper over kfq_* keeping the Python _WorkQueue interface.
 
     Maps hashable request objects <-> int64 keys at the boundary; the
-    queueing itself (heap, dedup, backoff) runs in C++.
+    queueing itself (heap, dedup, backoff) runs in C++.  ``metrics`` is the
+    shared WorkQueueMetrics shim (runtime/metrics.py) — hooks fire at the
+    same semantic points as _WorkQueue's so the workqueue_* series are in
+    parity across engines; timing state lives in the shim because the C++
+    queue's internals are opaque here.
     """
 
-    def __init__(self, *, base_delay: float = 0.05, max_delay: float = 30.0):
+    def __init__(self, *, base_delay: float = 0.05, max_delay: float = 30.0,
+                 metrics=None):
         lib = _load()
         if lib is None:
             raise NativeError("native library unavailable")
         self._lib = lib
         self._q = lib.kfq_new(base_delay, max_delay)
+        self._base = base_delay
+        self._max = max_delay
+        self.metrics = metrics
+        # Mirrors the C++ shutdown_ flag (only this wrapper's shut_down()
+        # sets it): the engine silently drops adds after shutdown, so the
+        # metric hooks must not fire for them — _WorkQueue guards the same
+        # way, and the shim's cross-engine parity depends on it.
+        self._shutdown = False
         self._lock = threading.Lock()
         self._next_id = 0
         self._to_id: Dict[Any, int] = {}
@@ -251,11 +264,26 @@ class NativeWorkQueue:
 
     def add(self, req: Any, *, delay: float = 0.0) -> None:
         with self._lock:
+            if self._shutdown:
+                return
+            if self.metrics is not None:
+                self.metrics.on_add(req, delay=delay)
             self._lib.kfq_add(self._q, self._key_locked(req), delay)
 
     def add_rate_limited(self, req: Any) -> None:
         with self._lock:
-            self._lib.kfq_add_rate_limited(self._q, self._key_locked(req))
+            if self._shutdown:
+                return
+            key = self._key_locked(req)
+            if self.metrics is not None:
+                # Mirror the C++ backoff (min(base * 2^failures, max)) so
+                # the shim's eligible-time bookkeeping matches what the
+                # engine will actually schedule.
+                n = self._lib.kfq_failures(self._q, key)
+                self.metrics.on_retry(req)
+                self.metrics.on_add(
+                    req, delay=min(self._base * (2 ** n), self._max))
+            self._lib.kfq_add_rate_limited(self._q, key)
 
     def forget(self, req: Any) -> None:
         with self._lock:
@@ -277,7 +305,19 @@ class NativeWorkQueue:
         if key < 0:
             return None
         with self._lock:
-            return self._from_id.get(key)
+            req = self._from_id.get(key)
+            # on_get under the SAME lock as add()'s on_add, like
+            # _WorkQueue.  One residual skew the wrapper cannot close: the
+            # C++ pop happens outside this lock, so an add(key) landing in
+            # the microseconds before the hook runs merges into the entry
+            # on_get consumes.  "Earliest eligible wins" keeps THIS
+            # delivery's wait correct; the racing re-add's own wait is
+            # later observed as ~0s (its entry was consumed here).  Making
+            # it exact needs kfq_get to return the enqueue timestamp —
+            # not worth the ABI change for a µs-window histogram skew.
+            if req is not None and self.metrics is not None:
+                self.metrics.on_get(req)
+        return req
 
     def done(self, req: Any) -> None:
         """Release the per-key exclusion taken by get().  Also the point
@@ -288,6 +328,9 @@ class NativeWorkQueue:
             key = self._to_id.get(req)
             if key is None:
                 return
+            if self.metrics is not None and self._lib.kfq_is_processing(
+                    self._q, key):
+                self.metrics.on_done(req)
             self._lib.kfq_done(self._q, key)
             if (
                 not self._lib.kfq_is_pending(self._q, key)
@@ -301,6 +344,8 @@ class NativeWorkQueue:
         return int(self._lib.kfq_pending(self._q))
 
     def shut_down(self) -> None:
+        with self._lock:
+            self._shutdown = True
         self._lib.kfq_shutdown(self._q)
 
     def __del__(self):

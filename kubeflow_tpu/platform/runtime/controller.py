@@ -52,7 +52,12 @@ class _WorkQueue:
     ``workers > 1`` without two workers ever reconciling one key at once
     (the single-reconciler-per-key model, SURVEY.md §5 race detection)."""
 
-    def __init__(self, *, base_delay: float = 0.05, max_delay: float = 30.0):
+    def __init__(self, *, base_delay: float = 0.05, max_delay: float = 30.0,
+                 metrics=None):
+        # Optional WorkQueueMetrics shim (runtime/metrics.py) — the same
+        # hooks NativeWorkQueue calls, so the two engines export identical
+        # workqueue_* series.
+        self.metrics = metrics
         self._cond = threading.Condition()
         self._heap: List[Tuple[float, int, Request]] = []
         # req -> (seq of the live heap entry, its scheduled time).  Stale heap
@@ -72,6 +77,8 @@ class _WorkQueue:
         with self._cond:
             if self._shutdown:
                 return
+            if self.metrics is not None:
+                self.metrics.on_add(req, delay=delay)
             when = time.monotonic() + max(delay, 0.0)
             if req in self._processing:
                 # Parked until done(); keep the EARLIEST requested time so a
@@ -91,8 +98,12 @@ class _WorkQueue:
 
     def add_rate_limited(self, req: Request) -> None:
         with self._cond:
+            if self._shutdown:
+                return  # same silent drop as add(); no retry counted
             n = self._failures.get(req, 0)
             self._failures[req] = n + 1
+        if self.metrics is not None:
+            self.metrics.on_retry(req)
         self.add(req, delay=min(self._base * (2**n), self._max))
 
     def forget(self, req: Request) -> None:
@@ -113,6 +124,8 @@ class _WorkQueue:
                         continue  # superseded by a rescheduled entry
                     del self._pending[req]
                     self._processing.add(req)
+                    if self.metrics is not None:
+                        self.metrics.on_get(req)
                     return req
                 if now >= deadline:
                     return None
@@ -124,6 +137,8 @@ class _WorkQueue:
     def done(self, req: Request) -> None:
         """Mark a get()-returned key finished; a parked re-add fires now."""
         with self._cond:
+            if self.metrics is not None and req in self._processing:
+                self.metrics.on_done(req)
             self._processing.discard(req)
             when = self._dirty.pop(req, None)
             if when is not None and not self._shutdown:
@@ -145,10 +160,16 @@ class _WorkQueue:
             self._cond.notify_all()
 
 
-def make_workqueue(*, base_delay: float = 0.05, max_delay: float = 30.0):
+def make_workqueue(*, base_delay: float = 0.05, max_delay: float = 30.0,
+                   name: Optional[str] = None):
     """Prefer the native C++ workqueue (libkfnative kfq_*); fall back to
     the pure-Python _WorkQueue.  Interfaces are identical; parity is
     enforced by tests/ctrlplane/test_native.py.
+
+    ``name`` turns on the client-go workqueue metrics (workqueue_depth,
+    _adds_total, _queue/_work_duration_seconds, _retries_total,
+    _unfinished_work_seconds, labeled {name=...}) through the shared
+    WorkQueueMetrics shim — identical series from either engine.
 
     Contract (same as client-go's workqueue): every key returned by
     ``get()`` MUST be released with ``done(key)`` — normally in a
@@ -158,12 +179,24 @@ def make_workqueue(*, base_delay: float = 0.05, max_delay: float = 30.0):
     key permanently."""
     from kubeflow_tpu.platform import native
 
+    shim = None
+    if name is not None:
+        from kubeflow_tpu.platform.runtime import metrics as _metrics
+
+        shim = _metrics.WorkQueueMetrics(name)
+    queue = None
     if native.available():
         try:
-            return native.NativeWorkQueue(base_delay=base_delay, max_delay=max_delay)
+            queue = native.NativeWorkQueue(
+                base_delay=base_delay, max_delay=max_delay, metrics=shim)
         except Exception:
-            pass
-    return _WorkQueue(base_delay=base_delay, max_delay=max_delay)
+            queue = None
+    if queue is None:
+        queue = _WorkQueue(
+            base_delay=base_delay, max_delay=max_delay, metrics=shim)
+    if shim is not None:
+        shim.attach(queue)
+    return queue
 
 
 EventMapper = Callable[[Resource], List[Request]]
@@ -221,7 +254,7 @@ class Controller:
         # enqueue reconciles.  Each receives the controller and should exit
         # when controller._stop is set.
         self.runnables = runnables or []
-        self.queue = make_workqueue()
+        self.queue = make_workqueue(name=name)
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()
         self.reconcile_count = 0
@@ -285,16 +318,31 @@ class Controller:
                 self.queue.done(req)
 
     def _reconcile_one(self, req: Request) -> None:
+        from kubeflow_tpu.platform.runtime import metrics, trace
+
+        # Per-reconcile trace: spans opened anywhere on this thread during
+        # the reconcile (client calls, informer reads) attach to it.  The
+        # dequeue span replays the workqueue wait the metrics shim observed
+        # when this key was handed out.
+        tr = trace.begin(self.name, f"{req.namespace}/{req.name}")
+        shim = getattr(self.queue, "metrics", None)
+        if tr is not None and shim is not None:
+            tr.add_span("dequeue", duration_s=shim.wait_of(req),
+                        queue="workqueue")
+        outcome = "success"
+        t0 = time.perf_counter()
         try:
-            result = self.reconciler.reconcile(req)
+            with trace.span("reconcile"):
+                result = self.reconciler.reconcile(req)
             self.queue.forget(req)
             self.reconcile_count += 1
             if result and result.requeue_after:
+                outcome = "requeue_after"
                 self.queue.add(req, delay=result.requeue_after)
         except Exception as e:
+            outcome = "error"
             self.error_count += 1
             from kubeflow_tpu.platform.k8s.errors import Conflict
-            from kubeflow_tpu.platform.runtime import metrics
 
             metrics.reconcile_errors_total.labels(controller=self.name).inc()
             if isinstance(e, Conflict):
@@ -313,6 +361,11 @@ class Controller:
                     traceback.format_exc(),
                 )
             self.queue.add_rate_limited(req)
+        finally:
+            metrics.controller_runtime_reconcile_time_seconds.labels(
+                controller=self.name, result=outcome
+            ).observe(time.perf_counter() - t0)
+            trace.finish(result=outcome)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kubeflow_tpu.platform.runtime import metrics, trace
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
     Resource,
@@ -50,6 +52,15 @@ class Informer:
         self._handlers: List[Handler] = []
         self._thread: Optional[threading.Thread] = None
         self._indexers: Dict[str, IndexFunc] = dict(indexers or {})
+        # Monotonic time of the last completed relist (None until the
+        # first sync) and of start().  Scraped as
+        # informer_last_sync_age_seconds by the runtime state collector
+        # (metrics.register_informer) — a growing age means the relist
+        # safety net has stalled; before the first sync the age counts
+        # from start(), so an informer wedged on its initial LIST is
+        # visible too.
+        self.last_sync_monotonic: Optional[float] = None
+        self.started_monotonic: Optional[float] = None
         # indexer name -> value -> {store key: object ref}; rebuilt on
         # relist, maintained per delta in _apply.  Reads copy only matches —
         # the point: an indexed lookup is O(result), not O(store)
@@ -77,6 +88,8 @@ class Informer:
                 "not restartable — build a new one")
         if self._thread is not None and self._thread.is_alive():
             return self
+        self.started_monotonic = time.monotonic()
+        metrics.register_informer(self)
         self._thread = threading.Thread(
             target=self._run, name=f"informer-{self.gvk.kind}", daemon=True
         )
@@ -85,6 +98,7 @@ class Informer:
 
     def stop(self) -> None:
         self._stop.set()
+        metrics.deregister_informer(self)
 
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
         return self._synced.wait(timeout)
@@ -105,15 +119,16 @@ class Informer:
     # -- read API ------------------------------------------------------------
 
     def get(self, name: str, namespace: Optional[str] = None) -> Optional[Resource]:
-        with self._lock:
-            obj = self._store.get((namespace or "", name))
-        # Copy like every KubeClient.list/get: a caller mutating a
-        # result must not corrupt the shared cache.
-        return copy_resource(obj) if obj is not None else None
+        with trace.span("informer.get", kind=self.gvk.kind):
+            with self._lock:
+                obj = self._store.get((namespace or "", name))
+            # Copy like every KubeClient.list/get: a caller mutating a
+            # result must not corrupt the shared cache.
+            return copy_resource(obj) if obj is not None else None
 
     def list(self, namespace: Optional[str] = None, *,
              label_selector: Optional[Dict[str, str]] = None) -> List[Resource]:
-        with self._lock:
+        with trace.span("informer.list", kind=self.gvk.kind), self._lock:
             if namespace is not None:
                 refs = [o for (ns, _), o in self._store.items()
                         if ns == namespace]
@@ -132,7 +147,7 @@ class Informer:
         """Objects filed under ``value`` by ``indexer`` — O(matches), the
         cache-backed read controller-runtime gives its reconcilers
         (client-go cache.Indexer.ByIndex)."""
-        with self._lock:
+        with trace.span("informer.index_list", kind=self.gvk.kind), self._lock:
             bucket = self._indexes[indexer].get(value)
             return [copy_resource(o) for o in bucket.values()] if bucket else []
 
@@ -178,6 +193,7 @@ class Informer:
         """Rebuild the store from a full LIST; returns the collection
         resourceVersion to resume the watch from (None when the client
         can't provide one — the watch then replays, deduped by _apply)."""
+        t0 = time.monotonic()
         if hasattr(self.client, "list_with_rv"):
             items, rv = self.client.list_with_rv(self.gvk, self.namespace)
         else:
@@ -201,6 +217,9 @@ class Informer:
         for key, obj in old.items():
             if key not in fresh:
                 self._notify(handlers, "DELETED", obj)
+        self.last_sync_monotonic = time.monotonic()
+        metrics.informer_relist_duration_seconds.labels(
+            kind=self.gvk.kind).observe(self.last_sync_monotonic - t0)
         return rv
 
     @staticmethod
@@ -263,6 +282,8 @@ class Informer:
                         # the same backoff as the transport-error path, so a
                         # persistently erroring server isn't hot-looped with
                         # full LISTs.
+                        metrics.informer_watch_restarts_total.labels(
+                            kind=self.gvk.kind).inc()
                         rv = None
                         self._stop.wait(1.0)
                         break
@@ -279,5 +300,7 @@ class Informer:
                         "informer %s: watch failed, relisting", self.gvk.kind,
                         exc_info=True,
                     )
+                    metrics.informer_watch_restarts_total.labels(
+                        kind=self.gvk.kind).inc()
                     rv = None  # stale-RV or transport error: start clean
                     self._stop.wait(1.0)

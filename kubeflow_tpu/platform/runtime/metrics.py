@@ -7,8 +7,16 @@ monitoring.go:28-60) plus the TPU-specific gauges the north star asks for
 """
 from __future__ import annotations
 
+import threading
+import time
+import weakref
+from typing import Dict, Optional
+
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
+# Module-local registry, NEVER prometheus_client.REGISTRY: the process-global
+# default would stack duplicate collectors on test reimports
+# (tests/ctrlplane/test_metrics.py pins this hygiene rule).
 registry = CollectorRegistry()
 
 notebook_create_total = Counter(
@@ -134,17 +142,20 @@ service_heartbeat = Counter(
 )
 
 _heartbeats = {}
+_heartbeats_lock = threading.Lock()
 
 
 def start_heartbeat(component: str, *, interval: float = 10.0):
     """Tick service_heartbeat{component} every ``interval`` seconds from a
     daemon thread (reference monitoring.go:47-60).  Idempotent per
-    component; returns the stop Event."""
-    import threading
-
-    if component in _heartbeats:
-        return _heartbeats[component]
-    stop = threading.Event()
+    component while the heartbeat is live; a stopped entry is replaced so
+    a component can restart its heartbeat.  Returns the stop Event."""
+    with _heartbeats_lock:
+        existing = _heartbeats.get(component)
+        if existing is not None and not existing.is_set():
+            return existing
+        stop = threading.Event()
+        _heartbeats[component] = stop
 
     def tick():
         counter = service_heartbeat.labels(
@@ -154,8 +165,316 @@ def start_heartbeat(component: str, *, interval: float = 10.0):
             counter.inc()
 
     threading.Thread(target=tick, name=f"heartbeat-{component}", daemon=True).start()
-    _heartbeats[component] = stop
     return stop
+
+
+def stop_heartbeat(component: str) -> None:
+    """Stop a component's heartbeat and drop its entry, so a later
+    start_heartbeat(component) starts a fresh ticker instead of returning
+    the dead Event forever (the pre-fix leak)."""
+    with _heartbeats_lock:
+        stop = _heartbeats.pop(component, None)
+    if stop is not None:
+        stop.set()
+
+
+# -- workqueue metrics (client-go util/workqueue names) -----------------------
+#
+# The reference exports controller-runtime's workqueue instrumentation
+# verbatim (client-go workqueue/metrics.go); the same series here make the
+# watch → queue → reconcile hot path legible per controller.  Counters and
+# histograms are eager; depth and unfinished-work are computed at scrape
+# time by _RuntimeStateCollector from the live queues (same single-list
+# discipline as NotebookFleetCollector).
+
+_QUEUE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0)
+
+workqueue_adds_total = Counter(
+    "workqueue_adds_total", "Adds handled by workqueue", ["name"],
+    registry=registry,
+)
+workqueue_retries_total = Counter(
+    "workqueue_retries_total", "Rate-limited (backoff) re-adds by workqueue",
+    ["name"], registry=registry,
+)
+workqueue_queue_duration_seconds = Histogram(
+    "workqueue_queue_duration_seconds",
+    "Seconds an item waits in the workqueue before being handed to a worker",
+    ["name"], buckets=_QUEUE_BUCKETS, registry=registry,
+)
+workqueue_work_duration_seconds = Histogram(
+    "workqueue_work_duration_seconds",
+    "Seconds processing an item takes (get to done)",
+    ["name"], buckets=_QUEUE_BUCKETS, registry=registry,
+)
+
+
+class WorkQueueMetrics:
+    """Shared instrumentation shim for both workqueue engines.
+
+    ``_WorkQueue`` (pure Python) and ``NativeWorkQueue`` (C++ via ctypes)
+    call the same four hooks at the same semantic points — add accepted,
+    rate-limited re-add, item handed to a worker, item released — so the
+    exported series stay in parity whichever engine ``make_workqueue``
+    picks.  Timing state lives here (keyed by request) because the native
+    queue's internals are opaque to Python.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._queued_at: Dict[object, float] = {}   # key -> eligible time
+        self._started_at: Dict[object, float] = {}  # key -> worker pickup
+        self._waits: Dict[object, float] = {}       # key -> observed queue wait
+        self._queue_ref = None  # weakref to the queue, for depth at scrape
+        self._adds = workqueue_adds_total.labels(name=name)
+        self._retries = workqueue_retries_total.labels(name=name)
+        self._queue_dur = workqueue_queue_duration_seconds.labels(name=name)
+        self._work_dur = workqueue_work_duration_seconds.labels(name=name)
+
+    def attach(self, queue) -> None:
+        self._queue_ref = weakref.ref(queue)
+        _register_workqueue(self)
+
+    # -- hooks (called by the queue implementations) -------------------------
+
+    def on_add(self, key, *, delay: float = 0.0) -> None:
+        """Every accepted add() call.  The queued-at time is the moment the
+        item becomes ELIGIBLE (now + delay) and keeps the earliest such
+        time across dedup'd re-adds — queue_duration then measures hot-queue
+        wait, not backoff sleep (client-go's delaying-queue semantics)."""
+        self._adds.inc()
+        when = time.monotonic() + max(delay, 0.0)
+        with self._lock:
+            cur = self._queued_at.get(key)
+            if cur is None or when < cur:
+                self._queued_at[key] = when
+
+    def on_retry(self, key) -> None:
+        self._retries.inc()
+
+    def on_get(self, key) -> None:
+        now = time.monotonic()
+        with self._lock:
+            when = self._queued_at.pop(key, None)
+            wait = max(0.0, now - when) if when is not None else 0.0
+            self._started_at[key] = now
+            self._waits[key] = wait
+        self._queue_dur.observe(wait)
+
+    def on_done(self, key) -> None:
+        now = time.monotonic()
+        with self._lock:
+            t0 = self._started_at.pop(key, None)
+            self._waits.pop(key, None)
+        if t0 is not None:
+            self._work_dur.observe(now - t0)
+
+    # -- reads (controller trace + scrape-time collector) --------------------
+
+    def wait_of(self, key) -> float:
+        """Queue wait observed at on_get for a key currently being
+        processed — the controller's 'dequeue' trace span."""
+        with self._lock:
+            return self._waits.get(key, 0.0)
+
+    def depth(self) -> Optional[int]:
+        q = self._queue_ref() if self._queue_ref is not None else None
+        return q.pending() if q is not None else None
+
+    def unfinished_seconds(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            return sum(now - t for t in self._started_at.values())
+
+
+# name -> shim; latest wins so restarted controllers (and re-run tests)
+# re-point the series instead of stacking.  The collector prunes entries
+# whose queue has been garbage collected.
+_wq_shims: Dict[str, WorkQueueMetrics] = {}
+_wq_lock = threading.Lock()
+
+
+def _register_workqueue(shim: WorkQueueMetrics) -> None:
+    with _wq_lock:
+        _wq_shims[shim.name] = shim
+
+
+# -- reconcile + rest-client + informer metrics -------------------------------
+
+controller_runtime_reconcile_time_seconds = Histogram(
+    "controller_runtime_reconcile_time_seconds",
+    "Reconcile latency by controller and outcome "
+    "(success|error|requeue_after)",
+    ["controller", "result"], buckets=_QUEUE_BUCKETS, registry=registry,
+)
+rest_client_request_duration_seconds = Histogram(
+    "rest_client_request_duration_seconds",
+    "API-server request latency by verb and kind",
+    ["verb", "kind"], buckets=_QUEUE_BUCKETS, registry=registry,
+)
+rest_client_requests_total = Counter(
+    "rest_client_requests_total",
+    "API-server requests by verb, kind, and status code "
+    "(code='<error>' for transport failures)",
+    ["verb", "kind", "code"], registry=registry,
+)
+informer_watch_restarts_total = Counter(
+    "informer_watch_restarts_total",
+    "Informer watch stream failures/expiries that forced a re-establish",
+    ["kind"], registry=registry,
+)
+informer_relist_duration_seconds = Histogram(
+    "informer_relist_duration_seconds",
+    "Full LIST + store rebuild duration per informer relist",
+    ["kind"], buckets=_QUEUE_BUCKETS, registry=registry,
+)
+
+# id(informer) -> (kind, weakref).  Keyed per INSTANCE, not per kind: two
+# live same-kind informers (e.g. a standalone culling controller's own
+# Notebook informer next to the notebook controller's) must both feed the
+# stall gauge — the collector reports the WORST (max) age per kind, so a
+# wedged informer can't hide behind a healthy sibling.  Dead refs are
+# pruned at scrape.
+_informers: Dict[int, object] = {}
+
+
+def register_informer(informer) -> None:
+    """Expose an informer's last-sync age at scrape time (Informer.start
+    calls this; idempotent)."""
+    with _wq_lock:
+        _informers[id(informer)] = (informer.gvk.kind, weakref.ref(informer))
+
+
+def deregister_informer(informer) -> None:
+    """Drop a stopped informer from the stall gauge (Informer.stop calls
+    this) — a retired informer's frozen last-sync time must not read as a
+    stall of its still-healthy same-kind siblings."""
+    with _wq_lock:
+        _informers.pop(id(informer), None)
+
+
+class _RuntimeStateCollector:
+    """Scrape-time gauges over live runtime objects: workqueue depth and
+    unfinished-work seconds per queue, last-sync age per informer.  One
+    cheap read per scrape instead of eager bookkeeping on the hot path."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        depth = GaugeMetricFamily(
+            "workqueue_depth", "Current workqueue backlog "
+            "(pending + parked re-adds)", labels=["name"],
+        )
+        unfinished = GaugeMetricFamily(
+            "workqueue_unfinished_work_seconds",
+            "Seconds of work in progress that hasn't been observed by "
+            "work_duration yet (sum over in-flight items)", labels=["name"],
+        )
+        sync_age = GaugeMetricFamily(
+            "informer_last_sync_age_seconds",
+            "Seconds since the informer last completed a full relist",
+            labels=["kind"],
+        )
+        with _wq_lock:
+            shims = dict(_wq_shims)
+            informers = dict(_informers)
+        for name, shim in sorted(shims.items()):
+            d = shim.depth()
+            if d is None:  # queue was garbage collected
+                with _wq_lock:
+                    if _wq_shims.get(name) is shim:
+                        del _wq_shims[name]
+                continue
+            depth.add_metric([name], d)
+            unfinished.add_metric([name], shim.unfinished_seconds())
+        now = time.monotonic()
+        ages: Dict[str, float] = {}
+        for key, (kind, ref) in informers.items():
+            informer = ref()
+            if informer is None:
+                with _wq_lock:
+                    if _informers.get(key) == (kind, ref):
+                        del _informers[key]
+                continue
+            # Before the first relist completes the age counts from
+            # start() — an informer wedged on its initial LIST must not be
+            # invisible to the very gauge meant to catch stalls.
+            last = getattr(informer, "last_sync_monotonic", None)
+            if last is None:
+                last = getattr(informer, "started_monotonic", None)
+            if last is not None:
+                age = max(0.0, now - last)
+                if age > ages.get(kind, -1.0):
+                    ages[kind] = age
+        for kind, age in sorted(ages.items()):
+            sync_age.add_metric([kind], age)
+        yield depth
+        yield unfinished
+        yield sync_age
+
+
+registry.register(_RuntimeStateCollector())
+
+
+# -- histogram quantile helpers (bench_scale.py's p50/p99 reporting) ----------
+
+
+def histogram_snapshot(hist: Histogram, match: Dict[str, str]) -> Dict[float, float]:
+    """Cumulative bucket counts by upper bound for the children of
+    ``hist`` whose labels are a superset of ``match`` — summed over
+    non-matched labels (e.g. over ``result`` for the reconcile
+    histogram)."""
+    buckets: Dict[float, float] = {}
+    for metric in hist.collect():
+        for s in metric.samples:
+            if not s.name.endswith("_bucket"):
+                continue
+            if not all(s.labels.get(k) == v for k, v in match.items()):
+                continue
+            le = float(s.labels["le"])
+            buckets[le] = buckets.get(le, 0.0) + s.value
+    return buckets
+
+
+def quantile_from_buckets(buckets: Dict[float, float], q: float) -> Optional[float]:
+    """Prometheus-style linear interpolation within the target bucket.
+    Returns None on an empty histogram; the +Inf bucket clamps to the
+    highest finite bound (same as histogram_quantile)."""
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    finite = [b for b in bounds if b != float("inf")]
+    for b in bounds:
+        count = buckets[b]
+        if count >= rank:
+            if b == float("inf"):
+                return finite[-1] if finite else None
+            if count == prev_count:
+                return b
+            return prev_bound + (b - prev_bound) * (
+                (rank - prev_count) / (count - prev_count)
+            )
+        prev_bound, prev_count = (0.0 if b == float("inf") else b), count
+    return finite[-1] if finite else None
+
+
+def reconcile_quantiles(controller: str, qs=(0.5, 0.99), *,
+                        since: Optional[Dict[float, float]] = None):
+    """Estimated reconcile-latency quantiles for one controller, summed
+    over results.  ``since`` (a prior histogram_snapshot) diffs out
+    observations from earlier runs in the same process."""
+    buckets = histogram_snapshot(
+        controller_runtime_reconcile_time_seconds, {"controller": controller}
+    )
+    if since is not None:
+        buckets = {le: c - since.get(le, 0.0) for le, c in buckets.items()}
+    return {q: quantile_from_buckets(buckets, q) for q in qs}
 
 
 def render() -> bytes:

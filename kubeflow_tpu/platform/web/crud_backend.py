@@ -111,6 +111,26 @@ CSRF_HEADER = "X-XSRF-TOKEN"
 SAFE_METHODS = {"GET", "HEAD", "OPTIONS"}
 
 
+def _kind_of_rule(rule: Optional[str]) -> Optional[str]:
+    """Resource kind a route serves, from its rule pattern: the first
+    static segment after ``/api``, where ``namespaces/<ns>`` is a scope
+    prefix but a bare ``/api/namespaces`` addresses the Namespace kind
+    itself — "/api/namespaces/<ns>/notebooks/<name>/pod" -> "notebooks",
+    "/api/namespaces" -> "namespaces", "/api/storageclasses" ->
+    "storageclasses".  None for non-/api routes (probes, /metrics, kfam's
+    /kfam/v1 tree which counts itself)."""
+    if not rule or not rule.startswith("/api/"):
+        return None
+    parts = [p for p in rule.split("/") if p]
+    i = 1  # parts[0] == "api"
+    if (i + 1 < len(parts) and parts[i] == "namespaces"
+            and parts[i + 1].startswith("<")):
+        i += 2  # skip the namespaces/<ns> scope
+    while i < len(parts) and parts[i].startswith("<"):
+        i += 1
+    return parts[i] if i < len(parts) else None
+
+
 def install_standard_middleware(app: App, backend: CrudBackend, *,
                                 secure_cookies: Optional[bool] = None) -> None:
     """authn gate + CSRF double-submit + probes, shared by every web app."""
@@ -150,6 +170,26 @@ def install_standard_middleware(app: App, backend: CrudBackend, *,
                 {"success": False, "status": 403, "log": "CSRF check failed"}, 403
             )
         return None
+
+    @app.after_request
+    def count_request(request: Request, response: Response) -> Response:
+        # Per-kind request counters on the shared framework, so the
+        # jupyter/volumes/tensorboards apps report request_kf like KFAM
+        # does (reference kfam/monitoring.go) without per-app wiring.  5xx
+        # is a service failure; 4xx is the client's problem.
+        kind = _kind_of_rule(request.environ.get("kubeflow.route_rule"))
+        if kind is not None:
+            from kubeflow_tpu.platform.runtime import metrics
+
+            if response.status_code >= 500:
+                metrics.request_kf_failure.labels(
+                    component=app.name, kind=kind,
+                    severity=metrics.SEVERITY_MAJOR,
+                ).inc()
+            else:
+                metrics.request_kf.labels(
+                    component=app.name, kind=kind).inc()
+        return response
 
     @app.after_request
     def set_csrf_cookie(request: Request, response: Response) -> Response:

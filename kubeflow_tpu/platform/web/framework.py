@@ -107,7 +107,12 @@ class App:
     def _dispatch(self, request: Request) -> Response:
         adapter = self._url_map.bind_to_environ(request.environ)
         try:
-            endpoint, args = adapter.match()
+            rule, args = adapter.match(return_rule=True)
+            endpoint = rule.endpoint
+            # The matched rule pattern (e.g. "/api/namespaces/<ns>/notebooks")
+            # — after_request hooks use it to label per-kind request
+            # counters without re-parsing concrete paths.
+            request.environ["kubeflow.route_rule"] = rule.rule
             for hook in self.before_request_hooks:
                 early = hook(request)
                 if early is not None:

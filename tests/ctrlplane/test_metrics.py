@@ -1,0 +1,300 @@
+"""Metrics-layer unit tests: fleet collector failure paths, heartbeat
+lifecycle, workqueue-shim parity across queue engines, registry hygiene,
+and the histogram-quantile helpers bench_scale.py reports from."""
+import threading
+import uuid
+
+import pytest
+
+from kubeflow_tpu.platform import native
+from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.runtime.controller import Request, _WorkQueue
+
+
+# -- NotebookFleetCollector ---------------------------------------------------
+
+
+def _families(collector):
+    return {f.name: f for f in collector.collect()}
+
+
+def test_fleet_collector_list_failure_yields_empty_families():
+    """A raising client must not take the /metrics page down: both gauge
+    families are still yielded, just with no samples (the except path)."""
+
+    class Boom:
+        def list(self, gvk, namespace=None, **kw):
+            raise RuntimeError("apiserver down")
+
+    metrics.register_fleet_collector(Boom())
+    try:
+        fams = _families(metrics._fleet_collector)
+        assert fams["tpu_chips_requested"].samples == []
+        assert fams["notebook_running"].samples == []
+        # The full registry render survives the scrape too.
+        assert b"notebook_running" in metrics.render()
+    finally:
+        metrics.register_fleet_collector(None)
+
+
+def test_register_fleet_collector_none_unhooks_dead_client():
+    """register_fleet_collector(None) in teardown must really unhook: a
+    later scrape may not touch the dead fixture client at all."""
+
+    class DeadFixture:
+        def __init__(self):
+            self.calls = 0
+
+        def list(self, gvk, namespace=None, **kw):
+            self.calls += 1
+            raise AssertionError("scraped a dead fixture client")
+
+    dead = DeadFixture()
+    metrics.register_fleet_collector(dead)
+    metrics.register_fleet_collector(None)
+    fams = _families(metrics._fleet_collector)
+    assert dead.calls == 0
+    assert fams["tpu_chips_requested"].samples == []
+    assert fams["notebook_running"].samples == []
+
+
+# -- heartbeat lifecycle ------------------------------------------------------
+
+
+def test_heartbeat_stop_allows_restart():
+    stop1 = metrics.start_heartbeat("hb-test", interval=60.0)
+    assert metrics.start_heartbeat("hb-test", interval=60.0) is stop1
+    metrics.stop_heartbeat("hb-test")
+    assert stop1.is_set()
+    assert "hb-test" not in metrics._heartbeats  # entry dropped, no leak
+    stop2 = metrics.start_heartbeat("hb-test", interval=60.0)
+    assert stop2 is not stop1 and not stop2.is_set()
+    metrics.stop_heartbeat("hb-test")
+
+
+def test_heartbeat_replaces_externally_stopped_entry():
+    """Setting the returned Event directly (the pre-stop_heartbeat idiom)
+    used to wedge the component forever; start now replaces it."""
+    stop1 = metrics.start_heartbeat("hb-test-2", interval=60.0)
+    stop1.set()  # stopped without going through stop_heartbeat
+    stop2 = metrics.start_heartbeat("hb-test-2", interval=60.0)
+    assert stop2 is not stop1 and not stop2.is_set()
+    metrics.stop_heartbeat("hb-test-2")
+
+
+def test_stop_heartbeat_unknown_component_is_noop():
+    metrics.stop_heartbeat("never-started")
+
+
+# -- workqueue metrics shim ---------------------------------------------------
+
+
+def _engines():
+    yield "python", lambda shim: _WorkQueue(
+        base_delay=0.01, max_delay=0.1, metrics=shim)
+    if native.available():
+        yield "native", lambda shim: native.NativeWorkQueue(
+            base_delay=0.01, max_delay=0.1, metrics=shim)
+
+
+def _sample(name, labels):
+    return metrics.registry.get_sample_value(name, labels) or 0.0
+
+
+@pytest.mark.parametrize(
+    "engine,make", list(_engines()), ids=lambda v: v if isinstance(v, str) else "",
+)
+def test_workqueue_series_parity(engine, make):
+    """Both queue engines drive the shared shim through an identical
+    add/retry/get/done sequence and must export identical counters —
+    the parity contract behind make_workqueue(name=...)."""
+    name = f"parity-{engine}-{uuid.uuid4().hex[:6]}"
+    shim = metrics.WorkQueueMetrics(name)
+    q = make(shim)
+    shim.attach(q)
+    labels = {"name": name}
+    r = Request("ns", "a")
+
+    q.add(r)
+    assert _sample("workqueue_depth", labels) == 1
+    assert q.get(1.0) == r
+    q.add_rate_limited(r)  # parked retry while processing
+    q.done(r)
+    assert q.get(2.0) == r
+    q.done(r)
+    q.forget(r)
+
+    assert _sample("workqueue_adds_total", labels) == 2
+    assert _sample("workqueue_retries_total", labels) == 1
+    assert _sample("workqueue_queue_duration_seconds_count", labels) == 2
+    assert _sample("workqueue_work_duration_seconds_count", labels) == 2
+    assert _sample("workqueue_depth", labels) == 0
+    assert _sample("workqueue_unfinished_work_seconds", labels) == 0
+    q.shut_down()
+
+
+@pytest.mark.parametrize(
+    "engine,make", list(_engines()), ids=lambda v: v if isinstance(v, str) else "",
+)
+def test_workqueue_unfinished_work_tracks_inflight(engine, make):
+    name = f"inflight-{engine}-{uuid.uuid4().hex[:6]}"
+    shim = metrics.WorkQueueMetrics(name)
+    q = make(shim)
+    shim.attach(q)
+    r = Request("ns", "slow")
+    q.add(r)
+    assert q.get(1.0) == r
+    # In-flight: unfinished work is accruing and wait_of is queryable
+    # (the controller's dequeue trace span reads it).
+    assert shim.unfinished_seconds() >= 0.0
+    assert shim.wait_of(r) >= 0.0
+    q.done(r)
+    assert shim.unfinished_seconds() == 0.0
+    q.shut_down()
+
+
+def test_controller_queue_is_instrumented_by_name():
+    from kubeflow_tpu.platform.runtime.controller import make_workqueue
+
+    name = f"ctl-{uuid.uuid4().hex[:6]}"
+    q = make_workqueue(name=name)
+    try:
+        assert q.metrics is not None and q.metrics.name == name
+        q.add(Request("ns", "x"))
+        assert _sample("workqueue_adds_total", {"name": name}) == 1
+    finally:
+        q.shut_down()
+
+
+# -- registry hygiene ---------------------------------------------------------
+
+
+def test_no_kubeflow_metrics_in_global_registry():
+    """Every kubeflow_tpu series must live in the module-local registry —
+    a collector in prometheus_client.REGISTRY (the process-global default)
+    would stack duplicates when tests reimport modules."""
+    import prometheus_client
+
+    # Import every module that defines or registers metrics.
+    import kubeflow_tpu.platform.k8s.client  # noqa: F401
+    import kubeflow_tpu.platform.runtime.controller  # noqa: F401
+    import kubeflow_tpu.platform.runtime.informer  # noqa: F401
+    import kubeflow_tpu.platform.web.crud_backend  # noqa: F401
+
+    ours = {
+        name
+        for names in metrics.registry._collector_to_names.values()
+        for name in names
+    }
+    assert ours, "module-local registry unexpectedly empty"
+    global_names = {
+        name
+        for names in prometheus_client.REGISTRY._collector_to_names.values()
+        for name in names
+    }
+    leaked = ours & global_names
+    assert not leaked, (
+        f"kubeflow_tpu metrics registered into the process-global "
+        f"prometheus registry: {sorted(leaked)}"
+    )
+
+
+# -- quantile helpers ---------------------------------------------------------
+
+
+def test_quantile_from_buckets_interpolates():
+    inf = float("inf")
+    # 10 observations <= 0.1, 10 more <= 1.0.
+    buckets = {0.1: 10.0, 1.0: 20.0, inf: 20.0}
+    assert metrics.quantile_from_buckets(buckets, 0.5) == pytest.approx(0.1)
+    assert metrics.quantile_from_buckets(buckets, 0.75) == pytest.approx(0.55)
+    # Everything beyond the last finite bound clamps to it.
+    assert metrics.quantile_from_buckets({inf: 5.0}, 0.5) is None
+    assert metrics.quantile_from_buckets({}, 0.5) is None
+
+
+def test_reconcile_quantiles_with_snapshot_diff():
+    hist = metrics.controller_runtime_reconcile_time_seconds
+    ctrl = f"quant-{uuid.uuid4().hex[:6]}"
+    child = hist.labels(controller=ctrl, result="success")
+    for _ in range(10):
+        child.observe(0.05)
+    snap = metrics.histogram_snapshot(hist, {"controller": ctrl})
+    for _ in range(10):
+        child.observe(100.0)  # beyond the last finite bucket
+    q = metrics.reconcile_quantiles(ctrl, (0.5, 0.99), since=snap)
+    # Only the post-snapshot observations count: all in the +Inf bucket.
+    assert q[0.5] == pytest.approx(60.0)  # clamped to last finite bound
+    assert q[0.99] == pytest.approx(60.0)
+    # Without the snapshot the earlier fast half pulls p50 down.
+    q_all = metrics.reconcile_quantiles(ctrl, (0.5,))
+    assert q_all[0.5] <= 0.1
+
+
+def test_informer_gauge_tracks_worst_same_kind_instance():
+    """Two live same-kind informers must BOTH feed the stall gauge (max
+    age wins — a wedged one can't hide behind a healthy sibling), and a
+    stopped informer deregisters so its frozen age doesn't false-alarm."""
+    import time
+
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+    from kubeflow_tpu.platform.runtime.informer import Informer
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.add_namespace("u")
+    a = Informer(kube, NOTEBOOK)
+    a.start()
+    assert a.wait_for_sync(5.0)
+
+    class Wedged:
+        def list(self, gvk, namespace=None, **kw):
+            time.sleep(30)
+
+        def watch(self, *args, **kw):
+            return iter(())
+
+    stuck = Informer(Wedged(), NOTEBOOK)
+    stuck.start()  # never syncs: age counts from start()
+    try:
+        assert id(a) in metrics._informers and id(stuck) in metrics._informers
+        time.sleep(0.3)
+
+        def notebook_age():
+            for fam in metrics._RuntimeStateCollector().collect():
+                if fam.name == "informer_last_sync_age_seconds":
+                    return {s.labels["kind"]: s.value for s in fam.samples}
+
+        ages = notebook_age()
+        # One sample per kind, dominated by the never-synced informer.
+        assert ages["Notebook"] >= stuck_age_floor(stuck)
+    finally:
+        stuck.stop()
+        a.stop()
+    assert id(stuck) not in metrics._informers
+    assert id(a) not in metrics._informers
+
+
+def stuck_age_floor(informer) -> float:
+    import time
+
+    return time.monotonic() - informer.started_monotonic - 0.05
+
+
+@pytest.mark.parametrize(
+    "engine,make", list(_engines()), ids=lambda v: v if isinstance(v, str) else "",
+)
+def test_workqueue_shutdown_drops_adds_without_counting(engine, make):
+    """Both engines silently drop adds after shut_down(); the shim must
+    not count them (or record queued-at state that never resolves)."""
+    name = f"shutdown-{engine}-{uuid.uuid4().hex[:6]}"
+    shim = metrics.WorkQueueMetrics(name)
+    q = make(shim)
+    shim.attach(q)
+    q.shut_down()
+    q.add(Request("ns", "late"))
+    q.add_rate_limited(Request("ns", "late2"))
+    assert _sample("workqueue_adds_total", {"name": name}) == 0
+    assert _sample("workqueue_retries_total", {"name": name}) == 0
+    with shim._lock:
+        assert not shim._queued_at  # no orphaned timing state

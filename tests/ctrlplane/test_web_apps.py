@@ -471,7 +471,8 @@ def test_dashboard_metrics_service(kube):
     assert url == "http://prom:9090/api/v1/query_range"
     assert params["end"] - params["start"] == Interval.Last5m.minutes * 60
 
-    for mtype in ("podcpu", "podmem", "tpu"):
+    # incl. the control-plane series (reconcile p99 / workqueue depth).
+    for mtype in ("podcpu", "podmem", "tpu", "reconcile", "workqueue"):
         assert http.get(
             f"{base}/api/metrics/{mtype}", headers=USER_HEADER
         ).status_code == 200
@@ -487,3 +488,69 @@ def test_dashboard_metrics_service(kube):
     base2 = serve(create_app(kube, auth=auth(), metrics_service=svc_broken))
     r = http.get(f"{base2}/api/metrics/podcpu", headers=USER_HEADER)
     assert r.status_code == 200 and r.json()["points"] == []
+
+
+def test_web_framework_counts_requests_per_kind(jwa):
+    """The shared middleware exports request_kf{component,kind} for every
+    /api route — the jupyter/volumes/tensorboards apps report per-kind
+    request counts like KFAM does, with no per-app wiring."""
+    from kubeflow_tpu.platform.runtime import metrics
+
+    def val(kind):
+        return metrics.registry.get_sample_value(
+            "request_kf_total", {"component": "jupyter-web-app", "kind": kind}
+        ) or 0.0
+
+    before = val("notebooks")
+    r = http.get(f"{jwa}/api/namespaces/user1/notebooks", headers=USER_HEADER)
+    assert r.status_code == 200
+    assert val("notebooks") == before + 1
+    # Probes and /metrics aren't resource requests; no series for them.
+    http.get(f"{jwa}/healthz")
+    assert metrics.registry.get_sample_value(
+        "request_kf_total", {"component": "jupyter-web-app", "kind": "healthz"}
+    ) is None
+
+
+def test_web_framework_counts_5xx_as_failures(kube):
+    from kubeflow_tpu.platform.runtime import metrics
+    from kubeflow_tpu.platform.web.crud_backend import (
+        CrudBackend,
+        install_standard_middleware,
+    )
+    from kubeflow_tpu.platform.web.framework import App
+
+    app = App("fail-app")
+    backend = CrudBackend(kube, AuthContext(disable_auth=True))
+    install_standard_middleware(app, backend, secure_cookies=False)
+
+    @app.route("/api/namespaces/<ns>/widgets")
+    def widgets(request, ns):
+        raise RuntimeError("boom")
+
+    server, base = app.test_server()
+    try:
+        assert http.get(f"{base}/api/namespaces/x/widgets").status_code == 500
+        assert metrics.registry.get_sample_value(
+            "request_kf_failure_total",
+            {"component": "fail-app", "kind": "widgets", "severity": "major"},
+        ) == 1.0
+        # 4xx is the client's problem: counted as a request, not a failure.
+        assert http.get(f"{base}/api/nope").status_code == 404
+    finally:
+        server.shutdown()
+
+
+def test_kind_of_rule_route_shapes():
+    from kubeflow_tpu.platform.web.crud_backend import _kind_of_rule
+
+    assert _kind_of_rule("/api/namespaces/<ns>/notebooks") == "notebooks"
+    assert _kind_of_rule(
+        "/api/namespaces/<ns>/notebooks/<name>/pod/<pod>/logs") == "notebooks"
+    # A bare /api/namespaces addresses the Namespace kind itself (the
+    # dashboard's picker route), not a scope prefix.
+    assert _kind_of_rule("/api/namespaces") == "namespaces"
+    assert _kind_of_rule("/api/storageclasses") == "storageclasses"
+    assert _kind_of_rule("/api/activities/<ns>") == "activities"
+    for rule in ("/healthz", "/metrics", "/kfam/v1/bindings", None):
+        assert _kind_of_rule(rule) is None
