@@ -41,16 +41,27 @@ import sys
 import threading
 import time
 
-# Baselines established on the dev harness after the round-5 fleet-scale
-# fixes (2026-07-31; Python runtime + native C++ workqueue, workers=4,
-# informer-cache reads): 600-notebook wave 1.65 ms/notebook, 600-object
-# resync 0.16 s CPU — see BASELINE.md "Control-plane fleet scale" for the
-# before/after and what each fix was.  The bands are deliberately loose
-# (3x) — this is a shared-CPU dev container; the tripwire is for order-of-
-# magnitude regressions (an accidental O(N^2)), not scheduler noise.
+# Baselines re-pinned 2026-08-04 on the current 2-CPU dev container after
+# the zero-copy frozen-view read path landed (informer reads return
+# read-only views instead of deep copies; resync enqueues key-only;
+# reconcilers read secondaries from the caches).  Same-machine
+# before/after: 600-object steady-state resync 1.67 -> 0.48 s CPU (-71%),
+# informer get() 62k -> 140k/s, list() 61k -> 1.5M objs/s — see
+# BASELINE.md "Control-plane fleet scale" and docs/performance.md.
+# ``fleet_resync_cpu_s`` is the MIN CPU over three steady-state cycles
+# (first-cycle warmup and scheduler noise dominated a single sample).
+# The bands stay loose (3x) — shared-CPU container; the tripwire is for
+# order-of-magnitude regressions (an accidental O(N^2) or a return of
+# copy-per-read), not scheduler noise.
 BASELINE = {
-    "fleet_converge_ms_per_notebook": 1.65,   # 600-notebook wave
-    "fleet_resync_cpu_s": 0.16,               # full 600-object resync cycle
+    "fleet_converge_ms_per_notebook": 6.0,    # 600-notebook wave
+    "fleet_resync_cpu_s": 0.55,               # min of 3 600-object cycles
+    # Read-path microbench (zero-copy frozen views): informer get()
+    # throughput and the resync cycle's peak tracemalloc footprint per
+    # object.  Pre-frozen-view: ~62k gets/s and ~3 KB/object of copy
+    # churn on this container.
+    "cached_get_per_s": 120_000.0,            # 600-object store
+    "resync_alloc_peak_kb_per_obj": 0.8,      # tracemalloc peak / N
 }
 BAND_FACTOR = 3.0
 # Large-fleet per-notebook converge time must stay within this factor of
@@ -218,20 +229,19 @@ class FleetHarness:
         }
 
     def resync_cycle(self, *, timeout: float = 120.0) -> dict:
-        """One full steady-state resync: list every primary, enqueue all,
-        drain.  This is the periodic cost a fleet pays forever (the
-        controller's resync_period loop) — the place an O(N^2) hides."""
+        """One full steady-state resync: enqueue every primary key, drain
+        the no-op reconciles.  This is the periodic cost a fleet pays
+        forever (the controller's resync_period loop) — the place an
+        O(N^2) hides.  Runs the controller's own pass
+        (Controller._resync_once): a key-only cache read (Informer.keys)
+        that enqueues N requests without materializing or copying N
+        objects."""
         base = self.ctrl.reconcile_count
         t0 = time.perf_counter()
         cpu0 = time.process_time()
-        objs = self.api_client.list(self.ctrl.primary, "fleet")
-        from kubeflow_tpu.platform.runtime import Request
-
-        for obj in objs:
-            self.ctrl.queue.add(
-                Request(obj["metadata"]["namespace"],
-                        obj["metadata"]["name"]))
-        n = len(objs)
+        # The controller's own pass reports how many keys it enqueued, so
+        # the drain target can never disagree with what actually queued.
+        n = self.ctrl._resync_once(self.api_client)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if (self.ctrl.queue.pending() == 0
@@ -244,6 +254,61 @@ class FleetHarness:
             "n": n,
             "wall_s": time.perf_counter() - t0,
             "cpu_s": time.process_time() - cpu0,
+        }
+
+    def read_microbench(self, *, seconds: float = 0.5) -> dict:
+        """Cached-read throughput straight off the informer store: get()
+        by key and full list() sweeps, both returning zero-copy frozen
+        views.  The pre-frozen-view informer deep-copied every result, so
+        this is the microbench that pins the read-path win."""
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+
+        informer = self.ctrl.informers[NOTEBOOK]
+        names = [name for _, name in informer.keys("fleet")]
+        t0 = time.perf_counter()
+        gets = 0
+        while time.perf_counter() - t0 < seconds:
+            informer.get(names[gets % len(names)], "fleet")
+            gets += 1
+        gets_per_s = gets / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        listed = 0
+        while time.perf_counter() - t0 < seconds:
+            listed += len(informer.list("fleet"))
+        list_objs_per_s = listed / (time.perf_counter() - t0)
+        return {
+            "get_per_s": gets_per_s,
+            "list_objs_per_s": list_objs_per_s,
+        }
+
+    def resync_alloc(self, *, timeout: float = 120.0) -> dict:
+        """One resync cycle under tracemalloc: peak allocated bytes and
+        net live blocks across the pass.  Copy-amplification (the
+        pre-frozen-view O(fleet x object-size) deep copies per resync)
+        shows up directly as peak growth; run separately from the timed
+        cycle because tracemalloc slows every allocation."""
+        import gc
+        import tracemalloc
+
+        gc.collect()
+        tracemalloc.start()
+        try:
+            base_current, _ = tracemalloc.get_traced_memory()
+            tracemalloc.reset_peak()
+            snap_before = tracemalloc.take_snapshot()
+            res = self.resync_cycle(timeout=timeout)
+            snap_after = tracemalloc.take_snapshot()
+            _, peak = tracemalloc.get_traced_memory()
+        finally:
+            tracemalloc.stop()
+        net_blocks = sum(
+            d.count_diff for d in snap_after.compare_to(snap_before, "filename")
+            if d.count_diff > 0)
+        return {
+            "n": res["n"],
+            "peak_kb": (peak - base_current) / 1024.0,
+            "peak_kb_per_obj": (peak - base_current) / 1024.0 / max(res["n"], 1),
+            "net_blocks": net_blocks,
         }
 
     def churn(self, *, seconds: float = 3.0, rate_hz: float = 200.0) -> dict:
@@ -294,12 +359,25 @@ class FleetHarness:
         }
 
 
+# vs_baseline convention across EVERY metric line: > 1.0 means better
+# than baseline (baseline/value for lower-is-better metrics like CPU and
+# allocations, value/baseline for throughput) — tooling trending the
+# field can compare lines without knowing each metric's direction.
 def _band(value: float, baseline: float) -> str:
     return "pass" if value <= baseline * BAND_FACTOR else "REGRESSION"
 
 
+def _band_min(value: float, baseline: float) -> str:
+    """Band for higher-is-better metrics (throughput)."""
+    return "pass" if value >= baseline / BAND_FACTOR else "REGRESSION"
+
+
 def run_fleet(n: int, *, churn_s: float, transport: str = "memory",
-              watch_window: float = None) -> dict:
+              watch_window: float = None, detail: bool = True) -> dict:
+    """``detail=False`` (the small comparison fleet) skips the read
+    microbench, the tracemalloc pass, and the min-of-3 resync protocol —
+    main() only reads the small fleet's wave numbers, so that work would
+    be paid and discarded."""
     from kubeflow_tpu.platform.runtime import metrics as rtmetrics
 
     h = FleetHarness(transport=transport, watch_window=watch_window)
@@ -322,12 +400,26 @@ def run_fleet(n: int, *, churn_s: float, transport: str = "memory",
         wave["reconcile_p99_ms"] = (
             round(quantiles[0.99] * 1e3, 3)
             if quantiles[0.99] is not None else None)
-        resync = h.resync_cycle()
+        if detail:
+            # Three steady-state cycles, keep the cheapest: cycle one pays
+            # lazy-import/JIT warmup and the wave's settling churn, and a
+            # 2-CPU shared container adds scheduler noise a single sample
+            # can't average out.  Min is the right statistic for "what
+            # does this code cost" under one-sided noise.
+            cycles = [h.resync_cycle() for _ in range(3)]
+            resync = min(cycles, key=lambda c: c["cpu_s"])
+            resync["cycles_cpu_s"] = [round(c["cpu_s"], 3) for c in cycles]
+            reads = h.read_microbench()
+            alloc = h.resync_alloc()
+        else:
+            resync = h.resync_cycle()
+            reads = alloc = None
         churn = h.churn(seconds=churn_s)
         rss1 = _rss_mb()
     finally:
         h.close()
-    return {"wave": wave, "resync": resync, "churn": churn,
+    return {"wave": wave, "resync": resync, "reads": reads, "alloc": alloc,
+            "churn": churn,
             "rss_mb_before": round(rss0, 1), "rss_mb_after": round(rss1, 1)}
 
 
@@ -350,7 +442,7 @@ def main(argv=None) -> int:
 
     small = run_fleet(args.small, churn_s=args.churn_seconds,
                       transport=args.transport,
-                      watch_window=args.watch_window)
+                      watch_window=args.watch_window, detail=False)
     large = run_fleet(args.large, churn_s=args.churn_seconds,
                       transport=args.transport,
                       watch_window=args.watch_window)
@@ -402,9 +494,10 @@ def main(argv=None) -> int:
     line = {
         "metric": "ctrlplane_fleet_resync_cpu_s",
         "value": round(resync_cpu, 3), "unit": "s (process CPU, "
-        f"{large['resync']['n']}-object resync cycle)",
+        f"{large['resync']['n']}-object resync cycle, min of 3)",
         "transport": args.transport,
         "wall_s": round(large["resync"]["wall_s"], 3),
+        "cycles_cpu_s": large["resync"]["cycles_cpu_s"],
     }
     if banded:
         line.update({
@@ -412,6 +505,44 @@ def main(argv=None) -> int:
                 BASELINE["fleet_resync_cpu_s"] / resync_cpu, 4)
             if resync_cpu else 1.0,
             "band": _band(resync_cpu, BASELINE["fleet_resync_cpu_s"]),
+            "band_floor": round(1.0 / BAND_FACTOR, 3),
+        })
+    print(json.dumps(line), flush=True)
+    # Read-path microbench (zero-copy frozen views): cached-read
+    # throughput and the resync cycle's allocation footprint.  Banded on
+    # the memory transport only, like the other value baselines.
+    line = {
+        "metric": "ctrlplane_cached_reads_per_s",
+        "value": round(large["reads"]["get_per_s"], 0), "unit": "gets/sec "
+        f"(informer store of {large['resync']['n']} objects)",
+        "list_objs_per_s": round(large["reads"]["list_objs_per_s"], 0),
+        "transport": args.transport,
+    }
+    if banded:
+        line.update({
+            "vs_baseline": round(
+                large["reads"]["get_per_s"]
+                / BASELINE["cached_get_per_s"], 4),
+            "band": _band_min(large["reads"]["get_per_s"],
+                              BASELINE["cached_get_per_s"]),
+            "band_floor": round(1.0 / BAND_FACTOR, 3),
+        })
+    print(json.dumps(line), flush=True)
+    line = {
+        "metric": "ctrlplane_resync_alloc_peak_kb_per_obj",
+        "value": round(large["alloc"]["peak_kb_per_obj"], 3),
+        "unit": "KiB/object (tracemalloc peak over one resync cycle)",
+        "peak_kb": round(large["alloc"]["peak_kb"], 1),
+        "net_blocks": large["alloc"]["net_blocks"],
+        "transport": args.transport,
+    }
+    if banded:
+        line.update({
+            "vs_baseline": round(
+                BASELINE["resync_alloc_peak_kb_per_obj"]
+                / max(large["alloc"]["peak_kb_per_obj"], 1e-9), 4),
+            "band": _band(large["alloc"]["peak_kb_per_obj"],
+                          BASELINE["resync_alloc_peak_kb_per_obj"]),
             "band_floor": round(1.0 / BAND_FACTOR, 3),
         })
     print(json.dumps(line), flush=True)

@@ -113,7 +113,8 @@ def declared_tpu_chips(notebook: Resource) -> float:
     return usage.get("requests.google.com/tpu", 0.0)
 
 
-def running_notebook_pod_usage(client, ns: str, running: list) -> dict:
+def running_notebook_pod_usage(client, ns: str, running: list, *,
+                               lister=None) -> dict:
     """Aggregate quota footprint of live pods that belong to RUNNING
     (non-stopped) notebooks — exactly the slice of a quota's status.used
     that the declared CR totals already cover (quota.effective_used).  A
@@ -121,13 +122,19 @@ def running_notebook_pod_usage(client, ns: str, running: list) -> dict:
     CR has left the declared tally, so they must keep counting as live
     usage or a respawn passes pre-flight and strands at pod admission.
     Shared by the spawn pre-flight, the picker and the dashboard card —
-    ONE implementation so the surfaces cannot drift apart."""
+    ONE implementation so the surfaces cannot drift apart.
+
+    ``lister`` (gvk, ns) -> objects lets callers substitute an informer
+    cache read (frozen views) for the live LIST; every access below is
+    read-only, so both shapes work."""
     from kubeflow_tpu.platform.k8s import quota as quota_mod
     from kubeflow_tpu.platform.k8s.types import POD, name_of
 
+    if lister is None:
+        lister = client.list
     running_names = {name_of(nb) for nb in running}
     usage: dict = {}
-    for pod in client.list(POD, ns):
+    for pod in lister(POD, ns):
         labels = deep_get(pod, "metadata", "labels", default={}) or {}
         phase = deep_get(pod, "status", "phase", default="")
         if labels.get(LABEL_NOTEBOOK_NAME) in running_names and \
@@ -140,7 +147,7 @@ def running_notebook_pod_usage(client, ns: str, running: list) -> dict:
     return usage
 
 
-def namespace_tpu_budget(client, ns: str) -> Optional[dict]:
+def namespace_tpu_budget(client, ns: str, *, lister=None) -> Optional[dict]:
     """Per-namespace TPU chip budget {hard, used, remaining} from the
     tightest ResourceQuota, under the platform's commitment accounting
     (quota.effective_used): chips declared by running notebook CRs (pods
@@ -148,18 +155,24 @@ def namespace_tpu_budget(client, ns: str) -> Optional[dict]:
     picker and the central dashboard card, so every surface agrees with
     what quota admission will actually do.  None when no quota constrains
     `google.com/tpu` in the namespace.
+
+    ``lister`` (gvk, ns) -> objects substitutes informer-cache reads
+    (frozen views) for live LISTs; everything here is read-only.
     """
     from kubeflow_tpu.platform.k8s import quota as quota_mod
     from kubeflow_tpu.platform.k8s.types import RESOURCEQUOTA
     from kubeflow_tpu.platform.k8s.types import NOTEBOOK as NOTEBOOK_GVK
 
-    quotas = client.list(RESOURCEQUOTA, ns)
+    if lister is None:
+        lister = client.list
+    quotas = lister(RESOURCEQUOTA, ns)
     if not quotas:
         return None
-    running = [nb for nb in client.list(NOTEBOOK_GVK, ns)
+    running = [nb for nb in lister(NOTEBOOK_GVK, ns)
                if not is_stopped(nb)]
     declared = sum(declared_tpu_chips(nb) for nb in running)
-    pod_used = running_notebook_pod_usage(client, ns, running).get(
+    pod_used = running_notebook_pod_usage(
+        client, ns, running, lister=lister).get(
         "requests.google.com/tpu", 0.0)
     return quota_mod.tpu_remaining(
         quotas, declared=declared, workload_pod_used=pod_used

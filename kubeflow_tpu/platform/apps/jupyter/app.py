@@ -39,14 +39,32 @@ from kubeflow_tpu.platform.web.framework import App, HttpError, success
 
 
 def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
-               secure_cookies: Optional[bool] = None) -> App:
+               secure_cookies: Optional[bool] = None,
+               caches: Optional[dict] = None) -> App:
+    """``caches`` ({GVK: started Informer}, optional) turns the table/
+    picker/pre-flight reads into zero-copy frozen-view cache reads (the
+    reference JWA reads through client-go informers the same way); absent
+    or unsynced caches fall back to live LISTs.  All the read sites below
+    are read-only, so both shapes behave identically."""
     app = App("jupyter-web-app")
-    backend = CrudBackend(client, auth)
+    backend = CrudBackend(client, auth, caches=caches)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
     from kubeflow_tpu.platform.web.static_serving import install_frontend
 
     install_frontend(app, "jupyter")
     cfg_path = spawner_config_path
+
+    def _cached_list(gvk, ns):
+        """DISPLAY reads with the app's OWN client (not the user's SAR —
+        see get_tpus), through the informer cache when one is wired and
+        synced.  Display only: quota ADMISSION (_quota_preflight and the
+        restart gate in patch_notebook) always reads LIVE — an admission
+        decision needs read-your-writes consistency the watch-propagation
+        window can't guarantee, and the pre-flight exists precisely to
+        stop a spawn that a stale read would wave through."""
+        from kubeflow_tpu.platform.runtime.informer import cache_or_client_list
+
+        return cache_or_client_list((caches or {}).get(gvk), client, gvk, ns)
 
     # -- config & environment -------------------------------------------------
 
@@ -87,7 +105,8 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         # would 403.
         return success({
             "tpus": out,
-            "quota": nbapi.namespace_tpu_budget(client, ns),
+            "quota": nbapi.namespace_tpu_budget(client, ns,
+                                                lister=_cached_list),
         })
 
     # -- notebooks ------------------------------------------------------------
@@ -191,8 +210,12 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
             # pre-flight as a fresh spawn (the stopped CR is excluded from
             # the declared tally, so it only checks against OTHERS' usage)
             # — otherwise the StatefulSet scales up into a pod-admission
-            # 403 and strands with no user-facing error.
-            current = backend.get_resource(user, NOTEBOOK, name, ns)
+            # 403 and strands with no user-facing error.  LIVE read (authz
+            # still gated): a stop-then-start inside the cache-propagation
+            # window must not see the stale not-stopped object and skip
+            # the pre-flight.
+            backend.ensure(user, "get", NOTEBOOK, ns)
+            current = client.get(NOTEBOOK, name, ns)
             if nbapi.is_stopped(current):
                 _quota_preflight(ns, current)
             patch = {"metadata": {"annotations": {nbapi.STOP_ANNOTATION: None}}}
@@ -265,7 +288,10 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
     def _running_notebooks(ns: str) -> list:
         """One NOTEBOOK list shared by the declared-usage and pod-usage
         accounting — the spawn/pre-flight hot path must not pay two
-        O(namespace) LISTs (and two lists could disagree mid-flight)."""
+        O(namespace) LISTs (and two lists could disagree mid-flight).
+        LIVE list, not the cache: a just-accepted notebook must count
+        against the next spawn immediately (read-your-writes), or two
+        rapid spawns both slip under the quota."""
         return [nb for nb in client.list(NOTEBOOK, ns)
                 if not nbapi.is_stopped(nb)]
 
@@ -279,6 +305,7 @@ def create_app(client, *, auth=None, spawner_config_path: Optional[str] = None,
         non-notebook pods — see quota.effective_used for why neither a
         plain status.used nor max(status.used, declared) is enough.
         """
+        # Admission path: every read LIVE (see _cached_list docstring).
         quotas = client.list(RESOURCEQUOTA, ns)
         if not quotas:
             return

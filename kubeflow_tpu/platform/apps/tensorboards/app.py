@@ -24,9 +24,13 @@ from kubeflow_tpu.platform.web.crud_backend import (
 from kubeflow_tpu.platform.web.framework import App, HttpError, success
 
 
-def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
+               caches: Optional[dict] = None) -> App:
+    """``caches`` ({GVK: started Informer}, optional): table/picker reads
+    come from the shared informer caches as zero-copy frozen views; the
+    handlers below are read-only over them."""
     app = App("tensorboards-web-app")
-    backend = CrudBackend(client, auth)
+    backend = CrudBackend(client, auth, caches=caches)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
     from kubeflow_tpu.platform.web.static_serving import install_frontend
 

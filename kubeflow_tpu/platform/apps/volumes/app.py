@@ -46,9 +46,14 @@ def pvc_from_dict(body: dict, namespace: str) -> dict:
     return pvc
 
 
-def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None) -> App:
+def create_app(client, *, auth=None, secure_cookies: Optional[bool] = None,
+               caches: Optional[dict] = None) -> App:
+    """``caches`` ({GVK: started Informer}, optional): PVC/pod/event reads
+    come from the shared informer caches as zero-copy frozen views; every
+    handler below is read-only over them, and writes still hit the
+    client."""
     app = App("volumes-web-app")
-    backend = CrudBackend(client, auth)
+    backend = CrudBackend(client, auth, caches=caches)
     install_standard_middleware(app, backend, secure_cookies=secure_cookies)
     from kubeflow_tpu.platform.web.static_serving import install_frontend
 

@@ -45,6 +45,7 @@ from kubeflow_tpu.platform.k8s.types import (
     meta,
     name_of,
     set_owner,
+    thaw,
 )
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
 from kubeflow_tpu.platform.runtime import metrics
@@ -101,6 +102,17 @@ class NotebookReconciler(Reconciler):
         )
 
     # -- cache-backed reads ---------------------------------------------------
+
+    def _cached_get(self, gvk, name: str, ns: str) -> Optional[Resource]:
+        """One object by key: zero-copy frozen cache view when the kind's
+        informer is wired and synced, live GET otherwise.  Returns None for
+        not-found on either path.  Writers must thaw() before mutating; a
+        create against a cache-lagged None gets AlreadyExists and falls
+        back to a fresh GET at the call site — never fight the cache."""
+        from kubeflow_tpu.platform.runtime.informer import cache_or_client_get
+
+        return cache_or_client_get(self.informers.get(gvk), self.client,
+                                   gvk, name, ns)
 
     def _pods_of(self, ns: str, name: str) -> List[Resource]:
         """This notebook's worker pods: indexed cache read when informers
@@ -233,7 +245,10 @@ class NotebookReconciler(Reconciler):
         replicas = 0 if nbapi.is_stopped(notebook) else (tpu.num_hosts if tpu else 1)
         sts_name = self.slice_sts_name(name, slice_idx)
 
-        pod_spec = copy.deepcopy(
+        # thaw(): plain mutable deep copy whether the notebook came from a
+        # fresh GET or a frozen cache view (copy_resource under the hood —
+        # measurably cheaper than copy.deepcopy on this per-reconcile path).
+        pod_spec = thaw(
             deep_get(notebook, "spec", "template", "spec", default={})
         )
         containers = pod_spec.get("containers") or [{}]
@@ -376,9 +391,8 @@ class NotebookReconciler(Reconciler):
 
     def _check_sts_ownership(self, ns: str, notebook_name: str,
                              sts_name: str) -> None:
-        try:
-            current = self.client.get(STATEFULSET, sts_name, ns)
-        except errors.NotFound:
+        current = self._cached_get(STATEFULSET, sts_name, ns)
+        if current is None:
             return
         owner = deep_get(current, "metadata", "labels", nbapi.LABEL_NOTEBOOK_NAME)
         if owner != notebook_name:
@@ -400,31 +414,57 @@ class NotebookReconciler(Reconciler):
         # (the Deployment pod-template-hash idiom).
         desired_hash = _content_hash(desired["spec"]["template"])
         meta(desired).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
-        try:
-            current = self.client.get(STATEFULSET, name, ns)
-        except errors.NotFound:
+        current = self._cached_get(STATEFULSET, name, ns)
+        if current is None:
             try:
                 created = self.client.create(desired)
+            except errors.AlreadyExists:
+                # Cache lag: a just-created STS hasn't landed in the
+                # informer yet.  Re-read fresh and fall through to the
+                # compare-and-update path instead of erroring the key —
+                # unless the fresh object belongs to a DIFFERENT notebook
+                # (a conflict the lagging ownership pre-check missed);
+                # never update a sibling's StatefulSet.
+                try:
+                    current = self.client.get(STATEFULSET, name, ns)
+                except errors.NotFound:
+                    # Created-then-deleted race: this pass failed its
+                    # create (count it); the backoff requeue recreates.
+                    metrics.notebook_create_failed_total.inc()
+                    raise
+                owner = deep_get(current, "metadata", "labels",
+                                 nbapi.LABEL_NOTEBOOK_NAME)
+                if owner != name_of(notebook):
+                    # A genuine create failure (the name belongs to a
+                    # sibling): count it — the bare raise skips the
+                    # except-ApiError branch below, which used to do so.
+                    metrics.notebook_create_failed_total.inc()
+                    raise
             except errors.ApiError:
                 metrics.notebook_create_failed_total.inc()
                 raise
-            metrics.notebook_create_total.inc()
-            self.recorder.event(
-                notebook, "Normal", "CreatedStatefulSet",
-                f"Created StatefulSet {name} "
-                f"(replicas={deep_get(desired, 'spec', 'replicas')})",
-            )
-            return created
-        changed = False
-        if deep_get(current, "spec", "replicas") != deep_get(desired, "spec", "replicas"):
-            current["spec"]["replicas"] = desired["spec"]["replicas"]
-            changed = True
+            else:
+                metrics.notebook_create_total.inc()
+                self.recorder.event(
+                    notebook, "Normal", "CreatedStatefulSet",
+                    f"Created StatefulSet {name} "
+                    f"(replicas={deep_get(desired, 'spec', 'replicas')})",
+                )
+                return created
+        changed_replicas = (deep_get(current, "spec", "replicas")
+                            != deep_get(desired, "spec", "replicas"))
         current_hash = deep_get(current, "metadata", "annotations", HASH_ANNOTATION)
-        if current_hash != desired_hash:
-            current["spec"]["template"] = desired["spec"]["template"]
-            meta(current).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
-            changed = True
-        if changed:
+        if changed_replicas or current_hash != desired_hash:
+            # Intent-to-write: thaw the frozen cache view into a private
+            # mutable copy.  A stale cached resourceVersion turns into a
+            # 409 handled by the normal conflict-requeue path.
+            current = thaw(current)
+            if changed_replicas:
+                current["spec"]["replicas"] = desired["spec"]["replicas"]
+            if current_hash != desired_hash:
+                current["spec"]["template"] = desired["spec"]["template"]
+                meta(current).setdefault(
+                    "annotations", {})[HASH_ANNOTATION] = desired_hash
             return self.client.update(current)
         return current
 
@@ -490,14 +530,18 @@ class NotebookReconciler(Reconciler):
         ns, name = meta(desired)["namespace"], name_of(desired)
         desired_hash = _content_hash(desired["spec"])
         meta(desired).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
-        try:
-            current = self.client.get(SERVICE, name, ns)
-        except errors.NotFound:
-            return self.client.create(desired)
+        current = self._cached_get(SERVICE, name, ns)
+        if current is None:
+            try:
+                return self.client.create(desired)
+            except errors.AlreadyExists:
+                # Cache lag — re-read fresh and reconcile against it.
+                current = self.client.get(SERVICE, name, ns)
         if deep_get(current, "metadata", "annotations", HASH_ANNOTATION) == desired_hash:
             return current
         # Overwrite only controller-owned fields; keep server-populated ones
         # (clusterIP is immutable — reference CopyServiceFields preserves it).
+        current = thaw(current)
         want = copy.deepcopy(desired["spec"])
         if "clusterIP" in current.get("spec", {}) and want.get("clusterIP") != "None":
             want["clusterIP"] = current["spec"]["clusterIP"]
@@ -533,10 +577,7 @@ class NotebookReconciler(Reconciler):
         ns, name = meta(notebook)["namespace"], name_of(notebook)
         desired = self.generate_pdb(notebook)
         pdb_name = f"{name}-slice"
-        try:
-            current = self.client.get(PODDISRUPTIONBUDGET, pdb_name, ns)
-        except errors.NotFound:
-            current = None
+        current = self._cached_get(PODDISRUPTIONBUDGET, pdb_name, ns)
         if desired is None:
             # Single-host, stopped, or spec changed away from multi-host: a
             # leftover PDB would block node drains forever.  Read-then-
@@ -549,9 +590,14 @@ class NotebookReconciler(Reconciler):
                     pass
             return
         if current is None:
-            self.client.create(desired)
-            return
+            try:
+                self.client.create(desired)
+            except errors.AlreadyExists:
+                current = self.client.get(PODDISRUPTIONBUDGET, pdb_name, ns)
+            else:
+                return
         if current.get("spec") != desired.get("spec"):
+            current = thaw(current)
             current["spec"] = desired["spec"]
             self.client.update(current)
 
@@ -598,11 +644,14 @@ class NotebookReconciler(Reconciler):
     def _reconcile_virtual_service(self, notebook: Resource) -> Resource:
         desired = self.generate_virtual_service(notebook)
         ns, name = meta(desired)["namespace"], name_of(desired)
-        try:
-            current = self.client.get(VIRTUALSERVICE, name, ns)
-        except errors.NotFound:
-            return self.client.create(desired)
+        current = self._cached_get(VIRTUALSERVICE, name, ns)
+        if current is None:
+            try:
+                return self.client.create(desired)
+            except errors.AlreadyExists:
+                current = self.client.get(VIRTUALSERVICE, name, ns)
         if current.get("spec") != desired.get("spec"):
+            current = thaw(current)
             current["spec"] = desired["spec"]
             return self.client.update(current)
         return current
@@ -699,7 +748,9 @@ class NotebookReconciler(Reconciler):
                 if (prior.get("count", 1), prior.get("lastTimestamp")) != (
                     ev.get("count", 1), last_ts,
                 ):
-                    prior = copy.deepcopy(prior)
+                    # Intent-to-write on a cached read: thaw() takes the
+                    # private mutable copy (the read itself was zero-copy).
+                    prior = thaw(prior)
                     prior["count"] = ev.get("count", 1)
                     prior["lastTimestamp"] = last_ts
                     try:

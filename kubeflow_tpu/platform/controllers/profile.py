@@ -482,6 +482,12 @@ def make_controller(client, *, heartbeat: bool = False, **kwargs):
         reconciler,
         primary=PROFILE,
         resync_period=300.0,
+        # Deliberately NO primary informer: a missing Profile CRD must
+        # degrade to a retrying raw watch, not a fatal cache-sync failure
+        # that takes the whole controller manager down (Controller.start
+        # raises on sync timeout).  The raw watch resumes by
+        # resourceVersion (_watch_loop), so re-establishments no longer
+        # replay every Profile as ADDED anyway.
         runnables=runnables,
         # Heartbeat rides the controller lifecycle: stop_heartbeat on stop
         # drops the ticker AND the registry entry, so a rebuilt controller

@@ -219,5 +219,12 @@ def make_controller(client, **kwargs):
         TensorboardReconciler(client, **kwargs),
         primary=TENSORBOARD,
         owns=[DEPLOYMENT, SERVICE, VIRTUALSERVICE],
+        # Deliberately NO primary informer: the Tensorboard CRD is
+        # optional, and an informer's failed cache sync is FATAL at
+        # Controller.start (it would take the whole manager down on a
+        # cluster without the CRD), where the raw watch just retries.
+        # The raw watch resumes by resourceVersion (_watch_loop), so the
+        # bounded-window full-replay cost the informer would have fixed
+        # is fixed anyway.
         resync_period=300.0,
     )

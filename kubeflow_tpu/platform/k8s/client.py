@@ -17,7 +17,15 @@ import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Protocol, Tuple
 
 from kubeflow_tpu.platform.k8s import errors
-from kubeflow_tpu.platform.k8s.types import GVK, Resource, gvk_of, meta, name_of, namespace_of
+from kubeflow_tpu.platform.k8s.types import (
+    GVK,
+    Resource,
+    gvk_of,
+    json_default,
+    meta,
+    name_of,
+    namespace_of,
+)
 
 WatchEvent = Tuple[str, Resource]  # ("ADDED"|"MODIFIED"|"DELETED"|"BOOKMARK", obj)
 
@@ -219,6 +227,14 @@ class RestKubeClient:
                 "strategic": "application/strategic-merge-patch+json",
                 "apply": "application/apply-patch+yaml",
             }[ptype]
+        data = None
+        if body is not None:
+            # Serialize here (not via requests' json=) so frozen cache
+            # views (types.FrozenResource) cross the wire directly — a
+            # read-modify-write round trip never deep-copies just to
+            # serialize.
+            data = json.dumps(body, default=json_default)
+            headers.setdefault("Content-Type", "application/json")
         code = "<error>"
         t0 = time.perf_counter()
         try:
@@ -227,7 +243,7 @@ class RestKubeClient:
                     method,
                     self.base_url + path,
                     params=params,
-                    json=body,
+                    data=data,
                     headers=headers or None,
                     stream=stream,
                     timeout=None if stream else self.timeout,

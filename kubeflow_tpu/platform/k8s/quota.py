@@ -22,6 +22,11 @@ that does not request a constrained resource counts 0 toward it (the real
 plugin *rejects* such pods outright; that rule would make every CPU-only
 sidecar in a TPU-quota'd namespace undeployable, so we relax it the way
 ``scopeSelector``-scoped quotas do).
+
+Read-ownership contract: every function here is STRICTLY read-only over
+the quotas/pods it is handed, so callers may pass zero-copy frozen views
+straight from an informer cache (``types.FrozenResource``) — the quota
+math never forces a thaw.  Outputs are always fresh plain dicts.
 """
 from __future__ import annotations
 

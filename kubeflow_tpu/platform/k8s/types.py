@@ -9,6 +9,7 @@ dependency-free and round-trip faithful.
 from __future__ import annotations
 
 import dataclasses
+from collections.abc import Mapping, Sequence
 from typing import Any, Dict, Iterable, Optional
 
 Resource = Dict[str, Any]
@@ -79,13 +80,30 @@ WELL_KNOWN: tuple[GVK, ...] = (
 )
 
 
+def pluralize(kind: str) -> str:
+    """Conventional REST plural for a kind, lowercased: the same rules
+    kubebuilder's flect applies for CRDs — ``y`` after a consonant becomes
+    ``ies`` (NetworkPolicy → networkpolicies), sibilant endings take
+    ``es`` (Ingress → ingresses, Status → statuses), and a kind that is
+    already plural (bare ``s``: Endpoints) passes through unchanged;
+    everything else appends ``s``."""
+    lower = kind.lower()
+    if lower.endswith("y") and len(lower) > 1 and lower[-2] not in "aeiou":
+        return lower[:-1] + "ies"
+    if lower.endswith(("ss", "us", "is", "x", "z", "ch", "sh")):
+        return lower + "es"
+    if lower.endswith("s"):
+        return lower  # already plural (Endpoints → endpoints)
+    return lower + "s"
+
+
 def gvk_for(api_version: str, kind: str) -> GVK:
     for g in WELL_KNOWN:
         if g.api_version == api_version and g.kind == kind:
             return g
     group, _, version = api_version.rpartition("/")
     # Fall back to the conventional lowercase-plural guess.
-    return GVK(group, version or api_version, kind, kind.lower() + "s")
+    return GVK(group, version or api_version, kind, pluralize(kind))
 
 
 # --- Object helpers ---------------------------------------------------------
@@ -108,7 +126,15 @@ def new(gvk: GVK, name: str, namespace: Optional[str] = None, *,
 
 
 def meta(obj: Resource) -> dict:
-    return obj.setdefault("metadata", {})
+    m = obj.get("metadata")
+    if m is not None:
+        return m
+    if type(obj) is dict:
+        return obj.setdefault("metadata", {})
+    # Read-only view without metadata: hand back a FROZEN empty mapping —
+    # a detached plain {} would swallow writes silently, where the whole
+    # contract is that a write without thaw() fails loudly.
+    return FrozenResource({})
 
 
 def name_of(obj: Resource) -> str:
@@ -180,7 +206,9 @@ def match_labels(obj: Resource, selector: Dict[str, str]) -> bool:
 def deep_get(obj: Resource, *path: str, default: Any = None) -> Any:
     cur: Any = obj
     for p in path:
-        if not isinstance(cur, dict) or p not in cur:
+        if type(cur) is not dict and not isinstance(cur, Mapping):
+            return default
+        if p not in cur:
             return default
         cur = cur[p]
     return cur
@@ -190,9 +218,9 @@ def copy_resource(x: Any) -> Any:
     """Deep copy for JSON-shaped resources (dict/list/scalars — the only
     shapes k8s objects hold; they all cross the wire as JSON).  ~5x faster
     than copy.deepcopy, which pays memoization and reflective dispatch this
-    data never needs; resource copies dominate the control plane at fleet
-    scale (bench_scale.py), so the constant matters.  An unexpected node
-    type falls back to copy.deepcopy for that subtree."""
+    data never needs.  Frozen views (FrozenResource/FrozenList) copy their
+    backing data, so the result is always plain and mutable.  An unexpected
+    node type falls back to copy.deepcopy for that subtree."""
     t = type(x)
     if t is dict:
         return {k: copy_resource(v) for k, v in x.items()}
@@ -200,6 +228,154 @@ def copy_resource(x: Any) -> Any:
         return [copy_resource(v) for v in x]
     if t is str or t is int or t is float or t is bool or x is None:
         return x
+    if t is FrozenResource or t is FrozenList:
+        return copy_resource(x._data)
     import copy
 
     return copy.deepcopy(x)
+
+
+# --- Zero-copy read-only views ----------------------------------------------
+#
+# Informer caches used to deep-copy every get/list/index_list result so a
+# caller mutation couldn't corrupt the shared store — O(fleet × object
+# size) allocations per resync wave, the control plane's dominant cost at
+# scale (bench_scale.py).  client-go solves this by CONTRACT (informer
+# objects are shared and must not be mutated); Python callers can't be
+# trusted by convention alone, so the contract is enforced: cached reads
+# return FrozenResource/FrozenList wrappers over the live cache objects
+# (zero copies), any mutation attempt raises TypeError, and a caller that
+# actually intends to write calls thaw(obj) for a private mutable deep
+# copy — controller-runtime's DeepCopy-on-intent-to-write, made explicit.
+
+_READONLY_MSG = "cached object is read-only; call thaw()"
+
+
+class FrozenResource(Mapping):
+    """Recursive read-only Mapping view over a cached dict.  Container
+    values are wrapped lazily on access, so an untouched subtree costs
+    nothing.  Equality follows Mapping semantics (== any Mapping with
+    equal items, including plain dicts)."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: dict):
+        self._data = data
+
+    def __getitem__(self, key):
+        return freeze(self._data[key])
+
+    def __iter__(self):
+        return iter(self._data)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def get(self, key, default=None):
+        if key in self._data:
+            return freeze(self._data[key])
+        return default
+
+    def keys(self):
+        return self._data.keys()
+
+    def __repr__(self) -> str:
+        return f"FrozenResource({self._data!r})"
+
+    def __deepcopy__(self, memo):
+        # A deep copy of a read-only view is a private copy; mutability is
+        # the point of taking one (same result as thaw()).
+        return copy_resource(self._data)
+
+    # -- mutation surface: refuse loudly --------------------------------------
+
+    def _readonly(self, *_a, **_k):
+        raise TypeError(_READONLY_MSG)
+
+    __setitem__ = __delitem__ = _readonly
+    setdefault = update = pop = popitem = clear = _readonly
+
+
+class FrozenList(Sequence):
+    """Recursive read-only Sequence view over a cached list."""
+
+    __slots__ = ("_data",)
+
+    def __init__(self, data: list):
+        self._data = data
+
+    def __getitem__(self, index):
+        if isinstance(index, slice):
+            return FrozenList(self._data[index])
+        return freeze(self._data[index])
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __iter__(self):
+        return (freeze(v) for v in self._data)
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, FrozenList):
+            return self._data == other._data
+        # Lists only, mirroring plain-list semantics exactly: a frozen
+        # view must never compare equal to a tuple its thawed twin
+        # wouldn't (['a'] == ('a',) is False).
+        if isinstance(other, list):
+            return len(self._data) == len(other) and all(
+                a == b for a, b in zip(self, other))
+        return NotImplemented
+
+    def __ne__(self, other) -> bool:
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    __hash__ = None
+
+    def __repr__(self) -> str:
+        return f"FrozenList({self._data!r})"
+
+    def __deepcopy__(self, memo):
+        return copy_resource(self._data)
+
+    def _readonly(self, *_a, **_k):
+        raise TypeError(_READONLY_MSG)
+
+    __setitem__ = __delitem__ = _readonly
+    append = extend = insert = remove = pop = clear = sort = reverse = _readonly
+    __iadd__ = __imul__ = _readonly
+
+
+def freeze(x: Any) -> Any:
+    """Read-only view of a JSON-shaped value; scalars pass through, an
+    already-frozen view is returned as-is.  O(1) — no copying."""
+    t = type(x)
+    if t is dict:
+        return FrozenResource(x)
+    if t is list:
+        return FrozenList(x)
+    return x
+
+
+def thaw(x: Any) -> Any:
+    """Private mutable deep copy of a (possibly frozen) resource — the
+    explicit intent-to-write step of the read-ownership contract.  Safe on
+    plain dicts too (REST reads are already private), so call sites behave
+    identically whether their read came from a cache or the wire."""
+    t = type(x)
+    if t is FrozenResource or t is FrozenList:
+        return copy_resource(x._data)
+    return copy_resource(x)
+
+
+def json_default(o: Any) -> Any:
+    """``json.dumps(..., default=json_default)`` hook: serialize frozen
+    views by handing the encoder their backing data — a read-modify-write
+    round trip never copies just to cross the wire."""
+    if type(o) is FrozenResource or type(o) is FrozenList:
+        return o._data
+    raise TypeError(
+        f"Object of type {type(o).__name__} is not JSON serializable")

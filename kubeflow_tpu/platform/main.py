@@ -136,6 +136,44 @@ def run_webhook(args) -> int:
     return 0
 
 
+# Kinds each web app reads hot (tables, pickers, quota pre-flight):
+# APP_USE_INFORMERS=true (default) serves these from shared informer
+# caches — zero-copy frozen views, one LIST+WATCH per kind instead of an
+# apiserver LIST per request (the reference's client-go informer model).
+# The web apps serve every namespace, so these informers are
+# CLUSTER-WIDE: only bounded, low-churn kinds belong here.  Pods and
+# Events deliberately stay on the live-client path — at the fleet sizes
+# the ROADMAP targets, caching every pod and (especially) every event in
+# each web replica would dominate its RSS and watch-delta CPU for reads
+# that are always namespace-scoped anyway.
+_WEB_APP_CACHED_KINDS = {
+    "jupyter": ("NOTEBOOK", "PVC", "PODDEFAULT", "RESOURCEQUOTA", "NODE"),
+    "volumes": ("PVC", "STORAGECLASS"),
+    "tensorboards": ("TENSORBOARD", "PVC", "PODDEFAULT"),
+}
+
+
+def _web_app_caches(client, name: str):
+    from kubeflow_tpu.platform.k8s import types as k8s_types
+    from kubeflow_tpu.platform.runtime.informer import Informer
+
+    import time
+
+    caches = {}
+    for kind_name in _WEB_APP_CACHED_KINDS.get(name, ()):
+        gvk = getattr(k8s_types, kind_name)
+        caches[gvk] = Informer(client, gvk, resync_period=3600.0).start()
+    # Best-effort warmup under ONE shared deadline: an unsynced cache just
+    # means live-client fallback until it lands (CrudBackend checks
+    # has_synced per read), so a slow apiserver must not stack a full
+    # timeout per kind in front of the server bind and trip the
+    # startup probe.
+    deadline = time.monotonic() + 10.0
+    for informer in caches.values():
+        informer.wait_for_sync(max(0.0, deadline - time.monotonic()))
+    return caches
+
+
 def run_web_app(name: str, args) -> int:
     factories = {
         "jupyter": "kubeflow_tpu.platform.apps.jupyter.app",
@@ -161,7 +199,11 @@ def run_web_app(name: str, args) -> int:
     if name == "kfam":
         kwargs["heartbeat"] = True
         kwargs["use_informer"] = True
-    app = module.create_app(_client(), **kwargs)
+    client = _client()
+    if name in _WEB_APP_CACHED_KINDS and config.env_bool(
+            "APP_USE_INFORMERS", True):
+        kwargs["caches"] = _web_app_caches(client, name)
+    app = module.create_app(client, **kwargs)
     from werkzeug.serving import make_server as wz_make_server
 
     server = wz_make_server("0.0.0.0", args.port, app, threaded=True)

@@ -272,36 +272,87 @@ class Controller:
         return []
 
     def _watch_loop(self, client, gvk: GVK, mapper: EventMapper) -> None:
+        """Raw (non-informer) watch source.  Re-establishments resume from
+        the last seen resourceVersion: without the resume, every bounded
+        watch window's rollover (RestKubeClient closes at 300 s) replayed
+        the ENTIRE kind as ADDED and re-enqueued every object — a full
+        spurious reconcile sweep per kind per window at fleet scale.  A
+        410-style ERROR (resume RV compacted) falls back to one full
+        replay, deduped by level-triggered reconcile."""
+        rv: Optional[str] = None
+        failures = 0
         while not self._stop.is_set():
             try:
-                for _etype, obj in client.watch(
-                    gvk, self.namespace, stop=self._stop
+                for etype, obj in client.watch(
+                    gvk, self.namespace, resource_version=rv, stop=self._stop
                 ):
+                    failures = 0
+                    if etype == "ERROR":
+                        rv = None
+                        self._stop.wait(1.0)
+                        break
                     for req in mapper(obj):
                         self.queue.add(req)
-            except Exception:
+                    new_rv = meta(obj).get("resourceVersion")
+                    if new_rv is not None:
+                        rv = new_rv
+            except Exception as e:
                 if not self._stop.is_set():
                     log.warning(
                         "%s: watch on %s failed, retrying:\n%s",
                         self.name, gvk.kind, traceback.format_exc(),
                     )
-                    self._stop.wait(1.0)
+                    from kubeflow_tpu.platform.k8s.errors import ApiError
+
+                    if isinstance(e, ApiError) and e.status == 410:
+                        # 410 Gone AT establishment — a real apiserver
+                        # answers a compacted resume RV before any event
+                        # can stream, so it never reaches the in-stream
+                        # ERROR branch.  Resuming with the same RV would
+                        # 410 forever (a silent watch livelock); fall
+                        # back to one full replay.  ONLY 410: a 429/500
+                        # blip says nothing about the RV, and dropping it
+                        # there would re-trigger the full-kind replay
+                        # sweep this resume exists to eliminate.
+                        rv = None
+                    # Transport errors keep the RV: they can't tell us it
+                    # went stale, and a stale one answers with an ERROR
+                    # event (or a 410) on the next attempt and resets
+                    # then.  Exponential backoff on consecutive failures,
+                    # same as the informer relist loop: a raw watch is
+                    # exactly what serves optional-CRD kinds (profile/
+                    # tensorboard controllers), and a missing CRD must
+                    # not hammer the apiserver once per second forever.
+                    failures += 1
+                    self._stop.wait(min(1.0 * 2 ** (failures - 1), 30.0))
+
+    def _resync_once(self, client) -> int:
+        """One resync pass: enqueue every primary key; returns how many.
+        Reads the informer cache key-only (Informer.keys) — the pass
+        exists to re-enqueue N requests, so it must not materialize,
+        wrap, or copy N objects to do it (zero copy_resource calls,
+        pinned by test_frozen_views)."""
+        n = 0
+        informer = self.informers.get(self.primary)
+        if informer is not None and informer.has_synced:
+            # Cache-backed resync: the informer already holds the
+            # primaries (and its own relist guards against missed
+            # deltas) — a raw LIST here would hit the apiserver
+            # with the full kind every period.
+            for ns, name in informer.keys(self.namespace):
+                self.queue.add(Request(ns, name))
+                n += 1
+        else:
+            for obj in client.list(self.primary, self.namespace):
+                for req in self._primary_mapper(obj):
+                    self.queue.add(req)
+                    n += 1
+        return n
 
     def _resync_loop(self, client) -> None:
         while not self._stop.wait(self.resync_period):
             try:
-                informer = self.informers.get(self.primary)
-                if informer is not None and informer.has_synced:
-                    # Cache-backed resync: the informer already holds the
-                    # primaries (and its own relist guards against missed
-                    # deltas) — a raw LIST here would hit the apiserver
-                    # with the full kind every period.
-                    objs = informer.list(self.namespace)
-                else:
-                    objs = client.list(self.primary, self.namespace)
-                for obj in objs:
-                    for req in self._primary_mapper(obj):
-                        self.queue.add(req)
+                self._resync_once(client)
             except Exception:
                 log.warning("%s: resync list failed", self.name, exc_info=True)
 

@@ -20,8 +20,8 @@ from kubeflow_tpu.platform.runtime import metrics, trace
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
     Resource,
-    copy_resource,
     deep_get,
+    freeze,
     meta,
     name_of,
     namespace_of,
@@ -35,6 +35,45 @@ Handler = Callable[[str, Resource], None]  # (event_type, object)
 # cache.Indexers) — e.g. pods by their notebook-name label.  Values should
 # embed the namespace (``f"{ns}/{...}"``) when the informer spans namespaces.
 IndexFunc = Callable[[Resource], List[str]]
+
+
+def cache_or_client_list(cache, client, gvk: GVK,
+                         namespace: Optional[str] = None, *,
+                         label_selector: Optional[Dict[str, str]] = None
+                         ) -> List[Resource]:
+    """THE cache-read fallback contract, in one place: read from the
+    informer when it is wired and synced (zero-copy frozen views), live
+    LIST otherwise — an unsynced cache must never serve "nothing" as
+    authoritative.  Shared by the web backends, reconcilers and quota
+    paths so the semantics can't drift between call sites."""
+    if cache is not None and cache.has_synced:
+        return cache.list(namespace, label_selector=label_selector)
+    return client.list(gvk, namespace, label_selector=label_selector)
+
+
+def cache_or_client_get(cache, client, gvk: GVK, name: str,
+                        namespace: Optional[str] = None, *,
+                        read_through: bool = False
+                        ) -> Optional[Resource]:
+    """Single-object flavor of the same contract.  Returns None for
+    not-found on either path (callers choose whether that is an error).
+
+    ``read_through=True`` confirms a cache MISS with one live GET before
+    answering None: a just-created object inside the watch-propagation
+    window must not 404 (read-your-writes for interactive surfaces).
+    Reconcilers leave it off — for them a lagging cache is the normal
+    level-triggered case and the extra GET per genuinely-deleted object
+    (every not-found reconcile) would defeat the cached read."""
+    if cache is not None and cache.has_synced:
+        obj = cache.get(name, namespace)
+        if obj is not None or not read_through:
+            return obj
+    from kubeflow_tpu.platform.k8s import errors
+
+    try:
+        return client.get(gvk, name, namespace)
+    except errors.NotFound:
+        return None
 
 
 class Informer:
@@ -62,15 +101,19 @@ class Informer:
         self.last_sync_monotonic: Optional[float] = None
         self.started_monotonic: Optional[float] = None
         # indexer name -> value -> {store key: object ref}; rebuilt on
-        # relist, maintained per delta in _apply.  Reads copy only matches —
-        # the point: an indexed lookup is O(result), not O(store)
-        # (bench_scale.py: per-reconcile label-selector LISTs were the
-        # control plane's last quadratic term at fleet scale).
+        # relist, maintained per delta in _apply.  Reads return frozen
+        # views of only the matches — an indexed lookup is O(result), not
+        # O(store) (bench_scale.py: per-reconcile label-selector LISTs
+        # were the control plane's last quadratic term at fleet scale).
         self._indexes: Dict[str, Dict[str, Dict[Tuple[str, str], Resource]]] = {
             name: {} for name in self._indexers
         }
         # (indexer, store key) -> values the key is currently filed under.
         self._key_values: Dict[Tuple[str, Tuple[str, str]], List[str]] = {}
+        # Built-in per-namespace index (ns -> {store key: object ref}) so
+        # list(namespace=...) and keys(namespace=...) are O(matches)
+        # instead of O(store); maintained exactly like the store.
+        self._by_ns: Dict[str, Dict[Tuple[str, str], Resource]] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -109,29 +152,34 @@ class Informer:
 
     def add_handler(self, handler: Handler) -> None:
         """Register for deltas.  Objects already in the store are replayed
-        as ADDED so late subscribers see a complete stream."""
+        as ADDED so late subscribers see a complete stream.  Handlers get
+        frozen views, like every other cache read."""
         with self._lock:
             self._handlers.append(handler)
             existing = list(self._store.values())
         for obj in existing:
-            handler("ADDED", obj)
+            handler("ADDED", freeze(obj))
 
     # -- read API ------------------------------------------------------------
+    #
+    # Every read returns a zero-copy FROZEN view of the cached object
+    # (types.FrozenResource): mutation attempts raise TypeError, and a
+    # caller that intends to write takes a private copy with types.thaw().
+    # The store never mutates an object in place (watch deltas replace
+    # whole objects), so a view handed out stays a consistent snapshot
+    # even after the cache moves on.
 
     def get(self, name: str, namespace: Optional[str] = None) -> Optional[Resource]:
         with trace.span("informer.get", kind=self.gvk.kind):
             with self._lock:
                 obj = self._store.get((namespace or "", name))
-            # Copy like every KubeClient.list/get: a caller mutating a
-            # result must not corrupt the shared cache.
-            return copy_resource(obj) if obj is not None else None
+            return freeze(obj) if obj is not None else None
 
     def list(self, namespace: Optional[str] = None, *,
              label_selector: Optional[Dict[str, str]] = None) -> List[Resource]:
         with trace.span("informer.list", kind=self.gvk.kind), self._lock:
             if namespace is not None:
-                refs = [o for (ns, _), o in self._store.items()
-                        if ns == namespace]
+                refs = list(self._by_ns.get(namespace, {}).values())
             else:
                 refs = list(self._store.values())
             if label_selector:
@@ -141,7 +189,16 @@ class Informer:
                                for k, v in label_selector.items())
 
                 refs = [o for o in refs if matches(o)]
-            return [copy_resource(o) for o in refs]
+            return [freeze(o) for o in refs]
+
+    def keys(self, namespace: Optional[str] = None) -> List[Tuple[str, str]]:
+        """(namespace, name) pairs in the cache — the key-only read for
+        resync loops, which enqueue N requests and must not materialize
+        (or wrap) N objects to do it."""
+        with self._lock:
+            if namespace is not None:
+                return list(self._by_ns.get(namespace, {}).keys())
+            return list(self._store.keys())
 
     def index_list(self, indexer: str, value: str) -> List[Resource]:
         """Objects filed under ``value`` by ``indexer`` — O(matches), the
@@ -149,7 +206,7 @@ class Informer:
         (client-go cache.Indexer.ByIndex)."""
         with trace.span("informer.index_list", kind=self.gvk.kind), self._lock:
             bucket = self._indexes[indexer].get(value)
-            return [copy_resource(o) for o in bucket.values()] if bucket else []
+            return [freeze(o) for o in bucket.values()] if bucket else []
 
     def __len__(self) -> int:
         with self._lock:
@@ -199,9 +256,13 @@ class Informer:
         else:
             items, rv = self.client.list(self.gvk, self.namespace), None
         fresh = {self._key(o): o for o in items}
+        by_ns: Dict[str, Dict[Tuple[str, str], Resource]] = {}
+        for key, obj in fresh.items():
+            by_ns.setdefault(key[0], {})[key] = obj
         with self._lock:
             old = self._store
             self._store = fresh
+            self._by_ns = by_ns
             if self._indexers:
                 self._indexes = {name: {} for name in self._indexers}
                 self._key_values.clear()
@@ -224,9 +285,10 @@ class Informer:
 
     @staticmethod
     def _notify(handlers, etype: str, obj: Resource) -> None:
+        view = freeze(obj)
         for h in handlers:
             try:
-                h(etype, obj)
+                h(etype, view)
             except Exception:
                 log.exception("informer handler failed")
 
@@ -237,6 +299,11 @@ class Informer:
             if etype == "DELETED":
                 if self._store.pop(key, None) is None:
                     return  # already gone; don't replay the delete
+                bucket = self._by_ns.get(key[0])
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del self._by_ns[key[0]]
                 self._index_drop(key)
             elif etype in ("ADDED", "MODIFIED"):
                 prior = self._store.get(key)
@@ -249,6 +316,7 @@ class Informer:
                     # must not see duplicates.
                     return
                 self._store[key] = obj
+                self._by_ns.setdefault(key[0], {})[key] = obj
                 self._index_set(key, obj)
             else:
                 return  # BOOKMARK etc.
@@ -259,6 +327,7 @@ class Informer:
 
         deadline = 0.0
         rv: Optional[str] = None
+        failures = 0
         while not self._stop.is_set():
             try:
                 if rv is None or _time.monotonic() >= deadline:
@@ -271,6 +340,7 @@ class Informer:
                     # 300s one.
                     rv = self._relist()
                     self._synced.set()
+                    failures = 0
                     deadline = _time.monotonic() + self.resync_period
                 for etype, obj in self.client.watch(
                     self.gvk, self.namespace, resource_version=rv,
@@ -303,4 +373,9 @@ class Informer:
                     metrics.informer_watch_restarts_total.labels(
                         kind=self.gvk.kind).inc()
                     rv = None  # stale-RV or transport error: start clean
-                    self._stop.wait(1.0)
+                    # Exponential backoff on CONSECUTIVE failures: a
+                    # persistent error (RBAC 403 on the LIST, missing
+                    # CRD) must not hammer the apiserver with a full
+                    # relist attempt every second forever.
+                    failures += 1
+                    self._stop.wait(min(1.0 * 2 ** (failures - 1), 30.0))

@@ -17,6 +17,7 @@ one thread-local read per span.
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import logging
 import secrets
@@ -66,9 +67,16 @@ class Span:
         return d
 
 
+# Trace ids: one urandom read per PROCESS (the prefix), then a counter —
+# secrets.token_hex per reconcile was a syscall on every dequeue, visible
+# in the fleet resync's CPU floor (bench_scale.py).
+_id_prefix = secrets.token_hex(4)
+_id_counter = itertools.count()
+
+
 class Trace:
     def __init__(self, controller: str, request: str):
-        self.trace_id = secrets.token_hex(8)
+        self.trace_id = f"{_id_prefix}{next(_id_counter) & 0xFFFFFFFF:08x}"
         self.controller = controller
         self.request = request
         self.start_ts = time.time()

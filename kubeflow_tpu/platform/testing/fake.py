@@ -282,6 +282,10 @@ class FakeKube:
 
     def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge") -> Resource:
         with self._lock:
+            # Accept patches that embed frozen cache views (copy_resource
+            # unwraps them to plain data) — the native merge engine and
+            # jsonpatch only speak dict/list.
+            patch = _copy_obj(patch)
             current = self._get_ref(gvk, name, namespace)
             # The merge below mutates the stored object in place; keep a
             # rollback copy so a post-merge validation failure (malformed

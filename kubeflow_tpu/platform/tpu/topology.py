@@ -18,6 +18,7 @@ consume it, so the two can never disagree about what a topology means.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Dict, Optional, Tuple
 
@@ -101,7 +102,12 @@ class SliceSpec:
 def slice_spec(
     accelerator: str, topology: Optional[str] = None, slices: Optional[int] = None
 ) -> SliceSpec:
-    """Resolve (accelerator, topology[, slices]) → SliceSpec, validating."""
+    """Resolve (accelerator, topology[, slices]) → SliceSpec, validating.
+
+    Memoized: the result is a frozen dataclass and the resolution is pure,
+    but every reconcile re-resolves its notebook's spec.tpu several times
+    (generation, status, PDB, quota math) — at fleet scale the repeated
+    topology parsing was measurable on the no-op resync path."""
     if slices is None:
         slices = 1
     try:
@@ -110,6 +116,17 @@ def slice_spec(
         raise ValueError(f"invalid TPU slice count {slices!r}") from None
     if slices < 1:
         raise ValueError(f"invalid TPU slice count {slices}")
+    if not isinstance(accelerator, str) or (
+            topology is not None and not isinstance(topology, str)):
+        raise ValueError(
+            f"invalid TPU accelerator/topology {accelerator!r}/{topology!r}")
+    return _slice_spec_cached(accelerator, topology, slices)
+
+
+@functools.lru_cache(maxsize=1024)
+def _slice_spec_cached(
+    accelerator: str, topology: Optional[str], slices: int
+) -> SliceSpec:
     if accelerator not in ACCELERATORS:
         raise ValueError(
             f"unknown TPU accelerator {accelerator!r}; known: {sorted(ACCELERATORS)}"

@@ -53,11 +53,22 @@ def no_authentication(fn):
 
 
 class CrudBackend:
-    """Bundles client + auth for the per-resource API helpers."""
+    """Bundles client + auth for the per-resource API helpers.
 
-    def __init__(self, client, auth: Optional[AuthContext] = None):
+    ``caches`` is an optional {GVK: started Informer}: kinds present there
+    are READ from the shared informer cache (zero-copy frozen views —
+    the reference web apps read through client-go informers the same way)
+    instead of a per-request apiserver LIST/GET; every read is still
+    SubjectAccessReview-gated, and an unsynced cache falls back to the
+    live client so a cold start never serves "nothing" as authoritative.
+    Writes always go to the client."""
+
+    def __init__(self, client, auth: Optional[AuthContext] = None, *,
+                 caches: Optional[dict] = None):
         self.client = client
         self.auth = auth or AuthContext()
+        self.caches = caches or {}
+
 
     # -- authz gate ----------------------------------------------------------
 
@@ -76,12 +87,27 @@ class CrudBackend:
     # -- generic verbs (each authz-gated like the reference api/ wrappers) ---
 
     def list_resources(self, user, gvk, namespace=None, label_selector=None):
+        from kubeflow_tpu.platform.runtime.informer import cache_or_client_list
+
         self.ensure(user, "list", gvk, namespace)
-        return self.client.list(gvk, namespace, label_selector=label_selector)
+        return cache_or_client_list(self.caches.get(gvk), self.client, gvk,
+                                    namespace, label_selector=label_selector)
 
     def get_resource(self, user, gvk, name, namespace=None):
+        from kubeflow_tpu.platform.runtime.informer import cache_or_client_get
+
         self.ensure(user, "get", gvk, namespace)
-        return self.client.get(gvk, name, namespace)
+        # read_through: a UI GET right after its own POST must not 404
+        # out of a cache the watch delta hasn't reached yet.
+        obj = cache_or_client_get(self.caches.get(gvk), self.client, gvk,
+                                  name, namespace, read_through=True)
+        if obj is None:
+            from kubeflow_tpu.platform.k8s import errors
+
+            raise errors.NotFound(
+                f'{gvk.plural} "{name}" not found'
+                + (f' in namespace "{namespace}"' if namespace else ""))
+        return obj
 
     def create_resource(self, user, obj, *, dry_run=False):
         from kubeflow_tpu.platform.k8s.types import gvk_of, namespace_of

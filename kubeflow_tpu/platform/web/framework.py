@@ -26,9 +26,13 @@ class HttpError(Exception):
 
 
 def json_response(data: Any, status: int = 200, *, headers: Optional[dict] = None) -> Response:
+    from kubeflow_tpu.platform.k8s.types import json_default
+
+    # default hook: responses may embed frozen cache views (zero-copy
+    # informer reads) — serialize them without thawing.
     return Response(
-        json.dumps(data), status=status, content_type="application/json",
-        headers=headers,
+        json.dumps(data, default=json_default), status=status,
+        content_type="application/json", headers=headers,
     )
 
 

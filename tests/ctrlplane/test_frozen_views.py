@@ -1,0 +1,406 @@
+"""Zero-copy informer reads: the enforced read-ownership contract.
+
+Informer reads (get/list/index_list, handler deliveries) hand out frozen
+views over the shared cache — client-go's "informer objects must not be
+mutated" rule, enforced instead of conventional.  This suite is the
+mutation-safety matrix: no mutation attempt, at any nesting depth, on
+either the frozen or the thawed path, may corrupt the shared store or its
+indexes; the resync loop must enqueue key-only with ZERO copy_resource
+calls; and frozen views must serialize/compare/write back like plain
+dicts.  Plus the gvk_for pluralization rules that ride along this PR.
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+import pytest
+
+from kubeflow_tpu.platform.k8s import types as k8s_types
+from kubeflow_tpu.platform.k8s.types import (
+    NOTEBOOK,
+    POD,
+    FrozenList,
+    FrozenResource,
+    freeze,
+    gvk_for,
+    json_default,
+    pluralize,
+    thaw,
+)
+from kubeflow_tpu.platform.runtime.informer import Informer
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def nb(name, ns="ns1", image="img"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns,
+                     "labels": {"team": "ml"}},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": image}]}}},
+        "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+    }
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("ns1")
+    k.add_namespace("ns2")
+    return k
+
+
+@pytest.fixture
+def informer(kube):
+    kube.create(nb("a"))
+    kube.create(nb("b", ns="ns2"))
+    inf = Informer(
+        kube, NOTEBOOK,
+        indexers={"team": lambda o: [
+            (o["metadata"].get("labels") or {}).get("team", "")]},
+    ).start()
+    assert inf.wait_for_sync(5)
+    yield inf
+    inf.stop()
+
+
+# -- frozen path: every mutation surface refuses ------------------------------
+
+
+def test_every_mutation_surface_raises(informer):
+    obj = informer.get("a", "ns1")
+    assert isinstance(obj, FrozenResource)
+    for attempt in (
+        lambda: obj.__setitem__("x", 1),
+        lambda: obj.__delitem__("spec"),
+        lambda: obj.setdefault("x", 1),
+        lambda: obj.update({"x": 1}),
+        lambda: obj.pop("spec"),
+        lambda: obj.popitem(),
+        lambda: obj.clear(),
+        # nested dicts (metadata.labels) are frozen too
+        lambda: obj["metadata"].__setitem__("name", "evil"),
+        lambda: obj["metadata"]["labels"].__setitem__("team", "evil"),
+        lambda: obj["metadata"]["labels"].pop("team"),
+        # nested lists (spec containers, status conditions)
+        lambda: obj["spec"]["template"]["spec"]["containers"].append({}),
+        lambda: obj["spec"]["template"]["spec"]["containers"].__setitem__(
+            0, {}),
+        lambda: obj["status"]["conditions"][0].__setitem__("status", "False"),
+        lambda: obj["status"]["conditions"].clear(),
+    ):
+        with pytest.raises(TypeError, match="read-only; call thaw"):
+            attempt()
+    # The shared store never noticed any of it.
+    assert informer.get("a", "ns1")["metadata"]["labels"]["team"] == "ml"
+
+
+def test_list_and_index_list_and_handlers_hand_out_frozen_views(informer):
+    for obj in informer.list():
+        assert isinstance(obj, FrozenResource)
+    for obj in informer.list("ns1"):
+        assert isinstance(obj, FrozenResource)
+    for obj in informer.index_list("team", "ml"):
+        assert isinstance(obj, FrozenResource)
+    seen = []
+    informer.add_handler(lambda et, o: seen.append(o))
+    assert seen and all(isinstance(o, FrozenResource) for o in seen)
+
+
+def test_namespace_list_uses_per_ns_index(informer):
+    assert [o["metadata"]["name"] for o in informer.list("ns2")] == ["b"]
+    assert informer.keys("ns2") == [("ns2", "b")]
+    assert sorted(informer.keys()) == [("ns1", "a"), ("ns2", "b")]
+    assert informer.list("nope") == [] and informer.keys("nope") == []
+
+
+# -- thawed path: private copies, store and indexes survive -------------------
+
+
+def test_thaw_is_private_and_indexes_survive(kube, informer):
+    view = informer.get("a", "ns1")
+    mine = thaw(view)
+    assert isinstance(mine, dict)
+    mine["metadata"]["labels"]["team"] = "evil"
+    mine["spec"]["template"]["spec"]["containers"][0]["image"] = "evil"
+    mine["status"]["conditions"].append({"type": "Evil"})
+    # Store object untouched...
+    again = informer.get("a", "ns1")
+    assert again["metadata"]["labels"]["team"] == "ml"
+    assert again["spec"]["template"]["spec"]["containers"][0]["image"] == "img"
+    assert len(again["status"]["conditions"]) == 1
+    # ...and the index still files "a" under its original value.
+    assert [o["metadata"]["name"] for o in informer.index_list("team", "ml")
+            if o["metadata"]["namespace"] == "ns1"] == ["a"]
+    assert informer.index_list("team", "evil") == []
+    # deepcopy of a frozen view behaves like thaw (mutable private copy).
+    other = copy.deepcopy(view)
+    other["metadata"]["name"] = "elsewhere"
+    assert informer.get("a", "ns1")["metadata"]["name"] == "a"
+
+
+def test_thaw_on_plain_objects_is_a_deep_copy(kube):
+    plain = nb("p")
+    mine = thaw(plain)
+    mine["metadata"]["labels"]["team"] = "evil"
+    assert plain["metadata"]["labels"]["team"] == "ml"
+
+
+# -- interop: equality, serialization, write-back -----------------------------
+
+
+def test_frozen_equality_matches_plain(kube, informer):
+    view = informer.get("a", "ns1")
+    plain = kube.get(NOTEBOOK, "a", "ns1")
+    assert view == plain and plain == view
+    assert view["status"]["conditions"] == plain["status"]["conditions"]
+    assert plain["status"]["conditions"] == view["status"]["conditions"]
+    assert isinstance(view["status"]["conditions"], FrozenList)
+    plain["metadata"]["labels"]["team"] = "other"
+    assert view != plain
+
+
+def test_frozen_views_serialize_via_json_default(informer):
+    view = informer.get("a", "ns1")
+    round_tripped = json.loads(json.dumps(view, default=json_default))
+    assert round_tripped == thaw(view)
+    # Embedded frozen subtrees serialize too (the read-modify-write shape:
+    # a plain object carrying frozen nested values).
+    status = {"conditions": view["status"]["conditions"]}
+    assert json.loads(json.dumps(status, default=json_default)) == {
+        "conditions": [{"type": "Ready", "status": "True"}]}
+
+
+def test_fakekube_writes_accept_frozen_views(kube, informer):
+    # update() built from a thawed view with frozen subtrees grafted in.
+    base = kube.get(NOTEBOOK, "a", "ns1")
+    view = informer.get("a", "ns1")
+    base["status"] = {"conditions": view["status"]["conditions"]}
+    out = kube.update_status(base)
+    assert out["status"]["conditions"][0]["type"] == "Ready"
+    assert isinstance(out["status"]["conditions"], list)
+    # patch() carrying frozen values.
+    patched = kube.patch(
+        NOTEBOOK, "a",
+        {"metadata": {"annotations": {"from-frozen": "yes"},
+                      "labels": view["metadata"]["labels"]}},
+        "ns1")
+    assert patched["metadata"]["annotations"]["from-frozen"] == "yes"
+    # create() of an object embedding a frozen template.
+    fresh = nb("c")
+    fresh["spec"]["template"] = view["spec"]["template"]
+    created = kube.create(fresh)
+    assert created["spec"]["template"]["spec"]["containers"][0]["image"] == "img"
+
+
+def test_deep_get_and_meta_work_on_frozen(informer):
+    from kubeflow_tpu.platform.k8s.types import deep_get, meta
+
+    view = informer.get("a", "ns1")
+    assert deep_get(view, "spec", "template", "spec",
+                    "containers")[0]["name"] == "a"
+    assert deep_get(view, "no", "such", "path", default=7) == 7
+    assert meta(view)["name"] == "a"
+    # No metadata on a frozen view: an EMPTY FROZEN mapping — reads see
+    # nothing, writes fail loudly (a detached plain {} would swallow them).
+    empty = meta(freeze({}))
+    assert empty == {}
+    with pytest.raises(TypeError, match="read-only"):
+        empty["annotations"] = {}
+
+
+# -- resync: key-only, zero copies --------------------------------------------
+
+
+def test_resync_loop_performs_zero_copy_resource_calls(kube, monkeypatch):
+    from kubeflow_tpu.platform.runtime import Controller, Reconciler
+
+    for i in range(25):
+        kube.create(nb(f"r-{i:02d}"))
+    inf = Informer(kube, NOTEBOOK).start()
+    assert inf.wait_for_sync(5)
+
+    class Noop(Reconciler):
+        def reconcile(self, req):
+            return None
+
+    ctrl = Controller("frozen-resync-test", Noop(), primary=NOTEBOOK,
+                      informers={NOTEBOOK: inf})
+    calls = []
+    real = k8s_types.copy_resource
+
+    def counting(x):
+        calls.append(x)
+        return real(x)
+
+    monkeypatch.setattr(k8s_types, "copy_resource", counting)
+    try:
+        ctrl._resync_once(kube)
+    finally:
+        monkeypatch.undo()
+    assert calls == [], (
+        f"resync pass copied {len(calls)} objects; it must enqueue "
+        "key-only (Informer.keys)")
+    assert ctrl.queue.pending() == 25
+    ctrl.queue.shut_down()
+    inf.stop()
+
+
+def test_resync_falls_back_to_client_list_when_cache_unsynced(kube):
+    from kubeflow_tpu.platform.runtime import Controller, Reconciler
+
+    kube.create(nb("solo"))
+
+    class Noop(Reconciler):
+        def reconcile(self, req):
+            return None
+
+    ctrl = Controller("fallback-resync-test", Noop(), primary=NOTEBOOK)
+    ctrl._resync_once(kube)
+    assert ctrl.queue.pending() == 1
+    ctrl.queue.shut_down()
+
+
+# -- raw watch resume (non-informer sources) ----------------------------------
+
+
+def test_raw_watch_loop_resumes_by_rv_and_recovers_from_410():
+    """Controller._watch_loop must re-establish raw watches from the last
+    seen resourceVersion (no full-kind backlog replay per bounded window)
+    — and when the apiserver rejects that RV AT ESTABLISHMENT (a real
+    server answers a compacted RV with HTTP 410 before any event can
+    stream), it must fall back to one full replay instead of livelocking
+    on the same expired RV forever."""
+    import threading
+    import time as _time
+
+    from kubeflow_tpu.platform.k8s import errors as k8s_errors
+    from kubeflow_tpu.platform.runtime import Controller, Reconciler
+
+    class Noop(Reconciler):
+        def reconcile(self, req):
+            return None
+
+    class FakeWatchClient:
+        def __init__(self):
+            self.calls = []
+            self.done = threading.Event()
+
+        def watch(self, gvk, namespace=None, *, resource_version=None,
+                  stop=None, **kw):
+            self.calls.append(resource_version)
+            n = len(self.calls)
+            if n == 1:
+                # initial watch: one event, then the bounded window closes
+                def first():
+                    obj = nb("w1")
+                    obj["metadata"]["resourceVersion"] = "7"
+                    yield ("ADDED", obj)
+                return first()
+            if n == 2:
+                # resume attempt: the RV was compacted — reject at
+                # establishment, like a real apiserver
+                raise k8s_errors.ApiError(
+                    "too old resource version", status=410)
+
+            def rest():
+                self.done.set()
+                while stop is None or not stop.is_set():
+                    _time.sleep(0.01)
+                return
+                yield  # pragma: no cover
+
+            return rest()
+
+    client = FakeWatchClient()
+    ctrl = Controller("watch-resume-test", Noop(), primary=NOTEBOOK)
+    t = threading.Thread(
+        target=ctrl._watch_loop,
+        args=(client, NOTEBOOK, ctrl._primary_mapper), daemon=True)
+    t.start()
+    assert client.done.wait(10.0), f"watch never recovered: {client.calls}"
+    ctrl._stop.set()
+    ctrl.queue.shut_down()
+    t.join(timeout=5.0)
+    # call 1: fresh (None); call 2: resumed from the event's RV; call 3:
+    # reset to None after the establishment-410.
+    assert client.calls == [None, "7", None], client.calls
+
+
+def test_raw_watch_event_rv_is_carried_to_next_establishment():
+    import threading
+    import time as _time
+
+    from kubeflow_tpu.platform.runtime import Controller, Reconciler
+
+    class Noop(Reconciler):
+        def reconcile(self, req):
+            return None
+
+    class FakeWatchClient:
+        def __init__(self):
+            self.calls = []
+            self.done = threading.Event()
+
+        def watch(self, gvk, namespace=None, *, resource_version=None,
+                  stop=None, **kw):
+            self.calls.append(resource_version)
+            if len(self.calls) == 1:
+                def first():
+                    obj = nb("w1")
+                    obj["metadata"]["resourceVersion"] = "42"
+                    yield ("ADDED", obj)
+                return first()
+
+            def rest():
+                self.done.set()
+                while stop is None or not stop.is_set():
+                    _time.sleep(0.01)
+                return
+                yield  # pragma: no cover
+
+            return rest()
+
+    client = FakeWatchClient()
+    ctrl = Controller("watch-rv-test", Noop(), primary=NOTEBOOK)
+    t = threading.Thread(
+        target=ctrl._watch_loop,
+        args=(client, NOTEBOOK, ctrl._primary_mapper), daemon=True)
+    t.start()
+    assert client.done.wait(10.0)
+    ctrl._stop.set()
+    ctrl.queue.shut_down()
+    t.join(timeout=5.0)
+    assert client.calls[:2] == [None, "42"], client.calls
+
+
+# -- gvk_for fallback pluralization -------------------------------------------
+
+
+@pytest.mark.parametrize("kind,plural", [
+    ("NetworkPolicy", "networkpolicies"),       # consonant + y -> ies
+    ("PriorityClass", "priorityclasses"),       # sibilant ss -> es
+    ("Ingress", "ingresses"),
+    ("Status", "statuses"),                     # us -> es
+    ("Analysis", "analysises"),                 # is -> es (deterministic
+                                                # guess; flect's irregular
+                                                # table would say analyses)
+    ("Endpoints", "endpoints"),                 # already plural: unchanged
+    ("Gateway", "gateways"),                    # vowel + y -> ys
+    ("Box", "boxes"),
+    ("Branch", "branches"),
+    ("Dish", "dishes"),
+    ("Topaz", "topazes"),
+    ("Widget", "widgets"),                      # default -> s
+])
+def test_gvk_for_fallback_pluralization(kind, plural):
+    assert pluralize(kind) == plural
+    gvk = gvk_for("example.com/v1", kind)
+    assert gvk.plural == plural and gvk.kind == kind
+
+
+def test_gvk_for_well_known_kinds_keep_registered_plurals():
+    assert gvk_for("v1", "Pod").plural == "pods"
+    assert gvk_for("v1", "Pod") is POD
+    assert gvk_for("kubeflow.org/v1beta1", "Notebook").plural == "notebooks"

@@ -243,13 +243,26 @@ def test_index_survives_relist(kube):
     inf.stop()
 
 
-def test_index_list_results_are_copies(kube):
+def test_index_list_results_are_read_only(kube):
+    # Zero-copy contract: index_list hands out frozen views; a caller
+    # that tries to mutate one gets TypeError and the cache stays intact
+    # (the deeper matrix lives in test_frozen_views.py).
+    import pytest as _pytest
+
+    from kubeflow_tpu.platform.k8s.types import thaw
+
     kube.create(rb("b1", "ns1"))
     inf = Informer(kube, ROLEBINDING,
                    indexers={"user": _user_index}).start()
     assert inf.wait_for_sync()
     got = inf.index_list("user", "ns1/alice@x.org")[0]
-    got["metadata"]["annotations"]["user"] = "evil@x.org"
+    with _pytest.raises(TypeError, match="read-only"):
+        got["metadata"]["annotations"]["user"] = "evil@x.org"
     assert inf.index_list("user", "ns1/alice@x.org"), \
         "cache corrupted by caller mutation"
+    # thaw() is the sanctioned write path: private copy, cache untouched.
+    mine = thaw(got)
+    mine["metadata"]["annotations"]["user"] = "evil@x.org"
+    assert inf.index_list("user", "ns1/alice@x.org")[0][
+        "metadata"]["annotations"]["user"] == "alice@x.org"
     inf.stop()

@@ -5,6 +5,11 @@ failure modes it exists to catch (per-reconcile namespace LISTs, store
 scans over every kind, unindexed event mirroring) show up as a blown
 budget.  The full-size numbers (600/1000 notebooks) live in BASELINE.md
 and are re-measured by ``python bench_scale.py``.
+
+The allocation tripwire at the bottom is TIER-1 (not slow): a miniature
+N=40 fleet under tracemalloc that fails fast on copy-amplification
+regressions (a return of copy-per-read on the informer path) without the
+full bench.
 """
 from __future__ import annotations
 
@@ -14,7 +19,7 @@ import pytest
 
 from kubeflow_tpu.platform.runtime import Request
 
-pytestmark = pytest.mark.slow
+slow = pytest.mark.slow
 
 
 def _harness(**kwargs):
@@ -23,6 +28,28 @@ def _harness(**kwargs):
     return FleetHarness(**kwargs)
 
 
+def test_resync_allocation_stays_in_band():
+    """Tier-1 copy-amplification tripwire: one steady-state resync cycle
+    of a 40-notebook fleet, under tracemalloc.  The peak allocation per
+    no-op reconcile is pinned: zero-copy frozen-view reads measure
+    ~2.5 KiB/object on the dev container, while the pre-frozen-view
+    copy-per-read path measured ~4.9 KiB/object — so the 4.0 band fails
+    fast if deep copies creep back onto the informer read path, long
+    before the full bench would notice."""
+    h = _harness()
+    try:
+        h.wave(40, timeout=60.0)
+        h.resync_cycle(timeout=30.0)  # warmup: lazy imports, first drain
+        alloc = h.resync_alloc(timeout=30.0)
+    finally:
+        h.close()
+    assert alloc["n"] >= 40
+    assert alloc["peak_kb_per_obj"] < 4.0, (
+        f"resync allocated {alloc['peak_kb_per_obj']:.2f} KiB/object at "
+        f"peak (band 4.0) — copy amplification is back on the read path")
+
+
+@slow
 @pytest.mark.parametrize("n", [150])
 def test_wave_converges_within_budget(n):
     h = _harness()
@@ -39,6 +66,7 @@ def test_wave_converges_within_budget(n):
     assert res["errors"] == 0
 
 
+@slow
 def test_near_linear_scaling_small_vs_large():
     """Per-notebook converge time must not grow superlinearly with fleet
     size (the assertion functional tests cannot make)."""
@@ -55,6 +83,7 @@ def test_near_linear_scaling_small_vs_large():
     assert ratio < 3.0, f"superlinear: {ratio:.2f}x per-notebook at 4x fleet"
 
 
+@slow
 def test_resync_cycle_drains_and_is_cheap():
     h = _harness()
     try:
@@ -67,6 +96,7 @@ def test_resync_cycle_drains_and_is_cheap():
         h.close()
 
 
+@slow
 def test_steady_churn_queue_stays_drained():
     h = _harness()
     try:
@@ -81,6 +111,7 @@ def test_steady_churn_queue_stays_drained():
         h.close()
 
 
+@slow
 def test_noop_reconcile_cost_flat_in_fleet_size():
     """The per-reconcile cost must be O(1) in fleet size — cache-indexed
     reads, no namespace-wide LISTs (the round-5 informer architecture)."""
@@ -105,6 +136,7 @@ def test_noop_reconcile_cost_flat_in_fleet_size():
         f"({costs[100]*1e3:.2f} -> {costs[400]*1e3:.2f} ms)")
 
 
+@slow
 def test_http_transport_fleet_with_short_watch_windows():
     """The same fleet machinery over the REAL wire (RestKubeClient against
     httpkube — the envtest analogue), with the client's bounded watch

@@ -554,3 +554,83 @@ def test_kind_of_rule_route_shapes():
     assert _kind_of_rule("/api/activities/<ns>") == "activities"
     for rule in ("/healthz", "/metrics", "/kfam/v1/bindings", None):
         assert _kind_of_rule(rule) is None
+
+
+# -- informer-cache-backed reads (zero-copy frozen views, APP_USE_INFORMERS) --
+
+
+def test_web_apps_serve_cached_frozen_reads_over_http(kube):
+    """Every production web app runs with informer caches by default
+    (main.py APP_USE_INFORMERS): spin each app up WITH caches wired and
+    drive its hot routes over real HTTP — any handler that mutates a
+    cached result (TypeError on frozen views) or serializes one outside
+    json_response would 500 here.  Also pins read-your-writes: a GET
+    right after a POST must not 404 out of a lagging cache
+    (CrudBackend's read-through)."""
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app as jwa_app
+    from kubeflow_tpu.platform.apps.tensorboards.app import (
+        create_app as twa_app,
+    )
+    from kubeflow_tpu.platform.apps.volumes.app import create_app as vwa_app
+    from kubeflow_tpu.platform.k8s.types import (
+        NODE,
+        PODDEFAULT,
+        RESOURCEQUOTA,
+        STORAGECLASS,
+        TENSORBOARD,
+    )
+    from kubeflow_tpu.platform.runtime.informer import Informer
+
+    kube.create({"apiVersion": "v1", "kind": "ResourceQuota",
+                 "metadata": {"name": "q", "namespace": "user1"},
+                 "spec": {"hard": {"google.com/tpu": "16"}}})
+    kube.create({"apiVersion": "v1", "kind": "PersistentVolumeClaim",
+                 "metadata": {"name": "vol1", "namespace": "user1"},
+                 "spec": {"accessModes": ["ReadWriteOnce"],
+                          "resources": {"requests": {"storage": "1Gi"}}}})
+    caches = {g: Informer(kube, g).start()
+              for g in (NOTEBOOK, PVC, PODDEFAULT, RESOURCEQUOTA, NODE,
+                        STORAGECLASS, TENSORBOARD)}
+    try:
+        for inf in caches.values():
+            assert inf.wait_for_sync(10)
+
+        jwa = serve(jwa_app(kube, auth=auth(), caches=caches))
+        r = http.post(f"{jwa}/api/namespaces/user1/notebooks",
+                      headers=USER_HEADER,
+                      json={"name": "cached-nb", "image": "img",
+                            "tpus": {"accelerator": "v5e",
+                                     "topology": "2x4"}})
+        assert r.status_code == 200, r.text
+        # read-your-writes: immediate GET must not 404 from a lagging
+        # cache (read-through), and the quota picker must serve from the
+        # RESOURCEQUOTA/NODE caches without error.
+        r = http.get(f"{jwa}/api/namespaces/user1/notebooks/cached-nb",
+                     headers=USER_HEADER)
+        assert r.status_code == 200, r.text
+        assert r.json()["notebook"]["metadata"]["name"] == "cached-nb"
+        r = http.get(f"{jwa}/api/namespaces/user1/tpus", headers=USER_HEADER)
+        assert r.status_code == 200 and r.json()["quota"]["hard"] == 16
+        r = http.get(f"{jwa}/api/namespaces/user1/pvcs", headers=USER_HEADER)
+        assert r.status_code == 200
+
+        vwa = serve(vwa_app(kube, auth=auth(), caches=caches))
+        r = http.get(f"{vwa}/api/namespaces/user1/pvcs", headers=USER_HEADER)
+        assert r.status_code == 200
+        assert "vol1" in [p["name"] for p in r.json()["pvcs"]]
+        r = http.get(f"{vwa}/api/storageclasses", headers=USER_HEADER)
+        assert r.status_code == 200
+
+        twa = serve(twa_app(kube, auth=auth(), caches=caches))
+        r = http.post(f"{twa}/api/namespaces/user1/tensorboards",
+                      headers=USER_HEADER,
+                      json={"name": "tb1", "logspath": "pvc://vol1/logs"})
+        assert r.status_code == 200, r.text
+        r = http.get(f"{twa}/api/namespaces/user1/tensorboards",
+                     headers=USER_HEADER)
+        assert r.status_code == 200
+        r = http.get(f"{twa}/api/namespaces/user1/pvcs", headers=USER_HEADER)
+        assert r.status_code == 200
+    finally:
+        for inf in caches.values():
+            inf.stop()
