@@ -141,6 +141,35 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # Chaos/resilience lane (ISSUE 4): the retry/circuit/dead-letter unit
+    # matrix plus the tier-1 seeded smoke storm on every control-plane
+    # change.  The 60 s http-transport soak is slow-marked and runs in the
+    # postsubmit lane below.
+    name="resilience",
+    include_dirs=[
+        "kubeflow_tpu/platform/k8s/*", "kubeflow_tpu/platform/runtime/*",
+        "kubeflow_tpu/platform/testing/*",
+        "kubeflow_tpu/platform/controllers/*", "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest("tests/ctrlplane/test_resilience.py")),
+        Step("chaos-smoke", _pytest("tests/ctrlplane/test_chaos.py")
+             + ["-m", "not slow"], depends="unit"),
+    ],
+))
+
+_register(ComponentWorkflow(
+    name="resilience-soak",
+    include_dirs=[
+        "kubeflow_tpu/platform/k8s/*", "kubeflow_tpu/platform/runtime/*",
+        "kubeflow_tpu/platform/testing/*", "releasing/*",
+    ],
+    job_types=["postsubmit"],
+    steps=[Step("soak", _pytest("tests/ctrlplane/test_chaos.py")
+                + ["-m", "slow"])],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

@@ -17,6 +17,9 @@ from __future__ import annotations
 
 import datetime
 import json
+import logging
+import threading
+import time
 from typing import Callable, List, Optional
 
 from kubeflow_tpu.platform import config
@@ -42,11 +45,17 @@ IDLE_STATE = "idle"
 Prober = Callable[[str], Optional[List[dict]]]  # url -> kernels or None on error
 
 
-def default_prober(url: str) -> Optional[List[dict]]:
+def default_prober(url: str, *, timeout: Optional[float] = None
+                   ) -> Optional[List[dict]]:
+    """HTTP probe of the Jupyter kernels API.  ``timeout`` is the whole
+    per-probe budget (env ``CULL_PROBE_TIMEOUT_SECONDS``) — a wedged user
+    pod must cost a bounded slice of the worker's cycle, never a hang."""
     import requests
 
+    if timeout is None:
+        timeout = config.env_float("CULL_PROBE_TIMEOUT_SECONDS", 10.0)
     try:
-        resp = requests.get(url, timeout=10)
+        resp = requests.get(url, timeout=timeout)
         if resp.status_code != 200:
             return None
         data = resp.json()
@@ -66,6 +75,8 @@ class CullingReconciler(Reconciler):
         cluster_domain: Optional[str] = None,
         now: Optional[Callable[[], datetime.datetime]] = None,
         cache=None,
+        probe_timeout: Optional[float] = None,
+        probe_budget_s: Optional[float] = None,
     ):
         self.client = client
         # Optional Notebook Informer (make_controller wires the same one
@@ -73,7 +84,28 @@ class CullingReconciler(Reconciler):
         # notebook from the shared cache as a zero-copy frozen view
         # instead of one apiserver GET per probe period per notebook.
         self.cache = cache
-        self.prober = prober or default_prober
+        self.probe_timeout = (
+            probe_timeout if probe_timeout is not None
+            else config.env_float("CULL_PROBE_TIMEOUT_SECONDS", 10.0)
+        )
+        # Per-cycle probe budget: cumulative wall seconds the reconcilers
+        # may spend probing per check period (all workers combined).  Once
+        # exhausted, remaining notebooks this cycle count as BUSY and are
+        # re-checked next period — a fleet of wedged pods degrades culling
+        # to "slower", never to "the probe loop ate the controller".
+        # 0 = unlimited (the default; operators opt in).
+        self.probe_budget_s = (
+            probe_budget_s if probe_budget_s is not None
+            else config.env_float("CULL_PROBE_BUDGET_SECONDS", 0.0)
+        )
+        self._budget_lock = threading.Lock()
+        self._budget_window_start: Optional[float] = None
+        self._budget_used = 0.0
+        if prober is not None:
+            self.prober = prober
+        else:
+            self.prober = lambda url: default_prober(
+                url, timeout=self.probe_timeout)
         self.idle_minutes = (
             idle_minutes
             if idle_minutes is not None
@@ -150,9 +182,12 @@ class CullingReconciler(Reconciler):
 
         self._last_probe[key] = now
 
-        kernels = self.prober(self.kernels_url(req.namespace, req.name))
+        kernels = self._safe_probe(req.namespace, req.name)
         if kernels is None:
-            # Unreachable (starting, crashing, mid-scale) — don't cull blind.
+            # Unreachable / errored / over budget (starting, crashing,
+            # mid-scale, broken prober) — FAIL SAFE: a notebook whose
+            # idleness probe can't answer counts as BUSY and is never
+            # culled blind.  Next period retries.
             return requeue
         if not self._all_idle(kernels):
             self._record_activity(notebook, now)
@@ -176,6 +211,49 @@ class CullingReconciler(Reconciler):
         metrics.notebook_culling_total.inc()
         metrics.last_culling_timestamp.set(now.timestamp())
         return None
+
+    def _safe_probe(self, namespace: str, name: str) -> Optional[List[dict]]:
+        """Run the prober under the fail-safe contract: ANY exception (a
+        raising prober must not crash-loop the reconcile into backoff —
+        with a broken probe endpoint that loop would probe at retry rate
+        forever) and an exhausted per-cycle budget both answer None, which
+        reconcile treats as busy.  Probe wall time is charged against the
+        budget window."""
+        reserved = 0.0
+        if self.probe_budget_s > 0:
+            now_mono = time.monotonic()
+            period_s = max(self.check_period * 60.0, 1e-9)
+            with self._budget_lock:
+                if (self._budget_window_start is None
+                        or now_mono - self._budget_window_start >= period_s):
+                    self._budget_window_start = now_mono
+                    self._budget_used = 0.0
+                if self._budget_used >= self.probe_budget_s:
+                    metrics.culling_probe_failures_total.inc()
+                    return None
+                # RESERVE the worst case (the probe timeout) before
+                # probing: with N concurrent workers, check-then-spend
+                # accounting would let all N pass the gate while each
+                # other's probes are still in flight — overshooting an
+                # operator's budget by workers x timeout per window.  The
+                # reservation is trued up to actual cost below.
+                reserved = self.probe_timeout
+                self._budget_used += reserved
+        t0 = time.monotonic()
+        try:
+            kernels = self.prober(self.kernels_url(namespace, name))
+        except Exception:
+            logging.getLogger("kubeflow_tpu.culling").warning(
+                "idleness probe for %s/%s raised; counting as busy",
+                namespace, name, exc_info=True)
+            kernels = None
+        finally:
+            if self.probe_budget_s > 0:
+                with self._budget_lock:
+                    self._budget_used += (time.monotonic() - t0) - reserved
+        if kernels is None:
+            metrics.culling_probe_failures_total.inc()
+        return kernels
 
     def _get_notebook(self, name: str, namespace: str) -> Optional[Resource]:
         """Frozen cache read when the shared informer is wired and synced

@@ -11,7 +11,9 @@ the envtest-style suites.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Protocol, Tuple
@@ -30,6 +32,23 @@ from kubeflow_tpu.platform.k8s.types import (
 WatchEvent = Tuple[str, Resource]  # ("ADDED"|"MODIFIED"|"DELETED"|"BOOKMARK", obj)
 
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+log = logging.getLogger("kubeflow_tpu.k8s.client")
+
+# Verbs safe to retry blind: re-issuing them cannot duplicate a side effect
+# (GET/LIST/logs read; DELETE is idempotent — a retried delete of an
+# already-gone object answers 404, which callers already treat as done;
+# watch establishment holds no state until events flow).  create/update/
+# patch are NOT here: a timeout is indistinguishable from "the server
+# applied it and the response was lost", and a blind re-create would
+# AlreadyExists / double-apply.  A 429 is the exception for every verb —
+# the server explicitly rejected the request BEFORE processing it, so
+# replaying it is always safe (client-go retries 429s the same way).
+IDEMPOTENT_VERBS = frozenset(
+    {"get", "list", "logs", "delete", "watch"})
+
+# Transient HTTP statuses worth a retry on idempotent verbs.
+RETRYABLE_STATUSES = frozenset({500, 502, 503, 504})
 
 
 class KubeClient(Protocol):
@@ -130,6 +149,82 @@ class TokenBucket:
             time.sleep(wait)
 
 
+class CircuitBreaker:
+    """Client-health circuit: after ``threshold`` CONSECUTIVE transient
+    failures the circuit opens and requests fail fast (TransportError)
+    for ``cooldown`` seconds, then ONE half-open probe is let through —
+    success closes the circuit, failure re-opens it.  A down apiserver
+    then costs one probe per cooldown instead of every caller hanging a
+    full timeout, and the state is an operator signal
+    (rest_client_circuit_state in /metrics, /healthz).  threshold <= 0
+    disables the breaker entirely.  Thread-safe."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, threshold: int, cooldown: float):
+        self.threshold = int(threshold)
+        self.cooldown = float(cooldown)
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+
+    def _set_state(self, state: str) -> None:
+        from kubeflow_tpu.platform.runtime import metrics
+
+        self._state = state
+        metrics.rest_client_circuit_state.set(
+            {self.CLOSED: 0, self.HALF_OPEN: 1, self.OPEN: 2}[state])
+        if state == self.OPEN:
+            metrics.rest_client_circuit_opens_total.inc()
+
+    def allow(self) -> bool:
+        """May a request proceed right now?  In the open state only the
+        single half-open probe per cooldown window gets True."""
+        if self.threshold <= 0:
+            return True
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            if (time.monotonic() - self._opened_at >= self.cooldown
+                    and not self._probing):
+                self._probing = True
+                self._set_state(self.HALF_OPEN)
+                return True
+            return False
+
+    def on_success(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            if self._state != self.CLOSED:
+                self._set_state(self.CLOSED)
+
+    def on_failure(self) -> None:
+        if self.threshold <= 0:
+            return
+        with self._lock:
+            self._failures += 1
+            self._probing = False
+            if self._failures >= self.threshold and self._state != self.OPEN:
+                self._set_state(self.OPEN)
+            if self._state == self.OPEN:
+                self._opened_at = time.monotonic()
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._failures
+
+
 class RestKubeClient:
     """KubeClient over the real API server.
 
@@ -139,6 +234,22 @@ class RestKubeClient:
     ``qps``/``burst`` bound request rate (env ``K8S_CLIENT_QPS`` /
     ``K8S_CLIENT_BURST``; watch long-polls are exempt — they hold a
     connection, they don't spam requests).
+
+    Resilience (client-go parity; every knob env-tunable):
+
+    * every verb carries a FINITE (connect, read) timeout — no request
+      can hang the caller forever (``K8S_CLIENT_TIMEOUT_CONNECT`` /
+      ``K8S_CLIENT_TIMEOUT``; watch streams use the bounded watch window
+      + slack as their read timeout instead);
+    * transient failures (transport errors, 5xx) are retried with FULL
+      JITTER backoff for idempotent verbs only (IDEMPOTENT_VERBS — never
+      blind create/update/patch); 429 is retried for every verb and a
+      server-sent Retry-After is honored verbatim
+      (``K8S_CLIENT_RETRIES`` / ``_RETRY_BASE`` / ``_RETRY_CAP``);
+    * a consecutive-failure circuit breaker fails fast while the
+      apiserver is down and probes half-open per cooldown
+      (``K8S_CLIENT_CB_THRESHOLD`` / ``K8S_CLIENT_CB_COOLDOWN``);
+      ``health()`` is the /healthz surface.
     """
 
     def __init__(
@@ -149,16 +260,40 @@ class RestKubeClient:
         ca_cert: Optional[str] = None,
         client_cert: Optional[Tuple[str, str]] = None,
         verify: Optional[bool] = None,
-        timeout: float = 30.0,
+        timeout: Optional[float] = None,
+        connect_timeout: Optional[float] = None,
         qps: Optional[float] = None,
         burst: Optional[int] = None,
+        retries: Optional[int] = None,
+        retry_base: Optional[float] = None,
+        retry_cap: Optional[float] = None,
+        breaker_threshold: Optional[int] = None,
+        breaker_cooldown: Optional[float] = None,
     ):
         import requests
 
         if base_url is None:
             base_url, token, ca_cert, client_cert = self._resolve_config()
         self.base_url = base_url.rstrip("/")
-        self.timeout = timeout
+        self.timeout = (timeout if timeout is not None
+                        else float(os.environ.get("K8S_CLIENT_TIMEOUT", "30")))
+        self.connect_timeout = (
+            connect_timeout if connect_timeout is not None
+            else float(os.environ.get("K8S_CLIENT_TIMEOUT_CONNECT", "5")))
+        self.retries = (retries if retries is not None
+                        else int(os.environ.get("K8S_CLIENT_RETRIES", "3")))
+        self.retry_base = (
+            retry_base if retry_base is not None
+            else float(os.environ.get("K8S_CLIENT_RETRY_BASE", "0.1")))
+        self.retry_cap = (
+            retry_cap if retry_cap is not None
+            else float(os.environ.get("K8S_CLIENT_RETRY_CAP", "5.0")))
+        self.breaker = CircuitBreaker(
+            breaker_threshold if breaker_threshold is not None
+            else int(os.environ.get("K8S_CLIENT_CB_THRESHOLD", "5")),
+            breaker_cooldown if breaker_cooldown is not None
+            else float(os.environ.get("K8S_CLIENT_CB_COOLDOWN", "10.0")),
+        )
         if qps is None:
             qps = float(os.environ.get("K8S_CLIENT_QPS", "50"))
         if burst is None:
@@ -173,6 +308,14 @@ class RestKubeClient:
             self._session.verify = verify
         elif ca_cert:
             self._session.verify = ca_cert
+
+    def health(self) -> dict:
+        """Client-health snapshot for /healthz: circuit state +
+        consecutive transient failures."""
+        return {
+            "circuit": self.breaker.state,
+            "consecutive_failures": self.breaker.consecutive_failures,
+        }
 
     @staticmethod
     def _resolve_config() -> Tuple[str, Optional[str], Optional[str], Optional[Tuple[str, str]]]:
@@ -206,20 +349,61 @@ class RestKubeClient:
 
     # -- plumbing ------------------------------------------------------------
 
+    @staticmethod
+    def _retry_after_of(resp) -> Optional[float]:
+        raw = resp.headers.get("Retry-After")
+        if raw is None:
+            return None
+        try:
+            return max(0.0, float(raw))
+        except (TypeError, ValueError):
+            return None  # HTTP-date flavor: treat as unspecified
+
+    def _should_retry(self, exc: errors.ApiError, verb: str, attempt: int) -> bool:
+        """Retry policy: bounded attempts; 429 for every verb (the server
+        rejected the request before processing — replay is always safe);
+        transport errors and retryable 5xx for idempotent verbs only.
+        Circuit-open failures are never retried — the breaker's whole point
+        is failing FAST, and its cooldown dwarfs any jitter delay anyway
+        (the half-open probe covers recovery)."""
+        if getattr(exc, "circuit_open", False):
+            return False
+        if attempt >= self.retries:
+            return False
+        if isinstance(exc, errors.TooManyRequests):
+            return True
+        if verb not in IDEMPOTENT_VERBS:
+            return False
+        return (isinstance(exc, errors.TransportError)
+                or exc.status in RETRYABLE_STATUSES)
+
+    def _retry_delay(self, exc: errors.ApiError, attempt: int) -> float:
+        """Honored Retry-After when the server sent one (capped at 30 s so
+        a hostile/buggy header can't park a controller); FULL jitter
+        otherwise — uniform in [0, base*2^attempt], capped.  Full jitter
+        (vs plain exponential) de-synchronizes a fleet of clients that all
+        failed on the same apiserver hiccup."""
+        if exc.retry_after is not None:
+            return min(exc.retry_after, 30.0)
+        return random.uniform(
+            0.0, min(self.retry_cap, self.retry_base * (2 ** attempt)))
+
     def _request(self, method: str, path: str, *, params: Optional[dict] = None,
                  body: Optional[Any] = None, stream: bool = False,
-                 verb: Optional[str] = None, kind: str = ""):
+                 verb: Optional[str] = None, kind: str = "",
+                 limiter_exempt: bool = False):
         """``verb``/``kind`` label the client metrics (semantic verb —
         list vs get both ride HTTP GET — and the resource kind), the same
         surface the reference gets from client-go's rest_client_* series;
-        the call is also a span on the current reconcile trace."""
-        from kubeflow_tpu.platform.runtime import metrics, trace
+        the call is also a span on the current reconcile trace.  Wraps
+        ``_request_once`` in the bounded retry policy (_should_retry)."""
+        from kubeflow_tpu.platform.runtime import metrics
 
         verb = verb or method.lower()
-        if self._limiter is not None:
-            self._limiter.acquire()
         headers = {}
         if method == "PATCH":
+            # Computed ONCE, outside the retry loop: pop() is destructive
+            # and a second attempt must not silently fall back to "merge".
             ptype = (params or {}).pop("_patch_type", "merge")
             headers["Content-Type"] = {
                 "merge": "application/merge-patch+json",
@@ -235,19 +419,71 @@ class RestKubeClient:
             # serialize.
             data = json.dumps(body, default=json_default)
             headers.setdefault("Content-Type", "application/json")
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(
+                    method, path, params=params, data=data, headers=headers,
+                    stream=stream, verb=verb, kind=kind,
+                    limiter_exempt=limiter_exempt)
+            except errors.ApiError as e:
+                if not errors.is_transient(e):
+                    raise
+                if not self._should_retry(e, verb, attempt):
+                    raise
+                delay = self._retry_delay(e, attempt)
+                attempt += 1
+                metrics.rest_client_retries_total.labels(verb=verb).inc()
+                log.debug("retrying %s %s (attempt %d) in %.3fs after: %s",
+                          verb, path, attempt, delay, e)
+                if delay > 0:
+                    time.sleep(delay)
+
+    def _request_once(self, method: str, path: str, *, params, data, headers,
+                      stream: bool, verb: str, kind: str,
+                      limiter_exempt: bool = False):
+        """One attempt: circuit gate, rate limit, wire call, metrics.
+        Transport failures surface as errors.TransportError so callers and
+        the retry policy see one taxonomy for 'apiserver unreachable'."""
+        import requests
+
+        from kubeflow_tpu.platform.runtime import metrics, trace
+
+        if not self.breaker.allow():
+            metrics.rest_client_requests_total.labels(
+                verb=verb, kind=kind, code="<circuit-open>").inc()
+            err = errors.TransportError(
+                f"circuit breaker open ({self.breaker.consecutive_failures}"
+                " consecutive failures); refusing to call the apiserver")
+            err.circuit_open = True  # _should_retry: fail fast, no jitter
+            raise err
+        if self._limiter is not None and not limiter_exempt:
+            self._limiter.acquire()
         code = "<error>"
         t0 = time.perf_counter()
         try:
             with trace.span(f"k8s.{verb}", kind=kind) as sp:
-                resp = self._session.request(
-                    method,
-                    self.base_url + path,
-                    params=params,
-                    data=data,
-                    headers=headers or None,
-                    stream=stream,
-                    timeout=None if stream else self.timeout,
-                )
+                try:
+                    resp = self._session.request(
+                        method,
+                        self.base_url + path,
+                        params=params,
+                        data=data,
+                        headers=headers or None,
+                        stream=stream,
+                        # Finite on EVERY verb: a stream (watch/log follow)
+                        # reads within the bounded watch window + slack;
+                        # everything else uses the configured read timeout.
+                        timeout=(
+                            self.connect_timeout,
+                            (self.WATCH_TIMEOUT_SECONDS + 30) if stream
+                            else self.timeout,
+                        ),
+                    )
+                except requests.RequestException as e:
+                    self.breaker.on_failure()
+                    raise errors.TransportError(
+                        f"{method} {path}: {e}") from e
                 code = str(resp.status_code)
                 if sp is not None:
                     sp.attrs["code"] = code
@@ -257,8 +493,18 @@ class RestKubeClient:
                         message = status.get("message", resp.text)
                     except Exception:
                         status, message = None, resp.text
-                    raise errors.error_for_status(
-                        resp.status_code, message, status)
+                    err = errors.error_for_status(
+                        resp.status_code, message, status,
+                        retry_after=self._retry_after_of(resp))
+                    # Only server-side breakage trips the breaker: 4xx are
+                    # the caller's problem and say nothing about client
+                    # health (429 included — a throttling server is UP).
+                    if err.status in RETRYABLE_STATUSES:
+                        self.breaker.on_failure()
+                    else:
+                        self.breaker.on_success()
+                    raise err
+                self.breaker.on_success()
                 return resp
         finally:
             metrics.rest_client_request_duration_seconds.labels(
@@ -357,34 +603,40 @@ class RestKubeClient:
         sel = _selector_string(label_selector)
         if sel:
             params["labelSelector"] = sel
-        from kubeflow_tpu.platform.runtime import metrics
+        import requests
 
+        # Establishment is idempotent (no event has streamed yet), so it
+        # rides the same _request plumbing as GET/LIST — circuit gate,
+        # bounded jittered retries, honored Retry-After, metrics (the
+        # stream=True read timeout is the bounded window + slack, and
+        # establishment stays QPS-exempt: a watch holds a connection, it
+        # doesn't spam requests).  Once events flow, a mid-stream failure
+        # propagates — only the CALLER knows the last RV to resume from
+        # (Controller._watch_loop / Informer._run).
+        resp = self._request(
+            "GET", gvk.path(namespace), params=params, stream=True,
+            verb="watch", kind=gvk.kind, limiter_exempt=True)
         try:
-            resp = self._session.request(
-                "GET",
-                self.base_url + gvk.path(namespace),
-                params=params,
-                stream=True,
-                timeout=(10, self.WATCH_TIMEOUT_SECONDS + 30),
-            )
-        except Exception:
-            metrics.rest_client_requests_total.labels(
-                verb="watch", kind=gvk.kind, code="<error>").inc()
-            raise
-        # Establishment only — a watch holds a connection for minutes, so
-        # its duration histogram would only measure the bounded window.
-        metrics.rest_client_requests_total.labels(
-            verb="watch", kind=gvk.kind, code=str(resp.status_code)).inc()
-        if resp.status_code >= 400:
-            raise errors.error_for_status(resp.status_code, resp.text)
-        try:
-            for line in resp.iter_lines():
+            # chunk_size=1: iter_lines' default (512) BUFFERS the stream —
+            # a single small watch event (~200 B of JSON) sits unread in
+            # the client until enough later events pad the chunk out, so a
+            # quiet kind's deltas arrive minutes late (only flushed by the
+            # next event burst or the window closing).  Byte-sized reads
+            # cost more syscalls, but a watch is a low-rate long-poll and
+            # DELIVERY LATENCY is its entire job.
+            for line in resp.iter_lines(chunk_size=1):
                 if stop is not None and stop.is_set():
                     return
                 if not line:
                     continue
                 evt = json.loads(line)
                 yield evt.get("type", ""), evt.get("object", {})
+        except requests.RequestException as e:
+            # Mid-stream transport death (read timeout, reset): typed, so
+            # watch loops keep their RV (k8s.errors taxonomy) instead of
+            # pattern-matching requests internals.
+            raise errors.TransportError(
+                f"watch {gvk.kind} stream: {e}") from e
         finally:
             resp.close()
 

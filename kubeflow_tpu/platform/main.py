@@ -34,8 +34,16 @@ class _QuietHandler(WSGIRequestHandler):
 
 
 def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
-                  debug_traces: bool = None):
+                  debug_traces: bool = None, client=None):
     """/healthz + /metrics + /debug/traces for the controller deployment.
+
+    ``client``: when it exposes ``health()`` (RestKubeClient), /healthz
+    carries the client-side resilience state — circuit breaker position
+    and consecutive transient failures — so an operator (or a probe
+    script) can tell "the manager is fine, the apiserver path is not"
+    apart from "the manager is broken".  An OPEN circuit does NOT flip
+    /healthz to 503: restarting the pod would not fix an unreachable
+    apiserver, it would just lose the informer caches.
 
     /metrics carries the whole control-plane surface (workqueue_*,
     controller_runtime_reconcile_time_seconds, rest_client_*, informer_*);
@@ -52,9 +60,12 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
         path = environ.get("PATH_INFO", "")
         if path == "/healthz":
             ok = manager.healthy()
+            body = {"healthy": ok}
+            if client is not None and hasattr(client, "health"):
+                body["rest_client"] = client.health()
             start_response("200 OK" if ok else "503 Service Unavailable",
                            [("Content-Type", "application/json")])
-            return [json.dumps({"healthy": ok}).encode()]
+            return [json.dumps(body).encode()]
         if path == "/metrics":
             from kubeflow_tpu.platform.runtime import metrics
 
@@ -111,7 +122,7 @@ def run_controllers(args) -> int:
         mgr.add(culling.make_controller(
             client, notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
     mgr.start()
-    _serve_health(mgr, args.health_port)
+    _serve_health(mgr, args.health_port, client=client)
     logging.info("controllers running (health on :%d)", args.health_port)
     _wait_for_term()
     mgr.stop()

@@ -15,15 +15,28 @@ from __future__ import annotations
 
 import dataclasses
 import heapq
+import json
 import logging
 import threading
 import time
 import traceback
 from typing import Callable, Dict, List, Optional, Tuple
 
+from kubeflow_tpu.platform import config
 from kubeflow_tpu.platform.k8s.types import GVK, Resource, controller_of, meta, name_of, namespace_of
 
 log = logging.getLogger("kubeflow_tpu.runtime")
+
+# Dead-letter: after this many CONSECUTIVE non-conflict reconcile failures
+# of one key, stop the backoff-requeue loop, write a terminal
+# ReconcileFailed condition + Warning event on the primary, and park the
+# key until a new watch event / resync revives it.  0 disables (retry
+# forever, the pre-dead-letter behavior).
+DEFAULT_MAX_RETRIES = config.env_int("CONTROLLER_MAX_RETRIES", 15)
+# Stuck-reconcile watchdog: a reconcile still in flight after this many
+# seconds increments reconcile_stuck_total and dumps its (in-progress)
+# trace as one JSON log line.  0 disables the watchdog thread.
+DEFAULT_STUCK_SECONDS = config.env_float("CONTROLLER_STUCK_SECONDS", 300.0)
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -219,6 +232,8 @@ class Controller:
         shared_informers: Optional[dict] = None,
         on_start: Optional[Callable[[], None]] = None,
         on_stop: Optional[Callable[[], None]] = None,
+        max_retries: Optional[int] = None,
+        stuck_deadline: Optional[float] = None,
     ):
         self.name = name
         self.reconciler = reconciler
@@ -259,6 +274,25 @@ class Controller:
         self._stop = threading.Event()
         self.reconcile_count = 0
         self.error_count = 0
+        # -- resilience state --------------------------------------------
+        # Dead-letter: consecutive NON-CONFLICT failures per key (409s are
+        # the optimistic-concurrency happy path and never count), and the
+        # parked keys with their last error.  A parked key is NOT blocked
+        # from reconciling — watch events and resyncs still enqueue it
+        # (level-triggered); parking only stops the backoff retry loop, so
+        # a permanently-broken object costs one attempt per external
+        # trigger instead of a hot loop forever.
+        self.max_retries = (max_retries if max_retries is not None
+                            else DEFAULT_MAX_RETRIES)
+        self.dead_letters: Dict[Request, str] = {}
+        self._key_failures: Dict[Request, int] = {}
+        # Stuck-reconcile watchdog: req -> [monotonic start, trace, dumped]
+        # maintained by _reconcile_one, scanned by _watchdog_loop.
+        self.stuck_deadline = (stuck_deadline if stuck_deadline is not None
+                               else DEFAULT_STUCK_SECONDS)
+        self._inflight: Dict[Request, list] = {}
+        self._inflight_lock = threading.Lock()
+        self._client = None  # set by start(); dead-letter writes need it
 
     # -- event plumbing ------------------------------------------------------
 
@@ -382,45 +416,184 @@ class Controller:
                         queue="workqueue")
         outcome = "success"
         t0 = time.perf_counter()
+        with self._inflight_lock:
+            self._inflight[req] = [time.monotonic(), tr, False]
         try:
             with trace.span("reconcile"):
                 result = self.reconciler.reconcile(req)
             self.queue.forget(req)
             self.reconcile_count += 1
+            self._on_reconcile_success(req)
             if result and result.requeue_after:
                 outcome = "requeue_after"
                 self.queue.add(req, delay=result.requeue_after)
         except Exception as e:
             outcome = "error"
             self.error_count += 1
-            from kubeflow_tpu.platform.k8s.errors import Conflict
+            from kubeflow_tpu.platform.k8s.errors import AlreadyExists, Conflict
 
             metrics.reconcile_errors_total.labels(controller=self.name).inc()
-            if isinstance(e, Conflict):
+            # Exact-match on optimistic-concurrency Conflict: AlreadyExists
+            # subclasses it for HTTP reasons (both 409) but is a CREATE
+            # COLLISION — e.g. an unmanaged same-name object squatting on a
+            # child's name — which requeueing cannot heal, so it must keep
+            # counting toward the dead-letter threshold.
+            if isinstance(e, Conflict) and not isinstance(e, AlreadyExists):
                 # Optimistic-concurrency 409: the requeue IS the
                 # resolution (same as controller-runtime).  One line,
                 # no stack — a traceback on the expected path would
-                # train readers to ignore real ones (VERDICT r1).
+                # train readers to ignore real ones (VERDICT r1).  Never
+                # counts toward the dead-letter threshold: conflicts are
+                # self-healing, not a sign the object is unprocessable.
                 log.info(
                     "%s: reconcile %s/%s conflicted (will retry): %s",
                     self.name, req.namespace, req.name, e,
                 )
+                self.queue.add_rate_limited(req)
             else:
                 log.error(
                     "%s: reconcile %s/%s failed:\n%s",
                     self.name, req.namespace, req.name,
                     traceback.format_exc(),
                 )
-            self.queue.add_rate_limited(req)
+                failures = self._key_failures.get(req, 0) + 1
+                self._key_failures[req] = failures
+                if self.max_retries and failures > self.max_retries:
+                    outcome = "dead_letter"
+                    self._dead_letter(req, e, failures)
+                else:
+                    self.queue.add_rate_limited(req)
         finally:
+            with self._inflight_lock:
+                self._inflight.pop(req, None)
             metrics.controller_runtime_reconcile_time_seconds.labels(
                 controller=self.name, result=outcome
             ).observe(time.perf_counter() - t0)
             trace.finish(result=outcome)
 
+    # -- dead-letter path ----------------------------------------------------
+
+    def _on_reconcile_success(self, req: Request) -> None:
+        self._key_failures.pop(req, None)
+        if self.dead_letters.pop(req, None) is not None:
+            # The key recovered after being parked: clear the terminal
+            # condition so the object stops reading as failed.
+            log.info("%s: %s/%s recovered from dead-letter",
+                     self.name, req.namespace, req.name)
+            self._write_terminal_condition(req, clear=True)
+
+    def _dead_letter(self, req: Request, exc: Exception, failures: int) -> None:
+        """Park a key that exhausted its retries: no more backoff requeues
+        (a later watch event / resync still revives it — level-triggered),
+        a terminal ``ReconcileFailed`` condition + Warning event on the
+        primary so the failure is visible where users look, and a metric
+        for operators.  Re-parks of an already-parked key (a resync
+        retried it and it failed again) skip the writes — one condition
+        write per outage, not one per resync period."""
+        from kubeflow_tpu.platform.runtime import metrics
+
+        already_parked = req in self.dead_letters
+        self.dead_letters[req] = str(exc)
+        # Reset the queue's rate-limit history: the next revival (watch
+        # event / resync) should reconcile promptly, not inherit a
+        # maxed-out backoff from the failures that parked it.
+        self.queue.forget(req)
+        if already_parked:
+            return
+        metrics.reconcile_dead_letter_total.labels(controller=self.name).inc()
+        log.error(
+            "%s: %s/%s dead-lettered after %d consecutive failures "
+            "(parked until a new event; last error: %s)",
+            self.name, req.namespace, req.name, failures, exc,
+        )
+        self._write_terminal_condition(req, message=str(exc))
+
+    def _write_terminal_condition(self, req: Request, *,
+                                  message: str = "", clear: bool = False) -> None:
+        """Best-effort: set (or clear) status.conditions[ReconcileFailed]
+        on the primary and emit the matching event.  Every failure here is
+        swallowed — the client may be exactly what's broken, and the
+        dead-letter bookkeeping above must stand regardless."""
+        client = self._client
+        if client is None:
+            return
+        try:
+            obj = client.get(self.primary, req.name, req.namespace or None)
+        except Exception:
+            return
+        conditions = [c for c in (obj.get("status") or {}).get("conditions", [])
+                      if c.get("type") != "ReconcileFailed"]
+        if not clear:
+            conditions.append({
+                "type": "ReconcileFailed", "status": "True",
+                "reason": "MaxRetriesExceeded",
+                "message": message,
+                "lastTransitionTime": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            })
+        try:
+            obj.setdefault("status", {})["conditions"] = conditions
+            client.update_status(obj)
+        except Exception:
+            log.debug("%s: could not write ReconcileFailed condition for "
+                      "%s/%s", self.name, req.namespace, req.name,
+                      exc_info=True)
+        if not clear:
+            try:
+                from kubeflow_tpu.platform.runtime.events import EventRecorder
+
+                EventRecorder(client, self.name).event(
+                    obj, "Warning", "ReconcileFailed",
+                    f"reconcile gave up after max retries: {message}")
+            except Exception:
+                pass
+
+    # -- stuck-reconcile watchdog --------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Scan in-flight reconciles for deadline overruns: a worker stuck
+        in blocking I/O can't report itself, so an outside thread raises
+        the flag — metric + one-line JSON dump of the trace collected so
+        far (the PR-1 span tree: the dump says WHERE it is stuck, e.g. a
+        k8s.get span still open against a dead apiserver)."""
+        from kubeflow_tpu.platform.runtime import metrics
+
+        period = max(0.01, min(self.stuck_deadline / 4.0, 5.0))
+        while not self._stop.wait(period):
+            now = time.monotonic()
+            with self._inflight_lock:
+                overdue = [
+                    (req, entry) for req, entry in self._inflight.items()
+                    if now - entry[0] >= self.stuck_deadline and not entry[2]
+                ]
+                for _req, entry in overdue:
+                    entry[2] = True  # one dump per stuck reconcile
+            for req, entry in overdue:
+                metrics.reconcile_stuck_total.labels(
+                    controller=self.name).inc()
+                tr = entry[1]
+                # The trace belongs to a LIVE reconcile on another thread:
+                # spans/attrs mutate under us, so serialization can race
+                # (dict-changed-during-iteration).  Best-effort — a failed
+                # dump must never kill the watchdog thread.
+                dump = ""
+                if tr is not None:
+                    try:
+                        dump = "; trace so far: " + json.dumps(
+                            tr.to_dict(), sort_keys=True)
+                    except Exception:
+                        dump = "; trace unavailable (reconcile actively " \
+                               "tracing)"
+                log.error(
+                    "%s: reconcile %s/%s stuck for %.1fs (deadline %.1fs)%s",
+                    self.name, req.namespace, req.name,
+                    now - entry[0], self.stuck_deadline, dump,
+                )
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, client) -> None:
+        self._client = client
         if self._on_start is not None:
             self._on_start()
         pairs: List[Tuple[GVK, EventMapper]] = [(self.primary, self._primary_mapper)]
@@ -477,6 +650,13 @@ class Controller:
         for i in range(self.workers):
             t = threading.Thread(
                 target=self._worker, name=f"{self.name}-worker-{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+        if self.stuck_deadline and self.stuck_deadline > 0:
+            t = threading.Thread(
+                target=self._watchdog_loop,
+                name=f"{self.name}-watchdog", daemon=True,
             )
             t.start()
             self._threads.append(t)

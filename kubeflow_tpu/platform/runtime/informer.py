@@ -39,21 +39,47 @@ IndexFunc = Callable[[Resource], List[str]]
 
 def cache_or_client_list(cache, client, gvk: GVK,
                          namespace: Optional[str] = None, *,
-                         label_selector: Optional[Dict[str, str]] = None
+                         label_selector: Optional[Dict[str, str]] = None,
+                         on_degraded: Optional[Callable] = None
                          ) -> List[Resource]:
     """THE cache-read fallback contract, in one place: read from the
     informer when it is wired and synced (zero-copy frozen views), live
     LIST otherwise — an unsynced cache must never serve "nothing" as
     authoritative.  Shared by the web backends, reconcilers and quota
-    paths so the semantics can't drift between call sites."""
+    paths so the semantics can't drift between call sites.
+
+    Graceful degradation: when the LIVE path fails transiently (transport
+    error, 429, 5xx — errors.is_transient) and a cache exists at all,
+    serve whatever the cache holds instead of erroring, and tell the
+    caller through ``on_degraded(exc)`` so surfaces can mark the response
+    (``degraded: true``) — a flapping apiserver degrades reads to
+    possibly-stale instead of taking the whole page down.  Hard errors
+    (403, 404 ...) always propagate."""
+    from kubeflow_tpu.platform.k8s import errors
+
     if cache is not None and cache.has_synced:
         return cache.list(namespace, label_selector=label_selector)
-    return client.list(gvk, namespace, label_selector=label_selector)
+    try:
+        return client.list(gvk, namespace, label_selector=label_selector)
+    except errors.ApiError as e:
+        if cache is None or not errors.is_transient(e):
+            raise
+        if not cache.has_synced and len(cache) == 0:
+            # A never-synced EMPTY cache has nothing to degrade to — a 200
+            # with zero items would assert "you have no notebooks", which
+            # is this function's own never-serve-nothing-as-authoritative
+            # rule.  (A warm but unsynced store — handed-off or seeded —
+            # is still worth serving.)  Propagate the 503 instead.
+            raise
+        if on_degraded is not None:
+            on_degraded(e)
+        return cache.list(namespace, label_selector=label_selector)
 
 
 def cache_or_client_get(cache, client, gvk: GVK, name: str,
                         namespace: Optional[str] = None, *,
-                        read_through: bool = False
+                        read_through: bool = False,
+                        on_degraded: Optional[Callable] = None
                         ) -> Optional[Resource]:
     """Single-object flavor of the same contract.  Returns None for
     not-found on either path (callers choose whether that is an error).
@@ -63,17 +89,35 @@ def cache_or_client_get(cache, client, gvk: GVK, name: str,
     window must not 404 (read-your-writes for interactive surfaces).
     Reconcilers leave it off — for them a lagging cache is the normal
     level-triggered case and the extra GET per genuinely-deleted object
-    (every not-found reconcile) would defeat the cached read."""
+    (every not-found reconcile) would defeat the cached read.
+
+    Same degraded fallback as cache_or_client_list: a transient live-GET
+    failure with a cache wired answers the cache's view (which may be a
+    miss → None) and signals ``on_degraded`` instead of erroring."""
+    from kubeflow_tpu.platform.k8s import errors
+
     if cache is not None and cache.has_synced:
         obj = cache.get(name, namespace)
         if obj is not None or not read_through:
             return obj
-    from kubeflow_tpu.platform.k8s import errors
-
     try:
         return client.get(gvk, name, namespace)
     except errors.NotFound:
         return None
+    except errors.ApiError as e:
+        if cache is None or not errors.is_transient(e):
+            raise
+        obj = cache.get(name, namespace)
+        if obj is None:
+            # A degraded MISS must not masquerade as NotFound: on the
+            # read-through path this is exactly the just-created-object
+            # window, and answering None would 404 an object the caller
+            # may have written moments ago.  Propagate the transient
+            # error (503 + Retry-After at the web layer) instead.
+            raise
+        if on_degraded is not None:
+            on_degraded(e)
+        return obj
 
 
 class Informer:

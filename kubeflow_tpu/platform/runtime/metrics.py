@@ -319,6 +319,48 @@ rest_client_requests_total = Counter(
     "(code='<error>' for transport failures)",
     ["verb", "kind", "code"], registry=registry,
 )
+rest_client_retries_total = Counter(
+    "rest_client_retries_total",
+    "Transparent client-side retries by verb (bounded, idempotent verbs "
+    "+ 429s; see k8s/client.py retry policy)",
+    ["verb"], registry=registry,
+)
+rest_client_circuit_state = Gauge(
+    "rest_client_circuit_state",
+    "Client circuit breaker state (0=closed, 1=half-open, 2=open)",
+    registry=registry,
+)
+rest_client_circuit_opens_total = Counter(
+    "rest_client_circuit_opens_total",
+    "Times the client circuit breaker tripped open "
+    "(consecutive transient failures crossed the threshold)",
+    registry=registry,
+)
+reconcile_stuck_total = Counter(
+    "reconcile_stuck_total",
+    "Reconciles that exceeded the stuck-reconcile deadline "
+    "(the watchdog dumped their trace; they may still be running)",
+    ["controller"], registry=registry,
+)
+reconcile_dead_letter_total = Counter(
+    "reconcile_dead_letter_total",
+    "Keys parked on the dead-letter path after exhausting max retries "
+    "(terminal ReconcileFailed condition written; no more backoff requeues "
+    "until a new event or resync revives the key)",
+    ["controller"], registry=registry,
+)
+culling_probe_failures_total = Counter(
+    "notebook_culling_probe_failures_total",
+    "Idleness probes that errored or timed out (the notebook counts as "
+    "BUSY — fail safe, never culled on a broken probe)",
+    registry=registry,
+)
+degraded_responses_total = Counter(
+    "degraded_responses_total",
+    "Web responses served from a possibly-stale informer cache because "
+    "the live apiserver read failed transiently (degraded: true)",
+    ["component"], registry=registry,
+)
 informer_watch_restarts_total = Counter(
     "informer_watch_restarts_total",
     "Informer watch stream failures/expiries that forced a re-establish",

@@ -1,3 +1,4 @@
+from kubeflow_tpu.platform.testing.chaos import ChaosKube, Fault, storm
 from kubeflow_tpu.platform.testing.fake import FakeKube
 
-__all__ = ["FakeKube"]
+__all__ = ["ChaosKube", "FakeKube", "Fault", "storm"]

@@ -105,11 +105,14 @@ class HttpKube:
             return self._dispatch(environ, start_response)
         except errors.ApiError as e:
             body = json.dumps(e.to_status()).encode()
-            start_response(
-                f"{e.status} {e.reason}",
-                [("Content-Type", "application/json"),
-                 ("Content-Length", str(len(body)))],
-            )
+            headers = [("Content-Type", "application/json"),
+                       ("Content-Length", str(len(body)))]
+            if e.retry_after is not None:
+                # ChaosKube-injected 429/503s carry their backpressure hint
+                # across the wire, so RestKubeClient's honored-Retry-After
+                # path is exercised end to end.
+                headers.append(("Retry-After", str(e.retry_after)))
+            start_response(f"{e.status} {e.reason}", headers)
             return [body]
 
     def _dispatch(self, environ, start_response):

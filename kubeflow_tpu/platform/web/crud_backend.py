@@ -12,6 +12,7 @@ double-submit cookie (csrf.py).
 from __future__ import annotations
 
 import secrets as pysecrets
+import threading
 from typing import Callable, List, Optional
 
 from werkzeug.wrappers import Request, Response
@@ -19,6 +20,25 @@ from werkzeug.wrappers import Request, Response
 from kubeflow_tpu.platform import config
 from kubeflow_tpu.platform.k8s.types import GVK
 from kubeflow_tpu.platform.web.framework import App, HttpError, json_response
+
+# Request-scoped "this response was served from a possibly-stale cache"
+# flag (thread-local: one request per worker thread under werkzeug).  Set
+# by the cache_or_client_* degraded fallback, cleared per request and
+# folded into the JSON envelope (``degraded: true``) by the standard
+# middleware below.
+_degraded = threading.local()
+
+
+def _mark_degraded(_exc) -> None:
+    _degraded.flag = True
+
+
+def _clear_degraded() -> None:
+    _degraded.flag = False
+
+
+def _is_degraded() -> bool:
+    return getattr(_degraded, "flag", False)
 
 
 class AuthContext:
@@ -90,8 +110,12 @@ class CrudBackend:
         from kubeflow_tpu.platform.runtime.informer import cache_or_client_list
 
         self.ensure(user, "list", gvk, namespace)
+        # on_degraded: a transient live-LIST failure with a cache wired
+        # serves the cache and stamps ``degraded: true`` on the response
+        # (install_standard_middleware) instead of 500ing the page.
         return cache_or_client_list(self.caches.get(gvk), self.client, gvk,
-                                    namespace, label_selector=label_selector)
+                                    namespace, label_selector=label_selector,
+                                    on_degraded=_mark_degraded)
 
     def get_resource(self, user, gvk, name, namespace=None):
         from kubeflow_tpu.platform.runtime.informer import cache_or_client_get
@@ -100,7 +124,8 @@ class CrudBackend:
         # read_through: a UI GET right after its own POST must not 404
         # out of a cache the watch delta hasn't reached yet.
         obj = cache_or_client_get(self.caches.get(gvk), self.client, gvk,
-                                  name, namespace, read_through=True)
+                                  name, namespace, read_through=True,
+                                  on_degraded=_mark_degraded)
         if obj is None:
             from kubeflow_tpu.platform.k8s import errors
 
@@ -166,6 +191,13 @@ def install_standard_middleware(app: App, backend: CrudBackend, *,
     )
 
     @app.before_request
+    def reset_degraded(request: Request) -> Optional[Response]:
+        # Thread-local, so a previous request's degraded read on this
+        # worker thread must not taint the current response.
+        _clear_degraded()
+        return None
+
+    @app.before_request
     def authn_gate(request: Request) -> Optional[Response]:
         adapter = app._url_map.bind_to_environ(request.environ)
         try:
@@ -218,6 +250,34 @@ def install_standard_middleware(app: App, backend: CrudBackend, *,
         return response
 
     @app.after_request
+    def stamp_degraded(request: Request, response: Response) -> Response:
+        # A successful JSON response built from a degraded (cache-served)
+        # read carries ``degraded: true`` so UIs can badge the staleness —
+        # the same envelope, one extra field; error responses are left
+        # alone.  Runs only when a read actually degraded, so the happy
+        # path never re-parses its own JSON.
+        if not _is_degraded():
+            return response
+        _clear_degraded()
+        if (response.status_code < 400
+                and (response.content_type or "").startswith(
+                    "application/json")):
+            import json as _json
+
+            try:
+                body = _json.loads(response.get_data(as_text=True))
+            except ValueError:
+                return response
+            if isinstance(body, dict):
+                body["degraded"] = True
+                response.set_data(_json.dumps(body))
+                from kubeflow_tpu.platform.runtime import metrics
+
+                metrics.degraded_responses_total.labels(
+                    component=app.name).inc()
+        return response
+
+    @app.after_request
     def set_csrf_cookie(request: Request, response: Response) -> Response:
         if secure and CSRF_COOKIE not in request.cookies:
             response.set_cookie(
@@ -229,7 +289,15 @@ def install_standard_middleware(app: App, backend: CrudBackend, *,
     @app.route("/healthz")
     @no_authentication
     def healthz(request: Request):
-        return json_response({"status": "ok"})
+        body = {"status": "ok"}
+        # Client-side resilience state (RestKubeClient circuit breaker)
+        # rides along where one exists — "the app is fine, the apiserver
+        # path is not" is the distinction an operator probing /healthz
+        # actually needs.  An open circuit stays 200: restarting a web
+        # replica doesn't fix an unreachable apiserver.
+        if hasattr(backend.client, "health"):
+            body["rest_client"] = backend.client.health()
+        return json_response(body)
 
     @app.route("/metrics")
     @no_authentication

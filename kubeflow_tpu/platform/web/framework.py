@@ -45,10 +45,11 @@ def success(data: Any = None, status: int = 200, **extra) -> Response:
     return json_response(body, status)
 
 
-def failure(message: str, status: int = 400) -> Response:
+def failure(message: str, status: int = 400,
+            *, headers: Optional[dict] = None) -> Response:
     return json_response(
         {"success": False, "status": status, "log": message, "user_action": message},
-        status,
+        status, headers=headers,
     )
 
 
@@ -136,7 +137,17 @@ class App:
             from kubeflow_tpu.platform.k8s.errors import ApiError
 
             if isinstance(e, ApiError):
-                response = failure(str(e), e.status)
+                headers = None
+                if e.status in (429, 503):
+                    # Transient upstream failure (apiserver throttling or
+                    # unreachable — TransportError maps to 503): tell the
+                    # client when to come back instead of a bare error.
+                    # Honor the server-sent Retry-After when one rode in.
+                    retry_after = getattr(e, "retry_after", None)
+                    headers = {"Retry-After":
+                               str(max(1, round(retry_after))
+                                   if retry_after else 5)}
+                response = failure(str(e), e.status, headers=headers)
             else:
                 response = failure("internal error", 500)
                 traceback.print_exc()

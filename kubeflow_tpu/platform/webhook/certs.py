@@ -17,11 +17,6 @@ import ipaddress
 import os
 from typing import Iterable, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
-
 
 def generate_self_signed(
     cn: str = "kft-webhook",
@@ -35,6 +30,15 @@ def generate_self_signed(
     against a server presenting exactly this pair, which is what lets the
     rotation tests prove the server really reloaded.
     """
+    # Imported here, not at module top: ``write_pair``'s atomic-rotation
+    # machinery has no crypto dependency and must stay importable on
+    # images without the ``cryptography`` package (keygen then comes from
+    # cert-manager / out-of-band files).
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
     sans = []
@@ -70,14 +74,29 @@ def generate_self_signed(
 def write_pair(directory: str, cert_pem: bytes, key_pem: bytes
                ) -> Tuple[str, str]:
     """Write tls.crt/tls.key under ``directory`` (the cert-manager secret
-    layout) atomically enough for the reload loop: key first, then cert,
-    each via rename so a reloader never reads a half-written file."""
-    paths = []
+    layout) atomically: BOTH temp files are fully written and fsynced to
+    disk first, and only then renamed into place (key first, then cert,
+    back to back).  Ordering matters twice over:
+
+    * a writer killed mid-write (crash, OOM, SIGKILL) leaves at most a
+      stale ``.tmp`` file — the live pair is never truncated, because the
+      target paths are only ever touched by atomic rename;
+    * the reloader can observe at most the tiny window between the two
+      renames (new key + old cert); its trial-load rejects the mismatched
+      pair and retries next tick without ever poisoning the live context
+      (WebhookServer.reload_certs).
+    """
+    tmps = []
     for fname, blob in (("tls.key", key_pem), ("tls.crt", cert_pem)):
         path = os.path.join(directory, fname)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        tmps.append((tmp, path))
+    paths = []
+    for tmp, path in tmps:
         os.replace(tmp, path)
         paths.append(path)
     return paths[1], paths[0]  # (cert_path, key_path)

@@ -136,6 +136,10 @@ class WebhookServer:
         )
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Rotation attempts that found an unloadable pair on disk (partial
+        # write, key/cert mismatch mid-rename) — observable instead of a
+        # silent ``pass``, and the rotation tests' kill-mid-write probe.
+        self.reload_failures = 0
 
     @property
     def port(self) -> int:
@@ -162,11 +166,27 @@ class WebhookServer:
         current = self._mtimes()
         if current != self._cert_mtimes and all(current):
             try:
+                # Trial-load on a SCRATCH context first: a partial write or
+                # a mid-rename key/cert mismatch must fail here, where it
+                # cannot poison the serving context — the server keeps
+                # handshaking with the previous pair and the next tick
+                # retries (certs.write_pair renames atomically, so the
+                # window is the gap between the two renames at most).
+                probe = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                probe.load_cert_chain(self._cert_file, self._key_file)
                 self._ctx.load_cert_chain(self._cert_file, self._key_file)
                 self._cert_mtimes = current
                 return True
-            except (OSError, ssl.SSLError):
-                pass  # partial write mid-rotation; retry next tick
+            except (OSError, ssl.SSLError) as e:
+                # Partial write mid-rotation: counted and logged (a cert
+                # writer that stays broken past its expiry must not be
+                # silent), retried next tick.
+                self.reload_failures += 1
+                import logging
+
+                logging.getLogger("kubeflow_tpu.webhook").warning(
+                    "cert reload failed (attempt %d; keeping previous "
+                    "pair): %s", self.reload_failures, e)
         return False
 
     def _cert_reload_loop(self) -> None:

@@ -1,0 +1,398 @@
+"""Chaos soak: seeded fault storms through ChaosKube, end to end.
+
+The fixed-seed storms here are the repo's repeatable failure injection
+(ISSUE 4 tentpole): the notebook + culling controllers and the jupyter
+backend all run against a ChaosKube-wrapped apiserver and must CONVERGE
+with zero invariant violations —
+
+* no duplicate children (exactly one StatefulSet/Service set per notebook,
+  each owned by its notebook),
+* no lost status updates (every notebook reports its true replica state),
+* no unfrozen cache mutations (informer views value-equal server state),
+* no unbounded retry loops (queues drain; dead-letter never fires for
+  transient faults, and DOES fire for permanent ones).
+
+Tier 1 runs the small smoke storm; the 60 s soak (http transport: a real
+RestKubeClient through HttpKube over a chaotic FakeKube, so retries,
+Retry-After, circuit and watch-resume all cross an actual wire) is
+``slow``-marked for the nightly lane.
+"""
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    NOTEBOOK,
+    SERVICE,
+    STATEFULSET,
+    deep_get,
+)
+from kubeflow_tpu.platform.testing import ChaosKube, FakeKube, Fault
+from kubeflow_tpu.platform.testing.chaos import storm
+
+SEED = 20260804  # fixed, in-repo: every run storms identically
+
+
+# -- ChaosKube unit behavior --------------------------------------------------
+
+
+def make_nb(name, ns="fleet"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "2x4"},
+            "template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jupyter-jax"}]}},
+        },
+    }
+
+
+def test_chaos_schedule_is_deterministic():
+    """Same seed + same call sequence → identical fault log."""
+    def run():
+        kube = FakeKube()
+        kube.add_namespace("ns")
+        chaos = ChaosKube(kube, storm(rate=0.3), seed=SEED)
+        for i in range(40):
+            try:
+                chaos.create(make_nb(f"nb-{i}", "ns"))
+            except errors.ApiError:
+                pass
+            try:
+                chaos.list(NOTEBOOK, "ns")
+            except errors.ApiError:
+                pass
+        return list(chaos.fault_log)
+
+    log1, log2 = run(), run()
+    assert log1 == log2
+    assert log1, "a 30% storm over 80 calls must inject something"
+
+
+def test_chaos_respects_verb_and_kind_selectors():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    chaos = ChaosKube(kube, [
+        Fault("503", 1.0, verbs=frozenset({"create"}),
+              kinds=frozenset({"Notebook"})),
+    ], seed=1)
+    # Wrong kind: never faulted.
+    chaos.create({"apiVersion": "v1", "kind": "Namespace",
+                  "metadata": {"name": "other"}})
+    # Wrong verb: never faulted.
+    assert chaos.list(NOTEBOOK, "ns") == []
+    with pytest.raises(errors.ServiceUnavailable):
+        chaos.create(make_nb("nb", "ns"))
+    assert chaos.injected() == 1
+    assert chaos.fault_log == [("create", "503", "Notebook")]
+
+
+def test_chaos_max_injections_bounds_a_fault():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    chaos = ChaosKube(kube, [Fault("500", 1.0, max_injections=2)], seed=1)
+    for i in range(2):
+        with pytest.raises(errors.InternalError):
+            chaos.list(NOTEBOOK, "ns")
+    assert chaos.list(NOTEBOOK, "ns") == []  # storm exhausted
+    assert chaos.injected("500") == 2
+
+
+def test_chaos_pause_stops_injection():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    chaos = ChaosKube(kube, [Fault("500", 1.0)], seed=1)
+    with pytest.raises(errors.InternalError):
+        chaos.list(NOTEBOOK, "ns")
+    chaos.pause()
+    assert chaos.list(NOTEBOOK, "ns") == []
+
+
+# -- the soak harness ---------------------------------------------------------
+
+
+class ChaosHarness:
+    """Notebook + culling controllers (shared informer) against a chaotic
+    apiserver, with a kubelet sim bringing worker pods up on the REAL
+    (non-chaotic) store — the cluster itself is healthy, only the
+    apiserver path flakes."""
+
+    def __init__(self, chaos_client, kube, *, idle_minutes=1e9):
+        from kubeflow_tpu.platform.controllers import culling
+        from kubeflow_tpu.platform.controllers.notebook import make_controller
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK as NB
+
+        self.kube = kube
+        self.client = chaos_client
+        self.ctrl = make_controller(chaos_client, use_istio=False)
+        self.ctrl.workers = 4
+        self.cull = culling.make_controller(
+            chaos_client,
+            notebook_informer=self.ctrl.informers.get(NB),
+            prober=lambda url: [{"execution_state": "busy"}],
+            idle_minutes=idle_minutes,
+            check_period_minutes=0.02,
+        )
+        self._stop = threading.Event()
+        self._kubelet = threading.Thread(target=self._kubelet_loop,
+                                         daemon=True)
+        self._kubelet.start()
+        self.ctrl.start(chaos_client)
+        self.cull.start(chaos_client)
+
+    def close(self):
+        self._stop.set()
+        self.cull.stop()
+        self.ctrl.stop()
+        self._kubelet.join(timeout=5)
+
+    def _kubelet_loop(self):
+        from kubeflow_tpu.platform.k8s.types import deep_get as dg
+
+        acked = {}
+        for _etype, sts in self.kube.watch(STATEFULSET, "fleet",
+                                           stop=self._stop):
+            name = sts["metadata"]["name"]
+            replicas = dg(sts, "spec", "replicas", default=0)
+            if acked.get(name) == replicas or not replicas:
+                continue
+            tmpl = dg(sts, "spec", "template")
+            for i in range(replicas):
+                pod = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": f"{name}-{i}", "namespace": "fleet",
+                        "labels": dict(dg(tmpl, "metadata", "labels",
+                                          default={}) or {}),
+                    },
+                    "spec": dg(tmpl, "spec"),
+                }
+                try:
+                    self.kube.create(pod)
+                except errors.AlreadyExists:
+                    pass
+                try:
+                    self.kube.set_pod_phase("fleet", f"{name}-{i}",
+                                            "Running", ready=True)
+                except errors.ApiError:
+                    pass
+            acked[name] = replicas
+
+    def wait_converged(self, n, timeout):
+        """Every notebook fully ready, queues drained."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            nbs = self.kube.list(NOTEBOOK, "fleet")
+            ready = [
+                nb for nb in nbs
+                if deep_get(nb, "status", "replicas", default=0)
+                and deep_get(nb, "status", "readyReplicas", default=-1)
+                == deep_get(nb, "status", "replicas", default=0)
+            ]
+            if (len(ready) >= n and self.ctrl.queue.pending() == 0):
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -- invariants ----------------------------------------------------------
+
+    def assert_invariants(self, n):
+        nbs = self.kube.list(NOTEBOOK, "fleet")
+        assert len(nbs) == n
+        stses = self.kube.list(STATEFULSET, "fleet")
+        svcs = self.kube.list(SERVICE, "fleet")
+
+        # No duplicate children: exactly one STS per notebook, owned by it.
+        by_owner = {}
+        for sts in stses:
+            refs = [r for r in sts["metadata"].get("ownerReferences", [])
+                    if r.get("kind") == "Notebook"]
+            assert len(refs) == 1, f"{sts['metadata']['name']}: owners {refs}"
+            by_owner.setdefault(refs[0]["name"], []).append(
+                sts["metadata"]["name"])
+        for nb in nbs:
+            name = nb["metadata"]["name"]
+            assert by_owner.get(name) == [name], (
+                f"{name}: duplicate/missing slice STS {by_owner.get(name)}")
+        # Two services per notebook (user-facing + headless), no extras.
+        svc_names = sorted(s["metadata"]["name"] for s in svcs)
+        want = sorted(sum((([nb["metadata"]["name"],
+                             nb["metadata"]["name"] + "-workers"])
+                           for nb in nbs), []))
+        assert svc_names == want
+
+        # No lost status updates: status matches pod reality.
+        for nb in nbs:
+            assert deep_get(nb, "status", "readyReplicas") == \
+                deep_get(nb, "status", "replicas"), nb["metadata"]["name"]
+
+        # No unfrozen cache mutations: the informer's view of every
+        # notebook is value-equal to the server's object.
+        informer = self.ctrl.informers.get(NOTEBOOK)
+        if informer is not None and informer.has_synced:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                server = {nb["metadata"]["name"]: nb for nb in
+                          self.kube.list(NOTEBOOK, "fleet")}
+                cached = {name: informer.get(name, "fleet")
+                          for name in server}
+                if all(cached[k] is not None and dict(cached[k]) == server[k]
+                       for k in server):
+                    break
+                time.sleep(0.05)  # watch deltas still propagating
+            for name, obj in server.items():
+                view = informer.get(name, "fleet")
+                assert view is not None, f"{name} missing from cache"
+                assert dict(view) == obj, f"{name}: cache != server"
+
+        # No unbounded retry loops: nothing parked for transient faults.
+        assert not self.ctrl.dead_letters
+        assert not self.cull.dead_letters
+
+
+@pytest.fixture
+def fleet_kube():
+    kube = FakeKube()
+    kube.add_namespace("fleet")
+    kube.add_tpu_node("tpu-node-1", topology="2x4")
+    return kube
+
+
+def test_smoke_storm_converges_with_invariants(fleet_kube):
+    """Tier-1 chaos smoke: a small seeded storm (bounded injections so
+    the tail is calm) over the in-memory transport; the fleet must
+    converge with every invariant intact and the storm must have
+    actually stormed."""
+    chaos = ChaosKube(fleet_kube,
+                      storm(rate=0.08, max_injections=40), seed=SEED)
+    h = ChaosHarness(chaos, fleet_kube)
+    n = 12
+    try:
+        for i in range(n):
+            fleet_kube.create(make_nb(f"nb-{i:03d}"))
+        assert h.wait_converged(n, timeout=90.0), (
+            f"fleet unconverged under storm; queue depth "
+            f"{h.ctrl.queue.pending()}, faults {dict(h.client.calls)}")
+        chaos.pause()
+        h.assert_invariants(n)
+    finally:
+        h.close()
+    assert chaos.injected() > 0, "the storm never stormed"
+
+
+def test_permanent_fault_dead_letters_instead_of_hot_looping(fleet_kube):
+    """Acceptance: dead-letter fires for PERMANENT faults — with STS
+    creation 100% broken, the notebook key parks with a terminal
+    ReconcileFailed condition instead of retrying forever."""
+    chaos = ChaosKube(fleet_kube, [
+        Fault("500", 1.0, verbs=frozenset({"create"}),
+              kinds=frozenset({"StatefulSet"})),
+    ], seed=SEED)
+    from kubeflow_tpu.platform.controllers.notebook import make_controller
+
+    ctrl = make_controller(chaos, use_istio=False)
+    ctrl.max_retries = 3
+    try:
+        ctrl.queue._base = 0.001
+    except AttributeError:
+        pass
+    ctrl.start(chaos)
+    try:
+        fleet_kube.create(make_nb("doomed"))
+        deadline = time.monotonic() + 30.0
+        while not ctrl.dead_letters and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ctrl.dead_letters, "permanent fault never dead-lettered"
+        nb = fleet_kube.get(NOTEBOOK, "doomed", "fleet")
+        conds = {c["type"] for c in
+                 deep_get(nb, "status", "conditions", default=[])}
+        assert "ReconcileFailed" in conds
+        # Bounded: the broken create was attempted ~(1 + max_retries)
+        # times plus event-driven revivals, not a hot loop.
+        assert chaos.injected("500") <= 10
+    finally:
+        ctrl.stop()
+
+
+def test_jupyter_backend_degrades_under_storm(fleet_kube):
+    """The jupyter backend under the same storm: every response is a
+    clean envelope — 2xx (possibly ``degraded``), a mapped apiserver
+    error, or 503/429 with Retry-After.  Never a raw 500 'internal
+    error'."""
+    from kubeflow_tpu.platform.apps.jupyter.app import create_app
+    from kubeflow_tpu.platform.web.crud_backend import AuthContext
+
+    chaos = ChaosKube(fleet_kube, storm(rate=0.25), seed=SEED)
+    app = create_app(chaos, auth=AuthContext(disable_auth=True),
+                     secure_cookies=False)
+    from werkzeug.test import Client
+    import json as _json
+
+    c = Client(app)
+    saw_failure = False
+    for _ in range(60):
+        resp = c.get("/api/namespaces/fleet/notebooks")
+        payload = _json.loads(resp.get_data(as_text=True))
+        if resp.status_code == 200:
+            assert payload["success"] is True
+        else:
+            saw_failure = True
+            assert payload["success"] is False
+            assert payload["log"] != "internal error", (
+                "an injected transient fault leaked as a raw 500")
+            if resp.status_code in (429, 503):
+                assert resp.headers.get("Retry-After")
+    assert chaos.injected() > 0
+    assert saw_failure, "a 25% storm over 60 requests should fail some"
+
+
+@pytest.mark.slow
+def test_soak_60s_http_transport_storm(fleet_kube):
+    """The full-stack soak: RestKubeClient (retries, Retry-After,
+    circuit, finite timeouts) over real HTTP against HttpKube serving a
+    chaotic store, 60 s of churn + storm, then quiesce and assert every
+    invariant.  Watch drops sever real chunked streams mid-flight."""
+    from kubeflow_tpu.platform.k8s.client import RestKubeClient
+    from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+
+    chaos = ChaosKube(fleet_kube, storm(rate=0.05), seed=SEED)
+    server = HttpKubeServer(chaos).start()
+    client = RestKubeClient(server.base_url, qps=0, retries=3,
+                            retry_base=0.02, retry_cap=0.5,
+                            breaker_threshold=8, breaker_cooldown=0.2)
+    client.WATCH_TIMEOUT_SECONDS = 5  # many resume windows per soak
+    h = ChaosHarness(client, fleet_kube)
+    n = 20
+    try:
+        for i in range(n):
+            fleet_kube.create(make_nb(f"nb-{i:03d}"))
+        # 60 s of churn under storm: annotation touches + stop/start
+        # toggles through the CHAOTIC client (writes see 409/503/429).
+        t_end = time.monotonic() + 60.0
+        import random as _random
+
+        rng = _random.Random(SEED)
+        touches = 0
+        while time.monotonic() < t_end:
+            name = f"nb-{rng.randrange(n):03d}"
+            try:
+                nb = fleet_kube.get(NOTEBOOK, name, "fleet")
+                nb["metadata"].setdefault("annotations", {})[
+                    "touch"] = str(touches)
+                fleet_kube.update(nb)
+                touches += 1
+            except errors.ApiError:
+                pass
+            time.sleep(0.05)
+        chaos.pause()  # quiesce: let the fleet converge cleanly
+        assert h.wait_converged(n, timeout=120.0), (
+            f"fleet unconverged after soak; queue depth "
+            f"{h.ctrl.queue.pending()}")
+        h.assert_invariants(n)
+        assert chaos.injected() > 50, dict(h.client.calls)
+    finally:
+        h.close()
+        server.stop()
