@@ -22,9 +22,12 @@ SCALE_BAND x the small fleet's (superlinear blowup — an O(N^2) resync,
 deep-copy amplification on the event path — is exactly what functional
 tests cannot see).
 
-Protocol notes: the controller runs with workers=4 (the e2e default is 1;
-4 matches the race-stress tier and a production controller-runtime
-MaxConcurrentReconciles).  The kubelet sim acks StatefulSets from a
+Protocol notes: the controller runs with workers=4 (now also the
+platform-wide dispatch default — CONTROLLER_WORKERS; 4 matches the
+race-stress tier and a production controller-runtime
+MaxConcurrentReconciles).  The workers sweep + wire-converge phases
+(run_worker_sweep) measure the parallel-dispatch win itself over the
+HTTP transport.  The kubelet sim acks StatefulSets from a
 watch, so pod bring-up latency scales with the fleet the way a real
 cluster's would (per-STS, not per-wave).  Everything is event-driven;
 convergence is observed from the NOTEBOOK watch stream, not by polling
@@ -53,9 +56,14 @@ import time
 # The bands stay loose (3x) — shared-CPU container; the tripwire is for
 # order-of-magnitude regressions (an accidental O(N^2) or a return of
 # copy-per-read), not scheduler noise.
+# fleet_converge and resync_cpu re-pinned 2026-08-04 after the parallel-
+# dispatch + write-coalescing PR (workers=4 default, FlightPool secondary
+# fan-out, diff-and-patch writes): same-machine 600-notebook wave
+# converge 6.0 -> 2.2 ms/notebook and steady-state resync CPU
+# 0.55 -> 0.19 s measured on the 2-CPU container.
 BASELINE = {
-    "fleet_converge_ms_per_notebook": 6.0,    # 600-notebook wave
-    "fleet_resync_cpu_s": 0.55,               # min of 3 600-object cycles
+    "fleet_converge_ms_per_notebook": 2.2,    # 600-notebook wave
+    "fleet_resync_cpu_s": 0.2,                # min of 3 600-object cycles
     # Read-path microbench (zero-copy frozen views): informer get()
     # throughput and the resync cycle's peak tracemalloc footprint per
     # object.  Pre-frozen-view: ~62k gets/s and ~3 KB/object of copy
@@ -78,7 +86,27 @@ SCALE_BAND = 2.0
 # visible next to the existing converge band.
 CHAOS_SEED = 20260804
 CHAOS_RATE = 0.05
-CHAOS_CONVERGE_BASELINE_S = 12.0  # 80-notebook storm on the 2-CPU container
+# 80-notebook storm on the 2-CPU container; the banded value is the MIN
+# over run_chaos's storm samples (the storm tail is a backoff lottery —
+# whether the last key draws a near-max backoff; single samples measured
+# 7-79 s on identical code, so the min is the one-sided-noise statistic,
+# same as the resync-CPU protocol).  Re-pinned 12.0 -> 7.0 for the
+# write-coalesced path: merge patches carry no resourceVersion, so the
+# storm's 409-on-update faults have almost nothing left to hit —
+# alternating same-machine A/B measured storm converge 11.7-52.2 s on
+# the full-update path vs 3.2-7.3 s on the patched path.
+CHAOS_CONVERGE_BASELINE_S = 7.0
+# Parallel-dispatch bands (ISSUE 5): the wave-converge-vs-workers sweep
+# and the wire-level converge both run over the HTTP transport — parallel
+# dispatch exists to overlap blocking apiserver round trips, which the
+# in-memory fake doesn't have (its reconciles are GIL-bound CPU where
+# extra workers can't help).  K8S_CLIENT_QPS is forced to 0 for these
+# phases: the default 50-QPS limiter throttles every arm to the same rate
+# and would measure the limiter, not the dispatch.
+WORKER_SWEEP_WORKERS = (1, 4)
+WORKER_SWEEP_MIN_SPEEDUP = 1.3   # workers=4 must beat workers=1 by >=30%
+WORKER_SWEEP_RTT_S = 0.002       # injected per-call apiserver RTT
+WIRE_CONVERGE_BASELINE_S = 5.5   # 80-nb wave, http, workers=4, QPS off
 
 
 def _rss_mb() -> float:
@@ -102,7 +130,7 @@ class FleetHarness:
 
     def __init__(self, *, workers: int = 4, transport: str = "memory",
                  watch_window: float = None, chaos_seed: int = None,
-                 chaos_rate: float = CHAOS_RATE):
+                 chaos_rate: float = CHAOS_RATE, chaos_faults: list = None):
         import logging
 
         from kubeflow_tpu.platform.controllers.notebook import make_controller
@@ -121,12 +149,16 @@ class FleetHarness:
         # runs through a seeded ChaosKube storm (the kubelet/convergence
         # sims keep talking to the healthy store — only the control plane
         # flakes), for the ctrlplane_chaos_converge_s band.
+        # chaos_faults overrides the schedule (e.g. the worker sweep's
+        # pure-latency RTT model).
         self.chaos = None
-        if chaos_seed is not None:
+        if chaos_seed is not None or chaos_faults is not None:
             from kubeflow_tpu.platform.testing.chaos import ChaosKube, storm
 
-            self.chaos = ChaosKube(self.api_client, storm(rate=chaos_rate),
-                                   seed=chaos_seed)
+            faults = (chaos_faults if chaos_faults is not None
+                      else storm(rate=chaos_rate))
+            self.chaos = ChaosKube(self.api_client, faults,
+                                   seed=chaos_seed or 0)
             self.api_client = self.chaos
         self.ctrl = make_controller(self.api_client, use_istio=False)
         self.ctrl.workers = workers
@@ -448,10 +480,14 @@ def run_fleet(n: int, *, churn_s: float, transport: str = "memory",
 
 
 def run_chaos(n: int, *, seed: int = CHAOS_SEED, rate: float = CHAOS_RATE,
-              transport: str = "memory") -> dict:
-    """The resilience band: one clean wave and one seeded-storm wave of
-    the same fleet, reporting storm-over-clean converge overhead, faults
-    injected, and dead-letters (must be 0 — the storm is transient)."""
+              transport: str = "memory", storms: int = 2) -> dict:
+    """The resilience band: one clean wave and ``storms`` seeded-storm
+    waves of the same fleet, reporting the MIN storm converge (plus every
+    sample), faults injected, and dead-letters (must be 0 — the storm is
+    transient).  Min-of-N, like the resync-CPU protocol: the storm tail
+    is a backoff lottery — whether the last key draws a near-max backoff
+    right before converging — measured here swinging 7-79 s on IDENTICAL
+    code, so a single sample would band the dice, not the code."""
     import logging
 
     clean = FleetHarness(transport=transport)
@@ -459,27 +495,84 @@ def run_chaos(n: int, *, seed: int = CHAOS_SEED, rate: float = CHAOS_RATE,
         clean_s = clean.wave(n)["converge_s"]
     finally:
         clean.close()
-    stormy = FleetHarness(transport=transport, chaos_seed=seed,
-                          chaos_rate=rate)
+    samples, injected, dead_letters, errors = [], 0, 0, 0
     # Injected faults log as reconcile errors by design; hundreds of
     # expected tracebacks would bury the metric lines.
     logging.getLogger("kubeflow_tpu.runtime").setLevel(logging.CRITICAL)
     try:
-        wave = stormy.wave(n)
-        injected = stormy.chaos.injected()
-        dead_letters = len(stormy.ctrl.dead_letters)
+        for i in range(max(1, storms)):
+            stormy = FleetHarness(transport=transport, chaos_seed=seed + i,
+                                  chaos_rate=rate)
+            try:
+                wave = stormy.wave(n)
+                samples.append(wave["converge_s"])
+                injected += stormy.chaos.injected()
+                dead_letters += len(stormy.ctrl.dead_letters)
+                errors += wave["errors"]
+            finally:
+                stormy.close()
     finally:
-        stormy.close()
         logging.getLogger("kubeflow_tpu.runtime").setLevel(logging.ERROR)
+    best = min(samples)
     return {
         "fleet": n,
         "clean_converge_s": round(clean_s, 3),
-        "storm_converge_s": round(wave["converge_s"], 3),
-        "overhead_x": round(wave["converge_s"] / max(clean_s, 1e-9), 3),
+        "storm_converge_s": round(best, 3),
+        "storm_samples_s": [round(s, 3) for s in samples],
+        "overhead_x": round(best / max(clean_s, 1e-9), 3),
         "faults_injected": injected,
         "dead_letters": dead_letters,
-        "reconcile_errors": wave["errors"],
+        "reconcile_errors": errors,
     }
+
+
+def run_worker_sweep(n: int, *, workers=WORKER_SWEEP_WORKERS,
+                     rtt_s: float = WORKER_SWEEP_RTT_S,
+                     timeout: float = 300.0) -> dict:
+    """Wave-converge-vs-workers: the SAME N-notebook wave on the same
+    machine, one harness per worker count, every apiserver call of the
+    controller carrying an injected ``rtt_s`` round-trip (a pure-latency
+    ChaosKube schedule).  Parallel dispatch exists to overlap exactly
+    this blocking time; the injected sleep releases the GIL the way a
+    real socket wait does, while the in-process HTTP transport is
+    GIL-bound end to end (client and server share one interpreter) and
+    would measure CPU contention, not dispatch — that's what the separate
+    wire-converge band is for.  Returns {workers: wave_dict}."""
+    from kubeflow_tpu.platform.testing.chaos import Fault
+
+    faults = [Fault("latency", 1.0, latency_s=rtt_s)]
+    results = {}
+    for w in workers:
+        h = FleetHarness(workers=w, chaos_faults=faults)
+        try:
+            results[w] = h.wave(n, timeout=timeout)
+        finally:
+            h.close()
+    return results
+
+
+def run_wire_converge(n: int, *, workers: int = 4,
+                      timeout: float = 300.0) -> dict:
+    """Wire-level converge: the full controller + informer + watch stack
+    over the real REST client against the fake served over HTTP
+    (HttpKube), QPS limiter off so the band tracks the wire path itself
+    (serialization, connection pool, chunked watch streams) rather than
+    the client-side throttle."""
+    import os
+
+    saved = os.environ.get("K8S_CLIENT_QPS")
+    os.environ["K8S_CLIENT_QPS"] = "0"
+    try:
+        h = FleetHarness(workers=workers, transport="http")
+        try:
+            return h.wave(n, timeout=timeout)
+        finally:
+            h.close()
+    finally:
+        if saved is None:
+            del os.environ["K8S_CLIENT_QPS"]
+        else:
+            os.environ["K8S_CLIENT_QPS"] = saved
 
 
 def main(argv=None) -> int:
@@ -487,6 +580,10 @@ def main(argv=None) -> int:
     p.add_argument("--small", type=int, default=150)
     p.add_argument("--large", type=int, default=600)
     p.add_argument("--chaos-fleet", type=int, default=80)
+    p.add_argument("--sweep-fleet", type=int, default=80,
+                   help="wave size for the workers sweep (memory transport "
+                        "+ injected per-call RTT) and the wire-converge "
+                        "band (http transport)")
     p.add_argument("--churn-seconds", type=float, default=3.0)
     p.add_argument("--transport", choices=["memory", "http"],
                    default="memory",
@@ -613,6 +710,7 @@ def main(argv=None) -> int:
         f"{args.chaos_fleet}-notebook wave, rate {CHAOS_RATE}, "
         f"seed {CHAOS_SEED})",
         "clean_converge_s": chaos["clean_converge_s"],
+        "storm_samples_s": chaos["storm_samples_s"],
         "overhead_x": chaos["overhead_x"],
         "faults_injected": chaos["faults_injected"],
         "dead_letters": chaos["dead_letters"],
@@ -631,6 +729,43 @@ def main(argv=None) -> int:
             "band_floor": round(1.0 / BAND_FACTOR, 3),
         })
     print(json.dumps(line), flush=True)
+    # Parallel dispatch proof (ISSUE 5): the workers sweep (injected-RTT
+    # model, where overlap is what's being measured) and the wire-level
+    # converge band (HttpKube, the full stack over a real socket).  Both
+    # are transport-fixed so they stay meaningful whatever --transport
+    # the rest of the run used.
+    sweep = run_worker_sweep(args.sweep_fleet)
+    w_lo, w_hi = min(sweep), max(sweep)
+    lo_s, hi_s = sweep[w_lo]["converge_s"], sweep[w_hi]["converge_s"]
+    speedup = lo_s / max(hi_s, 1e-9)
+    print(json.dumps({
+        "metric": "ctrlplane_wave_converge_workers",
+        "value": round(speedup, 3),
+        "unit": f"x speedup (workers={w_hi} vs workers={w_lo}, "
+                f"{args.sweep_fleet}-notebook wave, "
+                f"{WORKER_SWEEP_RTT_S * 1e3:g}ms injected RTT per call)",
+        **{f"workers_{w}_converge_s": round(r["converge_s"], 3)
+           for w, r in sorted(sweep.items())},
+        **{f"workers_{w}_reconciles": r["reconciles"]
+           for w, r in sorted(sweep.items())},
+        "band": "pass" if speedup >= WORKER_SWEEP_MIN_SPEEDUP
+        else "REGRESSION",
+        "band_floor": WORKER_SWEEP_MIN_SPEEDUP,
+    }), flush=True)
+    wire = run_wire_converge(args.sweep_fleet)
+    print(json.dumps({
+        "metric": "ctrlplane_wire_converge_s",
+        "value": round(wire["converge_s"], 3),
+        "unit": f"s ({args.sweep_fleet}-notebook wave, http transport, "
+                "workers=4, QPS limiter off)",
+        "reconcile_errors": wire["errors"],
+        "reconciles": wire["reconciles"],
+        "cpu_s": round(wire["cpu_s"], 3),
+        "vs_baseline": round(
+            WIRE_CONVERGE_BASELINE_S / max(wire["converge_s"], 1e-9), 4),
+        "band": _band(wire["converge_s"], WIRE_CONVERGE_BASELINE_S),
+        "band_floor": round(1.0 / BAND_FACTOR, 3),
+    }), flush=True)
     print(json.dumps({
         "metric": "ctrlplane_fleet_churn",
         "value": round(large["churn"]["achieved_hz"], 1), "unit": "updates/sec",

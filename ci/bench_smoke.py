@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""bench-smoke presubmit lane: run bench_scale.py at a tiny N and assert
+the band self-report still parses — every stdout line is JSON, every line
+carries a metric name, banded lines carry band/band_floor, and the
+parallel-dispatch keys this lane exists to guard
+(``ctrlplane_wave_converge_workers`` / ``ctrlplane_wire_converge_s``) are
+present.  A refactor that renames a metric, breaks a band field, or
+silently drops a phase fails CI here instead of being discovered the next
+time someone reads a BENCH json.
+
+The tiny N keeps this inside a presubmit budget; VALUES are not asserted
+(a 6-notebook wave on a shared CI box says nothing about regressions —
+that's what the banded full runs are for), only shape and coverage.
+"""
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+REQUIRED_METRICS = {
+    "ctrlplane_fleet_converge_ms_per_notebook",
+    "ctrlplane_fleet_scale_ratio",
+    "ctrlplane_fleet_resync_cpu_s",
+    "ctrlplane_cached_reads_per_s",
+    "ctrlplane_resync_alloc_peak_kb_per_obj",
+    "ctrlplane_chaos_converge_s",
+    "ctrlplane_wave_converge_workers",
+    "ctrlplane_wire_converge_s",
+    "ctrlplane_fleet_churn",
+}
+# Metrics whose full-run lines are banded; at smoke N they must still
+# carry the self-report fields so trending tooling never hits a gap.
+BANDED_METRICS = {
+    "ctrlplane_fleet_converge_ms_per_notebook",
+    "ctrlplane_fleet_scale_ratio",
+    "ctrlplane_wave_converge_workers",
+    "ctrlplane_wire_converge_s",
+    "ctrlplane_chaos_converge_s",
+}
+
+
+def main() -> int:
+    cmd = [
+        sys.executable, "bench_scale.py",
+        "--small", "6", "--large", "10", "--chaos-fleet", "6",
+        "--sweep-fleet", "8", "--churn-seconds", "0.5",
+    ]
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=560)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if not lines:
+        print("bench_scale produced no output", file=sys.stderr)
+        print(proc.stderr[-2000:], file=sys.stderr)
+        return 1
+    seen = {}
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            print(f"non-JSON bench line: {ln!r}", file=sys.stderr)
+            return 1
+        if "metric" not in rec:
+            print(f"bench line without metric name: {ln!r}", file=sys.stderr)
+            return 1
+        seen[rec["metric"]] = rec
+    missing = REQUIRED_METRICS - set(seen)
+    if missing:
+        print(f"missing bench metrics: {sorted(missing)}", file=sys.stderr)
+        return 1
+    for name in BANDED_METRICS:
+        rec = seen[name]
+        if rec.get("band") not in ("pass", "REGRESSION"):
+            print(f"{name}: band field missing/invalid: {rec.get('band')!r}",
+                  file=sys.stderr)
+            return 1
+        if not isinstance(rec.get("band_floor"), (int, float)):
+            print(f"{name}: band_floor missing", file=sys.stderr)
+            return 1
+    sweep = seen["ctrlplane_wave_converge_workers"]
+    for key in ("workers_1_converge_s", "workers_4_converge_s"):
+        if not isinstance(sweep.get(key), (int, float)):
+            print(f"sweep line missing {key}", file=sys.stderr)
+            return 1
+    print(f"bench-smoke OK: {len(seen)} metrics "
+          f"({', '.join(sorted(seen))})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,6 +170,21 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # bench-smoke presubmit lane (ISSUE 5 satellite): bench_scale.py at a
+    # tiny N, asserting the band self-report parses and the parallel-
+    # dispatch keys (ctrlplane_wave_converge_workers / wire-converge) are
+    # present — shape and coverage, not values (ci/bench_smoke.py).
+    name="bench-smoke",
+    include_dirs=[
+        "bench_scale.py", "ci/bench_smoke.py",
+        "kubeflow_tpu/platform/runtime/*", "kubeflow_tpu/platform/k8s/*",
+        "kubeflow_tpu/platform/testing/*",
+        "kubeflow_tpu/platform/controllers/*", "releasing/*",
+    ],
+    steps=[Step("smoke", [sys.executable, "ci/bench_smoke.py"])],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

@@ -31,7 +31,6 @@ from kubeflow_tpu.platform.k8s.types import (
     deep_get,
     meta,
     name_of,
-    thaw,
 )
 from kubeflow_tpu.platform.runtime import Reconciler, Request, Result
 from kubeflow_tpu.platform.runtime import metrics
@@ -201,13 +200,16 @@ class CullingReconciler(Reconciler):
         if idle_for < self.idle_minutes:
             return requeue
 
-        # Intent-to-write: the cached read is a frozen view — thaw() takes
-        # the private mutable copy (a no-op-cost copy on the client-read
-        # fallback path, where the object is already private).
-        notebook = thaw(notebook)
-        annotations = meta(notebook).setdefault("annotations", {})
-        annotations[nbapi.STOP_ANNOTATION] = now.strftime(TIME_FORMAT)
-        self.client.update(notebook)
+        # One-annotation merge patch: the cull write touches exactly the
+        # stop marker — no thaw of the frozen cache view, no full-object
+        # PUT, and no resourceVersion to 409 against the notebook
+        # controller's concurrent status writes.
+        self.client.patch(
+            NOTEBOOK, req.name,
+            {"metadata": {"annotations": {
+                nbapi.STOP_ANNOTATION: now.strftime(TIME_FORMAT)}}},
+            req.namespace,
+        )
         metrics.notebook_culling_total.inc()
         metrics.last_culling_timestamp.set(now.timestamp())
         return None

@@ -49,6 +49,8 @@ from kubeflow_tpu.platform.k8s.types import (
 )
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
 from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.runtime.apply import merge_patch_for, patch_status_diff
+from kubeflow_tpu.platform.runtime.flight import shared_pool
 from kubeflow_tpu.platform.tpu import SliceSpec
 
 HASH_ANNOTATION = "notebooks.kubeflow.org/generated-hash"
@@ -83,6 +85,12 @@ class NotebookReconciler(Reconciler):
         # reconcile triggered by a pod/event delta always sees it.
         self.informers: dict = informers or {}
         self.recorder = EventRecorder(client, "notebook-controller")
+        # Bounded shared fan-out for independent secondary writes: the
+        # slice StatefulSets and the Service/headless-Service/PDB/
+        # VirtualService quartet have no ordering dependency on each
+        # other, so they fly concurrently (runtime/flight.py) while
+        # status aggregation still waits on every result.
+        self.flights = shared_pool()
         self.use_istio = (
             use_istio if use_istio is not None else config.env_bool("USE_ISTIO", True)
         )
@@ -208,20 +216,27 @@ class NotebookReconciler(Reconciler):
             }]}
             if notebook.get("status") != status:
                 self.recorder.event(notebook, "Warning", "InvalidNotebook", str(e))
-                notebook = copy.deepcopy(notebook)
-                notebook["status"] = status
-                self.client.update_status(notebook)
+                patch_status_diff(self.client, NOTEBOOK, notebook, status)
             return None
 
         stses = self._reconcile_statefulsets(notebook)
         if stses is None:
             # Parked on a slice-name conflict (terminal until renamed).
             return None
-        self._reconcile_service(notebook)
-        self._reconcile_headless_service(notebook)
-        self._reconcile_pdb(notebook)
+        # The four service-layer secondaries are independent of each other
+        # (and of the already-written StatefulSets): fly them concurrently.
+        # run() waits for ALL and re-raises the first failure AFTER every
+        # sibling settled, so one failed write never hides the others and
+        # the backoff requeue retries the lot (level-triggered).
+        secondary_writes = [
+            lambda: self._reconcile_service(notebook),
+            lambda: self._reconcile_headless_service(notebook),
+            lambda: self._reconcile_pdb(notebook),
+        ]
         if self.use_istio:
-            self._reconcile_virtual_service(notebook)
+            secondary_writes.append(
+                lambda: self._reconcile_virtual_service(notebook))
+        self.flights.run(secondary_writes)
         self._update_status(notebook, stses)
         self._mirror_events(notebook)
         return None
@@ -369,13 +384,15 @@ class NotebookReconciler(Reconciler):
                 "reason": "SliceNameConflict", "message": str(e),
             }]}
             if notebook.get("status") != status:
-                parked = copy.deepcopy(notebook)
-                parked["status"] = status
-                self.client.update_status(parked)
+                patch_status_diff(self.client, NOTEBOOK, notebook, status)
             return None
-        out = [
-            self._reconcile_one_statefulset(notebook, s) for s in range(n_slices)
-        ]
+        # Every slice StatefulSet is independent (distinct names, one
+        # owner): write them concurrently through the bounded pool — a
+        # 4-slice notebook pays one round trip of latency, not four.
+        out = self.flights.run([
+            (lambda s=s: self._reconcile_one_statefulset(notebook, s))
+            for s in range(n_slices)
+        ])
         expected = {self.slice_sts_name(name, s) for s in range(n_slices)}
         # A transient list failure must raise (requeue with backoff) — a
         # silent skip would leave a scaled-down slice's pods holding TPUs
@@ -455,17 +472,24 @@ class NotebookReconciler(Reconciler):
                             != deep_get(desired, "spec", "replicas"))
         current_hash = deep_get(current, "metadata", "annotations", HASH_ANNOTATION)
         if changed_replicas or current_hash != desired_hash:
-            # Intent-to-write: thaw the frozen cache view into a private
-            # mutable copy.  A stale cached resourceVersion turns into a
-            # 409 handled by the normal conflict-requeue path.
-            current = thaw(current)
+            # Diff-and-patch the owned fields only (JSON merge patch): the
+            # frozen cache view is read directly — no thaw, no full-object
+            # PUT, and no resourceVersion precondition, so a stale cache
+            # can no longer turn into a 409 on this path at all.
+            spec_patch: dict = {}
             if changed_replicas:
-                current["spec"]["replicas"] = desired["spec"]["replicas"]
+                spec_patch["replicas"] = deep_get(desired, "spec", "replicas")
             if current_hash != desired_hash:
-                current["spec"]["template"] = desired["spec"]["template"]
-                meta(current).setdefault(
-                    "annotations", {})[HASH_ANNOTATION] = desired_hash
-            return self.client.update(current)
+                template_diff = merge_patch_for(
+                    deep_get(current, "spec", "template", default={}),
+                    desired["spec"]["template"])
+                if template_diff is not None:
+                    spec_patch["template"] = template_diff
+            patch: dict = {
+                "metadata": {"annotations": {HASH_ANNOTATION: desired_hash}}}
+            if spec_patch:
+                patch["spec"] = spec_patch
+            return self.client.patch(STATEFULSET, name, patch, ns)
         return current
 
     # -- services ------------------------------------------------------------
@@ -539,15 +563,19 @@ class NotebookReconciler(Reconciler):
                 current = self.client.get(SERVICE, name, ns)
         if deep_get(current, "metadata", "annotations", HASH_ANNOTATION) == desired_hash:
             return current
-        # Overwrite only controller-owned fields; keep server-populated ones
-        # (clusterIP is immutable — reference CopyServiceFields preserves it).
-        current = thaw(current)
+        # Patch only controller-owned fields; keep server-populated ones
+        # (clusterIP is immutable — reference CopyServiceFields preserves
+        # it, here by folding the live value into the desired spec before
+        # the diff so the patch never touches it).
         want = copy.deepcopy(desired["spec"])
         if "clusterIP" in current.get("spec", {}) and want.get("clusterIP") != "None":
             want["clusterIP"] = current["spec"]["clusterIP"]
-        current["spec"] = want
-        meta(current).setdefault("annotations", {})[HASH_ANNOTATION] = desired_hash
-        return self.client.update(current)
+        patch: dict = {
+            "metadata": {"annotations": {HASH_ANNOTATION: desired_hash}}}
+        spec_diff = merge_patch_for(current.get("spec"), want)
+        if spec_diff is not None:
+            patch["spec"] = spec_diff
+        return self.client.patch(SERVICE, name, patch, ns)
 
     # -- pod disruption budget ----------------------------------------------
 
@@ -596,10 +624,10 @@ class NotebookReconciler(Reconciler):
                 current = self.client.get(PODDISRUPTIONBUDGET, pdb_name, ns)
             else:
                 return
-        if current.get("spec") != desired.get("spec"):
-            current = thaw(current)
-            current["spec"] = desired["spec"]
-            self.client.update(current)
+        spec_diff = merge_patch_for(current.get("spec"), desired.get("spec"))
+        if spec_diff is not None:
+            self.client.patch(PODDISRUPTIONBUDGET, pdb_name,
+                              {"spec": spec_diff}, ns)
 
     # -- istio ---------------------------------------------------------------
 
@@ -650,10 +678,10 @@ class NotebookReconciler(Reconciler):
                 return self.client.create(desired)
             except errors.AlreadyExists:
                 current = self.client.get(VIRTUALSERVICE, name, ns)
-        if current.get("spec") != desired.get("spec"):
-            current = thaw(current)
-            current["spec"] = desired["spec"]
-            return self.client.update(current)
+        spec_diff = merge_patch_for(current.get("spec"), desired.get("spec"))
+        if spec_diff is not None:
+            return self.client.patch(VIRTUALSERVICE, name,
+                                     {"spec": spec_diff}, ns)
         return current
 
     # -- event mirroring -----------------------------------------------------
@@ -748,13 +776,15 @@ class NotebookReconciler(Reconciler):
                 if (prior.get("count", 1), prior.get("lastTimestamp")) != (
                     ev.get("count", 1), last_ts,
                 ):
-                    # Intent-to-write on a cached read: thaw() takes the
-                    # private mutable copy (the read itself was zero-copy).
-                    prior = thaw(prior)
-                    prior["count"] = ev.get("count", 1)
-                    prior["lastTimestamp"] = last_ts
+                    # Count bump on the cached read: a two-field merge
+                    # patch (no thaw, no RV, conflict-free) instead of a
+                    # full-object update of the frozen view.
                     try:
-                        self.client.update(prior)
+                        self.client.patch(
+                            EVENT, name_of(prior),
+                            {"count": ev.get("count", 1),
+                             "lastTimestamp": last_ts},
+                            ns)
                     except errors.ApiError:
                         pass
                 continue
@@ -840,10 +870,12 @@ class NotebookReconciler(Reconciler):
         except errors.ApiError:
             return
         try:
-            prior = copy.deepcopy(self.client.get(EVENT, marker_name, ns))
-            prior["lastTimestamp"] = ts
-            prior["count"] = int(prior.get("count", 1)) + 1
-            self.client.update(prior)
+            prior = self.client.get(EVENT, marker_name, ns)
+            self.client.patch(
+                EVENT, marker_name,
+                {"lastTimestamp": ts,
+                 "count": int(prior.get("count", 1)) + 1},
+                ns)
         except errors.ApiError:
             pass
 
@@ -877,9 +909,11 @@ class NotebookReconciler(Reconciler):
                 elapsed = _seconds_since(created)
                 if elapsed is not None:
                     metrics.notebook_spawn_seconds.observe(elapsed)
-            notebook = copy.deepcopy(notebook)
-            notebook["status"] = status
-            self.client.update_status(notebook)
+            # Diff-and-patch the changed subtree: a readiness tick sends
+            # {"status":{"readyReplicas":N}} instead of the whole object,
+            # and the RV-free merge patch cannot 409 against concurrent
+            # spec writes — the hot-path conflict class under chaos.
+            patch_status_diff(self.client, NOTEBOOK, notebook, status)
 
 
 def _seconds_since(timestamp: Optional[str]) -> Optional[float]:

@@ -432,10 +432,11 @@ class ProfileReconciler(Reconciler):
         self._set_status(profile, {"status": "Failed", "message": message})
 
     def _set_status(self, profile: Resource, status: dict) -> None:
-        if profile.get("status") != status:
-            profile = copy.deepcopy(profile)
-            profile["status"] = status
-            self.client.update_status(profile)
+        # Diff-and-patch the status subresource (runtime/apply.py): only
+        # the changed subtree is written, conflict-free.
+        from kubeflow_tpu.platform.runtime.apply import patch_status_diff
+
+        patch_status_diff(self.client, PROFILE, profile, status)
 
 
 def labels_file_watcher(path: str, *, poll_seconds: float = 1.0):

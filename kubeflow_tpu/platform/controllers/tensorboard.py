@@ -10,7 +10,6 @@ the JAX profiler plugin, so XLA/TPU traces dumped from notebooks
 """
 from __future__ import annotations
 
-import copy
 from typing import Optional
 
 from kubeflow_tpu.platform import config
@@ -205,10 +204,11 @@ class TensorboardReconciler(Reconciler):
         conditions = deep_get(deployment, "status", "conditions", default=[])
         ready = deep_get(deployment, "status", "readyReplicas", default=0)
         status = {"conditions": conditions, "readyReplicas": ready}
-        if tb.get("status") != status:
-            tb = copy.deepcopy(tb)
-            tb["status"] = status
-            self.client.update_status(tb)
+        # Diff-and-patch: only the changed status subtree crosses the wire,
+        # with no resourceVersion to conflict on (runtime/apply.py).
+        from kubeflow_tpu.platform.runtime.apply import patch_status_diff
+
+        patch_status_diff(self.client, TENSORBOARD, tb, status)
 
 
 def make_controller(client, **kwargs):

@@ -81,6 +81,16 @@ class KubeClient(Protocol):
         patch_type: str = "merge",
     ) -> Resource: ...
 
+    def patch_status(
+        self,
+        gvk: GVK,
+        name: str,
+        patch: Any,
+        namespace: Optional[str] = None,
+        *,
+        patch_type: str = "merge",
+    ) -> Resource: ...
+
     def delete(
         self,
         gvk: GVK,
@@ -269,6 +279,7 @@ class RestKubeClient:
         retry_cap: Optional[float] = None,
         breaker_threshold: Optional[int] = None,
         breaker_cooldown: Optional[float] = None,
+        pool_size: Optional[int] = None,
     ):
         import requests
 
@@ -300,6 +311,20 @@ class RestKubeClient:
             burst = int(os.environ.get("K8S_CLIENT_BURST", "100"))
         self._limiter = TokenBucket(qps, burst) if qps > 0 else None
         self._session = requests.Session()
+        # Explicit connection-pool sizing (K8S_CLIENT_POOL_SIZE): requests'
+        # default HTTPAdapter keeps only 10 sockets per host, so a
+        # multi-worker controller fanning secondaries out through the
+        # FlightPool (workers x flights concurrent requests to ONE host —
+        # the apiserver) would serialize on the socket pool right after
+        # the dispatch layer stopped serializing it.  Sized to cover the
+        # worker-count x flight-pool defaults with headroom for watches.
+        if pool_size is None:
+            pool_size = int(os.environ.get("K8S_CLIENT_POOL_SIZE", "32"))
+        self.pool_size = max(1, pool_size)
+        adapter = requests.adapters.HTTPAdapter(
+            pool_connections=self.pool_size, pool_maxsize=self.pool_size)
+        self._session.mount("https://", adapter)
+        self._session.mount("http://", adapter)
         if token:
             self._session.headers["Authorization"] = f"Bearer {token}"
         if client_cert:
@@ -572,6 +597,20 @@ class RestKubeClient:
             params={"_patch_type": patch_type},
             body=patch,
             verb="patch", kind=gvk.kind,
+        ).json()
+
+    def patch_status(self, gvk, name, patch, namespace=None, *,
+                     patch_type="merge") -> Resource:
+        """PATCH on the /status subresource: the status writer's minimal
+        write — a JSON merge patch of just the changed subtree carries no
+        resourceVersion, so it cannot 409 against concurrent spec writes
+        (the conflict class a full update_status pays under churn)."""
+        path = gvk.path(namespace, name) + "/status"
+        return self._request(
+            "PATCH", path,
+            params={"_patch_type": patch_type},
+            body=patch,
+            verb="patch_status", kind=gvk.kind,
         ).json()
 
     def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:

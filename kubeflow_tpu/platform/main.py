@@ -8,6 +8,14 @@ Config comes from the environment (in-cluster service account or
 $KUBECONFIG; see RestKubeClient._resolve_config) and the same knobs the
 reference binaries take (USE_ISTIO, ENABLE_CULLING, CULL_IDLE_TIME,
 USERID_HEADER, ...; SURVEY.md §5 "config/flag system").
+
+Write-path parallelism (docs/performance.md "write-path contract"):
+``CONTROLLER_WORKERS`` (default 4) sets reconcile workers per controller,
+``CONTROLLER_WORKERS_<NAME>`` (e.g. CONTROLLER_WORKERS_NOTEBOOK_CONTROLLER)
+pins one, ``CONTROLLER_FLIGHT_POOL_SIZE`` bounds the shared secondary
+fan-out pool, and ``K8S_CLIENT_POOL_SIZE`` sizes the REST client's
+connection pool so worker x flight parallelism isn't throttled at
+requests' 10-socket default.
 """
 from __future__ import annotations
 
@@ -123,7 +131,16 @@ def run_controllers(args) -> int:
             client, notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
     mgr.start()
     _serve_health(mgr, args.health_port, client=client)
-    logging.info("controllers running (health on :%d)", args.health_port)
+    from kubeflow_tpu.platform.runtime.flight import shared_pool
+
+    logging.info(
+        "controllers running (health on :%d; workers: %s; "
+        "flight pool %d; client pool %d)",
+        args.health_port,
+        ", ".join(f"{c.name}={c.workers}" for c in mgr.controllers),
+        shared_pool().size,
+        getattr(client, "pool_size", 0),
+    )
     _wait_for_term()
     mgr.stop()
     return 0

@@ -5,6 +5,8 @@ from kubeflow_tpu.platform.runtime.controller import (
     Request,
     Result,
 )
-from kubeflow_tpu.platform.runtime.events import EventRecorder
+from kubeflow_tpu.platform.runtime.events import EventCorrelator, EventRecorder
+from kubeflow_tpu.platform.runtime.flight import FlightPool
 
-__all__ = ["Controller", "Manager", "Reconciler", "Request", "Result", "EventRecorder"]
+__all__ = ["Controller", "Manager", "Reconciler", "Request", "Result",
+           "EventRecorder", "EventCorrelator", "FlightPool"]

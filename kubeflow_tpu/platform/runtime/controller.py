@@ -37,6 +37,25 @@ DEFAULT_MAX_RETRIES = config.env_int("CONTROLLER_MAX_RETRIES", 15)
 # seconds increments reconcile_stuck_total and dumps its (in-progress)
 # trace as one JSON log line.  0 disables the watchdog thread.
 DEFAULT_STUCK_SECONDS = config.env_float("CONTROLLER_STUCK_SECONDS", 300.0)
+# Parallel dispatch: reconcile workers per controller.  Multi-worker is
+# the DEFAULT (controller-runtime's MaxConcurrentReconciles shape) — the
+# workqueue's per-key mutual exclusion makes any worker count safe
+# (tests/ctrlplane/test_race_stress.py pins it under fire), so a wave of
+# distinct keys converges in parallel instead of single-file.  Tune the
+# fleet with CONTROLLER_WORKERS; pin one controller with
+# CONTROLLER_WORKERS_<NAME> (name upper-cased, dashes to underscores,
+# e.g. CONTROLLER_WORKERS_NOTEBOOK_CONTROLLER=8).
+DEFAULT_WORKERS = 4
+
+
+def worker_count(name: str) -> int:
+    """Resolve the worker count for controller ``name`` from the
+    environment (per-controller override, then the fleet default)."""
+    per = config.env_int(
+        "CONTROLLER_WORKERS_" + name.upper().replace("-", "_"), 0)
+    if per > 0:
+        return per
+    return max(1, config.env_int("CONTROLLER_WORKERS", DEFAULT_WORKERS))
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -226,7 +245,7 @@ class Controller:
         watches: Optional[List[Tuple[GVK, EventMapper]]] = None,
         namespace: Optional[str] = None,
         resync_period: Optional[float] = None,
-        workers: int = 1,
+        workers: Optional[int] = None,
         runnables: Optional[List[Callable[["Controller"], None]]] = None,
         informers: Optional[dict] = None,
         shared_informers: Optional[dict] = None,
@@ -242,7 +261,9 @@ class Controller:
         self.watches = watches or []
         self.namespace = namespace
         self.resync_period = resync_period
-        self.workers = workers
+        # None -> env-resolved (CONTROLLER_WORKERS / per-controller
+        # override) at construction time, so tests can monkeypatch env.
+        self.workers = workers if workers is not None else worker_count(name)
         # GVK -> Informer: a watched kind with an informer here is sourced
         # from the informer's delta stream instead of a raw client watch,
         # and the cache is updated BEFORE the mapper enqueues — so a
@@ -293,6 +314,13 @@ class Controller:
         self._inflight: Dict[Request, list] = {}
         self._inflight_lock = threading.Lock()
         self._client = None  # set by start(); dead-letter writes need it
+        self._recorder = None  # lazy EventRecorder (shared correlator)
+
+    def busy_workers(self) -> int:
+        """Reconciles in flight right now — the worker-utilization gauge
+        (controller_workers_busy / controller_workers at scrape time)."""
+        with self._inflight_lock:
+            return len(self._inflight)
 
     # -- event plumbing ------------------------------------------------------
 
@@ -532,8 +560,17 @@ class Controller:
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             })
         try:
-            obj.setdefault("status", {})["conditions"] = conditions
-            client.update_status(obj)
+            # Conditions-only merge patch on the status subresource (lists
+            # replace wholesale under RFC 7386): no resourceVersion, so
+            # the write can't 409 against whatever broke the reconcile.
+            patcher = getattr(client, "patch_status", None)
+            if patcher is not None:
+                patcher(self.primary, req.name,
+                        {"status": {"conditions": conditions}},
+                        req.namespace or None)
+            else:
+                obj.setdefault("status", {})["conditions"] = conditions
+                client.update_status(obj)
         except Exception:
             log.debug("%s: could not write ReconcileFailed condition for "
                       "%s/%s", self.name, req.namespace, req.name,
@@ -542,7 +579,13 @@ class Controller:
             try:
                 from kubeflow_tpu.platform.runtime.events import EventRecorder
 
-                EventRecorder(client, self.name).event(
+                if self._recorder is None:
+                    # One recorder for the controller's lifetime: its
+                    # EventCorrelator turns repeat dead-letters into
+                    # count-increment patches (or token-bucket drops)
+                    # instead of a fresh Event per park.
+                    self._recorder = EventRecorder(client, self.name)
+                self._recorder.event(
                     obj, "Warning", "ReconcileFailed",
                     f"reconcile gave up after max retries: {message}")
             except Exception:
@@ -593,7 +636,12 @@ class Controller:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, client) -> None:
+        from kubeflow_tpu.platform.runtime import metrics
+
         self._client = client
+        # Worker-utilization gauges (controller_workers{,_busy}) read this
+        # controller at scrape time; stop() deregisters.
+        metrics.register_controller(self)
         if self._on_start is not None:
             self._on_start()
         pairs: List[Tuple[GVK, EventMapper]] = [(self.primary, self._primary_mapper)]
@@ -669,8 +717,11 @@ class Controller:
             self._threads.append(t)
 
     def stop(self) -> None:
+        from kubeflow_tpu.platform.runtime import metrics
+
         self._stop.set()
         self.queue.shut_down()
+        metrics.deregister_controller(self)
         for informer in self._owned_informers.values():
             informer.stop()
         if self._on_stop is not None:

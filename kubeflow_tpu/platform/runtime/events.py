@@ -3,19 +3,136 @@
 The reference re-emits pod/statefulset events onto the Notebook CR so users
 see scheduling failures in the UI (reference notebook_controller.go:94-118);
 this recorder is the write side of that pattern.
+
+Write-coalescing (client-go EventCorrelator parity): a recorder used to
+CREATE a brand-new Event object for every call, so a hot failure path
+(dead-letter retries, chaos storms, a crash-looping pod) write-stormed
+the apiserver with near-identical objects.  Each recorder now routes
+every call through an :class:`EventCorrelator`:
+
+* **aggregation** — calls with the same correlation key (namespace,
+  involved object, type, reason, component; message deliberately
+  excluded, like client-go's aggregator key) PATCH the existing Event's
+  ``count``/``lastTimestamp``/``message`` instead of creating a sibling;
+* **spam filtering** — a per-key token bucket (burst
+  ``EVENT_CORRELATOR_BURST``, refill ``EVENT_CORRELATOR_REFILL_QPS``
+  tokens/sec — client-go's 25-burst / 1-per-5-min defaults) DROPS floods
+  beyond the budget; the drop is counted
+  (``event_recorder_events_total{action="drop"}``) but costs zero API
+  calls, which is the entire point.
+
+Correlation state is per-recorder memory (bounded LRU); a restarted
+controller starts a fresh Event per key, exactly like a restarted
+client-go broadcaster.
 """
 from __future__ import annotations
 
+import collections
+import threading
 import time
-from typing import Optional
+from typing import Optional, Tuple
 
-from kubeflow_tpu.platform.k8s.types import EVENT, Resource, api_version_of, meta, name_of, namespace_of
+from kubeflow_tpu.platform import config
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    EVENT,
+    Resource,
+    api_version_of,
+    meta,
+    name_of,
+    namespace_of,
+)
+
+DEFAULT_SPAM_BURST = 25
+DEFAULT_SPAM_REFILL_QPS = 1.0 / 300.0  # one replenished event per 5 min
+MAX_CORRELATION_KEYS = 4096
+
+
+class _Record:
+    """Per-key correlation state: the live Event's name, the local count,
+    and the spam-filter token bucket."""
+
+    __slots__ = ("event_name", "count", "tokens", "last_refill")
+
+    def __init__(self, burst: float, now: float):
+        self.event_name: Optional[str] = None
+        self.count = 0
+        self.tokens = burst
+        self.last_refill = now
+
+
+class EventCorrelator:
+    """Decide, per recorded event, whether to create, patch, or drop.
+
+    ``observe(key)`` returns ``("create", None)``, ``("patch", record)``
+    or ``("drop", None)``; the caller reports the created Event's name
+    back through ``created(key, name)`` so later calls can patch it.
+    Thread-safe; the key cache is a bounded LRU."""
+
+    def __init__(self, *, spam_burst: Optional[int] = None,
+                 spam_refill_qps: Optional[float] = None,
+                 max_keys: int = MAX_CORRELATION_KEYS,
+                 now=time.monotonic):
+        self.spam_burst = float(
+            spam_burst if spam_burst is not None
+            else config.env_int("EVENT_CORRELATOR_BURST", DEFAULT_SPAM_BURST))
+        self.spam_refill_qps = (
+            spam_refill_qps if spam_refill_qps is not None
+            else config.env_float("EVENT_CORRELATOR_REFILL_QPS",
+                                  DEFAULT_SPAM_REFILL_QPS))
+        self.max_keys = max_keys
+        self._now = now
+        self._lock = threading.Lock()
+        self._records: "collections.OrderedDict[Tuple, _Record]" = (
+            collections.OrderedDict())
+
+    def observe(self, key: Tuple) -> Tuple[str, Optional[_Record]]:
+        with self._lock:
+            now = self._now()
+            rec = self._records.get(key)
+            if rec is None:
+                rec = _Record(self.spam_burst, now)
+                self._records[key] = rec
+                while len(self._records) > self.max_keys:
+                    self._records.popitem(last=False)
+            else:
+                self._records.move_to_end(key)
+            # Token-bucket refill since the last look at this key.
+            rec.tokens = min(
+                self.spam_burst,
+                rec.tokens + (now - rec.last_refill) * self.spam_refill_qps)
+            rec.last_refill = now
+            if rec.tokens < 1.0:
+                return "drop", None
+            rec.tokens -= 1.0
+            rec.count += 1
+            if rec.event_name is None:
+                return "create", rec
+            return "patch", rec
+
+    def created(self, key: Tuple, event_name: str) -> None:
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is not None:
+                rec.event_name = event_name
+
+    def reset(self, key: Tuple) -> None:
+        """The key's Event vanished server-side: keep the record (and its
+        token bucket) but detach the Event name and restart the count, so
+        the caller's fall-through create starts a fresh series."""
+        with self._lock:
+            rec = self._records.get(key)
+            if rec is not None:
+                rec.event_name = None
+                rec.count = 1
 
 
 class EventRecorder:
-    def __init__(self, client, component: str):
+    def __init__(self, client, component: str, *,
+                 correlator: Optional[EventCorrelator] = None):
         self.client = client
         self.component = component
+        self.correlator = correlator or EventCorrelator()
 
     def event(
         self,
@@ -25,9 +142,30 @@ class EventRecorder:
         message: str,
         *,
         namespace: Optional[str] = None,
-    ) -> Resource:
+    ) -> Optional[Resource]:
+        """Record one event; returns the created/patched Event, or None
+        when the spam filter dropped it."""
+        from kubeflow_tpu.platform.runtime import metrics
+
         ns = namespace or namespace_of(obj) or "default"
+        # uid in the key (client-go aggregator parity): a deleted-and-
+        # recreated same-name object must start its own Event series, not
+        # patch counts onto the predecessor's uid-bound Event.
+        key = (ns, obj.get("kind", ""), name_of(obj),
+               meta(obj).get("uid", ""), event_type, reason, self.component)
+        action, rec = self.correlator.observe(key)
+        if action == "drop":
+            metrics.event_recorder_events_total.labels(action="drop").inc()
+            return None
         ts = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        if action == "patch":
+            patched = self._patch(key, rec, message, ts, ns)
+            if patched is not None:
+                metrics.event_recorder_events_total.labels(
+                    action="patch").inc()
+                return patched
+            # The prior Event is gone (aged out of etcd / deleted): fall
+            # through to a fresh create with the surviving local count.
         ev = {
             "apiVersion": "v1",
             "kind": "Event",
@@ -48,6 +186,26 @@ class EventRecorder:
             "source": {"component": self.component},
             "firstTimestamp": ts,
             "lastTimestamp": ts,
-            "count": 1,
+            "count": rec.count if rec is not None else 1,
         }
-        return self.client.create(ev)
+        created = self.client.create(ev)
+        self.correlator.created(key, name_of(created))
+        metrics.event_recorder_events_total.labels(action="create").inc()
+        return created
+
+    def _patch(self, key, rec: _Record, message: str, ts: str,
+               ns: str) -> Optional[Resource]:
+        """Count-increment PATCH of the existing Event (client-go
+        recordToSink's eventObserve path): a JSON merge patch of count +
+        lastTimestamp + message — no resourceVersion, so it can never 409
+        under churn.  NotFound resets the key for a fresh create."""
+        try:
+            return self.client.patch(
+                EVENT, rec.event_name,
+                {"count": rec.count, "lastTimestamp": ts,
+                 "message": message},
+                ns,
+            )
+        except errors.NotFound:
+            self.correlator.reset(key)
+            return None

@@ -361,6 +361,19 @@ degraded_responses_total = Counter(
     "the live apiserver read failed transiently (degraded: true)",
     ["component"], registry=registry,
 )
+flight_pool_flights_total = Counter(
+    "flight_pool_flights_total",
+    "Secondary writes fanned out through a FlightPool (one per submitted "
+    "call; inline short-circuits are not counted)",
+    ["pool"], registry=registry,
+)
+event_recorder_events_total = Counter(
+    "event_recorder_events_total",
+    "EventRecorder outcomes after correlation: create (novel key), patch "
+    "(count-increment of the existing Event), drop (spam-filter token "
+    "bucket exhausted — zero API calls)",
+    ["action"], registry=registry,
+)
 informer_watch_restarts_total = Counter(
     "informer_watch_restarts_total",
     "Informer watch stream failures/expiries that forced a re-establish",
@@ -396,6 +409,22 @@ def deregister_informer(informer) -> None:
         _informers.pop(id(informer), None)
 
 
+# id(controller) -> weakref, for the scrape-time worker-utilization gauges
+# (controller_workers / controller_workers_busy).  Same lifecycle contract
+# as the informer registry: Controller.start registers, stop deregisters.
+_controllers: Dict[int, object] = {}
+
+
+def register_controller(controller) -> None:
+    with _wq_lock:
+        _controllers[id(controller)] = weakref.ref(controller)
+
+
+def deregister_controller(controller) -> None:
+    with _wq_lock:
+        _controllers.pop(id(controller), None)
+
+
 class _RuntimeStateCollector:
     """Scrape-time gauges over live runtime objects: workqueue depth and
     unfinished-work seconds per queue, last-sync age per informer.  One
@@ -418,9 +447,22 @@ class _RuntimeStateCollector:
             "Seconds since the informer last completed a full relist",
             labels=["kind"],
         )
+        workers = GaugeMetricFamily(
+            "controller_workers",
+            "Configured reconcile worker count per controller "
+            "(CONTROLLER_WORKERS and per-controller overrides)",
+            labels=["controller"],
+        )
+        workers_busy = GaugeMetricFamily(
+            "controller_workers_busy",
+            "Workers with a reconcile in flight right now — utilization is "
+            "busy/workers",
+            labels=["controller"],
+        )
         with _wq_lock:
             shims = dict(_wq_shims)
             informers = dict(_informers)
+            controllers = dict(_controllers)
         for name, shim in sorted(shims.items()):
             d = shim.depth()
             if d is None:  # queue was garbage collected
@@ -451,9 +493,20 @@ class _RuntimeStateCollector:
                     ages[kind] = age
         for kind, age in sorted(ages.items()):
             sync_age.add_metric([kind], age)
+        for key, ref in controllers.items():
+            ctrl = ref()
+            if ctrl is None:
+                with _wq_lock:
+                    if _controllers.get(key) is ref:
+                        del _controllers[key]
+                continue
+            workers.add_metric([ctrl.name], ctrl.workers)
+            workers_busy.add_metric([ctrl.name], ctrl.busy_workers())
         yield depth
         yield unfinished
         yield sync_age
+        yield workers
+        yield workers_busy
 
 
 registry.register(_RuntimeStateCollector())

@@ -60,8 +60,8 @@ class Fault:
     ``rate``: probability per eligible call (or per delivered watch event
     for drop/drop_error).
     ``verbs`` / ``kinds``: restrict to these client verbs (get/list/
-    create/update/update_status/patch/delete/watch/logs/can_i) / resource
-    kinds; None = all.
+    create/update/update_status/patch/patch_status/delete/watch/logs/
+    can_i) / resource kinds; None = all.
     ``retry_after``: seconds advertised on an injected 429/503.
     ``latency_s``: sleep for "latency" faults.
     ``max_injections``: stop firing after N hits (None = unlimited) —
@@ -84,7 +84,8 @@ def storm(*, rate: float = 0.05, seed_latency: float = 0.002,
     failure class at ``rate``, writes additionally conflicting, watches
     dropping mid-stream.  Kept here so the tier-1 smoke, the slow soak
     and bench_scale's chaos band all storm the same way."""
-    writes = frozenset({"create", "update", "update_status", "patch"})
+    writes = frozenset({"create", "update", "update_status", "patch",
+                        "patch_status"})
     return [
         Fault("429", rate, retry_after=retry_after,
               max_injections=max_injections),
@@ -118,6 +119,9 @@ class ChaosKube:
         self.fault_log: List[Tuple[str, str, str]] = []
         # verb -> call count (faulted calls included).
         self.calls: Dict[str, int] = {}
+        # (verb, kind) -> call count — the write-path A/B assertions
+        # ("fewer Event creates than the pre-patch path") read this.
+        self.calls_by_kind: Dict[Tuple[str, str], int] = {}
         # Establishment kwargs per watch() call, for resume assertions.
         self.watch_establishments: List[dict] = []
         self._injections: Dict[int, int] = {}  # fault index -> times fired
@@ -139,9 +143,11 @@ class ChaosKube:
 
     # -- schedule ------------------------------------------------------------
 
-    def _record(self, verb: str) -> None:
+    def _record(self, verb: str, kind: str = "") -> None:
         with self._lock:
             self.calls[verb] = self.calls.get(verb, 0) + 1
+            key = (verb, kind)
+            self.calls_by_kind[key] = self.calls_by_kind.get(key, 0) + 1
 
     def _pick(self, verb: str, kind: str, *, stream: bool = False
               ) -> Optional[Fault]:
@@ -203,69 +209,76 @@ class ChaosKube:
 
     def get(self, gvk: GVK, name: str, namespace: Optional[str] = None
             ) -> Resource:
-        self._record("get")
+        self._record("get", gvk.kind)
         self._inject("get", gvk.kind)
         return self.inner.get(gvk, name, namespace)
 
     def list(self, gvk, namespace=None, *, label_selector=None,
              field_selector=None) -> List[Resource]:
-        self._record("list")
+        self._record("list", gvk.kind)
         self._inject("list", gvk.kind)
         return self.inner.list(gvk, namespace, label_selector=label_selector,
                                field_selector=field_selector)
 
     def list_with_rv(self, gvk, namespace=None):
-        self._record("list")
+        self._record("list", gvk.kind)
         self._inject("list", gvk.kind)
         if hasattr(self.inner, "list_with_rv"):
             return self.inner.list_with_rv(gvk, namespace)
         return self.inner.list(gvk, namespace), None
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
-        self._record("create")
+        self._record("create", gvk_of(obj).kind)
         self._inject("create", gvk_of(obj).kind)
         return self.inner.create(obj, dry_run=dry_run)
 
     def update(self, obj: Resource) -> Resource:
-        self._record("update")
+        self._record("update", gvk_of(obj).kind)
         self._inject("update", gvk_of(obj).kind)
         return self.inner.update(obj)
 
     def update_status(self, obj: Resource) -> Resource:
-        self._record("update_status")
+        self._record("update_status", gvk_of(obj).kind)
         self._inject("update_status", gvk_of(obj).kind)
         return self.inner.update_status(obj)
 
     def patch(self, gvk, name, patch, namespace=None, *,
               patch_type: str = "merge") -> Resource:
-        self._record("patch")
+        self._record("patch", gvk.kind)
         self._inject("patch", gvk.kind)
         return self.inner.patch(gvk, name, patch, namespace,
                                 patch_type=patch_type)
 
+    def patch_status(self, gvk, name, patch, namespace=None, *,
+                     patch_type: str = "merge") -> Resource:
+        self._record("patch_status", gvk.kind)
+        self._inject("patch_status", gvk.kind)
+        return self.inner.patch_status(gvk, name, patch, namespace,
+                                       patch_type=patch_type)
+
     def delete(self, gvk, name, namespace=None, *,
                propagation: str = "Background") -> None:
-        self._record("delete")
+        self._record("delete", gvk.kind)
         self._inject("delete", gvk.kind)
         return self.inner.delete(gvk, name, namespace,
                                  propagation=propagation)
 
     def can_i(self, user, verb, gvk, namespace=None, *, groups=None,
               subresource: str = "") -> bool:
-        self._record("can_i")
+        self._record("can_i", gvk.kind)
         self._inject("can_i", gvk.kind)
         return self.inner.can_i(user, verb, gvk, namespace,
                                 groups=groups, subresource=subresource)
 
     def pod_logs(self, name, namespace, *, container=None) -> str:
-        self._record("logs")
+        self._record("logs", "Pod")
         self._inject("logs", "Pod")
         return self.inner.pod_logs(name, namespace, container=container)
 
     def watch(self, gvk, namespace=None, *, resource_version=None,
               label_selector=None, stop: Optional[threading.Event] = None
               ) -> Iterator[Tuple[str, Resource]]:
-        self._record("watch")
+        self._record("watch", gvk.kind)
         with self._lock:
             self.watch_establishments.append({
                 "kind": gvk.kind, "namespace": namespace,

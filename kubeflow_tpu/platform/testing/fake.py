@@ -293,26 +293,7 @@ class FakeKube:
             # untouched.
             rollback = _copy_obj(current) \
                 if gvk.kind in ("ResourceQuota", "Pod") else None
-            if patch_type == "merge" or patch_type == "strategic":
-                from kubeflow_tpu.platform import native
-
-                # loaded(), not available(): the first available() call may
-                # BUILD the library (~2 min) — never under the store lock.
-                # Parity between the engines is pinned by test_native.py.
-                if native.loaded():
-                    merged = native.merge_patch_apply(current, patch)
-                    current.clear()
-                    current.update(merged)
-                else:
-                    _merge_patch(current, patch)
-            elif patch_type == "json":
-                from kubeflow_tpu.platform.webhook.jsonpatch import apply_patch
-
-                patched = apply_patch(_copy_obj(current), patch)
-                current.clear()
-                current.update(patched)
-            else:
-                raise errors.BadRequest(f"unsupported patch type {patch_type}")
+            self._apply_patch(current, patch, patch_type)
             if rollback is not None:
                 try:
                     if gvk.kind == "ResourceQuota":
@@ -338,6 +319,53 @@ class FakeKube:
             self._emit("MODIFIED", current)
             if gvk.kind in ("Pod", "ResourceQuota"):
                 self._requota(namespace)
+            return _copy_obj(current)
+
+    @staticmethod
+    def _apply_patch(current: Resource, patch, patch_type: str) -> None:
+        """Apply one patch flavor to ``current`` in place (shared by patch
+        and patch_status)."""
+        if patch_type == "merge" or patch_type == "strategic":
+            from kubeflow_tpu.platform import native
+
+            # loaded(), not available(): the first available() call may
+            # BUILD the library (~2 min) — never under the store lock.
+            # Parity between the engines is pinned by test_native.py.
+            if native.loaded():
+                merged = native.merge_patch_apply(current, patch)
+                current.clear()
+                current.update(merged)
+            else:
+                _merge_patch(current, patch)
+        elif patch_type == "json":
+            from kubeflow_tpu.platform.webhook.jsonpatch import apply_patch
+
+            patched = apply_patch(_copy_obj(current), patch)
+            current.clear()
+            current.update(patched)
+        else:
+            raise errors.BadRequest(f"unsupported patch type {patch_type}")
+
+    def patch_status(self, gvk, name, patch, namespace=None, *,
+                     patch_type="merge") -> Resource:
+        """PATCH on the /status subresource: only the status stanza of the
+        patched result persists — spec/metadata edits smuggled into a
+        status patch are discarded (the apiserver's subresource isolation,
+        mirroring how update_status keeps spec)."""
+        with self._lock:
+            patch = _copy_obj(patch)
+            current = self._get_ref(gvk, name, namespace)
+            staging = _copy_obj(current)
+            self._apply_patch(staging, patch, patch_type)
+            if "status" in staging:
+                current["status"] = staging["status"]
+            else:
+                current.pop("status", None)
+            self._bump(current)
+            self._emit("MODIFIED", current)
+            if gvk.kind == "Pod":
+                # Terminal phases (Succeeded/Failed) release quota.
+                self._requota(namespace_of(current))
             return _copy_obj(current)
 
     def delete(self, gvk, name, namespace=None, *, propagation="Background") -> None:

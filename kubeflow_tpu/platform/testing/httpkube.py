@@ -172,9 +172,16 @@ class HttpKube:
             )
             if ptype is None:
                 raise errors.BadRequest("unsupported patch content type")
-            out = self.kube.patch(
-                gvk, name, self._body(environ), namespace, patch_type=ptype
-            )
+            if sub == "status":
+                out = self.kube.patch_status(
+                    gvk, name, self._body(environ), namespace,
+                    patch_type=ptype,
+                )
+            else:
+                out = self.kube.patch(
+                    gvk, name, self._body(environ), namespace,
+                    patch_type=ptype,
+                )
             return self._json(start_response, out)
         if method == "DELETE":
             body = self._body(environ, optional=True) or {}

@@ -1,0 +1,153 @@
+"""EventRecorder correlation tests (runtime/events.py): same-reason
+recurrence patches count on the existing Event, novel reasons create,
+the spam-filter token bucket drops floods, and a vanished Event falls
+back to a fresh create.
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.platform.k8s.types import EVENT
+from kubeflow_tpu.platform.runtime.events import EventCorrelator, EventRecorder
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def _notebook(name="nb", ns="ns"):
+    return {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns, "uid": "u1"},
+    }
+
+
+def _recorder(kube, **correlator_kwargs):
+    return EventRecorder(
+        kube, "test-component",
+        correlator=EventCorrelator(**correlator_kwargs))
+
+
+def test_same_reason_increments_count_via_patch_not_create(monkeypatch):
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube)
+    nb = _notebook()
+
+    first = rec.event(nb, "Warning", "ReconcileFailed", "boom 1")
+    assert first["count"] == 1
+    patches = []
+    real_patch = kube.patch
+    monkeypatch.setattr(
+        kube, "patch",
+        lambda *a, **k: patches.append(a) or real_patch(*a, **k))
+    second = rec.event(nb, "Warning", "ReconcileFailed", "boom 2")
+    third = rec.event(nb, "Warning", "ReconcileFailed", "boom 3")
+    # One Event object total, count-incremented in place by PATCH.
+    events = [e for e in kube.list(EVENT, "ns")
+              if e.get("reason") == "ReconcileFailed"]
+    assert len(events) == 1
+    assert events[0]["count"] == 3
+    assert events[0]["message"] == "boom 3"
+    assert len(patches) == 2
+    assert second["count"] == 2 and third["count"] == 3
+
+
+def test_novel_reason_creates_a_fresh_event():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube)
+    nb = _notebook()
+    rec.event(nb, "Warning", "ReconcileFailed", "boom")
+    rec.event(nb, "Normal", "CreatedStatefulSet", "ok")
+    reasons = {e.get("reason") for e in kube.list(EVENT, "ns")}
+    assert reasons == {"ReconcileFailed", "CreatedStatefulSet"}
+
+
+def test_distinct_objects_do_not_correlate():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube)
+    rec.event(_notebook("nb-a"), "Warning", "Fail", "x")
+    rec.event(_notebook("nb-b"), "Warning", "Fail", "x")
+    assert len(kube.list(EVENT, "ns")) == 2
+
+
+def test_token_bucket_drops_floods():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube, spam_burst=3, spam_refill_qps=0.0)
+    nb = _notebook()
+    results = [rec.event(nb, "Warning", "Flood", f"m{i}") for i in range(10)]
+    # First `burst` calls land (1 create + 2 patches); the rest drop with
+    # ZERO extra API traffic.
+    assert [r is not None for r in results] == [True] * 3 + [False] * 7
+    events = [e for e in kube.list(EVENT, "ns") if e.get("reason") == "Flood"]
+    assert len(events) == 1
+    assert events[0]["count"] == 3
+
+
+def test_token_bucket_refills_over_time():
+    now = [0.0]
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = EventRecorder(
+        kube, "c",
+        correlator=EventCorrelator(spam_burst=1, spam_refill_qps=1.0,
+                                   now=lambda: now[0]))
+    nb = _notebook()
+    assert rec.event(nb, "Warning", "R", "a") is not None
+    assert rec.event(nb, "Warning", "R", "b") is None  # bucket empty
+    now[0] = 1.5  # refill one token
+    assert rec.event(nb, "Warning", "R", "c") is not None
+
+
+def test_vanished_event_falls_back_to_fresh_create():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube)
+    nb = _notebook()
+    first = rec.event(nb, "Warning", "Gone", "x")
+    kube.delete(EVENT, first["metadata"]["name"], "ns")
+    second = rec.event(nb, "Warning", "Gone", "y")
+    assert second is not None
+    assert second["count"] == 1  # fresh series, not a resurrected count
+    assert second["metadata"]["name"] != first["metadata"]["name"]
+    # And the NEW event is patchable again.
+    third = rec.event(nb, "Warning", "Gone", "z")
+    assert third["count"] == 2
+
+
+def test_recorder_metrics_actions(monkeypatch):
+    from kubeflow_tpu.platform.runtime import metrics
+
+    def counter_value(action):
+        return metrics.event_recorder_events_total.labels(
+            action=action)._value.get()
+
+    base = {a: counter_value(a) for a in ("create", "patch", "drop")}
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube, spam_burst=2, spam_refill_qps=0.0)
+    nb = _notebook()
+    rec.event(nb, "Warning", "M", "1")   # create
+    rec.event(nb, "Warning", "M", "2")   # patch
+    rec.event(nb, "Warning", "M", "3")   # drop
+    assert counter_value("create") - base["create"] == 1
+    assert counter_value("patch") - base["patch"] == 1
+    assert counter_value("drop") - base["drop"] == 1
+
+
+def test_recreated_object_starts_a_fresh_event_series():
+    """A deleted-and-recreated same-name object (new uid) must not patch
+    counts onto the predecessor's uid-bound Event (client-go aggregator
+    key parity)."""
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    rec = _recorder(kube)
+    old = dict(_notebook("nb"))
+    rec.event(old, "Warning", "Fail", "x")
+    new = {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns", "uid": "u2"},
+    }
+    ev = rec.event(new, "Warning", "Fail", "x")
+    assert ev["count"] == 1
+    events = [e for e in kube.list(EVENT, "ns") if e.get("reason") == "Fail"]
+    assert len(events) == 2
+    assert {e["involvedObject"]["uid"] for e in events} == {"u1", "u2"}

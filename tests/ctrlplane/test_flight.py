@@ -1,0 +1,142 @@
+"""FlightPool contract tests (runtime/flight.py): submission-order
+results, per-slot error propagation, inline nesting, bounded concurrency.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.runtime.flight import FlightPool, shared_pool
+
+
+def test_results_come_back_in_submission_order():
+    pool = FlightPool(4)
+    # Slower earlier slots: completion order is the REVERSE of submission
+    # order, so any completion-ordered implementation fails this.
+    delays = [0.05, 0.03, 0.01, 0.0]
+
+    def make(i):
+        def fn():
+            time.sleep(delays[i])
+            return i
+        return fn
+
+    assert pool.run([make(i) for i in range(4)]) == [0, 1, 2, 3]
+
+
+def test_errors_propagate_per_slot():
+    pool = FlightPool(4)
+
+    def boom_a():
+        raise ValueError("a")
+
+    def boom_b():
+        raise KeyError("b")
+
+    settled = pool.run([lambda: "ok0", boom_a, lambda: "ok2", boom_b],
+                       return_exceptions=True)
+    assert settled[0] == "ok0" and settled[2] == "ok2"
+    assert isinstance(settled[1], ValueError)
+    assert isinstance(settled[3], KeyError)
+
+
+def test_default_raises_first_error_after_all_slots_settle():
+    pool = FlightPool(4)
+    ran = []
+
+    def boom():
+        raise RuntimeError("first")
+
+    def late_ok():
+        time.sleep(0.02)
+        ran.append("late")
+        return "late"
+
+    # The error slot finishes long before the slow sibling; run() must
+    # still wait for the sibling (no partial fan-out) and then raise the
+    # first-by-submission-order error.
+    with pytest.raises(RuntimeError, match="first"):
+        pool.run([boom, late_ok])
+    assert ran == ["late"]
+
+
+def test_nested_fanout_runs_inline_no_deadlock():
+    # size=2 with 2 outer calls that each fan out 2 inner calls: if the
+    # inner run() queued behind its own parents the pool would deadlock.
+    pool = FlightPool(2)
+
+    def outer(tag):
+        def fn():
+            return pool.run([lambda: f"{tag}-0", lambda: f"{tag}-1"])
+        return fn
+
+    out = pool.run([outer("a"), outer("b")])
+    assert out == [["a-0", "a-1"], ["b-0", "b-1"]]
+
+
+def test_concurrency_is_bounded_by_size():
+    pool = FlightPool(2)
+    lock = threading.Lock()
+    state = {"now": 0, "peak": 0}
+
+    def fn():
+        with lock:
+            state["now"] += 1
+            state["peak"] = max(state["peak"], state["now"])
+        time.sleep(0.01)
+        with lock:
+            state["now"] -= 1
+
+    pool.run([fn] * 8)
+    assert state["peak"] <= 2
+
+
+def test_size_one_runs_inline():
+    pool = FlightPool(1)
+    tids = []
+
+    def fn():
+        tids.append(threading.get_ident())
+
+    pool.run([fn, fn, fn])
+    assert set(tids) == {threading.get_ident()}
+
+
+def test_empty_and_single_call():
+    pool = FlightPool(4)
+    assert pool.run([]) == []
+    assert pool.run([lambda: 7]) == [7]
+
+
+def test_inline_path_settles_all_slots_before_raising():
+    """size=1 (the determinism knob) must keep the pooled error contract:
+    every call still runs, then the first error re-raises — a failed
+    sibling never hides the others' writes at any pool size."""
+    pool = FlightPool(1)
+    ran = []
+
+    def boom():
+        raise RuntimeError("first")
+
+    with pytest.raises(RuntimeError, match="first"):
+        pool.run([boom, lambda: ran.append("late")])
+    assert ran == ["late"]
+
+
+def test_shared_pool_is_a_singleton():
+    assert shared_pool() is shared_pool()
+    assert shared_pool().size >= 1
+
+
+def test_shared_pool_follows_env_changes(monkeypatch):
+    """The monkeypatch-then-construct recipe must actually take effect:
+    a changed CONTROLLER_FLIGHT_POOL_SIZE yields a fresh singleton."""
+    before = shared_pool()
+    monkeypatch.setenv("CONTROLLER_FLIGHT_POOL_SIZE", "1")
+    one = shared_pool()
+    assert one.size == 1 and one is not before
+    assert shared_pool() is one  # stable while the env holds
+    monkeypatch.delenv("CONTROLLER_FLIGHT_POOL_SIZE")
+    assert shared_pool().size == before.size

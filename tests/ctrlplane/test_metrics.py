@@ -298,3 +298,74 @@ def test_workqueue_shutdown_drops_adds_without_counting(engine, make):
     assert _sample("workqueue_retries_total", {"name": name}) == 0
     with shim._lock:
         assert not shim._queued_at  # no orphaned timing state
+
+
+def test_worker_count_env_resolution(monkeypatch):
+    """Parallel dispatch wiring: CONTROLLER_WORKERS sets the fleet
+    default, CONTROLLER_WORKERS_<NAME> pins one controller, and an
+    explicit workers= argument beats both."""
+    from kubeflow_tpu.platform.runtime.controller import (
+        Controller,
+        DEFAULT_WORKERS,
+        Reconciler,
+        worker_count,
+    )
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+
+    monkeypatch.delenv("CONTROLLER_WORKERS", raising=False)
+    assert worker_count("notebook-controller") == DEFAULT_WORKERS
+    monkeypatch.setenv("CONTROLLER_WORKERS", "6")
+    assert worker_count("notebook-controller") == 6
+    monkeypatch.setenv("CONTROLLER_WORKERS_NOTEBOOK_CONTROLLER", "2")
+    assert worker_count("notebook-controller") == 2
+    assert worker_count("profile-controller") == 6
+
+    c = Controller("notebook-controller", Reconciler(), primary=NOTEBOOK)
+    assert c.workers == 2
+    c = Controller("notebook-controller", Reconciler(), primary=NOTEBOOK,
+                   workers=1)
+    assert c.workers == 1
+
+
+def test_worker_utilization_gauges_exported():
+    """controller_workers / controller_workers_busy come from the
+    scrape-time collector while the controller runs, and vanish after
+    stop() deregisters it."""
+    import time as _time
+
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+    from kubeflow_tpu.platform.runtime.controller import Controller, Reconciler
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    gate = threading.Event()
+    entered = threading.Event()
+
+    class Block(Reconciler):
+        def reconcile(self, req):
+            entered.set()
+            gate.wait(5.0)
+            return None
+
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    ctrl = Controller("util-probe", Block(), primary=NOTEBOOK, workers=3)
+    ctrl.start(kube)
+    try:
+        assert _sample("controller_workers", {"controller": "util-probe"}) == 3
+        kube.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "ns"},
+            "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+        })
+        assert entered.wait(5.0)
+        assert _sample("controller_workers_busy",
+                       {"controller": "util-probe"}) >= 1
+    finally:
+        gate.set()
+        ctrl.stop()
+    deadline = _time.monotonic() + 2.0
+    while _time.monotonic() < deadline:
+        if _sample("controller_workers", {"controller": "util-probe"}) == 0:
+            break
+        _time.sleep(0.01)
+    assert _sample("controller_workers", {"controller": "util-probe"}) == 0

@@ -108,9 +108,13 @@ def test_workqueue_per_key_exclusion_under_fire(make_q):
     assert all(v == 1 for v in max_in_flight.values())
 
 
-def test_controller_workers_gt_one_single_reconciler_per_key():
-    """A 4-worker controller under an event storm: the queue's exclusion
-    must make concurrent same-key reconciles impossible."""
+@pytest.mark.parametrize("workers", [4, 8])
+def test_controller_workers_gt_one_single_reconciler_per_key(workers):
+    """A multi-worker controller under an event storm: the queue's
+    exclusion must make concurrent same-key reconciles impossible at ANY
+    worker count — multi-worker is now the DEFAULT dispatch mode
+    (CONTROLLER_WORKERS), so this invariant is load-bearing, not
+    theoretical."""
     kube = FakeKube()
     kube.add_namespace("ns")
 
@@ -131,7 +135,8 @@ def test_controller_workers_gt_one_single_reconciler_per_key():
                 counts[req] += 1
             return None
 
-    ctrl = Controller("stress", Probe(), primary=NOTEBOOK, workers=4)
+    ctrl = Controller("stress", Probe(), primary=NOTEBOOK, workers=workers)
+    assert ctrl.workers == workers
     ctrl.start(kube)
     try:
         # Storm: create/update/delete a handful of notebooks repeatedly.

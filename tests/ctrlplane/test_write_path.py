@@ -1,0 +1,350 @@
+"""Write-coalesced hot path, pinned from two sides:
+
+1. ONE patch-semantics matrix every KubeClient implementation must pass —
+   FakeKube in memory, ChaosKube wrapping it (quiet schedule), and the
+   real RestKubeClient against the fake served over HTTP — so
+   ``patch``/``patch_status`` behave identically whichever client a
+   controller is handed (ISSUE 5 acceptance).
+2. A seeded-chaos A/B of the reconciler's write path: the same scenario
+   driven once through the patched writes and once through a shim that
+   restores the pre-patch shape (full RV-carrying updates, an Event
+   create per recorder call) must show strictly fewer 409 conflicts and
+   fewer Event creates on the patched side, asserted from ChaosKube call
+   logs.
+"""
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import EVENT, NOTEBOOK, STATEFULSET, thaw
+from kubeflow_tpu.platform.runtime.apply import merge_patch_for, patch_status_diff
+from kubeflow_tpu.platform.testing import FakeKube
+from kubeflow_tpu.platform.testing.chaos import ChaosKube, Fault
+from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+
+
+# -- the shared patch-semantics matrix ----------------------------------------
+
+
+@pytest.fixture(params=["fake", "chaos", "rest"])
+def client(request):
+    """Each KubeClient implementation over one seeded store."""
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "labels": {"keep": "me"},
+                     "annotations": {"a": "1", "b": "2"}},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "nb", "image": "img:1"}]}}},
+    })
+    kube.patch_status(NOTEBOOK, "nb", {
+        "status": {"readyReplicas": 0, "replicas": 2,
+                   "conditions": [{"type": "Ready", "status": "False"}]},
+    }, "ns")
+    if request.param == "fake":
+        yield kube
+        return
+    if request.param == "chaos":
+        yield ChaosKube(kube, faults=[])
+        return
+    from kubeflow_tpu.platform.k8s.client import RestKubeClient
+
+    server = HttpKubeServer(kube).start()
+    try:
+        yield RestKubeClient(server.base_url, qps=0)
+    finally:
+        server.stop()
+
+
+def test_merge_patch_add_replace_remove(client):
+    out = client.patch(NOTEBOOK, "nb", {
+        "metadata": {"annotations": {"a": "9", "b": None, "c": "3"}},
+    }, "ns")
+    assert out["metadata"]["annotations"] == {"a": "9", "c": "3"}
+    assert out["metadata"]["labels"] == {"keep": "me"}  # untouched siblings
+
+
+def test_patch_does_not_need_a_resource_version(client):
+    # Concurrent writer bumps the RV between our read and our patch: a
+    # merge patch carries no RV precondition, so no 409 — THE property the
+    # write-coalesced hot path buys under churn.
+    before = client.get(NOTEBOOK, "nb", "ns")
+    bump = thaw(before)
+    bump["metadata"].setdefault("annotations", {})["touch"] = "x"
+    client.update(bump)
+    out = client.patch(NOTEBOOK, "nb", {
+        "metadata": {"annotations": {"post-conflict": "yes"}}}, "ns")
+    assert out["metadata"]["annotations"]["post-conflict"] == "yes"
+    assert out["metadata"]["annotations"]["touch"] == "x"  # both survive
+
+
+def test_patch_keeps_status_subresource(client):
+    out = client.patch(NOTEBOOK, "nb", {
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "nb", "image": "img:2"}]}}}}, "ns")
+    assert out["status"]["replicas"] == 2  # spec patch left status alone
+
+
+def test_patch_status_touches_only_the_changed_subtree(client):
+    out = client.patch_status(NOTEBOOK, "nb", {
+        "status": {"readyReplicas": 2}}, "ns")
+    assert out["status"]["readyReplicas"] == 2
+    assert out["status"]["replicas"] == 2          # unpatched key kept
+    assert out["status"]["conditions"]             # unpatched key kept
+    live = client.get(NOTEBOOK, "nb", "ns")
+    assert live["status"]["readyReplicas"] == 2
+
+
+def test_patch_status_discards_smuggled_spec_edits(client):
+    client.patch_status(NOTEBOOK, "nb", {
+        "status": {"readyReplicas": 1},
+        "spec": {"template": None},
+        "metadata": {"labels": {"keep": "overwritten"}},
+    }, "ns")
+    live = client.get(NOTEBOOK, "nb", "ns")
+    assert live["status"]["readyReplicas"] == 1
+    assert live["spec"]["template"] is not None          # spec isolated
+    assert live["metadata"]["labels"] == {"keep": "me"}  # metadata isolated
+
+
+def test_patch_status_null_removes_status_keys(client):
+    out = client.patch_status(NOTEBOOK, "nb", {
+        "status": {"conditions": None}}, "ns")
+    assert "conditions" not in out["status"]
+    assert out["status"]["replicas"] == 2
+
+
+def test_patch_missing_object_is_typed_not_found(client):
+    with pytest.raises(errors.NotFound):
+        client.patch(NOTEBOOK, "ghost", {"metadata": {}}, "ns")
+    with pytest.raises(errors.NotFound):
+        client.patch_status(NOTEBOOK, "ghost", {"status": {}}, "ns")
+
+
+def test_patch_bumps_resource_version_and_emits_watch_delta(client):
+    before = client.get(NOTEBOOK, "nb", "ns")
+    after = client.patch_status(NOTEBOOK, "nb",
+                                {"status": {"readyReplicas": 2}}, "ns")
+    assert (after["metadata"]["resourceVersion"]
+            != before["metadata"]["resourceVersion"])
+
+
+# -- merge_patch_for / patch_status_diff helpers ------------------------------
+
+
+def test_merge_patch_for_minimal_diff():
+    cur = {"a": 1, "b": {"x": 1, "y": 2}, "c": [1, 2], "gone": True}
+    want = {"a": 1, "b": {"x": 1, "y": 3}, "c": [1, 2, 3]}
+    assert merge_patch_for(cur, want) == {
+        "b": {"y": 3}, "c": [1, 2, 3], "gone": None}
+    assert merge_patch_for(cur, copy.deepcopy(cur)) is None
+    assert merge_patch_for({}, {"new": 1}) == {"new": 1}
+    assert merge_patch_for(None, {"new": 1}) == {"new": 1}
+
+
+def test_merge_patch_for_accepts_frozen_current():
+    from kubeflow_tpu.platform.k8s.types import freeze
+
+    cur = freeze({"spec": {"replicas": 1, "svc": "s"}})
+    patch = merge_patch_for(cur, {"spec": {"replicas": 2, "svc": "s"}})
+    assert patch == {"spec": {"replicas": 2}}
+    # The produced patch must be plain data (serializable, mutable).
+    assert type(patch["spec"]) is dict
+
+
+def test_patch_status_diff_writes_only_on_change():
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    chaos = ChaosKube(kube, faults=[])
+    chaos.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns"},
+        "spec": {"template": {"spec": {"containers": [{"name": "c"}]}}},
+    })
+    obj = chaos.get(NOTEBOOK, "nb", "ns")
+    assert patch_status_diff(chaos, NOTEBOOK, obj, {"replicas": 2})
+    obj = chaos.get(NOTEBOOK, "nb", "ns")
+    assert not patch_status_diff(chaos, NOTEBOOK, obj, {"replicas": 2})
+    assert chaos.calls.get("patch_status") == 1
+    assert chaos.calls.get("update_status") is None
+
+
+# -- the chaos A/B: patched writes vs the pre-patch shape ---------------------
+
+
+class _AlwaysCreateCorrelator:
+    """The pre-correlator recorder: every call is a fresh Event create."""
+
+    def observe(self, key):
+        return "create", None
+
+    def created(self, key, name):
+        pass
+
+
+class _LegacyWriteShim:
+    """Restores the pre-patch write shape for the A/B arm: ``patch``
+    becomes GET + full-object PUT (resourceVersion attached, so injected
+    and real 409s apply), and ``patch_status`` is ABSENT so status writers
+    fall back to full ``update_status`` — exactly the seed-era path."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def patch(self, gvk, name, patch, namespace=None, *, patch_type="merge"):
+        from kubeflow_tpu.platform.testing.fake import _merge_patch
+
+        cur = thaw(self.inner.get(gvk, name, namespace))
+        _merge_patch(cur, copy.deepcopy(patch))
+        return self.inner.update(cur)
+
+    @property
+    def patch_status(self):
+        raise AttributeError("pre-patch clients have no patch_status")
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _run_write_scenario(legacy: bool, *, passes: int = 6):
+    """Drive the notebook reconciler synchronously over a seeded 409 storm
+    (conflicts injected on the RV-carrying verbs only — merge patches
+    carry no RV, which is how a real apiserver behaves) and return the
+    ChaosKube for call-log assertions."""
+    from kubeflow_tpu.platform.controllers.notebook import NotebookReconciler
+    from kubeflow_tpu.platform.runtime import EventRecorder, Request
+
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    kube.add_tpu_node("tpu-node", topology="2x4")
+    chaos = ChaosKube(kube, faults=[
+        Fault("409", 0.4, verbs=frozenset({"update", "update_status"})),
+    ], seed=7)
+    client = _LegacyWriteShim(chaos) if legacy else chaos
+    rec = NotebookReconciler(client, use_istio=False,
+                             mirror_min_interval=3600.0)
+    if legacy:
+        rec.recorder = EventRecorder(
+            client, "notebook-controller",
+            correlator=_AlwaysCreateCorrelator())
+    names = [f"nb-{i}" for i in range(3)]
+    for name in names:
+        kube.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": name, "namespace": "ns"},
+            "spec": {
+                "tpu": {"accelerator": "v5e", "topology": "2x4"},
+                "template": {"spec": {"containers": [
+                    {"name": name, "image": "img"}]}},
+            },
+        })
+    # A sibling squatting on nb-conflict's slice-1 name: every reconcile
+    # of nb-conflict emits a SliceNameConflict Warning — the recorder
+    # flood the correlator exists to coalesce.
+    kube.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "nb-conflict-s1", "namespace": "ns",
+                     "labels": {"notebook-name": "other"}},
+        "spec": {"replicas": 1, "selector": {"matchLabels": {"x": "y"}},
+                 "template": {"metadata": {"labels": {"x": "y"}},
+                              "spec": {"containers": [{"name": "c"}]}}},
+    })
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb-conflict", "namespace": "ns"},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "2x4", "slices": 2},
+            "template": {"spec": {"containers": [
+                {"name": "nb-conflict", "image": "img"}]}},
+        },
+    })
+    for p in range(passes):
+        # Touch each notebook's spec between passes (stop/start toggles
+        # replicas) so every pass has real STS + status writes to make.
+        for name in names:
+            nb = kube.get(NOTEBOOK, name, "ns")
+            ann = nb["metadata"].setdefault("annotations", {})
+            if p % 2:
+                ann["kubeflow-resource-stopped"] = "2026-08-04T00:00:00Z"
+            else:
+                ann.pop("kubeflow-resource-stopped", None)
+            kube.update(nb)
+        for name in names + ["nb-conflict"]:
+            try:
+                rec.reconcile(Request("ns", name))
+            except errors.ApiError:
+                pass  # injected conflicts; the queue would requeue
+    return chaos, kube
+
+
+def test_patched_write_path_beats_legacy_under_seeded_conflicts():
+    legacy_chaos, _ = _run_write_scenario(legacy=True)
+    patched_chaos, patched_kube = _run_write_scenario(legacy=False)
+
+    # Strictly fewer 409s: the patched hot path writes through RV-free
+    # merge patches, so the conflict schedule has almost nothing to hit.
+    legacy_409 = legacy_chaos.injected("409")
+    patched_409 = patched_chaos.injected("409")
+    assert legacy_409 > 0, "scenario must actually conflict the legacy arm"
+    assert patched_409 < legacy_409, (patched_409, legacy_409)
+
+    # Fewer Event creates: the correlator turns the per-pass
+    # SliceNameConflict flood into count-increment patches.
+    legacy_event_creates = legacy_chaos.calls_by_kind.get(
+        ("create", "Event"), 0)
+    patched_event_creates = patched_chaos.calls_by_kind.get(
+        ("create", "Event"), 0)
+    assert patched_event_creates < legacy_event_creates, (
+        patched_event_creates, legacy_event_creates)
+
+    # And the coalesced Event really carries the flood as a count.
+    conflict_events = [
+        e for e in patched_kube.list(EVENT, "ns")
+        if e.get("reason") == "SliceNameConflict"]
+    assert len(conflict_events) == 1
+    assert conflict_events[0]["count"] > 1
+
+    # The patched arm's steady-state secondary writes go through patch
+    # verbs, not full updates.
+    assert patched_chaos.calls.get("patch_status", 0) > 0
+    assert patched_chaos.calls_by_kind.get(("update", "StatefulSet"), 0) == 0
+
+
+def test_sts_update_path_is_a_patch_and_converges():
+    """Spec change -> the reconciler PATCHes only the owned fields, and a
+    second reconcile is a no-op (hash annotation converged)."""
+    from kubeflow_tpu.platform.controllers.notebook import NotebookReconciler
+    from kubeflow_tpu.platform.runtime import Request
+
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    chaos = ChaosKube(kube, faults=[])
+    rec = NotebookReconciler(chaos, use_istio=False,
+                             mirror_min_interval=3600.0)
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "nb", "image": "img:1"}]}}},
+    })
+    rec.reconcile(Request("ns", "nb"))
+    nb = kube.get(NOTEBOOK, "nb", "ns")
+    nb["spec"]["template"]["spec"]["containers"][0]["image"] = "img:2"
+    kube.update(nb)
+    before = dict(chaos.calls)
+    rec.reconcile(Request("ns", "nb"))
+    sts = kube.get(STATEFULSET, "nb", "ns")
+    assert sts["spec"]["template"]["spec"]["containers"][0]["image"] == "img:2"
+    assert chaos.calls.get("patch", 0) > before.get("patch", 0)
+    assert chaos.calls.get("update", 0) == before.get("update", 0)
+    # Converged: the next reconcile writes nothing.
+    quiet = dict(chaos.calls)
+    rec.reconcile(Request("ns", "nb"))
+    for verb in ("create", "update", "update_status", "patch",
+                 "patch_status"):
+        assert chaos.calls.get(verb, 0) == quiet.get(verb, 0), verb

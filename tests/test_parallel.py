@@ -4,6 +4,14 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+# Compute-side modules need the accelerator-era jax API (jax.shard_map et
+# al.); importorskip keeps COLLECTION clean on platform-only environments
+# instead of erroring the whole tier-1 run (BENCH/ISSUE 5 satellite).
+pytest.importorskip(
+    "kubeflow_tpu.parallel.ring",
+    reason="compute-side accelerator env required (jax.shard_map)",
+    exc_type=ImportError)
+
 from kubeflow_tpu.models import create_model
 from kubeflow_tpu.ops.attention import xla_attention
 from kubeflow_tpu.parallel import (
