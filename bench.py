@@ -46,6 +46,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+from kubeflow_tpu.telemetry import compute as ctel
+
 # Re-derived under the CURRENT 3-window protocol in round 5 (VERDICT r4
 # item 5; BASELINE.md "ResNet baseline re-derivation"): the original
 # 2538.49 (2026-07-29) was a single-window best from round 1, and under
@@ -80,10 +82,17 @@ def value_band(value: float, baseline: float,
                floor: float = VALUE_BAND_FLOOR) -> str:
     return "pass" if value >= baseline * floor else "REGRESSION"
 
+
+def _round_or_none(v, ndigits: int):
+    return None if v is None else round(v, ndigits)
+
 # TPU v5e public spec: 197 bf16 TFLOP/s per chip (394 int8).  MFU for the
 # llama lines is model FLOPs (no remat recompute counted — the standard
-# MFU convention) over this peak.
-V5E_BF16_PEAK_TFS = 197.0
+# MFU convention) over this peak.  The constant AND the accounting now
+# live in the telemetry core (telemetry/compute.py) so these report lines
+# and the train loop's live train_mfu gauge are one formula by
+# construction; re-exported here for the established names.
+V5E_BF16_PEAK_TFS = ctel.V5E_BF16_PEAK_TFS
 
 BATCH = 256
 IMAGE = 224
@@ -92,24 +101,10 @@ STEPS = 20
 WINDOWS = 3
 
 
-def lm_train_flops_per_token(cfg, seq: int) -> float:
-    """Model FLOPs per token for one LM train step (fwd + bwd = 3x fwd).
-
-    Explicit accounting (written down in BASELINE.md "MFU accounting"):
-    matmul FLOPs = 2*M*N*K; causal attention counts the score and value
-    matmuls at HALF the full s^2 work (the flash kernel skips the upper
-    triangle; XLA's masked arm does the full s^2, so its MFU reads
-    conservatively low — stated in BASELINE.md).  Embedding lookup,
-    norms, rotary and elementwise ops are omitted (<1% at these shapes).
-    Remat recompute is NOT counted: MFU measures useful model FLOPs.
-    """
-    d = cfg.dim
-    kv_dim = d * cfg.n_kv_heads // cfg.n_heads
-    proj = 2 * d * d + 2 * 2 * d * kv_dim + 2 * d * d  # q, k+v, o
-    attn = 2 * 2 * seq * d / 2  # QK^T + AV at causal half-occupancy
-    ffn = 3 * 2 * d * cfg.ffn_dim  # SwiGLU: gate, up, down
-    head = 2 * d * cfg.vocab_size
-    return 3.0 * (cfg.n_layers * (proj + attn + ffn) + head)
+# Model-FLOPs accounting (BASELINE.md "MFU accounting") — ONE
+# implementation in the telemetry core, shared with the train loop's live
+# MFU gauge; the established bench.py name stays importable.
+lm_train_flops_per_token = ctel.lm_train_flops_per_token
 
 
 def _llama_train_bench(
@@ -126,6 +121,7 @@ def _llama_train_bench(
     grad_dtype=None,
     xla_grad_dtype="same",
     value_baseline: float = None,
+    include_hbm_peak: bool = False,
 ) -> None:
     """Shared A/B protocol: flash-kernel arm vs XLA-attention arm on the
     identical model, amortized in-jit step loops with a final scalar fetch
@@ -171,33 +167,43 @@ def _llama_train_bench(
         for _ in range(n_warmup):
             s, metrics = step(s, tokens)
         float(metrics["loss"])
+        # Windows feed the telemetry step histogram (snapshot-diffed per
+        # arm) so the report's step p50/p99 come from the SAME layer a
+        # live /metrics scrape serves — never a private timer.
+        snap = ctel.step_snapshot()
         dts = []
         for _ in range(n_windows):
             t0 = time.perf_counter()
             for _ in range(n_steps):
                 s, metrics = step(s, tokens)
             float(metrics["loss"])
-            dts.append(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            dts.append(dt)
+            ctel.observe_window(n_steps, dt)
         tokens_per_window = batch * seq * n_steps
+        q = ctel.step_quantiles((0.5, 0.99), since=snap)
         return (
             tokens_per_window / min(dts),
             tokens_per_window * len(dts) / sum(dts),
+            q,
         )
 
-    flash_tps, flash_mean = measure(flash_cfg, "pallas",
-                                    arm_grad_dtype=grad_dtype)
+    flash_tps, flash_mean, flash_q = measure(flash_cfg, "pallas",
+                                             arm_grad_dtype=grad_dtype)
     # xla_grad_dtype="same" inherits grad_dtype; at 1.36B the XLA arm
     # pins f32 — bf16 grads change its block-remat schedule enough that
     # the compile OOMs on the 16 GB chip (measured round 5), and the
     # dtype's ~1% effect is noise on a 27-30x ratio.
     xla_gd = grad_dtype if xla_grad_dtype == "same" else xla_grad_dtype
-    xla_tps, xla_mean = measure(xla_cfg, "xla", protocol=xla_protocol,
-                                arm_grad_dtype=xla_gd)
+    xla_tps, xla_mean, _xla_q = measure(xla_cfg, "xla", protocol=xla_protocol,
+                                        arm_grad_dtype=xla_gd)
     # Absolute efficiency (VERDICT r3 item 2): useful model FLOPs over the
-    # chip's bf16 peak, accounting in lm_train_flops_per_token + BASELINE.md.
+    # chip's bf16 peak — accounting AND gauges via telemetry.compute, so
+    # this line and a live scrape can never disagree.
     fpt = lm_train_flops_per_token(flash_cfg, seq)
-    tfs = flash_tps * fpt / 1e12
-    tfs_mean = flash_mean * fpt / 1e12
+    derived = ctel.update_throughput(flash_tps, flops_per_token=fpt)
+    tfs = derived["model_tflops_per_sec"]
+    tfs_mean = ctel.model_tflops_per_sec(flash_mean, fpt)
     line = {
         "metric": metric,
         "value": round(flash_tps, 1),
@@ -215,14 +221,25 @@ def _llama_train_bench(
         "xla_tokens_per_sec_mean": round(xla_mean, 1),
         "model_gflops_per_token": round(fpt / 1e9, 3),
         "model_tflops_per_sec": round(tfs, 1),
-        "mfu": round(tfs / V5E_BF16_PEAK_TFS, 4),
+        "mfu": round(derived["mfu"], 4),
         "model_tflops_per_sec_mean": round(tfs_mean, 1),
-        "mfu_mean": round(tfs_mean / V5E_BF16_PEAK_TFS, 4),
+        "mfu_mean": round(ctel.mfu(flash_mean, fpt), 4),
+        # Telemetry-derived keys (ci/bench_smoke.py pins their presence):
+        # flash-arm step quantiles from the shared histogram.
+        "step_p50_s": _round_or_none(flash_q.get(0.5), 6),
+        "step_p99_s": _round_or_none(flash_q.get(0.99), 6),
         "seq_len": seq,
         "batch": batch,
         "windows": windows,
         "steps_per_window": steps,
     }
+    if include_hbm_peak:
+        # peak_bytes_in_use is a PROCESS-LIFETIME high-water mark (no
+        # reset API) — only the first section's line may claim it as its
+        # own; later sections would misattribute whichever earlier
+        # section peaked highest.  The bench_sections summary carries the
+        # process-wide value.
+        line["hbm_peak_bytes"] = ctel.hbm_peak_bytes()
     if value_baseline is not None:
         # Band on the best-window VALUE against the established baseline —
         # the flash/XLA ratio above can hide a regression that hits both
@@ -236,6 +253,20 @@ def _llama_train_bench(
         line["xla_steps_per_window"], line["xla_windows"], \
             line["xla_warmup"] = xla_protocol
     print(json.dumps(line), flush=True)
+    # The XLA arm's masked attention ran its pre-flight estimator at
+    # trace time (ops/attention.py → telemetry.compute); surface the
+    # estimate as its own report line so a BENCH json shows the O(S²)
+    # footprint the fallback path would materialize.  AFTER the metric
+    # line: the driver's first/last-line parse expects the primary first.
+    mask_est = ctel.attention_estimate_value()
+    if mask_est:
+        print(json.dumps({
+            "metric": "attention_mask_bytes_estimate",
+            "value": int(mask_est),
+            "unit": "bytes",
+            "seq_len": seq,
+            "batch": batch,
+        }), flush=True)
     return line
 
 
@@ -281,6 +312,9 @@ def llama_8k_bench() -> None:
         "llama8k_train_tokens_per_sec", cfg, cfg,
         batch=batch, steps=steps, windows=windows, warmup=warmup,
         value_baseline=None if smoke else BASELINE_LLAMA8K_TPS,
+        # First section of a full run: the process HBM peak is this
+        # section's own.
+        include_hbm_peak=True,
     )
 
 
@@ -479,13 +513,17 @@ def resnet50_bench() -> None:
     # shows ~15% run-to-run interference (2157-2538 img/s across sessions
     # for identical code), and the best window is the stable estimator of
     # what the chip itself does.
+    snap = ctel.step_snapshot()
     dts = []
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
         for _ in range(STEPS):
             state, metrics = step(state, batch)
         float(metrics["loss"])
-        dts.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        dts.append(dt)
+        ctel.observe_window(STEPS, dt)
+    q = ctel.step_quantiles((0.5, 0.99), since=snap)
 
     # Both estimators on one line: value/vs_baseline stay best-window (the
     # stable estimator under tunnel interference), value_mean_window is the
@@ -506,6 +544,8 @@ def resnet50_bench() -> None:
                 "vs_baseline_mean": round(vs_mean, 4),
                 "band": resnet_band(vs_mean),
                 "band_floor": RESNET_REGRESSION_BAND,
+                "step_p50_s": _round_or_none(q.get(0.5), 6),
+                "step_p99_s": _round_or_none(q.get(0.99), 6),
             }
         ),
         flush=True,
@@ -577,17 +617,21 @@ def vit_b16_bench() -> None:
     for _ in range(1 if smoke else VIT_WARMUP):
         state, m = step(state, data)
     float(m["loss"])
+    snap = ctel.step_snapshot()
     dts = []
     for _ in range(n_windows):
         t0 = time.perf_counter()
         for _ in range(n_steps):
             state, m = step(state, data)
         float(m["loss"])
-        dts.append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        dts.append(dt)
+        ctel.observe_window(n_steps, dt)
+    q = ctel.step_quantiles((0.5, 0.99), since=snap)
     ips = batch * n_steps / min(dts)
     ips_mean = batch * n_steps * len(dts) / sum(dts)
     fpi = vit_train_flops_per_image(model.cfg)
-    tfs = ips * fpi / 1e12
+    tfs = ctel.model_tflops_per_sec(ips, fpi)
     line = {
         "metric": "vit_b16_images_per_sec",
         "value": round(ips, 1),
@@ -597,7 +641,9 @@ def vit_b16_bench() -> None:
         "vs_baseline_mean": round(ips_mean / BASELINE_VIT_IPS, 4),
         "model_gflops_per_image": round(fpi / 1e9, 1),
         "model_tflops_per_sec": round(tfs, 1),
-        "mfu": round(tfs / V5E_BF16_PEAK_TFS, 4),
+        "mfu": round(ctel.mfu(ips, fpi), 4),
+        "step_p50_s": _round_or_none(q.get(0.5), 6),
+        "step_p99_s": _round_or_none(q.get(0.99), 6),
         "batch": batch,
         "windows": n_windows,
         "steps_per_window": n_steps,
@@ -675,6 +721,23 @@ def main(argv=None) -> int:
         ("resnet50", resnet50_bench),
         ("vit_b16", vit_b16_bench),
     ]
+    if "--sections" in argv:
+        # --sections a,b: run a subset (the bench-smoke CI lane runs just
+        # llama8k — resnet/vit at smoke shapes still cost minutes on a
+        # shared CPU box).  Unknown names are an argument error.
+        i = argv.index("--sections") + 1
+        if i >= len(argv):
+            print("--sections requires a comma-separated list",
+                  file=sys.stderr)
+            return 2
+        wanted = [s for s in argv[i].split(",") if s]
+        known = {n for n, _ in sections}
+        unknown = [s for s in wanted if s not in known]
+        if unknown:
+            print(f"unknown bench sections {unknown}; valid: "
+                  f"{sorted(known)}", file=sys.stderr)
+            return 2
+        sections = [(n, fn) for n, fn in sections if n in wanted]
     primary = None
     failed = {}
     for i, (name, fn) in enumerate(sections):
@@ -703,6 +766,10 @@ def main(argv=None) -> int:
         "ok_sections": [n for n, _ in sections if n not in failed],
         "failed_sections": sorted(failed),
         "errors": failed,
+        # Process-lifetime HBM high-water mark across ALL sections
+        # (memory_stats peak has no reset; per-section attribution would
+        # lie — only the first section's line carries its own).
+        "hbm_peak_bytes": ctel.hbm_peak_bytes(),
     }), flush=True)
     if primary is not None:
         print(json.dumps(primary), flush=True)

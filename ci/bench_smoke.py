@@ -1,12 +1,20 @@
 #!/usr/bin/env python3
-"""bench-smoke presubmit lane: run bench_scale.py at a tiny N and assert
-the band self-report still parses — every stdout line is JSON, every line
-carries a metric name, banded lines carry band/band_floor, and the
-parallel-dispatch keys this lane exists to guard
-(``ctrlplane_wave_converge_workers`` / ``ctrlplane_wire_converge_s``) are
-present.  A refactor that renames a metric, breaks a band field, or
-silently drops a phase fails CI here instead of being discovered the next
-time someone reads a BENCH json.
+"""bench-smoke presubmit lane: run both bench harnesses at smoke size and
+assert their self-reports still parse — every stdout line is JSON, every
+line carries a metric name, banded lines carry band/band_floor, and the
+load-bearing keys are present:
+
+* ``bench_scale.py`` (control plane, tiny N): the parallel-dispatch keys
+  (``ctrlplane_wave_converge_workers`` / ``ctrlplane_wire_converge_s``);
+* ``bench.py --sections llama8k`` (compute plane, KFT_BENCH_SMOKE=1): the
+  telemetry-derived keys (``step_p50_s``/``step_p99_s`` from the shared
+  step histogram, the ``hbm_peak_bytes`` key — null on CPU — and the
+  ``attention_mask_bytes_estimate`` line the XLA arm's pre-flight
+  estimator publishes).
+
+A refactor that renames a metric, breaks a band field, or silently
+unhooks the telemetry wiring fails CI here instead of being discovered
+the next time someone reads a BENCH json.
 
 The tiny N keeps this inside a presubmit budget; VALUES are not asserted
 (a 6-notebook wave on a shared CI box says nothing about regressions —
@@ -15,6 +23,7 @@ that's what the banded full runs are for), only shape and coverage.
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 
@@ -40,6 +49,61 @@ BANDED_METRICS = {
 }
 
 
+def _parse_json_lines(stdout: str, what: str):
+    lines = [ln for ln in stdout.splitlines() if ln.strip()]
+    seen = {}
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            print(f"non-JSON {what} line: {ln!r}", file=sys.stderr)
+            return None
+        if "metric" not in rec:
+            print(f"{what} line without metric name: {ln!r}",
+                  file=sys.stderr)
+            return None
+        seen[rec["metric"]] = rec
+    return seen
+
+
+def check_compute_bench() -> int:
+    """bench.py smoke (CPU, llama8k only): the telemetry wiring keys."""
+    env = dict(os.environ, KFT_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--sections", "llama8k"],
+        capture_output=True, text=True, timeout=560, env=env,
+    )
+    seen = _parse_json_lines(proc.stdout, "bench")
+    if seen is None:
+        print(proc.stderr[-2000:], file=sys.stderr)
+        return 1
+    line = seen.get("llama8k_train_tokens_per_sec")
+    if line is None:
+        print(f"bench smoke missing the llama8k line: {sorted(seen)}",
+              file=sys.stderr)
+        print(proc.stderr[-2000:], file=sys.stderr)
+        return 1
+    for key in ("step_p50_s", "step_p99_s"):
+        if not isinstance(line.get(key), (int, float)):
+            print(f"llama8k line missing telemetry key {key}: {line}",
+                  file=sys.stderr)
+            return 1
+    if "hbm_peak_bytes" not in line:  # null on CPU, but the KEY must ride
+        print(f"llama8k line missing hbm_peak_bytes: {line}",
+              file=sys.stderr)
+        return 1
+    est = seen.get("attention_mask_bytes_estimate")
+    if est is None or not est.get("value", 0) > 0:
+        # The XLA arm ran a masked causal attention, so the pre-flight
+        # estimator MUST have published a positive footprint.
+        print(f"mask-estimate line missing/zero after the XLA arm: {est}",
+              file=sys.stderr)
+        return 1
+    print(f"bench-smoke compute OK: {len(seen)} metrics "
+          f"({', '.join(sorted(seen))})")
+    return 0
+
+
 def main() -> int:
     cmd = [
         sys.executable, "bench_scale.py",
@@ -47,22 +111,12 @@ def main() -> int:
         "--sweep-fleet", "8", "--churn-seconds", "0.5",
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=560)
-    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
-    if not lines:
-        print("bench_scale produced no output", file=sys.stderr)
+    seen = _parse_json_lines(proc.stdout, "bench_scale")
+    if not seen:
+        if seen is not None:
+            print("bench_scale produced no output", file=sys.stderr)
         print(proc.stderr[-2000:], file=sys.stderr)
         return 1
-    seen = {}
-    for ln in lines:
-        try:
-            rec = json.loads(ln)
-        except ValueError:
-            print(f"non-JSON bench line: {ln!r}", file=sys.stderr)
-            return 1
-        if "metric" not in rec:
-            print(f"bench line without metric name: {ln!r}", file=sys.stderr)
-            return 1
-        seen[rec["metric"]] = rec
     missing = REQUIRED_METRICS - set(seen)
     if missing:
         print(f"missing bench metrics: {sorted(missing)}", file=sys.stderr)
@@ -81,9 +135,9 @@ def main() -> int:
         if not isinstance(sweep.get(key), (int, float)):
             print(f"sweep line missing {key}", file=sys.stderr)
             return 1
-    print(f"bench-smoke OK: {len(seen)} metrics "
+    print(f"bench-smoke ctrlplane OK: {len(seen)} metrics "
           f"({', '.join(sorted(seen))})")
-    return 0
+    return check_compute_bench()
 
 
 if __name__ == "__main__":

@@ -170,16 +170,23 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
-    # bench-smoke presubmit lane (ISSUE 5 satellite): bench_scale.py at a
-    # tiny N, asserting the band self-report parses and the parallel-
-    # dispatch keys (ctrlplane_wave_converge_workers / wire-converge) are
-    # present — shape and coverage, not values (ci/bench_smoke.py).
+    # bench-smoke presubmit lane (ISSUE 5 satellite, extended by ISSUE 6):
+    # bench_scale.py at a tiny N PLUS bench.py's llama8k section under
+    # KFT_BENCH_SMOKE, asserting the band self-reports parse, the
+    # parallel-dispatch keys (ctrlplane_wave_converge_workers /
+    # wire-converge) are present, and the compute lines carry the
+    # telemetry-derived keys (step p50/p99, hbm_peak_bytes, the
+    # attention mask-estimate line) — shape and coverage, not values
+    # (ci/bench_smoke.py).
     name="bench-smoke",
     include_dirs=[
-        "bench_scale.py", "ci/bench_smoke.py",
+        "bench.py", "bench_scale.py", "ci/bench_smoke.py",
+        "kubeflow_tpu/telemetry/*",
         "kubeflow_tpu/platform/runtime/*", "kubeflow_tpu/platform/k8s/*",
         "kubeflow_tpu/platform/testing/*",
-        "kubeflow_tpu/platform/controllers/*", "releasing/*",
+        "kubeflow_tpu/platform/controllers/*",
+        "kubeflow_tpu/ops/*", "kubeflow_tpu/train/*",
+        "kubeflow_tpu/models/*", "releasing/*",
     ],
     steps=[Step("smoke", [sys.executable, "ci/bench_smoke.py"])],
 ))

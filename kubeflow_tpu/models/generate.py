@@ -40,39 +40,7 @@ def sample_logits(logits: jax.Array, rng: jax.Array, *,
     return jax.random.categorical(rng, logits, axis=-1)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "eos_token"),
-)
-def generate(model, params, prompt: jax.Array, *,
-             rng: Optional[jax.Array] = None,
-             prompt_mask: Optional[jax.Array] = None,
-             max_new_tokens: int = 32,
-             temperature: float = 0.0,
-             top_k: Optional[int] = None,
-             eos_token: Optional[int] = None) -> jax.Array:
-    """Generate ``max_new_tokens`` continuations for a [batch, prompt_len]
-    right-padded prompt (``prompt_mask`` True on real tokens).  Returns
-    [batch, max_new_tokens] token ids; after an EOS the row pads with EOS.
-
-    ``model`` must be a Llama-style module whose ``__call__`` supports
-    ``decode=True`` with a "cache" collection; its ``max_seq_len`` must
-    bound prompt_len + max_new_tokens.
-
-    MoE caveat: capacity-truncated routing is sequence-length dependent by
-    construction (per-step decode has fresh capacity; a full re-forward
-    shares capacity across the whole sequence), so for ``n_experts > 0``
-    cached decode equals the re-forward oracle only while no token is
-    dropped — the standard Switch/GShard decode behavior.
-    """
-    # int8-served params widen here, INSIDE the jit, so XLA fuses the
-    # dequant into each consuming matmul and HBM keeps the int8 copy
-    # (models/quantize.py); plain params pass through untouched.
-    from kubeflow_tpu.models.quantize import dequantize_params
-
-    params = dequantize_params(params)
-    b, prompt_len = prompt.shape
+def _check_cache_len(model, prompt_len: int, max_new_tokens: int) -> int:
     # The cache is bucketed to exactly the tokens this call can produce —
     # decode attends over cache_len keys, not the model's full max_seq_len
     # (an 8-token prompt + 32 new tokens on a 32k-context config would
@@ -83,8 +51,18 @@ def generate(model, params, prompt: jax.Array, *,
             f"prompt_len ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"= {cache_len} exceeds max_seq_len {model.cfg.max_seq_len}"
         )
-    if rng is None:
-        rng = jax.random.key(0)
+    return cache_len
+
+
+def _prefill_parts(model, params, prompt, prompt_mask, cache_len, *,
+                   temperature, top_k, eos_token, rng):
+    """Prefill over the padded prompt: fill the cache, sample the first
+    token.  Returns ``(carry, pad_bias)`` where carry is exactly the
+    decode scan's loop state ``(cache, first, lengths, rng, done)`` —
+    shared verbatim by the one-shot ``generate`` jit and the two-phase
+    ``generate_prefill``/``generate_decode`` pair, so both paths run the
+    same ops in the same order."""
+    b, prompt_len = prompt.shape
     if prompt_mask is None:
         prompt_mask = jnp.ones((b, prompt_len), dtype=bool)
     prompt_mask = prompt_mask.astype(bool)
@@ -118,6 +96,20 @@ def generate(model, params, prompt: jax.Array, *,
     rng, sub = jax.random.split(rng)
     first = sample_logits(last_logits, sub, temperature=temperature,
                           top_k=top_k)
+    done0 = jnp.zeros((b,), dtype=bool)
+    if eos_token is not None:
+        done0 = first == eos_token
+    return (cache, first, lengths, rng, done0), pad_bias
+
+
+def _decode_scan(model, params, carry, pad_bias, *, cache_len,
+                 max_new_tokens, temperature, top_k, eos_token):
+    """The decode phase: a single ``lax.scan`` over one-token steps from a
+    prefilled carry.  Returns the full [batch, max_new_tokens] output
+    (first token included)."""
+    first = carry[1]
+    if max_new_tokens == 1:
+        return first[:, None]
 
     def step(carry, _):
         cache, token, pos, rng, done = carry
@@ -138,14 +130,151 @@ def generate(model, params, prompt: jax.Array, *,
             done = done | (nxt == eos_token)
         return (state["cache"], nxt, pos + 1, rng, done), nxt
 
-    done0 = jnp.zeros((b,), dtype=bool)
-    if eos_token is not None:
-        done0 = first == eos_token
-    if max_new_tokens == 1:
-        return first[:, None]
-    carry = (cache, first, lengths, rng, done0)
     _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "eos_token"),
+)
+def generate(model, params, prompt: jax.Array, *,
+             rng: Optional[jax.Array] = None,
+             prompt_mask: Optional[jax.Array] = None,
+             max_new_tokens: int = 32,
+             temperature: float = 0.0,
+             top_k: Optional[int] = None,
+             eos_token: Optional[int] = None) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations for a [batch, prompt_len]
+    right-padded prompt (``prompt_mask`` True on real tokens).  Returns
+    [batch, max_new_tokens] token ids; after an EOS the row pads with EOS.
+
+    ``model`` must be a Llama-style module whose ``__call__`` supports
+    ``decode=True`` with a "cache" collection; its ``max_seq_len`` must
+    bound prompt_len + max_new_tokens.
+
+    MoE caveat: capacity-truncated routing is sequence-length dependent by
+    construction (per-step decode has fresh capacity; a full re-forward
+    shares capacity across the whole sequence), so for ``n_experts > 0``
+    cached decode equals the re-forward oracle only while no token is
+    dropped — the standard Switch/GShard decode behavior.
+    """
+    # int8-served params widen here, INSIDE the jit, so XLA fuses the
+    # dequant into each consuming matmul and HBM keeps the int8 copy
+    # (models/quantize.py); plain params pass through untouched.
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    cache_len = _check_cache_len(model, prompt.shape[1], max_new_tokens)
+    if rng is None:
+        rng = jax.random.key(0)
+    carry, pad_bias = _prefill_parts(
+        model, params, prompt, prompt_mask, cache_len,
+        temperature=temperature, top_k=top_k, eos_token=eos_token, rng=rng,
+    )
+    return _decode_scan(
+        model, params, carry, pad_bias, cache_len=cache_len,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, eos_token=eos_token,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "eos_token"),
+)
+def _generate_prefill_jit(model, params, prompt, *, rng, prompt_mask,
+                          max_new_tokens, temperature, top_k, eos_token):
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    cache_len = _check_cache_len(model, prompt.shape[1], max_new_tokens)
+    carry, pad_bias = _prefill_parts(
+        model, params, prompt, prompt_mask, cache_len,
+        temperature=temperature, top_k=top_k, eos_token=eos_token, rng=rng,
+    )
+    return carry[1], (carry, pad_bias)
+
+
+def generate_prefill(model, params, prompt: jax.Array, *,
+                     rng: Optional[jax.Array] = None,
+                     prompt_mask: Optional[jax.Array] = None,
+                     max_new_tokens: int = 32,
+                     temperature: float = 0.0,
+                     top_k: Optional[int] = None,
+                     eos_token: Optional[int] = None):
+    """Phase 1 of two-phase generation: the prompt pass alone.  Returns
+    ``(first_token [batch], decode_state)``; hand decode_state to
+    ``generate_decode`` for the rest.
+
+    Runs EXACTLY the ops of ``generate``'s prefill half (shared
+    ``_prefill_parts``), just jitted at a phase boundary — the seam serve
+    telemetry measures time-to-first-token at, and the seam ROADMAP item
+    2's continuous-batching scheduler admits requests into.  The token
+    budget rides along in decode_state (a host-side int, outside the
+    jit): the cache was sized for THIS budget, so decode must not run
+    with any other."""
+    if rng is None:
+        rng = jax.random.key(0)
+    first, state = _generate_prefill_jit(
+        model, params, prompt, rng=rng, prompt_mask=prompt_mask,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, eos_token=eos_token,
+    )
+    return first, (state, max_new_tokens)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "eos_token"),
+    # Donate the prefilled KV cache: without this the decode scan's
+    # working cache would coexist with the (dead) prefill output and the
+    # two-phase path would hold ~2x the one-shot jit's cache HBM at peak.
+    donate_argnums=(2,),
+)
+def _generate_decode_jit(model, params, state, *, max_new_tokens,
+                         temperature, top_k, eos_token):
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    carry, pad_bias = state
+    cache_len = pad_bias.shape[-1]
+    return _decode_scan(
+        model, params, carry, pad_bias, cache_len=cache_len,
+        max_new_tokens=max_new_tokens, temperature=temperature,
+        top_k=top_k, eos_token=eos_token,
+    )
+
+
+def generate_decode(model, params, decode_state, *,
+                    max_new_tokens: Optional[int] = None,
+                    temperature: float = 0.0,
+                    top_k: Optional[int] = None,
+                    eos_token: Optional[int] = None) -> jax.Array:
+    """Phase 2 of two-phase generation: the decode scan from a
+    ``generate_prefill`` state.  Returns the full
+    [batch, max_new_tokens] output (first token included), matching
+    ``generate``'s contract.
+
+    ``max_new_tokens`` defaults to the budget the prefill sized the
+    cache for; passing a DIFFERENT value raises — a longer scan would
+    silently write past cache_len (clamped into the last slot) and
+    return garbage continuations, never an error."""
+    state, prefill_budget = decode_state
+    if max_new_tokens is None:
+        max_new_tokens = prefill_budget
+    elif max_new_tokens != prefill_budget:
+        raise ValueError(
+            f"max_new_tokens {max_new_tokens} does not match the budget "
+            f"the prefill sized its cache for ({prefill_budget})"
+        )
+    return _generate_decode_jit(
+        model, params, state, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, eos_token=eos_token,
+    )
 
 
 @functools.partial(

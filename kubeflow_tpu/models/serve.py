@@ -25,10 +25,13 @@ import dataclasses
 import json
 import sys
 import threading
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from kubeflow_tpu.telemetry.serve import ServeTelemetry, span_or_null
 
 
 def _validate_and_pad(rows, vocab: int, *, max_new_tokens, default_max,
@@ -97,8 +100,57 @@ def _generated_token_count(rows, eos_token):
     return total
 
 
+def _telemetry_request(service, rows, eos_token, validate, run):
+    """ONE request lifecycle for both services — admit (validate, before
+    the lock so bad requests 400 without queueing) → queue (lock wait,
+    depth-gauged) → run → token counters + trace close.  The scaffolding
+    lives here so a telemetry change (span order, queue-depth semantics)
+    cannot drift between the decoder-only and seq2seq paths.  With
+    ``service.telemetry`` None (library use) every span/instrument is a
+    no-op and the lock semantics are exactly the pre-telemetry ones.
+
+    ``validate`` returns the positional args ``run(tel, t_arrival, ...)``
+    receives after the admit span; ``run`` executes under the lock and
+    returns the row lists handed back to the caller."""
+    tel = service.telemetry
+    t_arrival = time.perf_counter()
+    if tel is not None:
+        tel.begin_request()
+    try:
+        with span_or_null(tel, "admit"):
+            args = validate()
+            if tel is not None:
+                tel.batch_rows.observe(len(rows))
+                tel.batch_fill_ratio.observe(
+                    len(rows) / max(service.max_batch_rows, 1))
+                tel.input_tokens.inc(sum(len(r) for r in rows))
+        with span_or_null(tel, "queue"):
+            if tel is not None:
+                tel.queue_depth.inc()
+            try:
+                service._lock.acquire()
+            finally:
+                if tel is not None:
+                    tel.queue_depth.dec()
+        try:
+            result = run(tel, t_arrival, *args)
+        finally:
+            service._lock.release()
+        if tel is not None:
+            tel.output_tokens.inc(_generated_token_count(result, eos_token))
+            tel.finish_request("ok")
+        return result
+    except BaseException:
+        if tel is not None:
+            tel.finish_request("error")
+        raise
+
+
 class GenerationService:
     default_eos_token: Optional[int] = None
+    # ServeTelemetry, attached by create_app; None = un-instrumented
+    # library use (every telemetry touch is guarded).
+    telemetry: Optional[ServeTelemetry] = None
 
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
                  max_batch_rows: int = 64):
@@ -114,28 +166,67 @@ class GenerationService:
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  eos_token=_UNSET, seed: int = 0):
-        from kubeflow_tpu.models.generate import generate
+        from kubeflow_tpu.models.generate import (
+            generate,
+            generate_decode,
+            generate_prefill,
+        )
 
         if eos_token is _UNSET:
             eos_token = self.default_eos_token
-        # prompt+new > max_seq_len additionally 400s via generate()'s own
-        # cache_len check (caught below as ValueError).
-        prompt, mask, n = _validate_and_pad(
-            rows, self.model.cfg.vocab_size,
-            max_new_tokens=max_new_tokens,
-            default_max=self.default_max_new_tokens,
-            limit_new=self.model.cfg.max_seq_len,
-            limit_source=self.model.cfg.max_seq_len,
-            top_k=top_k, eos_token=eos_token,
-            limit_rows=self.max_batch_rows,
-        )
-        with self._lock:
-            out = generate(
-                self.model, self.params, prompt, prompt_mask=mask,
-                max_new_tokens=n, temperature=temperature, top_k=top_k,
-                eos_token=eos_token, rng=jax.random.key(seed),
+
+        def validate():
+            # prompt+new > max_seq_len additionally 400s via the generate
+            # jits' own cache_len check (caught upstream as ValueError).
+            return _validate_and_pad(
+                rows, self.model.cfg.vocab_size,
+                max_new_tokens=max_new_tokens,
+                default_max=self.default_max_new_tokens,
+                limit_new=self.model.cfg.max_seq_len,
+                limit_source=self.model.cfg.max_seq_len,
+                top_k=top_k, eos_token=eos_token,
+                limit_rows=self.max_batch_rows,
             )
-        return jax.device_get(out).tolist()
+
+        def run(tel, t_arrival, prompt, mask, n):
+            kw = dict(max_new_tokens=n, temperature=temperature,
+                      top_k=top_k, eos_token=eos_token)
+            if tel is None:
+                # Un-instrumented library use: the one-shot jit — no
+                # phase-boundary host sync, no cache materialized as a
+                # jit output.  The split below buys telemetry only.
+                out = generate(self.model, self.params, prompt,
+                               prompt_mask=mask, rng=jax.random.key(seed),
+                               **kw)
+                return jax.device_get(out).tolist()
+            # Two-phase generation: the prefill/decode jits run EXACTLY
+            # the one-shot generate()'s ops (shared implementation,
+            # pinned token-equal by tests/test_serve.py), split at the
+            # phase boundary so the request trace gets real
+            # prefill/decode spans and TTFT is the first token's actual
+            # host arrival.
+            with tel.span("prefill", rows=prompt.shape[0]):
+                first, decode_state = generate_prefill(
+                    self.model, self.params, prompt, prompt_mask=mask,
+                    rng=jax.random.key(seed), **kw)
+                # Device→host fetch of the first sampled token: the
+                # completion barrier TTFT is defined against.
+                jax.device_get(first)
+            tel.ttft.observe(time.perf_counter() - t_arrival)
+            t_decode = time.perf_counter()
+            with tel.span("decode", tokens=n):
+                out = generate_decode(
+                    self.model, self.params, decode_state, **kw)
+                result = jax.device_get(out).tolist()
+            if n > 1:
+                # Decode seconds per post-first token; the scan runs its
+                # full fixed length regardless of early EOS, so this is
+                # the honest per-token decode cost.
+                tel.per_token.observe(
+                    (time.perf_counter() - t_decode) / (n - 1))
+            return result
+
+        return _telemetry_request(self, rows, eos_token, validate, run)
 
 
 class Seq2SeqGenerationService:
@@ -144,6 +235,7 @@ class Seq2SeqGenerationService:
     target continuation (T5 convention: BOS = pad id 0, EOS = 1)."""
 
     default_eos_token: Optional[int] = 1
+    telemetry: Optional[ServeTelemetry] = None
 
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
                  max_target_len: int = 512, max_source_len: int = 4096,
@@ -166,27 +258,35 @@ class Seq2SeqGenerationService:
 
         if eos_token is _UNSET:
             eos_token = self.default_eos_token
-        source, mask, n = _validate_and_pad(
-            rows, self.model.cfg.vocab_size,
-            max_new_tokens=max_new_tokens,
-            default_max=self.default_max_new_tokens,
-            limit_new=self.max_target_len,
-            limit_source=self.max_source_len,
-            top_k=top_k, eos_token=eos_token,
-            limit_rows=self.max_batch_rows,
-        )
-        with self._lock:
-            out = generate_seq2seq(
-                self.model, self.params, source, source_mask=mask,
-                max_new_tokens=n, temperature=temperature, top_k=top_k,
-                eos_token=eos_token, rng=jax.random.key(seed),
+
+        def validate():
+            return _validate_and_pad(
+                rows, self.model.cfg.vocab_size,
+                max_new_tokens=max_new_tokens,
+                default_max=self.default_max_new_tokens,
+                limit_new=self.max_target_len,
+                limit_source=self.max_source_len,
+                top_k=top_k, eos_token=eos_token,
+                limit_rows=self.max_batch_rows,
             )
-        return jax.device_get(out).tolist()
+
+        def run(tel, t_arrival, source, mask, n):
+            # Encoder-decoder generation stays one jit (the encoder pass
+            # is not a prompt-cache prefill); the TTFT/per-token split
+            # applies to the decoder-only service.
+            with span_or_null(tel, "generate", tokens=n):
+                out = generate_seq2seq(
+                    self.model, self.params, source, source_mask=mask,
+                    max_new_tokens=n, temperature=temperature,
+                    top_k=top_k, eos_token=eos_token,
+                    rng=jax.random.key(seed),
+                )
+                return jax.device_get(out).tolist()
+
+        return _telemetry_request(self, rows, eos_token, validate, run)
 
 
 def create_app(service: GenerationService, *, model_name: str = "model"):
-    import time
-
     from prometheus_client import (
         CollectorRegistry,
         Counter,
@@ -194,7 +294,12 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
         generate_latest,
     )
 
-    from kubeflow_tpu.platform.web.framework import App, HttpError, success
+    from kubeflow_tpu.platform.web.framework import (
+        App,
+        HttpError,
+        json_response,
+        success,
+    )
 
     app = App("model-serve")
     # Per-app registry: one process can serve several models/tests without
@@ -213,10 +318,34 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     tokens_total = Counter(
         "generate_tokens_total", "Tokens generated", registry=registry,
     )
+    # Serve-path telemetry (telemetry/serve.py): queue/batch/TTFT/
+    # per-token series in the same per-app registry, plus the per-request
+    # tracer /debug/traces serves.  Attached to the service because the
+    # service owns the lock and the prefill/decode phase boundary.
+    tel = ServeTelemetry(registry, component=model_name)
+    service.telemetry = tel
 
     @app.route("/healthz")
     def healthz(request):
         return success({"healthy": True})
+
+    # Same contract as the controllers' /debug/traces (platform/main.py),
+    # including the DEBUG_TRACES=false opt-out: this port is as
+    # unauthenticated as the health port, and per-request traces reveal
+    # more than /metrics already does.
+    from kubeflow_tpu.platform import config as _config
+
+    debug_traces_enabled = _config.env_bool("DEBUG_TRACES", True)
+
+    @app.route("/debug/traces")
+    def debug_traces(request):
+        if not debug_traces_enabled:
+            raise HttpError(404, "debug traces disabled")
+        try:
+            n = int(request.args.get("n", ""))
+        except ValueError:
+            n = None
+        return json_response({"traces": tel.tracer.recent(n)})
 
     @app.route("/metrics")
     def metrics(request):

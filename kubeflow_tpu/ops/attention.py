@@ -19,6 +19,43 @@ import jax
 import jax.numpy as jnp
 
 
+def attention_footprint_bytes(*, batch: int, heads: int, q_len: int,
+                              k_len: int, causal: bool,
+                              segments: bool) -> int:
+    """O(S²) bytes the masked XLA path materializes, from shapes alone:
+    the f32 logits AND softmax probs ([b, h, sq, sk] each — softmax
+    computes in f32 before the value-matmul cast), the boolean causal
+    tril ([sq, sk] — the exact BENCH_r05 allocation), and the per-batch
+    segment mask when packing.  Computed at trace time, strictly before
+    XLA allocates any of it."""
+    s2 = q_len * k_len
+    total = 2 * 4 * batch * heads * s2            # f32 logits + probs
+    if causal:
+        total += s2                               # bool tril mask
+    if segments:
+        total += batch * s2                       # bool segment mask
+    return total
+
+
+def _preflight_mask_check(q: jax.Array, k: jax.Array, *, causal: bool,
+                          segments: bool) -> None:
+    """Publish the footprint estimate + budget warning (telemetry.compute)
+    for a masked attention call.  Runs under jit TRACING — shapes are
+    static Python ints and the gauge/warning fire before any allocation
+    attempt, which is the whole point: the BENCH_r05 RESOURCE_EXHAUSTED
+    becomes a watched signal, not a post-mortem."""
+    from kubeflow_tpu.telemetry import compute as ctel
+
+    est = attention_footprint_bytes(
+        batch=q.shape[0], heads=q.shape[2], q_len=q.shape[1],
+        k_len=k.shape[1], causal=causal, segments=segments,
+    )
+    ctel.note_attention_estimate(
+        est, batch=q.shape[0], heads=q.shape[2], q_len=q.shape[1],
+        k_len=k.shape[1], causal=causal, segments=segments, impl="xla",
+    )
+
+
 def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     if n_rep == 1:
         return x
@@ -43,6 +80,12 @@ def xla_attention(
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
+    if causal or segment_ids is not None:
+        # Pre-flight BEFORE building logits/mask: estimate the O(S²)
+        # footprint from static shapes and warn when it won't fit the
+        # HBM budget (telemetry.compute) — the BENCH_r05 crash mode.
+        _preflight_mask_check(
+            q, k, causal=causal, segments=segment_ids is not None)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
 
     # [b, h, sq, sk] logits in f32 for a stable softmax.

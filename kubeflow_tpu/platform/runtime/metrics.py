@@ -513,50 +513,17 @@ registry.register(_RuntimeStateCollector())
 
 
 # -- histogram quantile helpers (bench_scale.py's p50/p99 reporting) ----------
+#
+# The estimation machinery moved to the shared telemetry core
+# (telemetry/metrics.py) so bench.py's step quantiles and bench_scale's
+# reconcile quantiles run the same interpolation; the names stay
+# re-exported here for existing consumers.
 
-
-def histogram_snapshot(hist: Histogram, match: Dict[str, str]) -> Dict[float, float]:
-    """Cumulative bucket counts by upper bound for the children of
-    ``hist`` whose labels are a superset of ``match`` — summed over
-    non-matched labels (e.g. over ``result`` for the reconcile
-    histogram)."""
-    buckets: Dict[float, float] = {}
-    for metric in hist.collect():
-        for s in metric.samples:
-            if not s.name.endswith("_bucket"):
-                continue
-            if not all(s.labels.get(k) == v for k, v in match.items()):
-                continue
-            le = float(s.labels["le"])
-            buckets[le] = buckets.get(le, 0.0) + s.value
-    return buckets
-
-
-def quantile_from_buckets(buckets: Dict[float, float], q: float) -> Optional[float]:
-    """Prometheus-style linear interpolation within the target bucket.
-    Returns None on an empty histogram; the +Inf bucket clamps to the
-    highest finite bound (same as histogram_quantile)."""
-    if not buckets:
-        return None
-    bounds = sorted(buckets)
-    total = buckets[bounds[-1]]
-    if total <= 0:
-        return None
-    rank = q * total
-    prev_bound, prev_count = 0.0, 0.0
-    finite = [b for b in bounds if b != float("inf")]
-    for b in bounds:
-        count = buckets[b]
-        if count >= rank:
-            if b == float("inf"):
-                return finite[-1] if finite else None
-            if count == prev_count:
-                return b
-            return prev_bound + (b - prev_bound) * (
-                (rank - prev_count) / (count - prev_count)
-            )
-        prev_bound, prev_count = (0.0 if b == float("inf") else b), count
-    return finite[-1] if finite else None
+from kubeflow_tpu.telemetry.metrics import (  # noqa: E402,F401
+    histogram_quantiles,
+    histogram_snapshot,
+    quantile_from_buckets,
+)
 
 
 def reconcile_quantiles(controller: str, qs=(0.5, 0.99), *,
@@ -564,12 +531,10 @@ def reconcile_quantiles(controller: str, qs=(0.5, 0.99), *,
     """Estimated reconcile-latency quantiles for one controller, summed
     over results.  ``since`` (a prior histogram_snapshot) diffs out
     observations from earlier runs in the same process."""
-    buckets = histogram_snapshot(
-        controller_runtime_reconcile_time_seconds, {"controller": controller}
+    return histogram_quantiles(
+        controller_runtime_reconcile_time_seconds, {"controller": controller},
+        qs, since=since,
     )
-    if since is not None:
-        buckets = {le: c - since.get(le, 0.0) for le, c in buckets.items()}
-    return {q: quantile_from_buckets(buckets, q) for q in qs}
 
 
 def render() -> bytes:

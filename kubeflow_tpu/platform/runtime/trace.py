@@ -13,22 +13,23 @@ Completed traces land in a bounded ring buffer served by ``/debug/traces``
 structured JSON log line, so a fleet operator can answer "where did that
 3 s reconcile go?" from stdout alone.  Overhead when nothing is watching:
 one thread-local read per span.
+
+The MACHINERY lives in ``kubeflow_tpu.telemetry.trace`` (one Tracer
+implementation for both halves of the repo — the train loop and the serve
+app run the same engine over their own buffers); this module binds the
+control plane's instance to the PR-1 API: same function surface, same
+env knobs, same ``kubeflow_tpu.runtime.trace`` logger, same
+controller/request wire keys.  Knobs stay MODULE attributes read at call
+time, so tests (and operators poking a live process) keep patching
+``trace.SLOW_RECONCILE_SECONDS`` / ``trace.ENABLED`` as before.
 """
 from __future__ import annotations
 
-import collections
-import itertools
-import json
-import logging
-import secrets
-import threading
-import time
-from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from kubeflow_tpu.platform import config
-
-log = logging.getLogger("kubeflow_tpu.runtime.trace")
+from kubeflow_tpu.telemetry.trace import Span, Tracer  # noqa: F401 (Span re-export)
+from kubeflow_tpu.telemetry.trace import Trace as _Trace
 
 # Reconciles at or above this wall time dump their span tree as a one-line
 # JSON log record.  Env-tunable; tests set the module attribute directly.
@@ -42,138 +43,59 @@ ENABLED = not config.env_bool("TRACE_DISABLE", False)
 # Ring buffer size for /debug/traces.
 TRACE_BUFFER_SIZE = config.env_int("TRACE_BUFFER_SIZE", 64)
 
-_local = threading.local()
-_lock = threading.Lock()
-_recent: collections.deque = collections.deque(maxlen=TRACE_BUFFER_SIZE)
+_KEYS = ("controller", "request")
+_tracer = Tracer(
+    "ctrlplane", keys=_KEYS, buffer_size=TRACE_BUFFER_SIZE,
+    logger="kubeflow_tpu.runtime.trace",
+    slow_message="slow reconcile trace",
+)
+log = _tracer.log
 
 
-class Span:
-    __slots__ = ("name", "offset_s", "duration_s", "attrs")
+class Trace(_Trace):
+    """Control-plane trace: the shared Trace with the PR-1 constructor
+    signature and (controller, request) dict keys."""
 
-    def __init__(self, name: str, offset_s: float, attrs: Dict):
-        self.name = name
-        self.offset_s = offset_s
-        self.duration_s = 0.0
-        self.attrs = attrs
-
-    def to_dict(self) -> dict:
-        d = {
-            "name": self.name,
-            "offset_ms": round(self.offset_s * 1e3, 3),
-            "duration_ms": round(self.duration_s * 1e3, 3),
-        }
-        if self.attrs:
-            d.update(self.attrs)
-        return d
-
-
-# Trace ids: one urandom read per PROCESS (the prefix), then a counter —
-# secrets.token_hex per reconcile was a syscall on every dequeue, visible
-# in the fleet resync's CPU floor (bench_scale.py).
-_id_prefix = secrets.token_hex(4)
-_id_counter = itertools.count()
-
-
-class Trace:
     def __init__(self, controller: str, request: str):
-        self.trace_id = f"{_id_prefix}{next(_id_counter) & 0xFFFFFFFF:08x}"
-        self.controller = controller
-        self.request = request
-        self.start_ts = time.time()
-        self._t0 = time.perf_counter()
-        self.spans: List[Span] = []
-        self.result = ""
-
-    def add_span(self, name: str, *, duration_s: float, offset_s: float = 0.0,
-                 **attrs) -> Span:
-        """Record an already-measured span (e.g. the workqueue wait, which
-        elapsed before the trace began)."""
-        sp = Span(name, offset_s, attrs)
-        sp.duration_s = duration_s
-        self.spans.append(sp)
-        return sp
-
-    def to_dict(self) -> dict:
-        return {
-            "trace_id": self.trace_id,
-            "controller": self.controller,
-            "request": self.request,
-            "start_ts": round(self.start_ts, 3),
-            "duration_ms": round(
-                (time.perf_counter() - self._t0) * 1e3, 3),
-            "result": self.result,
-            "spans": [s.to_dict() for s in self.spans],
-        }
+        super().__init__(controller, request, keys=_KEYS)
 
 
-def begin(controller: str, request: str) -> Optional[Trace]:
+def begin(controller: str, request: str) -> Optional[_Trace]:
     """Start a trace for a dequeued Request on the current thread (None
     when tracing is disabled).  Any stale trace (a prior reconcile that
     died without finish()) is discarded — traces never leak across
     reconciles."""
-    if not ENABLED:
-        _local.trace = None
-        return None
-    tr = Trace(controller, request)
-    _local.trace = tr
-    return tr
+    return _tracer.begin(controller, request, enabled=ENABLED)
 
 
-def current() -> Optional[Trace]:
-    return getattr(_local, "trace", None)
+def current() -> Optional[_Trace]:
+    return _tracer.current()
 
 
 def active() -> bool:
-    return getattr(_local, "trace", None) is not None
+    return _tracer.active()
 
 
-@contextmanager
 def span(name: str, **attrs):
     """Open a child span on the current thread's trace; no-op (yields
     None) when no trace is active, so library code can instrument
     unconditionally."""
-    tr = getattr(_local, "trace", None)
-    if tr is None:
-        yield None
-        return
-    t0 = time.perf_counter()
-    sp = Span(name, t0 - tr._t0, attrs)
-    try:
-        yield sp
-    finally:
-        sp.duration_s = time.perf_counter() - t0
-        tr.spans.append(sp)
+    return _tracer.span(name, **attrs)
 
 
 def finish(result: str = "") -> Optional[dict]:
     """Close the current thread's trace: record it in the ring buffer and,
     when it crossed the slow threshold, dump the span tree as one JSON log
     line.  Returns the trace dict (None when no trace was active)."""
-    tr = getattr(_local, "trace", None)
-    if tr is None:
-        return None
-    _local.trace = None
-    tr.result = result
-    d = tr.to_dict()
-    with _lock:
-        _recent.append(d)
-    if d["duration_ms"] >= SLOW_RECONCILE_SECONDS * 1e3:
-        log.warning("slow reconcile trace: %s", json.dumps(d, sort_keys=True))
-    return d
+    return _tracer.finish(result, slow_seconds=SLOW_RECONCILE_SECONDS)
 
 
 def recent(n: Optional[int] = None) -> List[dict]:
     """Most recent completed traces, newest last (the /debug/traces body).
-    ``n`` caps the result; n <= 0 returns nothing (``out[-0:]`` would be
-    everything)."""
-    with _lock:
-        out = list(_recent)
-    if n is None:
-        return out
-    return out[-n:] if n > 0 else []
+    ``n`` caps the result; n <= 0 returns nothing."""
+    return _tracer.recent(n)
 
 
 def clear() -> None:
     """Test helper: empty the ring buffer."""
-    with _lock:
-        _recent.clear()
+    _tracer.clear()

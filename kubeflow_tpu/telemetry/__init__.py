@@ -1,0 +1,35 @@
+"""Shared telemetry core for BOTH halves of the repo.
+
+The control plane (platform/runtime) and the compute plane
+(train/models/ops) export spans, histograms, and gauges through one
+implementation:
+
+* ``telemetry.trace`` — the Tracer (thread-carried traces, ring buffer,
+  slow-trace JSON dumps); ``platform/runtime/trace.py`` wraps one
+  instance in the PR-1 module API, ``telemetry.compute``/``serve`` own
+  their own.
+* ``telemetry.metrics`` — registry hygiene + histogram quantile
+  estimation (the bench/report seam).
+* ``telemetry.compute`` — step timing, MFU/throughput accounting, HBM
+  watermarks, the attention allocation pre-flight.
+* ``telemetry.serve`` — per-request serve metrics and spans.
+
+``logfmt`` is the shared structured-line formatter: machine-parseable
+``event key=value`` lines for everything that isn't a JSON span dump
+(train-loop progress lines, operator greps).
+"""
+from __future__ import annotations
+
+from kubeflow_tpu.telemetry.trace import Span, Trace, Tracer  # noqa: F401
+
+
+def logfmt(event: str, **fields) -> str:
+    """``event key=value ...`` with floats at %.6g — one line, no spaces
+    inside values' numeric forms, parseable by ``dict(kv.split("="))``."""
+    parts = [event]
+    for k, v in fields.items():
+        if isinstance(v, float):
+            parts.append(f"{k}={v:.6g}")
+        else:
+            parts.append(f"{k}={v}")
+    return " ".join(parts)

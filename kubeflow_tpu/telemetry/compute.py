@@ -1,0 +1,356 @@
+"""Compute-plane telemetry: step timing, throughput/MFU accounting, and
+HBM watermarks — the train/serve counterpart of the control plane's
+runtime/metrics.py.
+
+Three jobs, one registry:
+
+* **Step telemetry.** ``train_step_seconds{phase=compile|run}`` is fed by
+  the train loop (one observation per optimizer step, host wall time) and
+  by bench.py's measurement windows; scrape-time p50/p99 gauges ride on
+  top.  Throughput gauges (``train_tokens_per_sec`` /
+  ``train_model_tflops_per_sec`` / ``train_mfu``) are set through
+  ``update_throughput`` — the SAME accounting bench.py prints
+  (tokens/s × model FLOPs/token ÷ chip peak; see BASELINE.md "MFU
+  accounting"), so a live gauge and a BENCH json can never disagree.
+* **HBM watermarks.** ``device_memory_bytes{device,kind}`` samples
+  ``jax.Device.memory_stats()`` at scrape time; backends that return
+  None (CPU) simply export no samples — absent gauges, never a crash.
+  ``free_hbm_bytes``/``hbm_peak_bytes`` are the programmatic reads the
+  attention pre-flight estimator and bench.py use.
+* **Allocation pre-flight.** ``note_attention_estimate`` publishes an
+  O(S²) attention footprint computed from shapes BEFORE any buffer is
+  materialized and emits one structured warning line when the estimate
+  crosses ``ATTENTION_HBM_BUDGET_FRACTION`` of free HBM — the BENCH_r05
+  RESOURCE_EXHAUSTED (ROADMAP item 3) as a watched signal instead of a
+  post-mortem.
+
+Everything lives in the module-local ``registry`` (telemetry/metrics.py
+hygiene contract); jax is imported lazily inside the samplers so
+importing this module never initializes a backend.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, Optional
+
+from prometheus_client import Counter, Gauge, Histogram
+
+from kubeflow_tpu.platform import config
+from kubeflow_tpu.telemetry import metrics as tmetrics
+from kubeflow_tpu.telemetry.trace import Tracer
+
+log = logging.getLogger("kubeflow_tpu.telemetry.compute")
+
+registry = tmetrics.new_registry()
+
+# TPU v5e public spec: 197 bf16 TFLOP/s per chip (394 int8).  The MFU
+# denominator for every accounting consumer (bench.py imports it from
+# here); overridable per call for other parts.
+V5E_BF16_PEAK_TFS = 197.0
+
+# Steps at or above this wall time dump their span tree as one JSON log
+# line (the step-level analog of TRACE_SLOW_RECONCILE_SECONDS).
+# Env-tunable; tests set the module attribute directly.
+TRAIN_SLOW_STEP_SECONDS = config.env_float("TRAIN_SLOW_STEP_SECONDS", 10.0)
+# Step tracing on by default (control-plane convention): span overhead is
+# microseconds against millisecond-to-second train steps.
+STEP_TRACE_ENABLED = not config.env_bool("TRAIN_TRACE_DISABLE", False)
+# Warn when a single attention call's O(S²) footprint estimate exceeds
+# this fraction of currently-free HBM.
+ATTENTION_HBM_BUDGET_FRACTION = config.env_float(
+    "ATTENTION_HBM_BUDGET_FRACTION", 0.5)
+
+# Per-step traces (data → dispatch → bookkeeping spans) from the train
+# loop; slow steps dump through this tracer's logger.
+train_tracer = Tracer(
+    "train", keys=("component", "step"),
+    buffer_size=config.env_int("TRAIN_TRACE_BUFFER_SIZE", 64),
+    logger="kubeflow_tpu.train.trace",
+    slow_message="slow train step trace",
+)
+
+_STEP_BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                 10.0, 30.0, 60.0, 180.0, 600.0)
+
+train_step_seconds = Histogram(
+    "train_step_seconds",
+    "Optimizer-step wall time by phase (compile = the first step of a "
+    "loop/window, which pays jit compilation; run = steady state)",
+    ["phase"], buckets=_STEP_BUCKETS, registry=registry,
+)
+train_steps_total = Counter(
+    "train_steps_total", "Optimizer steps executed", registry=registry,
+)
+train_slow_steps_total = Counter(
+    "train_slow_steps_total",
+    "Steps that crossed TRAIN_SLOW_STEP_SECONDS (their span tree was "
+    "dumped; see the kubeflow_tpu.train.trace logger)",
+    registry=registry,
+)
+train_tokens_per_sec = Gauge(
+    "train_tokens_per_sec",
+    "Training throughput over the last completed log window",
+    registry=registry,
+)
+train_model_tflops_per_sec = Gauge(
+    "train_model_tflops_per_sec",
+    "Useful model TFLOP/s over the last log window (tokens/s x model "
+    "FLOPs/token; remat recompute not counted — the MFU convention)",
+    registry=registry,
+)
+train_mfu = Gauge(
+    "train_mfu",
+    "Model FLOPs utilization over the last log window, against the "
+    "configured chip peak (default: v5e bf16, 197 TF/s)",
+    registry=registry,
+)
+
+attention_mask_bytes_estimate = Gauge(
+    "attention_mask_bytes_estimate",
+    "Pre-flight estimate of the O(S^2) bytes the XLA attention path will "
+    "materialize (mask + f32 logits + probs), computed from shapes BEFORE "
+    "allocation — the BENCH_r05 RESOURCE_EXHAUSTED mode as a signal",
+    registry=registry,
+)
+attention_mask_budget_warnings_total = Counter(
+    "attention_mask_budget_warnings_total",
+    "Attention calls whose footprint estimate exceeded "
+    "ATTENTION_HBM_BUDGET_FRACTION of free HBM (one structured warning "
+    "line each)",
+    registry=registry,
+)
+
+
+# -- accounting (ONE formula for gauges, bench lines, and reports) ------------
+
+
+def lm_train_flops_per_token(cfg, seq: int) -> float:
+    """Model FLOPs per token for one LM train step (fwd + bwd = 3x fwd).
+
+    Explicit accounting (written down in BASELINE.md "MFU accounting"):
+    matmul FLOPs = 2*M*N*K; causal attention counts the score and value
+    matmuls at HALF the full s^2 work (the flash kernel skips the upper
+    triangle; XLA's masked arm does the full s^2, so its MFU reads
+    conservatively low — stated in BASELINE.md).  Embedding lookup,
+    norms, rotary and elementwise ops are omitted (<1% at these shapes).
+    Remat recompute is NOT counted: MFU measures useful model FLOPs.
+
+    Lives in the telemetry core (not bench.py, which re-exports it) so
+    the train loop's live MFU gauge and the bench report lines share ONE
+    accounting by construction.
+    """
+    d = cfg.dim
+    kv_dim = d * cfg.n_kv_heads // cfg.n_heads
+    proj = 2 * d * d + 2 * 2 * d * kv_dim + 2 * d * d  # q, k+v, o
+    attn = 2 * 2 * seq * d / 2  # QK^T + AV at causal half-occupancy
+    ffn = 3 * 2 * d * cfg.ffn_dim  # SwiGLU: gate, up, down
+    head = 2 * d * cfg.vocab_size
+    return 3.0 * (cfg.n_layers * (proj + attn + ffn) + head)
+
+
+def model_tflops_per_sec(tokens_per_sec: float,
+                         flops_per_token: float) -> float:
+    return tokens_per_sec * flops_per_token / 1e12
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        peak_tflops: float = V5E_BF16_PEAK_TFS) -> float:
+    return model_tflops_per_sec(tokens_per_sec, flops_per_token) / peak_tflops
+
+
+def update_throughput(tokens_per_sec: float, *,
+                      flops_per_token: Optional[float] = None,
+                      peak_tflops: Optional[float] = None) -> Dict[str, float]:
+    """Refresh the throughput gauges from one completed window and return
+    the derived values (the report-line fields).  FLOPs accounting is
+    optional — without it only tokens/s is exported."""
+    train_tokens_per_sec.set(tokens_per_sec)
+    out: Dict[str, float] = {"tokens_per_sec": tokens_per_sec}
+    if flops_per_token:
+        peak = peak_tflops or V5E_BF16_PEAK_TFS
+        tfs = model_tflops_per_sec(tokens_per_sec, flops_per_token)
+        train_model_tflops_per_sec.set(tfs)
+        train_mfu.set(tfs / peak)
+        out["model_tflops_per_sec"] = tfs
+        out["mfu"] = tfs / peak
+    return out
+
+
+def observe_step(seconds: float, *, phase: str = "run") -> None:
+    """One optimizer step's wall time into the step histogram."""
+    train_step_seconds.labels(phase=phase).observe(seconds)
+    train_steps_total.inc()
+
+
+def observe_window(n_steps: int, window_seconds: float, *,
+                   phase: str = "run") -> None:
+    """A timed n-step measurement window (the bench protocol): recorded
+    as n observations of the mean step time, so window-level timing and
+    the per-step histogram stay one distribution."""
+    if n_steps <= 0:
+        return
+    mean = window_seconds / n_steps
+    child = train_step_seconds.labels(phase=phase)
+    for _ in range(n_steps):
+        child.observe(mean)
+    train_steps_total.inc(n_steps)
+
+
+def step_snapshot() -> Dict[float, float]:
+    """Cumulative step-histogram buckets (summed over phases) — pass to
+    ``step_quantiles(since=...)`` to diff out earlier work."""
+    return tmetrics.histogram_snapshot(train_step_seconds, {})
+
+
+def step_quantiles(qs=(0.5, 0.99), *,
+                   since: Optional[Dict[float, float]] = None,
+                   phase: Optional[str] = None):
+    """Estimated step-time quantiles, summed over phases unless ``phase``
+    narrows it."""
+    match = {} if phase is None else {"phase": phase}
+    return tmetrics.histogram_quantiles(
+        train_step_seconds, match, qs, since=since)
+
+
+class _StepQuantileCollector:
+    """Scrape-time ``train_step_seconds_p50/_p99`` gauges over the run
+    phase of the live histogram — live estimates without PromQL, the
+    compute analog of bench_scale's reconcile p50/p99 read."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        qs = step_quantiles((0.5, 0.99), phase="run")
+        for q, name in ((0.5, "train_step_seconds_p50"),
+                        (0.99, "train_step_seconds_p99")):
+            g = GaugeMetricFamily(
+                name, f"Estimated p{int(q * 100)} run-phase step time "
+                "(histogram interpolation)")
+            if qs.get(q) is not None:
+                g.add_metric([], qs[q])
+            yield g
+
+
+registry.register(_StepQuantileCollector())
+
+
+# -- HBM watermarks -----------------------------------------------------------
+
+# memory_stats() key -> exported kind label.
+_MEMORY_KINDS = (
+    ("bytes_in_use", "in_use"),
+    ("peak_bytes_in_use", "peak"),
+    ("bytes_limit", "limit"),
+)
+
+
+def device_memory_snapshot() -> Dict[str, Dict[str, int]]:
+    """{device_label: {kind: bytes}} for every device whose backend
+    implements memory_stats(); devices returning None (CPU) are simply
+    absent.  Never raises — telemetry must not take the workload down."""
+    out: Dict[str, Dict[str, int]] = {}
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        kinds = {
+            kind: int(stats[key])
+            for key, kind in _MEMORY_KINDS if key in stats
+        }
+        if kinds:
+            out[f"{d.platform}:{d.id}"] = kinds
+    return out
+
+
+class _DeviceMemoryCollector:
+    """Scrape-time ``device_memory_bytes{device,kind}``: one
+    memory_stats() sweep per Prometheus scrape, zero cost on the step
+    stream."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        fam = GaugeMetricFamily(
+            "device_memory_bytes",
+            "Accelerator memory by device and kind "
+            "(in_use | peak | limit), from jax.Device.memory_stats(); "
+            "absent on backends without memory introspection",
+            labels=["device", "kind"],
+        )
+        for dev, kinds in sorted(device_memory_snapshot().items()):
+            for kind, val in sorted(kinds.items()):
+                fam.add_metric([dev, kind], val)
+        yield fam
+
+
+registry.register(_DeviceMemoryCollector())
+
+
+def hbm_peak_bytes() -> Optional[int]:
+    """Worst peak_bytes_in_use across devices (the bench report's
+    ``hbm_peak_bytes``); None when no device reports memory stats."""
+    peaks = [k["peak"] for k in device_memory_snapshot().values()
+             if "peak" in k]
+    return max(peaks) if peaks else None
+
+
+def free_hbm_bytes() -> Optional[int]:
+    """Tightest (limit - in_use) across devices — the budget the
+    attention pre-flight estimator checks against.  None when no device
+    reports both numbers (CPU): estimation still publishes its gauge,
+    only the budget warning is skipped."""
+    frees = [
+        k["limit"] - k["in_use"]
+        for k in device_memory_snapshot().values()
+        if "limit" in k and "in_use" in k
+    ]
+    return min(frees) if frees else None
+
+
+def note_attention_estimate(estimate_bytes: int, **shape_attrs) -> bool:
+    """Publish an attention footprint estimate (gauge) and, when it
+    exceeds the budget fraction of free HBM, emit ONE structured warning
+    JSON line + counter bump.  Returns True when the warning fired.
+    Called from ops/attention.py at trace time — strictly before any
+    device allocation for the masked path."""
+    attention_mask_bytes_estimate.set(estimate_bytes)
+    free = free_hbm_bytes()
+    if free is None:
+        return False
+    budget = ATTENTION_HBM_BUDGET_FRACTION * free
+    if estimate_bytes <= budget:
+        return False
+    attention_mask_budget_warnings_total.inc()
+    log.warning(
+        "attention footprint over budget: %s",
+        json.dumps({
+            "event": "attention_mask_budget_exceeded",
+            "estimate_bytes": int(estimate_bytes),
+            "free_hbm_bytes": int(free),
+            "budget_fraction": ATTENTION_HBM_BUDGET_FRACTION,
+            "ts": round(time.time(), 3),
+            **shape_attrs,
+        }, sort_keys=True),
+    )
+    return True
+
+
+def attention_estimate_value() -> Optional[float]:
+    """Current value of the estimate gauge (None before any attention
+    call) — the bench's mask-estimate report line."""
+    return registry.get_sample_value("attention_mask_bytes_estimate")
+
+
+def render() -> bytes:
+    return tmetrics.render(registry)

@@ -1,0 +1,89 @@
+"""Shared Prometheus plumbing: the registry-hygiene contract + histogram
+quantile estimation, one implementation for both planes.
+
+Registry hygiene (the PR-1 rule, now repo-wide): every kubeflow_tpu
+series lives in a **module-local** (or per-app) ``CollectorRegistry``,
+never ``prometheus_client.REGISTRY`` — the process-global default stacks
+duplicate collectors on test reimports.  Pinned for the control plane by
+``tests/ctrlplane/test_metrics.py::test_no_kubeflow_metrics_in_global_registry``
+(which now also covers the compute registry) — any new metrics module
+should build on ``new_registry()`` and land there too.
+
+The quantile helpers are the bench/report seam: ``bench_scale.py`` reads
+reconcile p50/p99 and ``bench.py`` reads step p50/p99 from live
+histograms through these functions, so a report line and a /metrics
+scrape can never disagree about what was measured.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from prometheus_client import CollectorRegistry, generate_latest
+
+
+def new_registry() -> CollectorRegistry:
+    """A fresh module-local registry (the only sanctioned home for
+    kubeflow_tpu collectors)."""
+    return CollectorRegistry()
+
+
+def render(registry: CollectorRegistry) -> bytes:
+    """Prometheus exposition text for a registry (the /metrics body)."""
+    return generate_latest(registry)
+
+
+def histogram_snapshot(hist, match: Dict[str, str]) -> Dict[float, float]:
+    """Cumulative bucket counts by upper bound for the children of
+    ``hist`` whose labels are a superset of ``match`` — summed over
+    non-matched labels (e.g. over ``result`` for the reconcile histogram,
+    over ``phase`` for the train-step histogram)."""
+    buckets: Dict[float, float] = {}
+    for metric in hist.collect():
+        for s in metric.samples:
+            if not s.name.endswith("_bucket"):
+                continue
+            if not all(s.labels.get(k) == v for k, v in match.items()):
+                continue
+            le = float(s.labels["le"])
+            buckets[le] = buckets.get(le, 0.0) + s.value
+    return buckets
+
+
+def quantile_from_buckets(buckets: Dict[float, float], q: float) -> Optional[float]:
+    """Prometheus-style linear interpolation within the target bucket.
+    Returns None on an empty histogram; the +Inf bucket clamps to the
+    highest finite bound (same as histogram_quantile)."""
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    total = buckets[bounds[-1]]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    finite = [b for b in bounds if b != float("inf")]
+    for b in bounds:
+        count = buckets[b]
+        if count >= rank:
+            if b == float("inf"):
+                return finite[-1] if finite else None
+            if count == prev_count:
+                return b
+            return prev_bound + (b - prev_bound) * (
+                (rank - prev_count) / (count - prev_count)
+            )
+        prev_bound, prev_count = (0.0 if b == float("inf") else b), count
+    return finite[-1] if finite else None
+
+
+def histogram_quantiles(hist, match: Dict[str, str], qs=(0.5, 0.99), *,
+                        since: Optional[Dict[float, float]] = None
+                        ) -> Dict[float, Optional[float]]:
+    """Estimated latency quantiles for one histogram slice.  ``since``
+    (a prior histogram_snapshot) diffs out observations from earlier runs
+    in the same process — the bench protocol for per-arm/per-wave
+    reporting."""
+    buckets = histogram_snapshot(hist, match)
+    if since is not None:
+        buckets = {le: c - since.get(le, 0.0) for le, c in buckets.items()}
+    return {q: quantile_from_buckets(buckets, q) for q in qs}

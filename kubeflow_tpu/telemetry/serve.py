@@ -1,0 +1,112 @@
+"""Serve-path telemetry: per-request metrics + spans for the generation
+services (models/serve.py).
+
+One ``ServeTelemetry`` per app registry (the per-app-registry pattern —
+one process can serve several models/tests without duplicate-timeseries
+collisions), attached to a service as ``service.telemetry``.  The
+request lifecycle maps to spans
+
+    admit (validate + right-pad) → queue (service-lock wait) →
+    prefill (prompt pass, ends when the FIRST token is on host) →
+    decode (the scan + device→host fetch)
+
+served by ``/debug/traces`` exactly like the control plane's reconcile
+traces; TTFT is observed at the prefill span's close (arrival → first
+token host-visible), per-token latency as decode seconds per generated
+token.  These are the series ROADMAP item 2's cross-request scheduler
+will be tuned against: queue depth and batch fill ratio are the
+continuous-batching headroom signals.
+"""
+from __future__ import annotations
+
+import itertools
+from contextlib import nullcontext
+from typing import Optional
+
+from prometheus_client import Counter, Gauge, Histogram
+
+from kubeflow_tpu.platform import config
+from kubeflow_tpu.telemetry.trace import Tracer
+
+# Requests at or above this wall time dump their span tree as one JSON
+# log line (kubeflow_tpu.serve.trace logger).  Env-tunable; tests set the
+# module attribute directly.
+SLOW_REQUEST_SECONDS = config.env_float("SERVE_SLOW_REQUEST_SECONDS", 30.0)
+
+_LATENCY_BUCKETS = (0.01, 0.05, 0.2, 1.0, 5.0, 20.0, 60.0, 180.0)
+_TOKEN_BUCKETS = (0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+
+_request_ids = itertools.count(1)
+
+
+class ServeTelemetry:
+    """Instruments + tracer for one serving app.  Every method is safe to
+    call from concurrent request threads; a service whose ``telemetry``
+    is None skips all of it (the library-use path)."""
+
+    def __init__(self, registry, *, component: str = "model-serve"):
+        self.component = component
+        self.tracer = Tracer(
+            component, keys=("component", "request"),
+            buffer_size=config.env_int("SERVE_TRACE_BUFFER_SIZE", 64),
+            logger="kubeflow_tpu.serve.trace",
+            slow_message="slow serve request trace",
+        )
+        self.queue_depth = Gauge(
+            "serve_queue_depth",
+            "Requests currently waiting on the generation lock (the "
+            "continuous-batching backlog signal)",
+            registry=registry,
+        )
+        self.batch_rows = Histogram(
+            "serve_batch_rows", "Rows admitted per generation batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128), registry=registry,
+        )
+        self.batch_fill_ratio = Histogram(
+            "serve_batch_fill_ratio",
+            "Admitted rows over the service's max_batch_rows (1.0 = the "
+            "batch axis is saturated)",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+            registry=registry,
+        )
+        self.ttft = Histogram(
+            "serve_time_to_first_token_seconds",
+            "Request arrival to the first generated token host-visible "
+            "(admit + queue wait + prefill; includes any compile)",
+            buckets=_LATENCY_BUCKETS, registry=registry,
+        )
+        self.per_token = Histogram(
+            "serve_per_token_seconds",
+            "Decode seconds per generated token past the first (one "
+            "observation per request)",
+            buckets=_TOKEN_BUCKETS, registry=registry,
+        )
+        self.input_tokens = Counter(
+            "serve_input_tokens_total", "Prompt/source tokens received",
+            registry=registry,
+        )
+        self.output_tokens = Counter(
+            "serve_output_tokens_total",
+            "Tokens generated (counted through the first EOS per row, "
+            "excluding post-EOS padding)",
+            registry=registry,
+        )
+
+    # -- request lifecycle ----------------------------------------------------
+
+    def begin_request(self):
+        return self.tracer.begin(
+            self.component, f"req-{next(_request_ids)}")
+
+    def span(self, name: str, **attrs):
+        return self.tracer.span(name, **attrs)
+
+    def finish_request(self, result: str) -> Optional[dict]:
+        return self.tracer.finish(
+            result, slow_seconds=SLOW_REQUEST_SECONDS)
+
+
+def span_or_null(tel: Optional[ServeTelemetry], name: str, **attrs):
+    """A telemetry span, or a no-op when the service runs un-instrumented
+    (direct library use)."""
+    return tel.span(name, **attrs) if tel is not None else nullcontext()

@@ -1,0 +1,191 @@
+"""Plane-agnostic span tracing: ONE implementation for both halves.
+
+PR 1 built per-reconcile tracing for the control plane
+(platform/runtime/trace.py); the compute plane (train steps, serve
+requests) needs the identical machinery — thread-carried traces, bounded
+ring buffer, slow-trace JSON dumps.  This module is that machinery lifted
+into a shared core: a ``Tracer`` owns its own thread-local slot, ring
+buffer, and logger, so the control plane's reconcile traces, the train
+loop's step traces, and a serve app's request traces never interleave,
+while span/dump semantics stay byte-compatible everywhere.
+
+Design points carried over verbatim from the PR-1 implementation:
+
+* the active trace rides a thread-local — spans opened anywhere
+  downstream attach without plumbing a context object through signatures;
+* completed traces land in a bounded deque (the ``/debug/traces`` body);
+* traces slower than a caller-supplied threshold dump their whole span
+  tree as ONE structured JSON log line;
+* trace ids are one urandom read per process (the prefix) plus a counter
+  — never a syscall per trace (the bench_scale resync-CPU finding).
+
+``platform/runtime/trace.py`` wraps a Tracer in the PR-1 module API (same
+env knobs, same logger name); ``telemetry/compute.py`` and
+``telemetry/serve.py`` instantiate their own.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+
+class Span:
+    __slots__ = ("name", "offset_s", "duration_s", "attrs")
+
+    def __init__(self, name: str, offset_s: float, attrs: Dict):
+        self.name = name
+        self.offset_s = offset_s
+        self.duration_s = 0.0
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        d = {
+            "name": self.name,
+            "offset_ms": round(self.offset_s * 1e3, 3),
+            "duration_ms": round(self.duration_s * 1e3, 3),
+        }
+        if self.attrs:
+            d.update(self.attrs)
+        return d
+
+
+# One urandom read per PROCESS; ids are prefix + counter (shared across
+# tracers — a trace id only needs to be unique, not per-plane).
+_id_prefix = secrets.token_hex(4)
+_id_counter = itertools.count()
+
+
+class Trace:
+    """One traced unit of work (a reconcile, a train step, a serve
+    request).  ``keys`` names the two identity fields in the exported
+    dict — ("controller", "request") on the control plane,
+    ("component", "request") elsewhere — so each plane's wire format
+    reads naturally while the machinery stays shared."""
+
+    def __init__(self, component: str, name: str,
+                 keys: Tuple[str, str] = ("component", "request")):
+        self.trace_id = f"{_id_prefix}{next(_id_counter) & 0xFFFFFFFF:08x}"
+        self.component = component
+        self.name = name
+        self.keys = keys
+        self.start_ts = time.time()
+        self._t0 = time.perf_counter()
+        self.spans: List[Span] = []
+        self.result = ""
+
+    def add_span(self, name: str, *, duration_s: float, offset_s: float = 0.0,
+                 **attrs) -> Span:
+        """Record an already-measured span (e.g. a queue wait that elapsed
+        before the trace began)."""
+        sp = Span(name, offset_s, attrs)
+        sp.duration_s = duration_s
+        self.spans.append(sp)
+        return sp
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            self.keys[0]: self.component,
+            self.keys[1]: self.name,
+            "start_ts": round(self.start_ts, 3),
+            "duration_ms": round(
+                (time.perf_counter() - self._t0) * 1e3, 3),
+            "result": self.result,
+            "spans": [s.to_dict() for s in self.spans],
+        }
+
+
+class Tracer:
+    """A plane's trace domain: its own thread-local active slot, ring
+    buffer, and slow-dump logger.  All methods mirror the PR-1 module
+    functions one-to-one."""
+
+    def __init__(self, name: str, *,
+                 keys: Tuple[str, str] = ("component", "request"),
+                 buffer_size: int = 64,
+                 logger: str = "kubeflow_tpu.telemetry.trace",
+                 slow_message: str = "slow trace"):
+        self.name = name
+        self.keys = keys
+        self.slow_message = slow_message
+        self.log = logging.getLogger(logger)
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._recent: collections.deque = collections.deque(
+            maxlen=buffer_size)
+
+    def begin(self, component: str, name: str, *,
+              enabled: bool = True) -> Optional[Trace]:
+        """Start a trace on the current thread (None when disabled).  Any
+        stale trace (prior work that died without finish()) is discarded —
+        traces never leak across units of work."""
+        if not enabled:
+            self._local.trace = None
+            return None
+        tr = Trace(component, name, self.keys)
+        self._local.trace = tr
+        return tr
+
+    def current(self) -> Optional[Trace]:
+        return getattr(self._local, "trace", None)
+
+    def active(self) -> bool:
+        return getattr(self._local, "trace", None) is not None
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Open a child span on the current thread's trace; no-op (yields
+        None) when no trace is active, so library code can instrument
+        unconditionally."""
+        tr = getattr(self._local, "trace", None)
+        if tr is None:
+            yield None
+            return
+        t0 = time.perf_counter()
+        sp = Span(name, t0 - tr._t0, attrs)
+        try:
+            yield sp
+        finally:
+            sp.duration_s = time.perf_counter() - t0
+            tr.spans.append(sp)
+
+    def finish(self, result: str = "", *,
+               slow_seconds: Optional[float] = None) -> Optional[dict]:
+        """Close the current thread's trace: record it in the ring buffer
+        and, when it crossed ``slow_seconds``, dump the span tree as one
+        JSON log line.  Returns the trace dict (None when no trace was
+        active)."""
+        tr = getattr(self._local, "trace", None)
+        if tr is None:
+            return None
+        self._local.trace = None
+        tr.result = result
+        d = tr.to_dict()
+        with self._lock:
+            self._recent.append(d)
+        if slow_seconds is not None and d["duration_ms"] >= slow_seconds * 1e3:
+            self.log.warning(
+                "%s: %s", self.slow_message, json.dumps(d, sort_keys=True))
+        return d
+
+    def recent(self, n: Optional[int] = None) -> List[dict]:
+        """Most recent completed traces, newest last (the /debug/traces
+        body).  ``n`` caps the result; n <= 0 returns nothing (``out[-0:]``
+        would be everything)."""
+        with self._lock:
+            out = list(self._recent)
+        if n is None:
+            return out
+        return out[-n:] if n > 0 else []
+
+    def clear(self) -> None:
+        """Test helper: empty the ring buffer."""
+        with self._lock:
+            self._recent.clear()

@@ -1,4 +1,4 @@
-"""Reusable training loop: log, checkpoint, resume, eval.
+"""Reusable training loop: log, checkpoint, resume, eval — instrumented.
 
 The reference platform leaves every training concern to user notebooks
 (SURVEY.md §2.13); this loop is the batteries the bundled images ship so a
@@ -13,6 +13,14 @@ Design points:
   steps, keeping the step stream free of host syncs — and the fetch is a
   scalar ``float()``, which on async/tunneled backends is the only
   reliable completion barrier (BASELINE.md measurement note).
+* **Step telemetry** (telemetry/compute.py): every step lands in
+  ``train_step_seconds{phase=compile|run}`` and carries a span trace
+  (data → dispatch → bookkeeping); log windows refresh the
+  ``train_tokens_per_sec``/``train_mfu`` gauges with the SAME accounting
+  bench.py prints.  A step slower than ``TRAIN_SLOW_STEP_SECONDS`` dumps
+  its span tree as one JSON log line (the step-level analog of the
+  control plane's slow-reconcile dumps) and, when a profile dir is
+  configured, auto-captures a JAX profiler trace of the NEXT step.
 * Pure orchestration: no jit/sharding in here — ``step_fn`` arrives
   already compiled (see parallel.train.make_sharded_train_step).
 """
@@ -20,8 +28,13 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
+from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from kubeflow_tpu import telemetry
+from kubeflow_tpu.telemetry import compute as ctel
 
 log = logging.getLogger("kubeflow_tpu.train")
 
@@ -35,6 +48,18 @@ class LoopConfig:
     max_to_keep: int = 3
     eval_every: int = 0          # 0 disables
     eval_steps: int = 10
+    # -- telemetry accounting (all optional) ---------------------------------
+    # Tokens consumed per optimizer step; inferred from a [batch, seq]
+    # integer token batch when unset.  Gates the tokens/s gauge.
+    tokens_per_step: Optional[int] = None
+    # Model FLOPs per token (telemetry.compute.lm_train_flops_per_token —
+    # the accounting bench.py documents).  Gates the MFU/TFLOPs gauges.
+    flops_per_token: Optional[float] = None
+    # MFU denominator; None = the v5e bf16 peak telemetry.compute pins.
+    peak_tflops: Optional[float] = None
+    # Auto-capture a JAX profiler trace of the step AFTER a slow one
+    # (once per run).  Falls back to $KFT_SLOW_STEP_PROFILE_DIR.
+    slow_step_profile_dir: Optional[str] = None
 
 
 def train_loop(
@@ -77,39 +102,100 @@ def train_loop(
     t0 = time.perf_counter()
     window_started_at = start_step
     step = start_step
+    tokens_per_step = cfg.tokens_per_step
+    profile_dir = cfg.slow_step_profile_dir or os.environ.get(
+        "KFT_SLOW_STEP_PROFILE_DIR")
+    profile_next = False
+    profile_done = False
 
     def fetch(metrics) -> Dict[str, float]:
         return {k: float(v) for k, v in metrics.items()}
 
     try:
         for step in range(start_step, cfg.total_steps):
+            now = step + 1
+            t_iter = time.perf_counter()
+            # The run's first step pays jit compilation (for a freshly
+            # built step_fn — a pre-warmed one is just a fast "compile"
+            # observation); the split keeps compile stalls out of the
+            # steady-state p50/p99.
+            phase = "compile" if step == start_step else "run"
+            ctel.train_tracer.begin(
+                "train", str(now), enabled=ctel.STEP_TRACE_ENABLED)
             try:
-                batch = next(it)
+                with ctel.train_tracer.span("data"):
+                    batch = next(it)
             except StopIteration:
+                ctel.train_tracer.finish("data_exhausted")
                 log.info("data exhausted at step %d", step)
                 break
-            state, last_metrics = step_fn(state, batch)
-            now = step + 1
-            if cfg.log_every and now % cfg.log_every == 0:
-                vals = fetch(last_metrics)  # completion barrier
-                dt = time.perf_counter() - t0
-                vals["steps_per_sec"] = (now - window_started_at) / max(dt, 1e-9)
-                entry = {"step": now, **vals}
-                history.append(entry)
-                (on_log or _default_log)(now, vals)
-                t0 = time.perf_counter()
-                window_started_at = now
-            if manager is not None:
-                manager.save(now, state)
-            if (
-                cfg.eval_every
-                and eval_fn is not None
-                and now % cfg.eval_every == 0
-            ):
-                vals = _run_eval(eval_fn, state, eval_batches, cfg.eval_steps)
-                entry = {"step": now, **{f"eval_{k}": v for k, v in vals.items()}}
-                history.append(entry)
-                (on_log or _default_log)(now, entry)
+            if tokens_per_step is None:
+                tokens_per_step = _tokens_in_batch(batch)
+            with ctel.train_tracer.span("dispatch", phase=phase):
+                if profile_next and not profile_done:
+                    profile_done, profile_next = True, False
+                    with _auto_profile(profile_dir), \
+                            ctel.train_tracer.span("profile",
+                                                   logdir=profile_dir):
+                        state, last_metrics = step_fn(state, batch)
+                        _barrier(last_metrics)
+                else:
+                    state, last_metrics = step_fn(state, batch)
+            # Step time = data + dispatch ONLY.  The bookkeeping below is
+            # deliberately excluded: on async backends the log-step fetch
+            # is a barrier that drains the WHOLE window's queued device
+            # work — counting it would flag every log_every-th step as
+            # "slow" and pollute the histogram with the logging cadence
+            # (checkpoint saves and eval likewise).  Those stalls stay
+            # visible as the bookkeeping span in the step trace.
+            dt_step = time.perf_counter() - t_iter
+            with ctel.train_tracer.span("bookkeeping"):
+                if cfg.log_every and now % cfg.log_every == 0:
+                    vals = fetch(last_metrics)  # completion barrier
+                    dt = time.perf_counter() - t0
+                    n_window = now - window_started_at
+                    vals["steps_per_sec"] = n_window / max(dt, 1e-9)
+                    if tokens_per_step:
+                        # Same accounting as bench.py: tokens/s over the
+                        # barrier-closed window; MFU = tokens/s x model
+                        # FLOPs/token / chip peak (telemetry.compute).
+                        vals.update(ctel.update_throughput(
+                            tokens_per_step * n_window / max(dt, 1e-9),
+                            flops_per_token=cfg.flops_per_token,
+                            peak_tflops=cfg.peak_tflops,
+                        ))
+                    entry = {"step": now, **vals}
+                    history.append(entry)
+                    (on_log or _default_log)(now, vals)
+                    t0 = time.perf_counter()
+                    window_started_at = now
+                if manager is not None:
+                    manager.save(now, state)
+                if (
+                    cfg.eval_every
+                    and eval_fn is not None
+                    and now % cfg.eval_every == 0
+                ):
+                    vals = _run_eval(eval_fn, state, eval_batches,
+                                     cfg.eval_steps)
+                    entry = {"step": now,
+                             **{f"eval_{k}": v for k, v in vals.items()}}
+                    history.append(entry)
+                    (on_log or _default_log)(now, entry)
+            ctel.observe_step(dt_step, phase=phase)
+            slow = dt_step >= ctel.TRAIN_SLOW_STEP_SECONDS
+            # The dump decision rides on the data+dispatch wall, not the
+            # whole trace duration (which includes bookkeeping).
+            ctel.train_tracer.finish(
+                "ok",
+                slow_seconds=ctel.TRAIN_SLOW_STEP_SECONDS if slow else None)
+            if slow:
+                ctel.train_slow_steps_total.inc()
+                if profile_dir and not profile_done:
+                    # Capture the NEXT step: this one already ran, and a
+                    # repeat of whatever stalled it is what the profile
+                    # should catch.
+                    profile_next = True
     finally:
         if manager is not None:
             final = step + 1
@@ -136,8 +222,82 @@ def _run_eval(eval_fn, state, eval_batches, eval_steps) -> Dict[str, float]:
 
 
 def _default_log(step: int, vals: Dict[str, float]) -> None:
-    parts = " ".join(
-        f"{k}={v:.4g}" if isinstance(v, float) else f"{k}={v}"
-        for k, v in vals.items() if k != "step"
-    )
-    print(f"step {step}: {parts}", flush=True)
+    # Structured key=value through the telemetry formatter — ONE
+    # machine-parseable shape for progress lines, consistent with the
+    # slow-step JSON dumps' field naming.  Printed (not just logged):
+    # stdout is the notebook/pod surface operators actually watch; the
+    # logger carries the same line for pipelines that configure handlers.
+    line = telemetry.logfmt(
+        "train_step", step=step,
+        **{k: v for k, v in vals.items() if k != "step"})
+    log.info("%s", line)
+    print(line, flush=True)
+
+
+def _tokens_in_batch(batch) -> Optional[int]:
+    """Tokens an LM step consumes, inferred from the batch: a [batch, seq]
+    integer array (or the first element of a (tokens, segment_ids) pair).
+    None for non-token batches (images) — the tokens/s gauge then stays
+    unset unless LoopConfig.tokens_per_step is given."""
+    if isinstance(batch, (tuple, list)):
+        if not batch:
+            return None
+        batch = batch[0]
+    shape = getattr(batch, "shape", None)
+    dtype = getattr(batch, "dtype", None)
+    if shape is None or dtype is None or len(shape) != 2:
+        return None
+    if "int" not in str(dtype):
+        return None
+    return int(shape[0]) * int(shape[1])
+
+
+def _barrier(metrics) -> None:
+    """Force completion so a profiled step's device work lands inside the
+    capture: a scalar device→host fetch when any metric converts (the
+    reliable barrier on async/tunneled backends — BASELINE.md), else
+    block_until_ready over whatever the step returned."""
+    vals = list((metrics or {}).values())
+    for v in vals:
+        try:
+            float(v)
+            return
+        except (TypeError, ValueError):
+            continue
+    try:
+        import jax
+
+        jax.block_until_ready(vals)
+    except Exception:
+        pass
+
+
+@contextmanager
+def _auto_profile(logdir: Optional[str]):
+    """Best-effort JAX profiler capture around the slow-step follow-up:
+    any profiler failure is logged and swallowed — a diagnosis aid must
+    never kill (or re-run) the training step it wraps.  The interactive
+    equivalent with strict semantics is train/profiling.py
+    ``profile_trace``."""
+    if not logdir:
+        yield
+        return
+    import jax
+
+    started = False
+    try:
+        os.makedirs(logdir, exist_ok=True)
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        log.warning("slow-step auto-profile: start_trace failed",
+                    exc_info=True)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                log.warning("slow-step auto-profile: stop_trace failed",
+                            exc_info=True)

@@ -17,20 +17,41 @@ Two entry points:
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 from typing import Any, Callable, Tuple
 
 import jax
 
+log = logging.getLogger("kubeflow_tpu.train.profiling")
+
 
 @contextlib.contextmanager
 def profile_trace(logdir: str):
-    """Capture a JAX profiler trace for the enclosed region."""
+    """Capture a JAX profiler trace for the enclosed region.
+
+    Crash-safe: when the REGION raises, ``stop_trace`` runs on a
+    best-effort basis — it can itself raise (e.g. ``start_trace`` died
+    half-initialized, or the backend wedged with the region), and a
+    profiling cleanup error must never mask the training exception the
+    operator actually needs.  On the clean path a ``stop_trace`` failure
+    still propagates: a "successful" profile with no trace written would
+    be a silent lie."""
     os.makedirs(logdir, exist_ok=True)
     jax.profiler.start_trace(logdir)
     try:
         yield logdir
-    finally:
+    except BaseException:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            log.warning(
+                "profiler stop_trace failed while unwinding a crashed "
+                "region (trace under %s may be incomplete)", logdir,
+                exc_info=True,
+            )
+        raise
+    else:
         jax.profiler.stop_trace()
 
 

@@ -226,6 +226,20 @@ def main(argv: Optional[list] = None) -> int:
     print(f"devices={len(jax.devices())} mesh={dict(mesh.shape)}", flush=True)
 
     build = build_lm if args.task == "lm" else build_image
+    telemetry_kwargs = {}
+    if args.task == "lm":
+        # Wire the loop's tokens/s + MFU gauges with the shared accounting
+        # (telemetry.compute — the formula bench.py prints); the model
+        # built here is a paramless config probe, not a second init.
+        from kubeflow_tpu.models import create_model
+        from kubeflow_tpu.telemetry import compute as ctel
+
+        probe = create_model(args.model, max_seq_len=args.seq)
+        telemetry_kwargs = dict(
+            tokens_per_step=args.batch * args.seq,
+            flops_per_token=ctel.lm_train_flops_per_token(
+                probe.cfg, args.seq),
+        )
     with global_mesh(mesh):
         state, step, batches = build(args, mesh)
         state, history = train_loop(
@@ -235,6 +249,7 @@ def main(argv: Optional[list] = None) -> int:
                 log_every=args.log_every,
                 checkpoint_dir=args.checkpoint_dir,
                 checkpoint_every=args.checkpoint_every,
+                **telemetry_kwargs,
             ),
         )
     if history:

@@ -170,16 +170,22 @@ def test_controller_queue_is_instrumented_by_name():
 
 
 def test_no_kubeflow_metrics_in_global_registry():
-    """Every kubeflow_tpu series must live in the module-local registry —
+    """Every kubeflow_tpu series must live in a module-local registry —
     a collector in prometheus_client.REGISTRY (the process-global default)
-    would stack duplicates when tests reimport modules."""
+    would stack duplicates when tests reimport modules.  Covers BOTH
+    planes: the control-plane registry (runtime/metrics.py) and the
+    compute-plane registry (telemetry/compute.py) obey the same
+    contract."""
     import prometheus_client
 
     # Import every module that defines or registers metrics.
+    import kubeflow_tpu.ops.attention  # noqa: F401
     import kubeflow_tpu.platform.k8s.client  # noqa: F401
     import kubeflow_tpu.platform.runtime.controller  # noqa: F401
     import kubeflow_tpu.platform.runtime.informer  # noqa: F401
     import kubeflow_tpu.platform.web.crud_backend  # noqa: F401
+    import kubeflow_tpu.train.loop  # noqa: F401
+    from kubeflow_tpu.telemetry import compute as ctel
 
     ours = {
         name
@@ -187,12 +193,23 @@ def test_no_kubeflow_metrics_in_global_registry():
         for name in names
     }
     assert ours, "module-local registry unexpectedly empty"
+    compute_names = {
+        name
+        for names in ctel.registry._collector_to_names.values()
+        for name in names
+    }
+    assert compute_names, "compute-plane registry unexpectedly empty"
+    assert "train_step_seconds" in compute_names
+    # The two planes' registries must not shadow each other's series
+    # either — one scrape target per family.
+    shared = ours & compute_names
+    assert not shared, f"series defined in both plane registries: {shared}"
     global_names = {
         name
         for names in prometheus_client.REGISTRY._collector_to_names.values()
         for name in names
     }
-    leaked = ours & global_names
+    leaked = (ours | compute_names) & global_names
     assert not leaked, (
         f"kubeflow_tpu metrics registered into the process-global "
         f"prometheus registry: {sorted(leaked)}"

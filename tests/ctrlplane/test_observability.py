@@ -106,6 +106,51 @@ def test_manager_metrics_expose_runtime_series_end_to_end():
         api_server.stop()
 
 
+def test_trace_lift_keeps_platform_surface_and_one_implementation():
+    """ISSUE 6 shared-core contract: the PR-1 module API survives the
+    lift into kubeflow_tpu.telemetry (no behavior drift — same names,
+    same knobs, same controller/request wire keys), and there is exactly
+    ONE span/trace implementation under both halves."""
+    from kubeflow_tpu import telemetry
+    from kubeflow_tpu.telemetry import compute as ctel
+
+    # Full PR-1 surface present with the env-knob module attributes.
+    for name in ("begin", "current", "active", "span", "finish", "recent",
+                 "clear", "Span", "Trace"):
+        assert hasattr(trace, name), name
+    assert isinstance(trace.SLOW_RECONCILE_SECONDS, float)
+    assert isinstance(trace.ENABLED, bool)
+    assert isinstance(trace.TRACE_BUFFER_SIZE, int)
+
+    # One implementation: the platform Span IS the telemetry Span, and
+    # the compute plane's tracer is the same engine.
+    assert trace.Span is telemetry.Span
+    assert issubclass(trace.Trace, telemetry.Trace)
+    assert isinstance(ctel.train_tracer, telemetry.Tracer)
+    assert trace.log.name == "kubeflow_tpu.runtime.trace"
+
+    # A begin/span/finish round-trip keeps the control-plane wire format.
+    trace.begin("probe-controller", "ns/name")
+    assert trace.active()
+    with trace.span("work", kind="Probe"):
+        pass
+    d = trace.finish(result="success")
+    assert d["controller"] == "probe-controller"
+    assert d["request"] == "ns/name"
+    assert d["result"] == "success"
+    assert d["spans"][0]["name"] == "work"
+    assert d["spans"][0]["kind"] == "Probe"
+    assert trace.recent()[-1]["trace_id"] == d["trace_id"]
+    # Disabled begin clears the active slot (the stale-trace guard).
+    prev = trace.ENABLED
+    try:
+        trace.ENABLED = False
+        assert trace.begin("x", "y") is None
+        assert not trace.active()
+    finally:
+        trace.ENABLED = prev
+
+
 def test_slow_reconcile_dumps_structured_trace(monkeypatch, caplog):
     """A reconcile crossing the slow threshold emits ONE JSON log line
     whose span tree covers dequeue → reconcile → client call, and the

@@ -284,6 +284,111 @@ def test_token_rows_reject_bools(client):
     assert resp.status_code == 400
 
 
+def test_serve_telemetry_series_and_traces(client):
+    """ISSUE 6 acceptance: /metrics exposes queue-depth, batch-occupancy,
+    TTFT, and per-token-latency series after a generate, and
+    /debug/traces returns a per-request span tree
+    (admit → queue → prefill → decode)."""
+    resp = client.post("/v1/generate", json={
+        "tokens": [[5, 9], [7, 1, 4]], "max_new_tokens": 4,
+    })
+    assert resp.status_code == 200
+    text = client.get("/metrics").get_data(as_text=True)
+    assert "serve_queue_depth 0.0" in text  # gauge exists, drained
+    assert "serve_batch_rows_bucket" in text
+    assert "serve_batch_fill_ratio_bucket" in text
+    assert "serve_time_to_first_token_seconds_count 1.0" in text
+    assert "serve_per_token_seconds_count 1.0" in text
+    assert "serve_input_tokens_total 5.0" in text  # 2 + 3 prompt tokens
+    assert "serve_output_tokens_total 8.0" in text  # 2 rows x 4, no EOS
+
+    body = client.get("/debug/traces").get_json()
+    traces = body["traces"]
+    assert traces, body
+    tr = traces[-1]
+    assert tr["component"] == "llama_debug"
+    assert tr["request"].startswith("req-")
+    assert tr["result"] == "ok"
+    names = [s["name"] for s in tr["spans"]]
+    assert names == ["admit", "queue", "prefill", "decode"]
+    # ?n= bounds the response (same contract as the controllers').
+    client.post("/v1/generate", json={"tokens": [[5]], "max_new_tokens": 2})
+    assert len(client.get("/debug/traces?n=1").get_json()["traces"]) == 1
+
+
+def test_serve_debug_traces_honors_opt_out(monkeypatch, service):
+    """DEBUG_TRACES=false 404s the serve traces endpoint — same opt-out
+    contract as the controllers' health port (both are unauthenticated)."""
+    from kubeflow_tpu.models.serve import create_app as mk_app
+
+    monkeypatch.setenv("DEBUG_TRACES", "false")
+    c = Client(mk_app(service, model_name="llama_debug"))
+    assert c.get("/debug/traces").status_code == 404
+    # Metrics stay up; only the trace bodies are gated.
+    assert c.get("/metrics").status_code == 200
+
+
+def test_serve_trace_records_invalid_requests(client):
+    client.post("/v1/generate", json={"tokens": [[999999]]})
+    traces = client.get("/debug/traces").get_json()["traces"]
+    assert traces and traces[-1]["result"] == "error"
+
+
+def test_serve_two_phase_matches_one_shot_generate(service):
+    """The instrumented service path (generate_prefill + generate_decode)
+    must produce EXACTLY the one-shot generate()'s tokens — the split is
+    a jit boundary, not a semantic change (shared _prefill_parts/
+    _decode_scan in models/generate.py)."""
+    from kubeflow_tpu.models.serve import create_app as mk_app
+
+    mk_app(service, model_name="llama_debug")  # attaches telemetry
+    rows = [[5, 9, 2], [4, 4, 4, 4]]
+    got = service.generate(rows, max_new_tokens=5, temperature=0.7,
+                           top_k=7, seed=3)
+    want = generate(
+        service.model, service.params,
+        jnp.array([[5, 9, 2, 0], [4, 4, 4, 4]], jnp.int32),
+        prompt_mask=jnp.array([[1, 1, 1, 0], [1, 1, 1, 1]], bool),
+        max_new_tokens=5, temperature=0.7, top_k=7,
+        rng=jax.random.key(3),
+    )
+    assert got == jax.device_get(want).tolist()
+
+
+def test_serve_metrics_over_http_transport(service):
+    """E2E over a real socket (the acceptance wording): generate via
+    HTTP, then scrape /metrics and /debug/traces from the same server."""
+    import json as _json
+    import urllib.request
+
+    from kubeflow_tpu.models.serve import create_app as mk_app
+
+    app = mk_app(service, model_name="llama_debug")
+    server, base = app.test_server()
+    try:
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            data=_json.dumps({"tokens": [[5, 9, 2]],
+                              "max_new_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = _json.loads(resp.read())
+        assert len(out["tokens"][0]) == 3
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "serve_time_to_first_token_seconds_count 1.0" in text
+        assert "serve_queue_depth 0.0" in text
+        with urllib.request.urlopen(base + "/debug/traces",
+                                    timeout=10) as resp:
+            traces = _json.loads(resp.read())["traces"]
+        assert len(traces) >= 1
+        assert [s["name"] for s in traces[-1]["spans"]] == [
+            "admit", "queue", "prefill", "decode"]
+    finally:
+        server.shutdown()
+
+
 def test_tokens_total_excludes_post_eos_padding():
     """generate() right-pads finished rows with EOS; the throughput counter
     counts through the first EOS only (ADVICE r1)."""

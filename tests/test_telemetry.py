@@ -1,0 +1,418 @@
+"""Compute-plane telemetry (ISSUE 6): HBM gauges degrade gracefully on
+CPU, the train loop's MFU gauge matches bench.py's accounting, slow steps
+dump span trees, the attention pre-flight estimator fires BEFORE any
+allocation (the BENCH_r05 crash mode, observable CPU-only), and the
+shared trace core serves both halves of the repo."""
+import json
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu import telemetry
+from kubeflow_tpu.telemetry import compute as ctel
+
+
+def _sample(name, labels=None):
+    return ctel.registry.get_sample_value(name, labels or {})
+
+
+class _FakeDevice:
+    platform = "faketpu"
+
+    def __init__(self, id, stats):
+        self.id = id
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+# -- HBM watermarks -----------------------------------------------------------
+
+
+def test_memory_stats_none_degrades_to_absent_gauges(monkeypatch):
+    """A backend without memory introspection (CPU) exports NO
+    device_memory_bytes samples — and nothing crashes."""
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDevice(0, None)])
+    text = ctel.render().decode()
+    assert "device_memory_bytes{" not in text
+    assert ctel.hbm_peak_bytes() is None
+    assert ctel.free_hbm_bytes() is None
+
+
+def test_memory_stats_errors_degrade_too(monkeypatch):
+    class Exploding:
+        platform, id = "faketpu", 0
+
+        def memory_stats(self):
+            raise RuntimeError("backend wedged")
+
+    monkeypatch.setattr(jax, "devices", lambda: [Exploding()])
+    assert ctel.device_memory_snapshot() == {}
+    assert "device_memory_bytes{" not in ctel.render().decode()
+
+
+def test_device_memory_collector_exports_kinds(monkeypatch):
+    stats = {"bytes_in_use": 3 * 2**30, "peak_bytes_in_use": 5 * 2**30,
+             "bytes_limit": 16 * 2**30}
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda: [_FakeDevice(0, stats), _FakeDevice(1, None)])
+    text = ctel.render().decode()
+    for kind, val in (("in_use", 3), ("peak", 5), ("limit", 16)):
+        assert _sample("device_memory_bytes",
+                       {"device": "faketpu:0", "kind": kind}) == val * 2**30
+    # The stats-less sibling is absent, not zero.
+    assert 'device="faketpu:1"' not in text
+    assert ctel.hbm_peak_bytes() == 5 * 2**30
+    assert ctel.free_hbm_bytes() == 13 * 2**30
+
+
+# -- attention pre-flight estimator ------------------------------------------
+
+
+def test_attention_estimator_fires_before_any_allocation(monkeypatch, caplog):
+    """A deliberately oversized causal xla_attention (8 GB+ of O(S²)
+    state on a mocked 1 GB-free device) publishes the estimate gauge and
+    the structured warning from TRACE time — jax.eval_shape allocates
+    nothing, which is exactly the point: the BENCH_r05
+    RESOURCE_EXHAUSTED becomes a signal before the OOM, not after."""
+    from kubeflow_tpu.ops.attention import (
+        attention_footprint_bytes,
+        xla_attention,
+    )
+
+    monkeypatch.setattr(ctel, "free_hbm_bytes", lambda: 2**30)
+    before = _sample("attention_mask_budget_warnings_total") or 0.0
+    b, s, h, d = 2, 8192, 8, 64
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.bfloat16)
+    with caplog.at_level(logging.WARNING,
+                         logger="kubeflow_tpu.telemetry.compute"):
+        out = jax.eval_shape(
+            lambda q, k, v: xla_attention(q, k, v, causal=True), q, q, q)
+    assert out.shape == (b, s, h, d)
+
+    want = attention_footprint_bytes(
+        batch=b, heads=h, q_len=s, k_len=s, causal=True, segments=False)
+    assert want == 2 * 4 * b * h * s * s + s * s
+    assert _sample("attention_mask_bytes_estimate") == want
+    assert _sample("attention_mask_budget_warnings_total") == before + 1
+
+    dumps = [r for r in caplog.records
+             if "attention footprint over budget" in r.getMessage()]
+    assert dumps, caplog.records
+    msg = dumps[-1].getMessage()
+    payload = json.loads(msg[msg.index("{"):])
+    assert payload["event"] == "attention_mask_budget_exceeded"
+    assert payload["estimate_bytes"] == want
+    assert payload["free_hbm_bytes"] == 2**30
+    assert payload["q_len"] == s and payload["causal"] is True
+
+
+def test_attention_estimator_quiet_within_budget(monkeypatch, caplog):
+    from kubeflow_tpu.ops.attention import xla_attention
+
+    monkeypatch.setattr(ctel, "free_hbm_bytes", lambda: 2**34)
+    before = _sample("attention_mask_budget_warnings_total") or 0.0
+    q = jnp.ones((1, 16, 2, 8), jnp.float32)
+    with caplog.at_level(logging.WARNING,
+                         logger="kubeflow_tpu.telemetry.compute"):
+        xla_attention(q, q, q, causal=True)
+    assert _sample("attention_mask_budget_warnings_total") == before
+    # The gauge still tracks the (tiny) footprint.
+    assert _sample("attention_mask_bytes_estimate") == 2 * 4 * 2 * 256 + 256
+
+
+def test_attention_estimator_skips_unmasked_path():
+    from kubeflow_tpu.ops.attention import xla_attention
+
+    marker = 123456789.0
+    ctel.attention_mask_bytes_estimate.set(marker)
+    q = jnp.ones((1, 8, 2, 8), jnp.float32)
+    xla_attention(q, q, q, causal=False)  # no mask -> no estimate update
+    assert _sample("attention_mask_bytes_estimate") == marker
+
+
+# -- train-loop step telemetry ------------------------------------------------
+
+
+def _fake_lm_loop(n_steps, step_seconds=0.0, log_every=0, **cfg_kwargs):
+    """train_loop over a pure-Python step (no jit) with [4, 32] int
+    batches — fast, and everything telemetry sees is identical in shape
+    to a real LM loop."""
+    from kubeflow_tpu.train.loop import LoopConfig, train_loop
+
+    class State:
+        step = 0
+
+    def step_fn(state, batch):
+        if step_seconds:
+            time.sleep(step_seconds)
+        state.step += 1
+        return state, {"loss": 1.0 / state.step}
+
+    batches = (np.ones((4, 32), np.int32) for _ in range(n_steps))
+    return train_loop(
+        State(), step_fn, batches,
+        LoopConfig(total_steps=n_steps, log_every=log_every, **cfg_kwargs),
+    )
+
+
+def test_step_histogram_and_quantile_gauges_populate():
+    snap = ctel.step_snapshot()
+    _fake_lm_loop(4)
+    q = ctel.step_quantiles((0.5, 0.99), since=snap)
+    assert q[0.5] is not None and q[0.99] is not None
+    text = ctel.render().decode()
+    assert "train_step_seconds_p50" in text
+    assert "train_step_seconds_p99" in text
+    # First step of the run lands in the compile phase, the rest in run.
+    assert ctel.registry.get_sample_value(
+        "train_step_seconds_count", {"phase": "run"}) >= 3
+
+
+def test_mfu_gauge_matches_bench_accounting():
+    """Acceptance: the loop's exported tokens/s + MFU agree with
+    bench.py's own accounting (tokens/s x FLOPs/token / peak) within 1%
+    on a toy fixed-shape run — same formula, same telemetry layer."""
+    import bench
+    from kubeflow_tpu.models.llama import CONFIGS
+
+    cfg = CONFIGS["llama_debug"]
+    fpt = ctel.lm_train_flops_per_token(cfg, 32)
+    # One accounting: bench.py's name IS the telemetry function.
+    assert bench.lm_train_flops_per_token(cfg, 32) == fpt
+
+    _, history = _fake_lm_loop(6, log_every=3, flops_per_token=fpt)
+    last = history[-1]
+    assert last["tokens_per_sec"] > 0
+    # tokens inferred from the [4, 32] int batch.
+    assert last["tokens_per_sec"] == pytest.approx(
+        last["steps_per_sec"] * 4 * 32, rel=1e-6)
+    expected_mfu = bench.ctel.mfu(last["tokens_per_sec"], fpt)
+    assert _sample("train_mfu") == pytest.approx(expected_mfu, rel=0.01)
+    assert _sample("train_tokens_per_sec") == pytest.approx(
+        last["tokens_per_sec"], rel=0.01)
+    assert last["mfu"] == pytest.approx(expected_mfu, rel=0.01)
+
+
+def test_slow_step_dump_fires_on_injected_sleep(monkeypatch, caplog):
+    """Acceptance: an injected slow step yields ONE JSON dump with >= 3
+    spans (data -> dispatch -> bookkeeping) on the train trace logger."""
+    monkeypatch.setattr(ctel, "TRAIN_SLOW_STEP_SECONDS", 0.01)
+    before = _sample("train_slow_steps_total") or 0.0
+    with caplog.at_level(logging.WARNING, logger="kubeflow_tpu.train.trace"):
+        _fake_lm_loop(2, step_seconds=0.03)
+    dumps = [r for r in caplog.records
+             if "slow train step trace" in r.getMessage()]
+    assert dumps
+    msg = dumps[0].getMessage()
+    payload = json.loads(msg[msg.index("{"):])
+    assert payload["component"] == "train"
+    assert payload["duration_ms"] >= 10.0
+    names = [s["name"] for s in payload["spans"]]
+    assert len(names) >= 3, names
+    assert {"data", "dispatch", "bookkeeping"} <= set(names)
+    dispatch = next(s for s in payload["spans"] if s["name"] == "dispatch")
+    assert dispatch["phase"] in ("compile", "run")
+    assert _sample("train_slow_steps_total") >= before + 2
+    # Same trace queryable from the ring buffer (the /debug/traces source).
+    assert any(t["trace_id"] == payload["trace_id"]
+               for t in ctel.train_tracer.recent())
+
+
+def test_log_window_barrier_not_counted_as_slow_step(monkeypatch, caplog):
+    """The log-step metric fetch is a whole-window barrier on async
+    backends — it must not trip the slow-step dump or land in the step
+    histogram (only data+dispatch count)."""
+    from kubeflow_tpu.train.loop import LoopConfig, train_loop
+
+    monkeypatch.setattr(ctel, "TRAIN_SLOW_STEP_SECONDS", 0.02)
+    before = _sample("train_slow_steps_total") or 0.0
+
+    class StallingMetric:
+        """float() stalls, like fetching a device value drains the
+        pipeline."""
+
+        def __float__(self):
+            time.sleep(0.05)
+            return 1.0
+
+    class State:
+        step = 0
+
+    def step_fn(state, batch):
+        return state, {"loss": StallingMetric()}
+
+    batches = (np.ones((4, 32), np.int32) for _ in range(3))
+    with caplog.at_level(logging.WARNING, logger="kubeflow_tpu.train.trace"):
+        train_loop(State(), step_fn, batches,
+                   LoopConfig(total_steps=3, log_every=1))
+    assert not [r for r in caplog.records
+                if "slow train step trace" in r.getMessage()]
+    assert _sample("train_slow_steps_total") == before
+
+
+def test_barrier_survives_non_scalar_first_metric():
+    from kubeflow_tpu.train.loop import _barrier
+
+    fetched = []
+
+    class Scalar:
+        def __float__(self):
+            fetched.append(True)
+            return 1.0
+
+    # A multi-element leading metric must not stop the sweep before a
+    # convertible value provides the completion barrier.
+    _barrier({"per_token": np.ones(3), "loss": Scalar()})
+    assert fetched == [True]
+    _barrier({})  # empty metrics: no crash
+    _barrier({"arr": np.ones(3)})  # nothing scalar: block_until_ready path
+
+
+def test_slow_step_auto_captures_profile(monkeypatch, tmp_path):
+    """A slow step arms a JAX profiler capture of the NEXT step (once per
+    run), wired through train/profiling.py machinery."""
+    import os
+
+    monkeypatch.setattr(ctel, "TRAIN_SLOW_STEP_SECONDS", 0.01)
+    logdir = str(tmp_path / "slowprof")
+    _fake_lm_loop(3, step_seconds=0.03, slow_step_profile_dir=logdir)
+    found = []
+    for _root, _dirs, files in os.walk(logdir):
+        found.extend(files)
+    assert found, f"no profiler trace files under {logdir}"
+    # The captured step carries a profile span.
+    prof = [t for t in ctel.train_tracer.recent()
+            for s in t["spans"] if s["name"] == "profile"]
+    assert prof
+
+
+def test_default_log_emits_structured_kv_line(capsys):
+    from kubeflow_tpu.train.loop import _default_log
+
+    _default_log(4, {"loss": 1.5, "steps_per_sec": 2.25, "step": 4})
+    out = capsys.readouterr().out.strip()
+    assert out.startswith("train_step ")
+    fields = dict(kv.split("=", 1) for kv in out.split()[1:])
+    assert fields == {"step": "4", "loss": "1.5", "steps_per_sec": "2.25"}
+
+
+def test_logfmt_shared_formatter():
+    line = telemetry.logfmt("ev", a=1, b=0.123456789, c="x")
+    assert line == "ev a=1 b=0.123457 c=x"
+
+
+# -- profiling robustness (satellite) ----------------------------------------
+
+
+def test_profile_trace_never_masks_region_exception(monkeypatch, caplog):
+    """A crashed region propagates ITS exception even when stop_trace
+    blows up in the unwind (the pre-fix behavior raised the profiler's
+    error instead, masking the training failure)."""
+    from kubeflow_tpu.train import profiling
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda logdir: None)
+
+    def bad_stop():
+        raise RuntimeError("profiler wedged")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", bad_stop)
+    with caplog.at_level(logging.WARNING,
+                         logger="kubeflow_tpu.train.profiling"):
+        with pytest.raises(ValueError, match="training blew up"):
+            with profiling.profile_trace(str("/tmp/kft-prof-test")):
+                raise ValueError("training blew up")
+    assert any("stop_trace failed" in r.getMessage() for r in caplog.records)
+
+
+def test_profile_trace_clean_path_still_strict(monkeypatch, tmp_path):
+    """On the SUCCESS path a stop_trace failure propagates — a 'profile'
+    that wrote no trace must not report success."""
+    from kubeflow_tpu.train import profiling
+
+    monkeypatch.setattr(jax.profiler, "start_trace", lambda logdir: None)
+
+    def bad_stop():
+        raise RuntimeError("no trace written")
+
+    monkeypatch.setattr(jax.profiler, "stop_trace", bad_stop)
+    with pytest.raises(RuntimeError, match="no trace written"):
+        with profiling.profile_trace(str(tmp_path)):
+            pass
+
+
+def test_profile_trace_start_failure_propagates(monkeypatch, tmp_path):
+    from kubeflow_tpu.train import profiling
+
+    def bad_start(logdir):
+        raise RuntimeError("no backend")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", bad_start)
+    with pytest.raises(RuntimeError, match="no backend"):
+        with profiling.profile_trace(str(tmp_path)):
+            pytest.fail("region must not run when start_trace failed")
+
+
+def test_generate_decode_rejects_mismatched_budget():
+    """The decode budget must equal what the prefill sized its cache for
+    — a longer scan would clamp writes into the last cache slot and
+    return garbage with no error (review finding); defaulted = safe,
+    mismatched = loud."""
+    import dataclasses
+
+    from kubeflow_tpu.models.generate import (
+        generate,
+        generate_decode,
+        generate_prefill,
+    )
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=64)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0),
+                        jnp.ones((1, 8), jnp.int32))["params"]
+    prompt = jnp.array([[5, 9, 2]], jnp.int32)
+    one = generate(model, params, prompt, max_new_tokens=4)
+    _, st = generate_prefill(model, params, prompt, max_new_tokens=4)
+    # Omitting the kwarg inherits the prefill budget.
+    assert (generate_decode(model, params, st) == one).all()
+    _, st = generate_prefill(model, params, prompt, max_new_tokens=4)
+    with pytest.raises(ValueError, match="does not match the budget"):
+        generate_decode(model, params, st, max_new_tokens=32)
+
+
+# -- shared trace core --------------------------------------------------------
+
+
+def test_tracer_isolated_per_plane():
+    """The train tracer and a fresh serve-style tracer share ONE
+    implementation but never interleave buffers or active slots."""
+    from kubeflow_tpu.telemetry.trace import Tracer
+
+    a = Tracer("a", keys=("component", "request"))
+    b = Tracer("b", keys=("component", "request"))
+    a.begin("a", "r1")
+    assert a.active() and not b.active()
+    with a.span("x"):
+        pass
+    with b.span("ghost"):  # no active b trace: no-op
+        pass
+    d = a.finish("ok")
+    assert [s["name"] for s in d["spans"]] == ["x"]
+    assert a.recent() and not b.recent()
+
+
+def test_tracer_key_naming_matches_plane():
+    from kubeflow_tpu.telemetry.trace import Tracer
+
+    t = Tracer("serve", keys=("component", "request"))
+    t.begin("model-serve", "req-1")
+    d = t.finish("ok")
+    assert d["component"] == "model-serve" and d["request"] == "req-1"
+    assert "controller" not in d
