@@ -72,6 +72,13 @@ RESNET_REGRESSION_BAND = 0.95
 # was -3.9%, attributed to the tunnel: same code both rounds, and the
 # within-session best-window repeats to ~1.3% — BASELINE.md), tight
 # enough to catch a real 12%+ regression.
+# Round-6 note: the flash arm's math is unchanged so the value band
+# holds, but the XLA denominator arm is now mask-free (iota-fused
+# masking + jitted init — ISSUE 7), so it both COMPLETES at seq 8192
+# (BENCH_r05 died in create_train_state) and runs faster: expect
+# vs_baseline ratios to compress while the banded VALUE stays the
+# trajectory's regression tripwire.  Re-pin the constant from the next
+# on-chip session's best window if it moves past the band.
 BASELINE_LLAMA8K_TPS = 155_739.0   # r3 best session (r4 read 149.7k)
 BASELINE_LLAMA1B4_TPS = 10_922.8   # r5 full-bench best, bf16-grad arm
 BASELINE_VIT_IPS = 968.5           # r4 hardware lane, promoted to bench r5
@@ -158,6 +165,10 @@ def _llama_train_bench(
         the r03-protocol window count would push the whole bench past the
         driver's budget for a denominator that is stable to 0.1%."""
         n_steps, n_windows, n_warmup = protocol or (steps, windows, warmup)
+        # Snapshot the impl-selection counter so the line can prove which
+        # kernel this arm traced — a flash arm that silently fell back to
+        # XLA would report a bogus ratio (ci/bench_smoke.py pins this).
+        pallas_calls0 = ctel.attention_impl_calls("pallas")
         cfg = dataclasses.replace(base_cfg, attn_impl=attn_impl)
         model = Llama(cfg)
         state = create_train_state(rng, model, tokens, optimizer)
@@ -186,17 +197,18 @@ def _llama_train_bench(
             tokens_per_window / min(dts),
             tokens_per_window * len(dts) / sum(dts),
             q,
+            ctel.attention_impl_calls("pallas") - pallas_calls0,
         )
 
-    flash_tps, flash_mean, flash_q = measure(flash_cfg, "pallas",
-                                             arm_grad_dtype=grad_dtype)
+    flash_tps, flash_mean, flash_q, flash_pc = measure(
+        flash_cfg, "pallas", arm_grad_dtype=grad_dtype)
     # xla_grad_dtype="same" inherits grad_dtype; at 1.36B the XLA arm
     # pins f32 — bf16 grads change its block-remat schedule enough that
     # the compile OOMs on the 16 GB chip (measured round 5), and the
     # dtype's ~1% effect is noise on a 27-30x ratio.
     xla_gd = grad_dtype if xla_grad_dtype == "same" else xla_grad_dtype
-    xla_tps, xla_mean, _xla_q = measure(xla_cfg, "xla", protocol=xla_protocol,
-                                        arm_grad_dtype=xla_gd)
+    xla_tps, xla_mean, _xla_q, xla_pc = measure(
+        xla_cfg, "xla", protocol=xla_protocol, arm_grad_dtype=xla_gd)
     # Absolute efficiency (VERDICT r3 item 2): useful model FLOPs over the
     # chip's bf16 peak — accounting AND gauges via telemetry.compute, so
     # this line and a live scrape can never disagree.
@@ -228,6 +240,13 @@ def _llama_train_bench(
         # flash-arm step quantiles from the shared histogram.
         "step_p50_s": _round_or_none(flash_q.get(0.5), 6),
         "step_p99_s": _round_or_none(flash_q.get(0.99), 6),
+        # Kernel-selection proof (attention_kernel_calls_total diff per
+        # arm): the flash arm must have traced the Pallas kernel at least
+        # once and the XLA arm never — a shape/routing regression that
+        # silently sends the "pallas" arm through XLA turns the ratio
+        # into 1.0x noise without this tripwire.
+        "flash_arm_pallas_calls": int(flash_pc),
+        "xla_arm_pallas_calls": int(xla_pc),
         "seq_len": seq,
         "batch": batch,
         "windows": windows,
@@ -256,8 +275,11 @@ def _llama_train_bench(
     # The XLA arm's masked attention ran its pre-flight estimator at
     # trace time (ops/attention.py → telemetry.compute); surface the
     # estimate as its own report line so a BENCH json shows the O(S²)
-    # footprint the fallback path would materialize.  AFTER the metric
-    # line: the driver's first/last-line parse expects the primary first.
+    # footprint the fallback path would materialize — since ISSUE 7 that
+    # is the f32 logits+probs pair only (masking is iota-fused,
+    # allocation-free; ci/bench_smoke.py asserts the exact formula).
+    # AFTER the metric line: the driver's first/last-line parse expects
+    # the primary first.
     mask_est = ctel.attention_estimate_value()
     if mask_est:
         print(json.dumps({

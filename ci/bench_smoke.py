@@ -92,11 +92,34 @@ def check_compute_bench() -> int:
         print(f"llama8k line missing hbm_peak_bytes: {line}",
               file=sys.stderr)
         return 1
+    # Kernel-selection proof (ISSUE 7): the flash arm must have traced
+    # the Pallas kernel at least once, and the XLA arm never — a routing
+    # regression that silently falls back to XLA makes the A/B ratio
+    # meaningless long before anyone reads a BENCH json.
+    if not line.get("flash_arm_pallas_calls", 0) > 0:
+        print("flash arm never selected the Pallas kernel "
+              f"(silent XLA fallback?): {line}", file=sys.stderr)
+        return 1
+    if line.get("xla_arm_pallas_calls") != 0:
+        print(f"XLA arm unexpectedly traced the Pallas kernel: {line}",
+              file=sys.stderr)
+        return 1
     est = seen.get("attention_mask_bytes_estimate")
     if est is None or not est.get("value", 0) > 0:
         # The XLA arm ran a masked causal attention, so the pre-flight
         # estimator MUST have published a positive footprint.
         print(f"mask-estimate line missing/zero after the XLA arm: {est}",
+              file=sys.stderr)
+        return 1
+    # The XLA arm is mask-free (ISSUE 7): the footprint is the f32
+    # logits+probs pair ONLY — 2 * 4 * b * h * sq * sk with the smoke
+    # config's h=2 (bench._smoke_cfg).  Exact equality: any extra term
+    # means a materialized mask buffer crept back into the estimator (or
+    # the path it describes).
+    want = 2 * 4 * est["batch"] * 2 * est["seq_len"] * est["seq_len"]
+    if est["value"] != want:
+        print(f"mask estimate {est['value']} != logits+probs-only {want}: "
+              f"a mask buffer term is back in the footprint: {est}",
               file=sys.stderr)
         return 1
     print(f"bench-smoke compute OK: {len(seen)} metrics "

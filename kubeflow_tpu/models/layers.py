@@ -117,8 +117,20 @@ class Attention(nn.Module):
         if decode and kv is not None:
             # Cross-attention with the once-projected K/V: no positional
             # masking (every source token is visible modulo mask_bias).
+            # Honors attn_impl — the bias-free case (no encoder padding /
+            # relative bias) is a flash-eligible cross-length shape
+            # (sq = decode tokens, sk = source len).  The bias path stays
+            # XLA for now: the kernel carries no bias tiles (a per-tile
+            # additive load is future work) and T5's relative/padding
+            # bias always lands here.  A FORCED "pallas" softens to
+            # "auto" on this opportunistic route: single-token decode
+            # steps (sq=1) sit below the kernel's tile floor, and a
+            # config that generated fine before must fall back, not
+            # raise, when this path's shapes reject the kernel.
+            impl = self.attn_impl if mask_bias is None else "xla"
             out = ops.dot_product_attention(
-                q, k, v, causal=False, bias=mask_bias, impl="xla",
+                q, k, v, causal=False, bias=mask_bias,
+                impl="auto" if impl == "pallas" else impl,
                 softmax_scale=self.softmax_scale,
             )
         elif decode:
@@ -149,6 +161,12 @@ class Attention(nn.Module):
                             q, k, v, rows, softmax_scale=self.softmax_scale
                         )
             if out is None:
+                # Stays impl="xla" deliberately: the cache path ALWAYS has
+                # a bias (the unwritten-slot/causal bias from
+                # _update_cache), which the flash kernel does not take —
+                # and the footprint is [b, h, s, max_len] with s = the
+                # prefill chunk, not O(S²) of the full sequence.  The
+                # single-token case has the opt-in flash_decode above.
                 out = ops.dot_product_attention(
                     q, k, v, causal=False, bias=bias, impl="xla",
                     softmax_scale=self.softmax_scale,

@@ -9,10 +9,17 @@ long-context support there is "whatever the user runs inside the notebook".
 Here it is a first-class op: ``impl="pallas"`` selects the flash kernel
 (ops/pallas/flash_attention.py), and ring-attention context parallelism
 builds on this op in ``kubeflow_tpu.parallel.ring``.
+
+Masking is allocation-free on every path.  The XLA fallback builds its
+causal condition from a ``broadcasted_iota`` row/col comparison fused
+straight into the ``jnp.where`` — no ``jnp.tril(jnp.ones(...))`` bool
+buffer (the exact BENCH_r05 RESOURCE_EXHAUSTED allocation, which
+materialized eagerly during ``model.init`` outside any jit) — and folds
+segment-id equality into the same fused select.  The flash kernel never
+materializes the [Sq, Sk] plane at all.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -24,17 +31,21 @@ def attention_footprint_bytes(*, batch: int, heads: int, q_len: int,
                               segments: bool) -> int:
     """O(S²) bytes the masked XLA path materializes, from shapes alone:
     the f32 logits AND softmax probs ([b, h, sq, sk] each — softmax
-    computes in f32 before the value-matmul cast), the boolean causal
-    tril ([sq, sk] — the exact BENCH_r05 allocation), and the per-batch
-    segment mask when packing.  Computed at trace time, strictly before
-    XLA allocates any of it."""
-    s2 = q_len * k_len
-    total = 2 * 4 * batch * heads * s2            # f32 logits + probs
-    if causal:
-        total += s2                               # bool tril mask
-    if segments:
-        total += batch * s2                       # bool segment mask
-    return total
+    computes in f32 before the value-matmul cast).  The masks themselves
+    no longer count: both the causal condition and the segment-id
+    equality are iota/compare ops fused into the select, so no standalone
+    mask buffer exists (``causal``/``segments`` stay in the signature for
+    the telemetry attrs and future per-variant accounting).  Computed at
+    trace time, strictly before XLA allocates any of it.
+
+    Scope: this is the JIT-regime footprint (every production path — the
+    train step and now ``create_train_state``'s jitted init — runs under
+    jit, where the select condition fuses to zero bytes).  A bare eager
+    call additionally holds the transient bool condition (sq·sk, plus
+    b·sq·sk with segments) while the select executes — O(S²)/4 of the
+    logits term, and still far below the old ones+tril+segment buffers."""
+    del causal, segments  # mask-free: neither adds a materialized buffer
+    return 2 * 4 * batch * heads * q_len * k_len  # f32 logits + probs
 
 
 def _preflight_mask_check(q: jax.Array, k: jax.Array, *, causal: bool,
@@ -75,15 +86,21 @@ def xla_attention(
     bias: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
 ) -> jax.Array:
-    """Reference implementation; XLA fuses this well enough for short seqs."""
+    """Reference implementation; XLA fuses this well enough for short seqs.
+
+    Masking is mask-free: the causal condition is an iota comparison and
+    the segment condition an equality compare, both fused by XLA into the
+    single ``jnp.where`` select over the logits — no [sq, sk] boolean
+    buffer is ever a standalone allocation (regression-pinned by
+    tests/test_attention.py's jaxpr inspection)."""
     orig_dtype = q.dtype
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)
     v = _repeat_kv(v, n_rep)
     if causal or segment_ids is not None:
-        # Pre-flight BEFORE building logits/mask: estimate the O(S²)
-        # footprint from static shapes and warn when it won't fit the
-        # HBM budget (telemetry.compute) — the BENCH_r05 crash mode.
+        # Pre-flight BEFORE building logits: estimate the O(S²) footprint
+        # from static shapes and warn when it won't fit the HBM budget
+        # (telemetry.compute) — the BENCH_r05 crash mode.
         _preflight_mask_check(
             q, k, causal=causal, segments=segment_ids is not None)
     scale = softmax_scale if softmax_scale is not None else q.shape[-1] ** -0.5
@@ -93,16 +110,23 @@ def xla_attention(
     logits = logits * scale
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
-    mask = None
+    cond = None
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        # Offset supports cross-ring blocks where q starts later than k.
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)[None, None]
+        # End-aligned (offset sk - sq, the old tril(k=sk-sq) convention —
+        # supports cross-ring blocks where q starts later than k).  The
+        # iotas are O(S) column/row VECTORS broadcast by the compare: under
+        # jit everything fuses into the select (zero mask buffers); even
+        # eagerly the only transient is the bool condition the select
+        # needs anyway — never an O(S²) int32 or f32 ones/tril buffer.
+        rows = jax.lax.broadcasted_iota(jnp.int32, (1, 1, sq, 1), 2)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, sk), 3)
+        cond = (rows + (sk - sq)) >= cols
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
-        mask = seg if mask is None else (mask & seg)
-    if mask is not None:
-        logits = jnp.where(mask, logits, -1e30)
+        cond = seg if cond is None else jnp.logical_and(cond, seg)
+    if cond is not None:
+        logits = jnp.where(cond, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return out.astype(orig_dtype)
@@ -122,15 +146,22 @@ def dot_product_attention(
     """Scaled dot-product attention, BSHD layout.
 
     impl: "auto" | "pallas" | "xla" | "ring" | "ulysses".  "auto" prefers
-    the Pallas flash kernel on TPU for bias-free shapes it supports, else
-    falls back to XLA.  "ring" runs sequence-parallel ring attention over
-    the active mesh's ``sp`` axis (kubeflow_tpu.parallel.ring); "ulysses"
-    re-shards head↔sequence with all-to-alls instead
-    (kubeflow_tpu.parallel.ulysses) — better when heads divide the axis and
-    per-device sequence fits HBM.
+    the Pallas flash kernel on TPU for bias-free shapes it supports
+    (including packed ``segment_ids`` and causal sq<sk), else falls back
+    to XLA.  "ring" runs sequence-parallel ring attention over the active
+    mesh's ``sp`` axis (kubeflow_tpu.parallel.ring); "ulysses" re-shards
+    head↔sequence with all-to-alls instead (kubeflow_tpu.parallel.ulysses)
+    — better when heads divide the axis and per-device sequence fits HBM.
+
+    The selected implementation is recorded at trace time in
+    ``attention_kernel_calls_total{impl}`` (telemetry.compute) — the
+    signal ci/bench_smoke.py uses to prove the flash arm really ran the
+    Pallas kernel rather than silently falling back.
     """
     if impl not in ("auto", "pallas", "xla", "ring", "ulysses"):
         raise ValueError(f"unknown impl {impl!r}")
+    from kubeflow_tpu.telemetry import compute as ctel
+
     if impl in ("ring", "ulysses"):
         from kubeflow_tpu.parallel.context import get_global_mesh
 
@@ -142,6 +173,7 @@ def dot_product_attention(
             )
         if bias is not None or segment_ids is not None:
             raise NotImplementedError(f"{impl} attention: bias/segment_ids TODO")
+        ctel.note_attention_impl(impl)
         if impl == "ring":
             from kubeflow_tpu.parallel.ring import ring_attention
 
@@ -158,16 +190,24 @@ def dot_product_attention(
     if impl in ("auto", "pallas"):
         from kubeflow_tpu.ops.pallas import flash_attention as fa
 
-        ok = fa.supported(q, k, v, bias=bias, segment_ids=segment_ids)
+        ok = fa.supported(q, k, v, bias=bias, segment_ids=segment_ids,
+                          causal=causal)
         if impl == "pallas" and not ok:
             raise ValueError("pallas flash attention does not support this shape")
-        use_pallas = ok and (impl == "pallas" or fa.should_use(q))
+        use_pallas = ok and (
+            impl == "pallas"
+            or fa.should_use(q, k, causal=causal,
+                             segments=segment_ids is not None)
+        )
     if use_pallas:
         from kubeflow_tpu.ops.pallas import flash_attention as fa
 
+        ctel.note_attention_impl("pallas")
         return fa.flash_attention(
-            q, k, v, causal=causal, softmax_scale=softmax_scale
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            softmax_scale=softmax_scale
         )
+    ctel.note_attention_impl("xla")
     return xla_attention(
         q,
         k,

@@ -13,7 +13,15 @@ laid out for the MXU:
 * The kv grid axis is ``arbitrary`` (sequential) so scratch carries across
   iterations; batch/head/q axes are ``parallel``.
 * Causal masking skips fully-masked kv blocks via ``pl.when`` — ~2x fewer
-  tiles at long sequence.
+  tiles at long sequence.  Cross-length causal shapes (sq < sk, the ragged
+  prefill / decode-style case) use the END-ALIGNED convention: query row i
+  sees keys up to i + (sk - sq), matching ``xla_attention``'s tril offset.
+* Packed sequences: ``segment_ids`` ([b, s] int, 0 = padding) mask
+  cross-document attention inside each tile.  The q ids ride lane-replicated
+  ([b, s, 128] — the lse layout) and the kv ids sublane-replicated
+  ([b, 8, s]), so each tile's compare is one VPU broadcast; rows whose
+  segment has no match in a tile zero their probs explicitly (the running
+  max is still the init sentinel there, so exp(s - m) would read 1).
 
 Backward: blocked Pallas kernels (FlashAttention-2 style).  The forward
 saves only the per-row logsumexp (lane-replicated [b, h, s, 128], the
@@ -24,7 +32,9 @@ group-summed after) — so memory stays O(S) end to end.  Measured on v5e:
 materialization starts thrashing HBM).
 
 On non-TPU backends the same kernel runs in interpret mode (used by the CPU
-test suite), but ``should_use`` only selects it on real TPU.
+test suite), but ``should_use`` only selects it on real TPU — where it now
+weighs the masked XLA path's O(S²) footprint against free HBM (the
+BENCH_r05 crash mode) on top of the measured seq-length crossover.
 """
 from __future__ import annotations
 
@@ -43,17 +53,29 @@ except ImportError:  # pragma: no cover
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
+# Segment-id operand layout: q ids lane-replicated (like the lse residual),
+# kv ids sublane-replicated — the minimal legal int32 tiles.
+_SEG_LANES = 128
+_SEG_SUBLANES = 8
 
 
 def _platform() -> str:
     return jax.devices()[0].platform
 
 
-def supported(q, k, v, *, bias=None, segment_ids=None) -> bool:
-    """Shape gate for the kernel; the public op falls back to XLA otherwise."""
+def supported(q, k, v, *, bias=None, segment_ids=None, causal=False) -> bool:
+    """Shape gate for the kernel; the public op falls back to XLA otherwise.
+
+    Bias stays XLA-only (no bias tiles in the kernel).  Cross-length
+    shapes are admitted — causal uses the end-aligned offset, so causal
+    requires sq <= sk (sq > sk would leave the leading rows fully masked,
+    which the XLA path defines as a uniform softmax and the kernel does
+    not).  ``segment_ids`` (packed training) requires sq == sk: one id
+    vector describes both sides, exactly the public op's contract.
+    """
     if pltpu is None:
         return False
-    if bias is not None or segment_ids is not None:
+    if bias is not None:
         return False
     b, sq, hq, d = q.shape
     _, sk, hk, dk = k.shape
@@ -61,11 +83,15 @@ def supported(q, k, v, *, bias=None, segment_ids=None) -> bool:
         return False
     if hq % hk != 0:
         return False
-    if sq != sk:
-        # The kernel's causal mask is diagonal-aligned at q_start == k_start;
-        # cross-length (decode-style) shapes take the XLA path, which uses
-        # end-aligned masking (tril offset sk-sq).
+    if causal and sq > sk:
         return False
+    if segment_ids is not None:
+        if sq != sk:
+            return False
+        if tuple(segment_ids.shape) != (b, sq):
+            return False
+        if not jnp.issubdtype(segment_ids.dtype, jnp.integer):
+            return False
     if d % 64 != 0 or d > 256:
         return False
     bq = min(DEFAULT_BLOCK_Q, sq)
@@ -73,16 +99,59 @@ def supported(q, k, v, *, bias=None, segment_ids=None) -> bool:
     return sq % bq == 0 and sk % bk == 0 and bq % 8 == 0 and bk % 128 == 0
 
 
-def should_use(q) -> bool:
-    """Heuristic: flash wins once the S^2 logits stop fitting cache/VMEM."""
+def should_use(q, k=None, *, causal=False, segments=False) -> bool:
+    """Routing heuristic for ``impl="auto"`` (only on real TPU; CPU always
+    prefers XLA's fused path).  Two triggers, either is sufficient:
+
+    * the masked XLA path's O(S²) footprint (attention_footprint_bytes)
+      would cross ``ATTENTION_HBM_BUDGET_FRACTION`` of free HBM — the
+      BENCH_r05 RESOURCE_EXHAUSTED mode, now a routing decision instead of
+      a crash;
+    * the measured seq-length crossover (flash wins once the S² logits
+      stop fitting cache/VMEM — v5e kernel table, BASELINE.md).
+
+    When the backend reports no memory stats only the crossover applies.
+    """
     if _platform() not in ("tpu", "axon"):
         return False
-    return q.shape[1] >= 1024
+    if q.shape[1] >= 1024:
+        return True
+    from kubeflow_tpu.ops.attention import attention_footprint_bytes
+    from kubeflow_tpu.telemetry import compute as ctel
+
+    k_len = q.shape[1] if k is None else k.shape[1]
+    est = attention_footprint_bytes(
+        batch=q.shape[0], heads=q.shape[2], q_len=q.shape[1], k_len=k_len,
+        causal=causal, segments=segments,
+    )
+    free = ctel.free_hbm_bytes()
+    if free is not None and est > ctel.ATTENTION_HBM_BUDGET_FRACTION * free:
+        return True
+    return False
+
+
+def _tile_mask(qseg_ref, kseg_ref, *, causal, q_start, k_start, offset,
+               block_q, block_k):
+    """The (block_q, block_k) boolean visibility mask for one tile, or None
+    when the tile is mask-free.  Shared by the forward and both backward
+    passes — the mask convention MUST stay identical across them."""
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (q_start + rows + offset) >= (k_start + cols)
+    if qseg_ref is not None:
+        q_sids = qseg_ref[0][:, 0:1]   # (block_q, 1), lane-replicated source
+        kv_sids = kseg_ref[0][0:1, :]  # (1, block_k), sublane-replicated
+        seg = q_sids == kv_sids        # (block_q, block_k)
+        mask = seg if mask is None else (mask & seg)
+    return mask
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
-    causal, scale, block_q, block_k, num_k
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+    acc_ref, m_ref, l_ref, *,
+    causal, scale, block_q, block_k, num_k, offset
 ):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -95,11 +164,11 @@ def _fwd_kernel(
 
     q_start = qi * block_q
     k_start = ki * block_k
-    # Under causal masking, a kv block strictly above the diagonal band is
-    # dead; skip its flops entirely.
+    # Under causal masking, a kv block strictly above the (offset) diagonal
+    # band is dead; skip its flops entirely.
     run = True
     if causal:
-        run = k_start <= q_start + block_q - 1
+        run = k_start <= q_start + offset + block_q - 1
 
     @pl.when(run)
     def _compute():
@@ -109,10 +178,11 @@ def _fwd_kernel(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         s = s * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (q_start + rows) >= (k_start + cols)
+        mask = _tile_mask(
+            qseg_ref, kseg_ref, causal=causal, q_start=q_start,
+            k_start=k_start, offset=offset, block_q=block_q, block_k=block_k,
+        )
+        if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[...]  # (block_q, 128), lane-replicated
@@ -120,6 +190,13 @@ def _fwd_kernel(
         m_new = jnp.maximum(m_prev, row_max)
         alpha = jnp.exp(m_prev - m_new)  # (block_q, 128)
         p = jnp.exp(s - m_new[:, 0:1])
+        if qseg_ref is not None:
+            # A row whose segment has no key in this tile is fully masked:
+            # its running max is still the _NEG_INF sentinel, so
+            # exp(s - m) above reads exp(0) = 1 on every masked slot —
+            # zero those probs so dead tiles contribute nothing (the first
+            # valid tile's alpha rescale then starts from a clean 0).
+            p = jnp.where(mask, p, 0.0)
         l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[...] = m_new
         acc_ref[...] = acc_ref[...] * alpha[:, 0:1] + jax.lax.dot_general(
@@ -156,8 +233,16 @@ def _scratch(shape, dtype=jnp.float32):
     return pl.MemoryRef(shape, dtype)  # pragma: no cover
 
 
+def _seg_operands(segment_ids, b, sq, sk):
+    """Expand [b, s] ids to the kernel's lane-/sublane-replicated layouts."""
+    ids = segment_ids.astype(jnp.int32)
+    qseg = jnp.broadcast_to(ids[:, :, None], (b, sq, _SEG_LANES))
+    kseg = jnp.broadcast_to(ids[:, None, :], (b, _SEG_SUBLANES, sk))
+    return qseg, kseg
+
+
 def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
-               return_residuals=False):
+               return_residuals=False, segment_ids=None):
     b, sq, hq, d = q.shape
     _, sk, hk, _ = k.shape
     n_rep = hq // hk
@@ -165,6 +250,10 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     num_k = sk // bk
+    # End-aligned causal: query row i sees keys up to i + (sk - sq) —
+    # identical to xla_attention's tril(k=sk-sq) convention.
+    offset = sk - sq if causal else 0
+    has_seg = segment_ids is not None
 
     # BHSD layout inside the kernel: the (seq, head_dim) tile is the MXU
     # operand, batch/head are pure grid axes.
@@ -173,6 +262,26 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
     vt = v.transpose(0, 2, 1, 3)
 
     grid = (b, hq, sq // bq, num_k)
+    inputs = [qt, kt, vt]
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec(
+            (1, 1, bk, d), lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)
+        ),
+        pl.BlockSpec(
+            (1, 1, bk, d), lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)
+        ),
+    ]
+    if has_seg:
+        qseg, kseg = _seg_operands(segment_ids, b, sq, sk)
+        inputs += [qseg, kseg]
+        in_specs += [
+            pl.BlockSpec((1, bq, _SEG_LANES),
+                         lambda bi, hi, qi, ki: (bi, qi, 0)),
+            pl.BlockSpec((1, _SEG_SUBLANES, bk),
+                         lambda bi, hi, qi, ki: (bi, 0, ki)),
+        ]
+
     base = functools.partial(
         _fwd_kernel,
         causal=causal,
@@ -180,9 +289,22 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
         block_q=bq,
         block_k=bk,
         num_k=num_k,
+        offset=offset,
     )
+
+    def kernel(*refs):
+        i = 3
+        qs = ks = None
+        if has_seg:
+            qs, ks = refs[i:i + 2]
+            i += 2
+        o_ref = refs[i]
+        lse = refs[i + 1] if return_residuals else None
+        acc_ref, m_ref, l_ref = refs[-3:]
+        base(refs[0], refs[1], refs[2], qs, ks, o_ref, lse,
+             acc_ref, m_ref, l_ref)
+
     if return_residuals:
-        kernel = base
         out_shape = [
             jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, hq, sq, 128), jnp.float32),  # lse
@@ -192,9 +314,6 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
             pl.BlockSpec((1, 1, bq, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
         ]
     else:
-        def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
-            base(q_ref, k_ref, v_ref, o_ref, None, acc_ref, m_ref, l_ref)
-
         out_shape = jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype)
         out_specs = pl.BlockSpec(
             (1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
@@ -203,15 +322,7 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, d), lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, d), lambda bi, hi, qi, ki, n_rep=n_rep: (bi, hi // n_rep, ki, 0)
-            ),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -223,15 +334,16 @@ def _flash_fwd(q, k, v, *, causal, softmax_scale, block_q, block_k, interpret,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt)
+    )(*inputs)
     if return_residuals:
         o, lse = out
         return o.transpose(0, 2, 1, 3), lse
     return out.transpose(0, 2, 1, 3)
 
 
-def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, *,
-              causal, scale, q_start, k_start, block_q, block_k):
+def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
+              qseg_ref, kseg_ref, *,
+              causal, scale, q_start, k_start, block_q, block_k, offset):
     """Shared backward tile math: (p, ds, do) for one (q, k) block pair.
     delta = rowsum(dO ∘ O) is recomputed here from the residuals instead of
     being materialized lane-replicated in HBM (it is one scalar per row; a
@@ -239,7 +351,7 @@ def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, *,
     ``glse_ref`` (optional) carries the cotangent of the lse output when
     the caller consumed it (flash_attention_with_lse): d lse_i/d s_ij = p_ij,
     so it enters as an extra per-row term inside the ds product.  The mask
-    convention must stay identical to _fwd_kernel's."""
+    convention must stay identical to _fwd_kernel's (_tile_mask)."""
     q = q_ref[0, 0].astype(jnp.float32)
     k = k_ref[0, 0].astype(jnp.float32)
     v = v_ref[0, 0].astype(jnp.float32)
@@ -257,11 +369,18 @@ def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, *,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where((q_start + rows) >= (k_start + cols), s, _NEG_INF)
+    mask = _tile_mask(
+        qseg_ref, kseg_ref, causal=causal, q_start=q_start, k_start=k_start,
+        offset=offset, block_q=block_q, block_k=block_k,
+    )
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
     p = jnp.exp(s - lse)  # (bq, bk)
+    if qseg_ref is not None:
+        # Mirror the forward's dead-tile guard: a fully-masked row carries
+        # the sentinel lse, where exp(s - lse) reads 1 — zero it so dq/dk/dv
+        # see no phantom probability mass.
+        p = jnp.where(mask, p, 0.0)
     dp = jax.lax.dot_general(
         do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -269,8 +388,9 @@ def _bwd_tile(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, *,
     return q, k, p, ds, do
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, dq_ref,
-               acc_ref, *, causal, scale, block_q, block_k, num_k):
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
+               qseg_ref, kseg_ref, dq_ref, acc_ref, *,
+               causal, scale, block_q, block_k, num_k, offset):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
 
@@ -282,14 +402,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, dq_ref,
     k_start = ki * block_k
     run = True
     if causal:
-        run = k_start <= q_start + block_q - 1
+        run = k_start <= q_start + offset + block_q - 1
 
     @pl.when(run)
     def _compute():
         _, k, _, ds, _ = _bwd_tile(
             q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
+            qseg_ref, kseg_ref,
             causal=causal, scale=scale, q_start=q_start, k_start=k_start,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, offset=offset,
         )
         acc_ref[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -300,15 +421,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
-def _dq_kernel_noglse(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                      acc_ref, **kw):
-    _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, None, dq_ref,
-               acc_ref, **kw)
-
-
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *,
-                causal, scale, block_q, block_k, num_q):
+                qseg_ref, kseg_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                causal, scale, block_q, block_k, num_q, offset):
     ki = pl.program_id(2)
     qi = pl.program_id(3)
 
@@ -321,14 +436,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
     k_start = ki * block_k
     run = True
     if causal:
-        run = k_start <= q_start + block_q - 1
+        run = k_start <= q_start + offset + block_q - 1
 
     @pl.when(run)
     def _compute():
         q, _, p, ds, do = _bwd_tile(
             q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
+            qseg_ref, kseg_ref,
             causal=causal, scale=scale, q_start=q_start, k_start=k_start,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, offset=offset,
         )
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -343,14 +459,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, glse_ref,
         dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _dkv_kernel_noglse(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                       dk_ref, dv_ref, dk_acc, dv_acc, **kw):
-    _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, None,
-                dk_ref, dv_ref, dk_acc, dv_acc, **kw)
-
-
 def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
-               block_k, interpret, g_lse=None):
+               block_k, interpret, g_lse=None, segment_ids=None):
     """Blocked FlashAttention-2 backward: a dq pass (kv sequential) and a
     dk/dv pass (q sequential).  GQA: dk/dv are produced per q-head and
     group-summed in XLA afterwards.  ``g_lse`` is the cotangent of the lse
@@ -362,6 +472,8 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     num_q, num_k = sq // bq, sk // bk
+    offset = sk - sq if causal else 0
+    has_seg = segment_ids is not None
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
@@ -370,6 +482,21 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     dot = g.transpose(0, 2, 1, 3)
     with_glse = g_lse is not None
     extra = (g_lse,) if with_glse else ()
+    seg_inputs = ()
+    if has_seg:
+        seg_inputs = _seg_operands(segment_ids, b, sq, sk)
+
+    def unpack(refs):
+        """(glse_ref, qseg_ref, kseg_ref) from the optional input tail."""
+        i = 6
+        glse = None
+        if with_glse:
+            glse = refs[i]
+            i += 1
+        qs = ks = None
+        if has_seg:
+            qs, ks = refs[i:i + 2]
+        return glse, qs, ks
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
     kv_spec = pl.BlockSpec(
@@ -379,15 +506,24 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     lse_spec = pl.BlockSpec(
         (1, 1, bq, 128), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
     )
-    dq_kernel = _dq_kernel if with_glse else _dq_kernel_noglse
+    seg_specs = [
+        pl.BlockSpec((1, bq, _SEG_LANES), lambda bi, hi, qi, ki: (bi, qi, 0)),
+        pl.BlockSpec((1, _SEG_SUBLANES, bk),
+                     lambda bi, hi, qi, ki: (bi, 0, ki)),
+    ] if has_seg else []
+
+    def dq_kernel(*refs):
+        glse, qs, ks = unpack(refs)
+        _dq_kernel(refs[0], refs[1], refs[2], refs[3], refs[4], refs[5],
+                   glse, qs, ks, refs[-2], refs[-1],
+                   causal=causal, scale=scale, block_q=bq, block_k=bk,
+                   num_k=num_k, offset=offset)
+
     dq = pl.pallas_call(
-        functools.partial(
-            dq_kernel, causal=causal, scale=scale,
-            block_q=bq, block_k=bk, num_k=num_k,
-        ),
+        dq_kernel,
         grid=(b, hq, num_q, num_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
-        + ([lse_spec] if with_glse else []),
+        + ([lse_spec] if with_glse else []) + seg_specs,
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
         scratch_shapes=[_scratch((bq, d))],
@@ -395,7 +531,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt, ot, dot, lse, *extra)
+    )(qt, kt, vt, ot, dot, lse, *extra, *seg_inputs)
 
     # dk/dv: grid ordered (k, q) so the q axis is the sequential one.
     q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
@@ -409,15 +545,24 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     dkv_out_spec = pl.BlockSpec(
         (1, 1, bk, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0)
     )
-    dkv_kernel = _dkv_kernel if with_glse else _dkv_kernel_noglse
+    seg_specs2 = [
+        pl.BlockSpec((1, bq, _SEG_LANES), lambda bi, hi, ki, qi: (bi, qi, 0)),
+        pl.BlockSpec((1, _SEG_SUBLANES, bk),
+                     lambda bi, hi, ki, qi: (bi, 0, ki)),
+    ] if has_seg else []
+
+    def dkv_kernel(*refs):
+        glse, qs, ks = unpack(refs)
+        _dkv_kernel(refs[0], refs[1], refs[2], refs[3], refs[4], refs[5],
+                    glse, qs, ks, refs[-4], refs[-3], refs[-2], refs[-1],
+                    causal=causal, scale=scale, block_q=bq, block_k=bk,
+                    num_q=num_q, offset=offset)
+
     dk, dv = pl.pallas_call(
-        functools.partial(
-            dkv_kernel, causal=causal, scale=scale,
-            block_q=bq, block_k=bk, num_q=num_q,
-        ),
+        dkv_kernel,
         grid=(b, hq, num_k, num_q),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2]
-        + ([lse_spec2] if with_glse else []),
+        + ([lse_spec2] if with_glse else []) + seg_specs2,
         out_specs=[dkv_out_spec, dkv_out_spec],
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, sk, d), k.dtype),
@@ -428,7 +573,7 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
         **_compiler_params(
             interpret, ("parallel", "parallel", "parallel", "arbitrary")
         ),
-    )(qt, kt, vt, ot, dot, lse, *extra)
+    )(qt, kt, vt, ot, dot, lse, *extra, *seg_inputs)
 
     if n_rep > 1:
         dk = dk.reshape(b, hk, n_rep, sk, d).sum(axis=2)
@@ -440,8 +585,9 @@ def _flash_bwd(q, k, v, out, lse, g, *, causal, softmax_scale, block_q,
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, softmax_scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_attention(q, k, v, segment_ids, causal, softmax_scale, block_q,
+                     block_k):
     interpret = _platform() not in ("tpu", "axon")
     return _flash_fwd(
         q,
@@ -452,7 +598,29 @@ def _flash_attention(q, k, v, causal, softmax_scale, block_q, block_k):
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        segment_ids=segment_ids,
     )
+
+
+def _block_override(name: str, value: int, seq: int,
+                    align: int) -> Optional[int]:
+    """Validate a KFT_FLASH_BLOCK_* env override against the kernel's
+    divisibility rules (the same ones ``supported()`` enforces for the
+    floor blocks).  An ALIGNMENT violation is a typo that can never be
+    legal for any shape — raise so a sweep fails loudly instead of
+    silently benchmarking the fallback.  A sequence the override does not
+    divide returns None (use the heuristic for that call): the override
+    is process-global while ``impl="auto"`` may route OTHER shapes (a
+    serve prefill, an eval pass) through the kernel in the same process,
+    and those must not crash on the sweep's knob."""
+    if value <= 0 or value % align != 0:
+        raise ValueError(
+            f"{name}={value} is not a positive multiple of {align} "
+            f"(TPU {'sublane' if align == 8 else 'lane'} alignment)"
+        )
+    if seq % value != 0:
+        return None
+    return value
 
 
 def default_blocks(sq: int, sk: int) -> tuple:
@@ -460,13 +628,28 @@ def default_blocks(sq: int, sk: int) -> tuple:
     tiles amortize per-grid-cell overhead as sequence grows — 2.3x faster
     at seq 8192 with 1024x1024 vs the 256x256 floor — until VMEM bounds
     them (2048 tiles fail to compile at d=128).  Ragged lengths fall back
-    to the floor, which divides everything supported() admits."""
-    bq = min(1024, max(DEFAULT_BLOCK_Q, (sq // 8) // 8 * 8))
-    bk = min(1024, max(DEFAULT_BLOCK_K, (sk // 8) // 128 * 128))
-    if sq % bq:
-        bq = min(DEFAULT_BLOCK_Q, sq)
-    if sk % bk:
-        bk = min(DEFAULT_BLOCK_K, sk)
+    to the floor, which divides everything supported() admits.
+
+    ``KFT_FLASH_BLOCK_Q`` / ``KFT_FLASH_BLOCK_K`` override the heuristic
+    per process (block sweeps without code edits); overrides are validated
+    against the kernel's alignment rules (raise on an always-illegal
+    size) and fall back to the heuristic for sequences they do not
+    divide — the override is process-global and must not crash other
+    auto-routed shapes."""
+    from kubeflow_tpu.platform import config
+
+    env_q = config.env_int("KFT_FLASH_BLOCK_Q", 0)
+    env_k = config.env_int("KFT_FLASH_BLOCK_K", 0)
+    bq = _block_override("KFT_FLASH_BLOCK_Q", env_q, sq, 8) if env_q else None
+    if bq is None:
+        bq = min(1024, max(DEFAULT_BLOCK_Q, (sq // 8) // 8 * 8))
+        if sq % bq:
+            bq = min(DEFAULT_BLOCK_Q, sq)
+    bk = _block_override("KFT_FLASH_BLOCK_K", env_k, sk, 128) if env_k else None
+    if bk is None:
+        bk = min(1024, max(DEFAULT_BLOCK_K, (sk // 8) // 128 * 128))
+        if sk % bk:
+            bk = min(DEFAULT_BLOCK_K, sk)
     return bq, bk
 
 
@@ -476,17 +659,22 @@ def flash_attention(
     v,
     *,
     causal: bool = False,
+    segment_ids: Optional[jax.Array] = None,
     softmax_scale: Optional[float] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ):
     """Flash attention, BSHD layout, GQA via fewer kv heads.  Block sizes
-    default to the measured sequence-length heuristic (default_blocks)."""
+    default to the measured sequence-length heuristic (default_blocks).
+    ``segment_ids`` ([b, s] int, 0 = pad) masks cross-document attention
+    for packed sequences; causal cross-length shapes (sq < sk) use the
+    end-aligned offset convention (see ``supported``)."""
     if block_q is None or block_k is None:
         auto_q, auto_k = default_blocks(q.shape[1], k.shape[1])
         block_q = auto_q if block_q is None else block_q
         block_k = auto_k if block_k is None else block_k
-    return _flash_attention(q, k, v, causal, softmax_scale, block_q, block_k)
+    return _flash_attention(q, k, v, segment_ids, causal, softmax_scale,
+                            block_q, block_k)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -540,7 +728,7 @@ def _with_lse_bwd(causal, softmax_scale, block_q, block_k, res, cotangents):
 _flash_attention_with_lse.defvjp(_with_lse_fwd, _with_lse_bwd)
 
 
-def _vjp_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
+def _vjp_fwd(q, k, v, segment_ids, causal, softmax_scale, block_q, block_k):
     # Under differentiation the forward additionally emits the per-row
     # logsumexp — the only residual the blocked backward needs beyond the
     # inputs and output (recomputing P per tile, FlashAttention-2 style).
@@ -548,18 +736,21 @@ def _vjp_fwd(q, k, v, causal, softmax_scale, block_q, block_k):
     out, lse = _flash_fwd(
         q, k, v, causal=causal, softmax_scale=softmax_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        return_residuals=True,
+        return_residuals=True, segment_ids=segment_ids,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _vjp_bwd(causal, softmax_scale, block_q, block_k, res, g):
-    q, k, v, out, lse = res
+    q, k, v, segment_ids, out, lse = res
     interpret = _platform() not in ("tpu", "axon")
-    return _flash_bwd(
+    dq, dk, dv = _flash_bwd(
         q, k, v, out, lse, g, causal=causal, softmax_scale=softmax_scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
+        segment_ids=segment_ids,
     )
+    # segment_ids are integral — no cotangent.
+    return dq, dk, dv, None
 
 
 _flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

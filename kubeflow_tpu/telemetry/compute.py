@@ -110,8 +110,17 @@ train_mfu = Gauge(
 attention_mask_bytes_estimate = Gauge(
     "attention_mask_bytes_estimate",
     "Pre-flight estimate of the O(S^2) bytes the XLA attention path will "
-    "materialize (mask + f32 logits + probs), computed from shapes BEFORE "
+    "materialize (f32 logits + probs only — masking is iota-fused and "
+    "allocation-free since ISSUE 7), computed from shapes BEFORE "
     "allocation — the BENCH_r05 RESOURCE_EXHAUSTED mode as a signal",
+    registry=registry,
+)
+attention_kernel_calls_total = Counter(
+    "attention_kernel_calls_total",
+    "dot_product_attention calls by the implementation actually selected "
+    "(trace-time count: one per attention site per jit trace) — the "
+    "anti-silent-fallback signal ci/bench_smoke.py pins",
+    ["impl"],
     registry=registry,
 )
 attention_mask_budget_warnings_total = Counter(
@@ -350,6 +359,20 @@ def attention_estimate_value() -> Optional[float]:
     """Current value of the estimate gauge (None before any attention
     call) — the bench's mask-estimate report line."""
     return registry.get_sample_value("attention_mask_bytes_estimate")
+
+
+def note_attention_impl(impl: str) -> None:
+    """Record which implementation dot_product_attention selected (called
+    at trace time from ops/attention.py)."""
+    attention_kernel_calls_total.labels(impl=impl).inc()
+
+
+def attention_impl_calls(impl: str) -> float:
+    """Cumulative attention_kernel_calls_total{impl} (0.0 before any call)
+    — bench.py snapshot-diffs this per arm to prove the flash arm really
+    traced the Pallas kernel."""
+    return registry.get_sample_value(
+        "attention_kernel_calls_total", {"impl": impl}) or 0.0
 
 
 def render() -> bytes:

@@ -7,6 +7,7 @@ data-dependent Python control flow — everything traces once.
 """
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.struct
@@ -50,7 +51,18 @@ def create_train_state(
     *,
     init_kwargs: Optional[dict] = None,
 ) -> TrainState:
-    variables = model.init(rng, example_input, **(init_kwargs or {}))
+    # model.init runs a full forward — op by op when called eagerly, which
+    # materializes EVERY intermediate at once at full sequence length (the
+    # exact frame BENCH_r05 died in with RESOURCE_EXHAUSTED at seq 8192).
+    # Tracing it under jit instead lets XLA fuse the iota-comparison
+    # attention masks (ops/attention.py) and free layer intermediates as
+    # it schedules, so train-state creation never holds O(S²) buffers
+    # op-by-op.  init_kwargs are bound via partial so static flags like
+    # train=False stay Python values.
+    init_fn = model.init
+    if init_kwargs:
+        init_fn = functools.partial(init_fn, **init_kwargs)
+    variables = jax.jit(init_fn)(rng, example_input)
     params = variables["params"]
     batch_stats = variables.get("batch_stats")
     return TrainState(

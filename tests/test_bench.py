@@ -8,17 +8,36 @@ import json
 import pytest
 
 
+def _metric_lines(capsys):
+    """{metric: parsed line}.  Section functions emit the metric line
+    FIRST and may follow it with companion lines (the
+    attention_mask_bytes_estimate line rides after the llama metric), so
+    smoke tests select by name instead of position."""
+    out = {}
+    for ln in capsys.readouterr().out.strip().splitlines():
+        rec = json.loads(ln)
+        out[rec["metric"]] = rec
+    return out
+
+
 @pytest.mark.slow
 def test_bench_llama_smoke_emits_metric(capsys, monkeypatch):
     monkeypatch.setenv("KFT_BENCH_SMOKE", "1")
     import bench
 
     bench.llama_8k_bench()
-    line = capsys.readouterr().out.strip().splitlines()[-1]
-    out = json.loads(line)
-    assert out["metric"] == "llama8k_train_tokens_per_sec"
+    lines = _metric_lines(capsys)
+    out = lines["llama8k_train_tokens_per_sec"]
     assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
     assert out["value"] > 0 and out["xla_tokens_per_sec"] > 0
+    # Kernel-selection proof (ISSUE 7): flash arm traced pallas, XLA arm
+    # never did.
+    assert out["flash_arm_pallas_calls"] > 0
+    assert out["xla_arm_pallas_calls"] == 0
+    # The XLA arm's pre-flight estimate rides as its own line, mask-free
+    # (logits + probs only).
+    est = lines["attention_mask_bytes_estimate"]
+    assert est["value"] > 0
 
 
 @pytest.mark.slow
@@ -27,9 +46,7 @@ def test_bench_llama_1b4_smoke_emits_metric(capsys, monkeypatch):
     import bench
 
     bench.llama_1b4_bench()
-    line = capsys.readouterr().out.strip().splitlines()[-1]
-    out = json.loads(line)
-    assert out["metric"] == "llama1b4_8k_train_tokens_per_sec"
+    out = _metric_lines(capsys)["llama1b4_8k_train_tokens_per_sec"]
     assert out["value"] > 0 and out["xla_tokens_per_sec"] > 0
     assert {"mfu", "model_tflops_per_sec", "mfu_mean",
             "model_gflops_per_token"} <= set(out)

@@ -98,7 +98,9 @@ def test_attention_estimator_fires_before_any_allocation(monkeypatch, caplog):
 
     want = attention_footprint_bytes(
         batch=b, heads=h, q_len=s, k_len=s, causal=True, segments=False)
-    assert want == 2 * 4 * b * h * s * s + s * s
+    # Mask-free footprint (ISSUE 7): f32 logits + probs ONLY — the causal
+    # condition is iota-fused into the select, no tril buffer term.
+    assert want == 2 * 4 * b * h * s * s
     assert _sample("attention_mask_bytes_estimate") == want
     assert _sample("attention_mask_budget_warnings_total") == before + 1
 
@@ -123,8 +125,8 @@ def test_attention_estimator_quiet_within_budget(monkeypatch, caplog):
                          logger="kubeflow_tpu.telemetry.compute"):
         xla_attention(q, q, q, causal=True)
     assert _sample("attention_mask_budget_warnings_total") == before
-    # The gauge still tracks the (tiny) footprint.
-    assert _sample("attention_mask_bytes_estimate") == 2 * 4 * 2 * 256 + 256
+    # The gauge still tracks the (tiny) footprint — logits + probs only.
+    assert _sample("attention_mask_bytes_estimate") == 2 * 4 * 2 * 256
 
 
 def test_attention_estimator_skips_unmasked_path():
