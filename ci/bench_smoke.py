@@ -6,11 +6,13 @@ load-bearing keys are present:
 
 * ``bench_scale.py`` (control plane, tiny N): the parallel-dispatch keys
   (``ctrlplane_wave_converge_workers`` / ``ctrlplane_wire_converge_s``);
-* ``bench.py --sections llama8k`` (compute plane, KFT_BENCH_SMOKE=1): the
-  telemetry-derived keys (``step_p50_s``/``step_p99_s`` from the shared
-  step histogram, the ``hbm_peak_bytes`` key — null on CPU — and the
-  ``attention_mask_bytes_estimate`` line the XLA arm's pre-flight
-  estimator publishes).
+* ``bench.py --sections llama8k,serve`` (compute plane,
+  KFT_BENCH_SMOKE=1): the telemetry-derived keys (``step_p50_s``/
+  ``step_p99_s`` from the shared step histogram, the ``hbm_peak_bytes``
+  key — null on CPU — and the ``attention_mask_bytes_estimate`` line
+  the XLA arm's pre-flight estimator publishes), plus the
+  continuous-batching ``serve`` A/B line (scheduler vs lock-serialized
+  tokens/s, speedup band, p99 TTFT/latency keys).
 
 A refactor that renames a metric, breaks a band field, or silently
 unhooks the telemetry wiring fails CI here instead of being discovered
@@ -67,10 +69,11 @@ def _parse_json_lines(stdout: str, what: str):
 
 
 def check_compute_bench() -> int:
-    """bench.py smoke (CPU, llama8k only): the telemetry wiring keys."""
+    """bench.py smoke (CPU, llama8k + serve): the telemetry wiring keys
+    and the continuous-batching A/B line."""
     env = dict(os.environ, KFT_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
     proc = subprocess.run(
-        [sys.executable, "bench.py", "--sections", "llama8k"],
+        [sys.executable, "bench.py", "--sections", "llama8k,serve"],
         capture_output=True, text=True, timeout=560, env=env,
     )
     seen = _parse_json_lines(proc.stdout, "bench")
@@ -122,6 +125,30 @@ def check_compute_bench() -> int:
               f"a mask buffer term is back in the footprint: {est}",
               file=sys.stderr)
         return 1
+    # Continuous-batching serve section (ISSUE 8): the A/B line must
+    # parse with both arms' throughput, the speedup band self-report,
+    # and the p99 keys — shape and coverage, not values (the 2x floor
+    # is asserted by the banded full run, not a shared CI box).
+    serve = seen.get("serve_continuous_batching_tokens_per_sec")
+    if serve is None:
+        print(f"bench smoke missing the serve line: {sorted(seen)}",
+              file=sys.stderr)
+        return 1
+    for key in ("value", "locked_tokens_per_sec", "speedup_vs_locked",
+                "band_floor", "latency_p99_s", "locked_latency_p99_s"):
+        if not isinstance(serve.get(key), (int, float)):
+            print(f"serve line missing key {key}: {serve}",
+                  file=sys.stderr)
+            return 1
+    if serve.get("band") not in ("pass", "REGRESSION"):
+        print(f"serve line band invalid: {serve.get('band')!r}",
+              file=sys.stderr)
+        return 1
+    for key in ("ttft_p99_s", "locked_ttft_p99_s"):
+        if key not in serve:  # null only on an empty histogram
+            print(f"serve line missing key {key}: {serve}",
+                  file=sys.stderr)
+            return 1
     print(f"bench-smoke compute OK: {len(seen)} metrics "
           f"({', '.join(sorted(seen))})")
     return 0

@@ -192,6 +192,28 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # Serve-soak lane (ISSUE 8, postsubmit): concurrent clients hammer
+    # the werkzeug generation app over a real socket for a bounded
+    # wall-clock, asserting the continuous-batching invariants — no
+    # dropped requests, no cross-request row mixing (greedy
+    # determinism), telemetry counters balance (admitted == evicted +
+    # in-flight) — plus the fast scheduler token-equality matrix as the
+    # gate in front of it.
+    name="serve-soak",
+    include_dirs=[
+        "kubeflow_tpu/models/*", "kubeflow_tpu/telemetry/*",
+        "kubeflow_tpu/ops/*", "releasing/*",
+    ],
+    job_types=["postsubmit"],
+    steps=[
+        Step("equality", _pytest("tests/test_scheduler.py")
+             + ["-m", "not slow"]),
+        Step("soak", _pytest("tests/test_scheduler.py")
+             + ["-m", "slow"], depends="equality"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],
@@ -224,7 +246,7 @@ _register(ComponentWorkflow(
         Step("unit", _pytest(
             "tests/test_models.py", "tests/test_attention.py",
             "tests/test_moe.py", "tests/test_parallel.py",
-            "tests/test_parallel_extra.py",
+            "tests/test_parallel_extra.py", "tests/test_scheduler.py",
         )),
         Step("dryrun", [
             sys.executable, "-c",

@@ -30,7 +30,12 @@ def sample_logits(logits: jax.Array, rng: jax.Array, *,
                   temperature: float = 1.0,
                   top_k: Optional[int] = None) -> jax.Array:
     """Sample token ids from [batch, vocab] logits.  temperature == 0 is
-    greedy; top_k restricts to the k highest-probability tokens."""
+    greedy; top_k restricts to the k highest-probability tokens.
+
+    Batch-coupled (one key draws noise for the whole [batch, vocab]
+    block): used by the seq2seq/beam paths.  The decoder-only generate
+    path uses ``sample_logits_rows`` instead — per-row keys, so a row's
+    sample stream is independent of which batch it happens to share."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(temperature, 1e-6)
@@ -38,6 +43,47 @@ def sample_logits(logits: jax.Array, rng: jax.Array, *,
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]  # [b, 1]
         logits = jnp.where(logits < kth, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1)
+
+
+def sample_logits_rows(logits: jax.Array, rngs: jax.Array, *,
+                       temps: jax.Array, top_ks: jax.Array,
+                       sampled: bool = True) -> jax.Array:
+    """Per-row sampling over [batch, vocab] logits: row i draws with its
+    OWN key ``rngs[i]`` and its own (dynamic) ``temps[i]``/``top_ks[i]``.
+
+    This is the continuous-batching sampling contract: because no op
+    couples rows, a row sampled inside the scheduler's slot pool emits
+    exactly the tokens it would emit generated alone — the pool
+    composition around it cannot perturb its stream.  ``temps[i] == 0``
+    is greedy; ``top_ks[i] <= 0`` means unrestricted.  ``sampled=False``
+    (static) compiles the pure-argmax graph — no sort/categorical work
+    when every row is greedy."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if not sampled:
+        return greedy
+    vocab = logits.shape[-1]
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    ks = jnp.clip(jnp.where(top_ks > 0, top_ks, vocab), 1, vocab)
+    # kth-largest per row with a DYNAMIC k: descending sort + gather.  The
+    # kth VALUE equals lax.top_k's — ties mask identically.
+    sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+    kth = jnp.take_along_axis(sorted_desc, (ks - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < kth, -1e30, scaled)
+    pick = jax.vmap(jax.random.categorical)(rngs, masked).astype(jnp.int32)
+    return jnp.where(temps == 0.0, greedy, pick)
+
+
+def _row_sampling_arrays(b: int, temperature, top_k, eos_token):
+    """Scalar request knobs → per-row DYNAMIC arrays (temps, top_ks,
+    eos_ids, has_eos).  Passed traced (not static) into the generate
+    jits: one compiled graph serves every sampling config per shape, and
+    the scheduler's slot pool can mix configs across rows of one step."""
+    temps = jnp.full((b,), temperature, jnp.float32)
+    top_ks = jnp.full((b,), top_k if top_k else 0, jnp.int32)
+    eos_ids = jnp.full((b,), eos_token if eos_token is not None else 0,
+                       jnp.int32)
+    has_eos = jnp.full((b,), eos_token is not None, bool)
+    return temps, top_ks, eos_ids, has_eos
 
 
 def _check_cache_len(model, prompt_len: int, max_new_tokens: int) -> int:
@@ -55,13 +101,16 @@ def _check_cache_len(model, prompt_len: int, max_new_tokens: int) -> int:
 
 
 def _prefill_parts(model, params, prompt, prompt_mask, cache_len, *,
-                   temperature, top_k, eos_token, rng):
+                   temps, top_ks, eos_ids, has_eos, sampled, rng):
     """Prefill over the padded prompt: fill the cache, sample the first
     token.  Returns ``(carry, pad_bias)`` where carry is exactly the
-    decode scan's loop state ``(cache, first, lengths, rng, done)`` —
-    shared verbatim by the one-shot ``generate`` jit and the two-phase
-    ``generate_prefill``/``generate_decode`` pair, so both paths run the
-    same ops in the same order."""
+    decode scan's loop state ``(cache, first, lengths, row_rngs, done)``
+    — shared verbatim by the one-shot ``generate`` jit, the two-phase
+    ``generate_prefill``/``generate_decode`` pair, AND the continuous-
+    batching scheduler (models/scheduler.py), which peels carry rows into
+    its slot pool.  ``row_rngs`` is a [b] key array — ``split(rng, b)``
+    — so every row owns an independent sample stream (see
+    ``sample_logits_rows``)."""
     b, prompt_len = prompt.shape
     if prompt_mask is None:
         prompt_mask = jnp.ones((b, prompt_len), dtype=bool)
@@ -93,18 +142,52 @@ def _prefill_parts(model, params, prompt, prompt_mask, cache_len, *,
     )
     last_logits = jnp.take_along_axis(logits, idx, axis=1)[:, 0]  # [b, vocab]
 
-    rng, sub = jax.random.split(rng)
-    first = sample_logits(last_logits, sub, temperature=temperature,
-                          top_k=top_k)
-    done0 = jnp.zeros((b,), dtype=bool)
-    if eos_token is not None:
-        done0 = first == eos_token
-    return (cache, first, lengths, rng, done0), pad_bias
+    row_rngs = jax.random.split(rng, b)                   # [b] keys
+    split2 = jax.vmap(jax.random.split)(row_rngs)         # [b, 2]
+    row_rngs, subs = split2[:, 0], split2[:, 1]
+    first = sample_logits_rows(last_logits, subs, temps=temps,
+                               top_ks=top_ks, sampled=sampled)
+    done0 = has_eos & (first == eos_ids)
+    return (cache, first, lengths, row_rngs, done0), pad_bias
+
+
+def decode_step(model, params, cache, token, pos, rngs, done, bias, *,
+                cache_len, temps, top_ks, eos_ids, has_eos, sampled,
+                cache_slots=None):
+    """ONE decode step over a [b]-row batch: apply the model on the
+    current token, advance every row's key, sample per row, apply EOS
+    freezing.  Returns ``(cache, next_token, pos + 1, rngs, done)``.
+
+    This is the single compiled step body shared by the fixed-length
+    ``_decode_scan`` (sequential generation; ``cache_slots=None`` — the
+    flax scalar cache index advances and the model's built-in causal
+    bias applies on top of ``bias``) and by the continuous-batching slot
+    pool (models/scheduler.py; ``cache_slots`` is a [b] per-row write
+    index and ``bias`` must carry the FULL per-row visibility mask).
+    Every op is row-independent, so a row steps identically in either
+    harness — the token-equality contract of continuous batching."""
+    logits, state = model.apply(
+        {"params": params, "cache": cache},
+        token[:, None],
+        positions=pos[:, None],
+        decode=True,
+        mask_bias=bias,
+        cache_len=cache_len,
+        cache_slots=cache_slots,
+        mutable=["cache"],
+    )
+    split2 = jax.vmap(jax.random.split)(rngs)
+    rngs, subs = split2[:, 0], split2[:, 1]
+    nxt = sample_logits_rows(logits[:, -1], subs, temps=temps,
+                             top_ks=top_ks, sampled=sampled)
+    nxt = jnp.where(done & has_eos, eos_ids, nxt)
+    done = done | (has_eos & (nxt == eos_ids))
+    return state["cache"], nxt, pos + 1, rngs, done
 
 
 def _decode_scan(model, params, carry, pad_bias, *, cache_len,
-                 max_new_tokens, temperature, top_k, eos_token):
-    """The decode phase: a single ``lax.scan`` over one-token steps from a
+                 max_new_tokens, temps, top_ks, eos_ids, has_eos, sampled):
+    """The decode phase: a single ``lax.scan`` over ``decode_step`` from a
     prefilled carry.  Returns the full [batch, max_new_tokens] output
     (first token included)."""
     first = carry[1]
@@ -112,23 +195,13 @@ def _decode_scan(model, params, carry, pad_bias, *, cache_len,
         return first[:, None]
 
     def step(carry, _):
-        cache, token, pos, rng, done = carry
-        rng, sub = jax.random.split(rng)
-        logits, state = model.apply(
-            {"params": params, "cache": cache},
-            token[:, None],
-            positions=pos[:, None],
-            decode=True,
-            mask_bias=pad_bias,
-            cache_len=cache_len,
-            mutable=["cache"],
+        cache, token, pos, rngs, done = carry
+        cache, nxt, pos, rngs, done = decode_step(
+            model, params, cache, token, pos, rngs, done, pad_bias,
+            cache_len=cache_len, temps=temps, top_ks=top_ks,
+            eos_ids=eos_ids, has_eos=has_eos, sampled=sampled,
         )
-        nxt = sample_logits(logits[:, -1], sub, temperature=temperature,
-                            top_k=top_k)
-        if eos_token is not None:
-            nxt = jnp.where(done, eos_token, nxt)
-            done = done | (nxt == eos_token)
-        return (state["cache"], nxt, pos + 1, rng, done), nxt
+        return (cache, nxt, pos, rngs, done), nxt
 
     _, rest = jax.lax.scan(step, carry, None, length=max_new_tokens - 1)
     return jnp.concatenate([first[:, None], rest.T], axis=1)
@@ -136,9 +209,29 @@ def _decode_scan(model, params, carry, pad_bias, *, cache_len,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "eos_token"),
+    static_argnames=("model", "max_new_tokens", "sampled"),
 )
+def _generate_jit(model, params, prompt, *, rng, prompt_mask, temps,
+                  top_ks, eos_ids, has_eos, max_new_tokens, sampled):
+    # int8-served params widen here, INSIDE the jit, so XLA fuses the
+    # dequant into each consuming matmul and HBM keeps the int8 copy
+    # (models/quantize.py); plain params pass through untouched.
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    cache_len = _check_cache_len(model, prompt.shape[1], max_new_tokens)
+    carry, pad_bias = _prefill_parts(
+        model, params, prompt, prompt_mask, cache_len,
+        temps=temps, top_ks=top_ks, eos_ids=eos_ids, has_eos=has_eos,
+        sampled=sampled, rng=rng,
+    )
+    return _decode_scan(
+        model, params, carry, pad_bias, cache_len=cache_len,
+        max_new_tokens=max_new_tokens, temps=temps, top_ks=top_ks,
+        eos_ids=eos_ids, has_eos=has_eos, sampled=sampled,
+    )
+
+
 def generate(model, params, prompt: jax.Array, *,
              rng: Optional[jax.Array] = None,
              prompt_mask: Optional[jax.Array] = None,
@@ -154,46 +247,44 @@ def generate(model, params, prompt: jax.Array, *,
     ``decode=True`` with a "cache" collection; its ``max_seq_len`` must
     bound prompt_len + max_new_tokens.
 
+    Sampling is per-row (``sample_logits_rows``): row i draws from key
+    ``split(rng, b)[i]``, so a row's stream depends only on its own key —
+    never on which rows share the batch.  temperature/top_k/eos ride as
+    DYNAMIC arrays, so one compiled graph per shape serves every
+    sampling config.
+
     MoE caveat: capacity-truncated routing is sequence-length dependent by
     construction (per-step decode has fresh capacity; a full re-forward
     shares capacity across the whole sequence), so for ``n_experts > 0``
     cached decode equals the re-forward oracle only while no token is
     dropped — the standard Switch/GShard decode behavior.
     """
-    # int8-served params widen here, INSIDE the jit, so XLA fuses the
-    # dequant into each consuming matmul and HBM keeps the int8 copy
-    # (models/quantize.py); plain params pass through untouched.
-    from kubeflow_tpu.models.quantize import dequantize_params
-
-    params = dequantize_params(params)
-    cache_len = _check_cache_len(model, prompt.shape[1], max_new_tokens)
     if rng is None:
         rng = jax.random.key(0)
-    carry, pad_bias = _prefill_parts(
-        model, params, prompt, prompt_mask, cache_len,
-        temperature=temperature, top_k=top_k, eos_token=eos_token, rng=rng,
-    )
-    return _decode_scan(
-        model, params, carry, pad_bias, cache_len=cache_len,
-        max_new_tokens=max_new_tokens, temperature=temperature,
-        top_k=top_k, eos_token=eos_token,
+    temps, top_ks, eos_ids, has_eos = _row_sampling_arrays(
+        prompt.shape[0], temperature, top_k, eos_token)
+    return _generate_jit(
+        model, params, prompt, rng=rng, prompt_mask=prompt_mask,
+        temps=temps, top_ks=top_ks, eos_ids=eos_ids, has_eos=has_eos,
+        max_new_tokens=max_new_tokens, sampled=temperature != 0.0,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "eos_token"),
+    static_argnames=("model", "max_new_tokens", "sampled"),
 )
 def _generate_prefill_jit(model, params, prompt, *, rng, prompt_mask,
-                          max_new_tokens, temperature, top_k, eos_token):
+                          temps, top_ks, eos_ids, has_eos,
+                          max_new_tokens, sampled):
     from kubeflow_tpu.models.quantize import dequantize_params
 
     params = dequantize_params(params)
     cache_len = _check_cache_len(model, prompt.shape[1], max_new_tokens)
     carry, pad_bias = _prefill_parts(
         model, params, prompt, prompt_mask, cache_len,
-        temperature=temperature, top_k=top_k, eos_token=eos_token, rng=rng,
+        temps=temps, top_ks=top_ks, eos_ids=eos_ids, has_eos=has_eos,
+        sampled=sampled, rng=rng,
     )
     return carry[1], (carry, pad_bias)
 
@@ -211,32 +302,34 @@ def generate_prefill(model, params, prompt: jax.Array, *,
 
     Runs EXACTLY the ops of ``generate``'s prefill half (shared
     ``_prefill_parts``), just jitted at a phase boundary — the seam serve
-    telemetry measures time-to-first-token at, and the seam ROADMAP item
-    2's continuous-batching scheduler admits requests into.  The token
-    budget rides along in decode_state (a host-side int, outside the
-    jit): the cache was sized for THIS budget, so decode must not run
-    with any other."""
+    telemetry measures time-to-first-token at, and the seam the
+    continuous-batching scheduler (models/scheduler.py) admits requests
+    into: decode_state's carry rows peel apart into pool slots.  The
+    token budget rides along in decode_state (a host-side int, outside
+    the jit): the cache was sized for THIS budget, so decode must not
+    run with any other."""
     if rng is None:
         rng = jax.random.key(0)
+    temps, top_ks, eos_ids, has_eos = _row_sampling_arrays(
+        prompt.shape[0], temperature, top_k, eos_token)
     first, state = _generate_prefill_jit(
         model, params, prompt, rng=rng, prompt_mask=prompt_mask,
-        max_new_tokens=max_new_tokens, temperature=temperature,
-        top_k=top_k, eos_token=eos_token,
+        temps=temps, top_ks=top_ks, eos_ids=eos_ids, has_eos=has_eos,
+        max_new_tokens=max_new_tokens, sampled=temperature != 0.0,
     )
     return first, (state, max_new_tokens)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "eos_token"),
+    static_argnames=("model", "max_new_tokens", "sampled"),
     # Donate the prefilled KV cache: without this the decode scan's
     # working cache would coexist with the (dead) prefill output and the
     # two-phase path would hold ~2x the one-shot jit's cache HBM at peak.
     donate_argnums=(2,),
 )
-def _generate_decode_jit(model, params, state, *, max_new_tokens,
-                         temperature, top_k, eos_token):
+def _generate_decode_jit(model, params, state, *, temps, top_ks, eos_ids,
+                         has_eos, max_new_tokens, sampled):
     from kubeflow_tpu.models.quantize import dequantize_params
 
     params = dequantize_params(params)
@@ -244,8 +337,8 @@ def _generate_decode_jit(model, params, state, *, max_new_tokens,
     cache_len = pad_bias.shape[-1]
     return _decode_scan(
         model, params, carry, pad_bias, cache_len=cache_len,
-        max_new_tokens=max_new_tokens, temperature=temperature,
-        top_k=top_k, eos_token=eos_token,
+        max_new_tokens=max_new_tokens, temps=temps, top_ks=top_ks,
+        eos_ids=eos_ids, has_eos=has_eos, sampled=sampled,
     )
 
 
@@ -271,9 +364,13 @@ def generate_decode(model, params, decode_state, *,
             f"max_new_tokens {max_new_tokens} does not match the budget "
             f"the prefill sized its cache for ({prefill_budget})"
         )
+    b = state[0][1].shape[0]
+    temps, top_ks, eos_ids, has_eos = _row_sampling_arrays(
+        b, temperature, top_k, eos_token)
     return _generate_decode_jit(
-        model, params, state, max_new_tokens=max_new_tokens,
-        temperature=temperature, top_k=top_k, eos_token=eos_token,
+        model, params, state, temps=temps, top_ks=top_ks, eos_ids=eos_ids,
+        has_eos=has_eos, max_new_tokens=max_new_tokens,
+        sampled=temperature != 0.0,
     )
 
 

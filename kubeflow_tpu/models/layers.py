@@ -83,10 +83,18 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, kv=None, positions=None, segment_ids=None,
-                 mask_bias=None, decode=False, max_decode_len=None):
+                 mask_bias=None, decode=False, max_decode_len=None,
+                 cache_slots=None):
         """``kv`` switches to cross-attention: keys/values project from the
         encoder sequence instead of ``x`` (RoPE/cache apply to
-        self-attention only)."""
+        self-attention only).
+
+        ``cache_slots`` ([b] int32, single-token decode only) writes each
+        row's k/v at its OWN cache slot instead of the shared scalar
+        cache index — the continuous-batching slot pool, where rows sit
+        at different depths of their generations.  In that mode the
+        built-in causal bias is skipped entirely: ``mask_bias`` must
+        carry the full per-row visibility mask."""
         b, s, dim = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or dim // self.num_heads
@@ -139,8 +147,22 @@ class Attention(nn.Module):
                     "decode=True does not support packed sequences "
                     "(segment_ids); the cache is one sequence per batch row"
                 )
-            k, v, bias = self._update_cache(k, v, max_decode_len)
-            if mask_bias is not None:
+            k, v, bias = self._update_cache(k, v, max_decode_len,
+                                            slots=cache_slots)
+            if bias is None:
+                # Per-row slot writes: visibility is entirely the
+                # caller's mask_bias (scheduler pool step).  Without
+                # one, every stale/unwritten cache position would
+                # attend unmasked — silently wrong logits, so refuse.
+                if mask_bias is None:
+                    raise ValueError(
+                        "cache_slots decode requires mask_bias: the "
+                        "per-row slot path has no built-in causal "
+                        "mask, so the caller must supply the full "
+                        "visibility bias"
+                    )
+                bias = mask_bias
+            elif mask_bias is not None:
                 bias = bias + mask_bias
             out = None
             if s == 1:
@@ -152,7 +174,8 @@ class Attention(nn.Module):
 
                 # bias must be head-uniform to collapse into a [b, S] row;
                 # a per-head bias (ALiBi/T5-style) must take the XLA path.
-                if fd.force_enabled() and bias.shape[1] == 1:
+                if fd.force_enabled() and bias is not None \
+                        and bias.shape[1] == 1:
                     rows = jnp.broadcast_to(
                         bias[:, 0, 0, :], (b, k.shape[1])
                     ).astype(jnp.float32)
@@ -187,13 +210,20 @@ class Attention(nn.Module):
         )(out)
         return out
 
-    def _update_cache(self, k, v, max_decode_len):
+    def _update_cache(self, k, v, max_decode_len, slots=None):
         """Autoregressive KV cache (flax "cache" collection): write the new
         k/v at the running index with a static-shape dynamic_update_slice,
         return the full cache plus the mask bias hiding future/unwritten
         slots.  Works for prefill (s>1 at index 0) and single-token decode
         (s=1) under one jit trace each — no data-dependent Python control
         flow (SURVEY-mandated XLA semantics).
+
+        ``slots`` ([b] int32) switches to per-row writes: row i's token
+        lands at cache slot ``slots[i]`` via a batched scatter, and the
+        returned bias is None — the scalar cache index neither applies
+        nor advances, because pool rows progress at independent depths
+        (continuous batching, models/scheduler.py).  The caller's
+        mask_bias must then carry the complete per-row visibility.
 
         The cache stays sequence-major ([b, S, kv_h, d]) — XLA's preferred
         decode layout.  A dS-major layout feeding the Pallas flash-decode
@@ -215,6 +245,18 @@ class Attention(nn.Module):
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
+        if slots is not None:
+            if s != 1:
+                raise ValueError(
+                    f"per-row cache_slots require single-token decode, "
+                    f"got s={s}"
+                )
+            rows = jnp.arange(b)
+            k_all = cached_k.value.at[rows, slots].set(k[:, 0])
+            v_all = cached_v.value.at[rows, slots].set(v[:, 0])
+            cached_k.value = k_all
+            cached_v.value = v_all
+            return k_all, v_all, None
         idx = cache_index.value
         k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
         v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))

@@ -113,7 +113,8 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids, decode=False,
-                 mask_bias=None, token_mask=None, cache_len=None):
+                 mask_bias=None, token_mask=None, cache_len=None,
+                 cache_slots=None):
         cfg = self.cfg
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="attn_norm")(x)
         h = Attention(
@@ -127,7 +128,8 @@ class LlamaBlock(nn.Module):
             attn_impl=cfg.attn_impl,
             name="attn",
         )(h, positions=positions, segment_ids=segment_ids, decode=decode,
-          max_decode_len=cache_len or cfg.max_seq_len, mask_bias=mask_bias)
+          max_decode_len=cache_len or cfg.max_seq_len, mask_bias=mask_bias,
+          cache_slots=cache_slots)
         x = x + h
         h = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="mlp_norm")(x)
         # remat_mode="mlp": recompute only the FFN hiddens in backward (the
@@ -160,13 +162,13 @@ class LlamaScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids, decode, mask_bias,
-                 token_mask, cache_len):
+                 token_mask, cache_len, cache_slots):
         block = LlamaBlock
         if self.cfg.remat and self.cfg.remat_mode == "block":
             block = nn.remat(LlamaBlock, static_argnums=(4, 7))
         x = block(self.cfg, name="block")(
             x, positions, segment_ids, decode, mask_bias, token_mask,
-            cache_len,
+            cache_len, cache_slots,
         )
         return x, None
 
@@ -177,7 +179,7 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, positions=None, segment_ids=None,
                  decode=False, mask_bias=None, token_mask=None,
-                 cache_len=None, return_hidden=False):
+                 cache_len=None, cache_slots=None, return_hidden=False):
         cfg = self.cfg
         b, s = tokens.shape
         if cache_len is not None and cache_len > cfg.max_seq_len:
@@ -197,12 +199,12 @@ class Llama(nn.Module):
                 LlamaScanBody,
                 variable_axes={"params": 0, "cache": 0, "losses": 0},
                 split_rngs={"params": True},
-                in_axes=(nn.broadcast,) * 6,
+                in_axes=(nn.broadcast,) * 7,
                 length=cfg.n_layers,
             )
             x, _ = scan(cfg, name="layers_scan")(
                 x, positions, segment_ids, decode, mask_bias, token_mask,
-                cache_len,
+                cache_len, cache_slots,
             )
         else:
             block = LlamaBlock
@@ -212,7 +214,7 @@ class Llama(nn.Module):
             for i in range(cfg.n_layers):
                 x = block(cfg, name=f"layer_{i}")(
                     x, positions, segment_ids, decode, mask_bias, token_mask,
-                    cache_len,
+                    cache_len, cache_slots,
                 )
         x = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="final_norm")(x)
         head = nn.Dense(

@@ -1,0 +1,685 @@
+"""Cross-request continuous-batching scheduler for decoder-only serving.
+
+The lock-serialized serve path (models/serve.py) batches only rows that
+arrive inside ONE request: concurrent users serialize behind the service
+lock, and the decode loop runs at whatever batch width the luckiest
+request happened to carry.  This module owns the alternative: a fixed
+pool of KV-cache slots and ONE running decode loop over it.
+
+    submit ──► queue ──► admit (prefill, request-batched) ──► slots
+                                                               │ decode
+               evict (EOS / budget) ◄──────────────────────────┘
+                 │
+                 └──► freed slot refilled from the queue mid-flight
+
+* **Slots.**  ``slots`` rows × ``slot_len`` cache positions, one cache
+  pytree shaped like the model's own ("cache" collection leaves grown to
+  [slots, slot_len, kv_h, d]).  ``slot_len`` plays the role of the
+  bucketed cache length — every admitted request's prompt+budget must
+  fit it (ops/pallas/flash_decode.py's block table wants it a multiple
+  of 128 on real chips; the default is the model's max_seq_len).
+* **Admission.**  A queued request prefills EXACTLY as the sequential
+  path does (``generate_prefill`` — same jit, same shapes, shared
+  compile cache), then its per-row decode state (cache rows, first
+  token, rope position, per-row RNG key, EOS flag) peels apart into free
+  slots.  Rows that don't fit yet wait in a pending-insert list and take
+  slots as evictions free them.
+* **Decode.**  One compiled step (``_pool_steps``: a ``quantum``-length
+  ``lax.scan`` over ``generate.decode_step`` with per-row cache slots
+  and a per-row visibility bias) advances EVERY active slot one token
+  per step.  temperature/top_k/EOS ride as per-row arrays, so one
+  executable serves any mix of requests.
+* **Eviction.**  A row leaves its slot the moment it has emitted EOS or
+  exhausted its budget; the slot's stale cache content needs no scrub —
+  the next occupant's visibility mask hides it, and masked slots
+  contribute exact zeros to attention.
+
+Token equality: every op in the pool step is row-independent (per-row
+sampling keys via ``sample_logits_rows``, per-row cache writes, per-row
+masks), and a row's cache layout in its slot is byte-for-byte the layout
+the sequential decode would have used (prompt at slots [0, prompt_len),
+decode tokens after, extra slots masked to exact-zero contributions).  A
+request therefore generates the SAME tokens continuous-batched as it
+does alone — greedy and seeded sampling, pinned by
+tests/test_scheduler.py.
+
+MoE caveat: capacity-truncated expert routing couples rows of a batch by
+construction, so n_experts > 0 models are batch-composition dependent in
+ANY batched server (the lock path included); the equality contract holds
+for dense decoders.
+
+GSPMD: pass ``mesh`` to run the same loop over a sharded model — params
+come pre-sharded (parallel/sharding.shard_params via serve.load_service
+--mesh), the slot pool's batch axis is placed with ``batch_sharding``,
+and XLA inserts the collectives inside the one compiled step.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.platform import config
+
+_NEG_INF = -1e30
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "quantum", "sampled"),
+    donate_argnums=(1,),
+)
+def _pool_steps(model, cache, params, token, pos, write, rngs, done,
+                pad_rows, temps, top_ks, eos_ids, has_eos, *,
+                quantum, sampled):
+    """``quantum`` decode steps over the whole slot pool in one
+    executable.  Returns ``(cache, rngs, toks [quantum, slots],
+    dones [quantum, slots])``.
+
+    Built from the exact sequential step body (generate.decode_step);
+    the only differences are mechanical: per-row cache writes at
+    ``write`` (the flax scalar index can't express rows at different
+    depths) and the causal visibility computed per row instead of from
+    that scalar — the bias VALUES at every live slot are identical to
+    the sequential run's, which is what keeps outputs token-equal."""
+    from kubeflow_tpu.models.generate import decode_step
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    S = pad_rows.shape[-1]
+    k_pos = jnp.arange(S)
+
+    def step(carry, _):
+        cache, token, pos, write, rngs, done = carry
+        # Finished rows keep stepping until the host evicts them; clamp
+        # their (discarded) writes into range.
+        slots = jnp.minimum(write, S - 1)
+        allowed = k_pos[None, :] <= slots[:, None]
+        bias = (jnp.where(allowed, 0.0, _NEG_INF)[:, None, None, :]
+                + pad_rows[:, None, None, :])
+        cache, nxt, pos, rngs, done = decode_step(
+            model, params, cache, token, pos, rngs, done, bias,
+            cache_len=S, temps=temps, top_ks=top_ks, eos_ids=eos_ids,
+            has_eos=has_eos, sampled=sampled, cache_slots=slots,
+        )
+        return (cache, nxt, pos, write + 1, rngs, done), (nxt, done)
+
+    carry = (cache, token, pos, write, rngs, done)
+    (cache, token, pos, write, rngs, done), (toks, dones) = jax.lax.scan(
+        step, carry, None, length=quantum)
+    # The final carry feeds the NEXT quantum directly (no host→device
+    # rebuild) whenever no admission changed the pool in between.
+    return cache, token, pos, write, rngs, done, toks, dones
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def _place_row(pool_cache, rngs, pad_rows, req_cache, req_rngs, pad_row,
+               slot, row):
+    """Copy request-cache row ``row`` into pool slot ``slot``, and land
+    the row's RNG key and visibility-bias row in the same dispatch (ONE
+    executable per placement — admission churn is on the serving hot
+    path).
+
+    K/V leaves are [b, L, kv_h, d] (or [layers, b, L, kv_h, d] under
+    scan_layers), so the batch axis is ``ndim - 4``; lower-rank leaves
+    (the scalar cache_index) pass through untouched.  ``slot``/``row``
+    are traced, so ONE compile per request-cache shape covers every
+    placement.  L <= slot_len: positions past L keep the previous
+    occupant's bytes, which the visibility mask turns into exact zeros."""
+
+    def one(p, r):
+        if getattr(r, "ndim", 0) < 4:
+            return p
+        axis = r.ndim - 4
+        starts_r = [0] * r.ndim
+        starts_r[axis] = row
+        sizes = list(r.shape)
+        sizes[axis] = 1
+        sliced = jax.lax.dynamic_slice(r, starts_r, sizes)
+        starts_p = [0] * p.ndim
+        starts_p[axis] = slot
+        return jax.lax.dynamic_update_slice(p, sliced.astype(p.dtype),
+                                            starts_p)
+
+    pool_cache = jax.tree.map(one, pool_cache, req_cache)
+    rngs = rngs.at[slot].set(req_rngs[row])
+    pad_rows = jax.lax.dynamic_update_slice(
+        pad_rows, pad_row[None], (slot, 0))
+    return pool_cache, rngs, pad_rows
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("model", "slots", "slot_len"))
+def _init_pool(model, params, *, slots, slot_len):
+    """Build the pool cache pytree by running one (discarded) decode step
+    at the pool shape — the flax cache variables initialize to zeros at
+    [slots, slot_len, ...]; the garbage this step writes at position 0
+    is behind every future occupant's mask."""
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    p = dequantize_params(params)
+    _, state = model.apply(
+        {"params": p}, jnp.zeros((slots, 1), jnp.int32),
+        positions=jnp.zeros((slots, 1), jnp.int32),
+        decode=True, cache_len=slot_len, mutable=["cache"],
+    )
+    return state["cache"]
+
+
+class PendingRequest:
+    """Submit-side handle: the request thread waits on the lifecycle
+    events (admitted → first token → done) while the scheduler thread
+    drives them; ``result()`` returns the row lists or re-raises the
+    scheduler-side error."""
+
+    def __init__(self, rows, *, max_new_tokens, temperature, top_k,
+                 eos_token, seed):
+        self.rows = rows
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_token = eos_token
+        self.seed = seed
+        self.tokens = None          # optional pre-padded [b, L] prompt
+        self.prompt_mask = None     # optional [b, L] validity mask
+        self.outputs: List[Optional[list]] = [None] * len(rows)
+        self.remaining = len(rows)
+        self.error: Optional[BaseException] = None
+        self.admitted = threading.Event()
+        self.first_token = threading.Event()
+        self.done = threading.Event()
+        self.t_admitted: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+
+    def _fail(self, exc: BaseException):
+        self.error = exc
+        self.admitted.set()
+        self.first_token.set()
+        self.done.set()
+
+    def wait_admitted(self):
+        self.admitted.wait()
+        if self.error is not None:
+            raise self.error
+
+    def wait_first_token(self):
+        self.first_token.wait()
+        if self.error is not None:
+            raise self.error
+
+    def result(self) -> List[list]:
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return list(self.outputs)
+
+
+class _Slot:
+    """Host-side bookkeeping for one pool row."""
+
+    __slots__ = ("req", "row", "first", "token", "pos", "write", "done",
+                 "budget", "collected", "temp", "top_k", "eos", "has_eos",
+                 "_cache", "_rng_src", "_pad_row")
+
+    def __init__(self, req, row, *, token, pos, write, done, budget):
+        self.req = req
+        self.row = row
+        self.first = token            # the prefill-sampled first token
+        self.token = token            # model input for the next step
+        self.pos = pos
+        self.write = write
+        self.done = done
+        self.budget = budget          # decode tokens still owed (n - 1)
+        self.collected: List[int] = []
+        self.temp = req.temperature
+        self.top_k = req.top_k or 0
+        self.eos = req.eos_token if req.eos_token is not None else 0
+        self.has_eos = req.eos_token is not None
+
+
+class DecodeScheduler:
+    """The continuous-batching engine: one background thread owns the
+    device (prefills at admission, one compiled pool step for decode);
+    request threads ``submit()`` and block on the returned
+    ``PendingRequest``.
+
+    Knobs (constructor arg, falling back to env):
+      slots     KFT_SERVE_SLOTS            pool width (default 8)
+      slot_len  KFT_SERVE_SLOT_LEN         cache positions per slot
+                                           (default model max_seq_len)
+      quantum   KFT_SERVE_DECODE_QUANTUM   decode steps per dispatch /
+                                           admission check (default 8)
+
+    A crash in the loop fails every outstanding request with the error
+    and marks the scheduler dead (``alive`` False) — the serving layer
+    falls back to the lock-serialized path instead of hanging clients.
+    """
+
+    def __init__(self, model, params, *, slots: Optional[int] = None,
+                 slot_len: Optional[int] = None,
+                 quantum: Optional[int] = None,
+                 mesh=None,
+                 telemetry: Optional[Callable[[], object]] = None):
+        self.model = model
+        self.params = params
+        self.slots = slots or config.env_int("KFT_SERVE_SLOTS", 8)
+        self.slot_len = slot_len or config.env_int(
+            "KFT_SERVE_SLOT_LEN", 0) or model.cfg.max_seq_len
+        if self.slot_len > model.cfg.max_seq_len:
+            raise ValueError(
+                f"slot_len {self.slot_len} exceeds the model's "
+                f"max_seq_len {model.cfg.max_seq_len}"
+            )
+        self.quantum = quantum or config.env_int(
+            "KFT_SERVE_DECODE_QUANTUM", 8)
+        self.mesh = mesh
+        # Zero-arg callable so a service can re-attach telemetry (every
+        # create_app builds a fresh registry) without a stale reference
+        # pinning dead instruments.
+        self._telemetry = telemetry or (lambda: None)
+
+        self._cond = threading.Condition()
+        self._queue: List[PendingRequest] = []
+        self._pending_rows: List[_Slot] = []  # prefilled, waiting for slots
+        self._slot_state: List[Optional[_Slot]] = [None] * self.slots
+        self._stop_flag = False
+        self._dead: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        self._admitted_total = 0
+        self._evicted_total = 0
+        self._steps_total = 0
+
+        # Device state, touched only by the loop thread after start.
+        self._cache = None
+        self._rngs = None
+        self._pad_rows = None
+        self._carry = None
+        self._batch_ns = None
+        if mesh is not None:
+            from kubeflow_tpu.parallel.sharding import batch_sharding
+
+            self._batch_ns = batch_sharding(mesh)
+
+    # -- public surface ---------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self._dead is None and not self._stop_flag
+
+    def submit(self, rows: List[List[int]], *, max_new_tokens: int,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               eos_token: Optional[int] = None, seed: int = 0,
+               tokens=None, prompt_mask=None) -> PendingRequest:
+        """Queue one request (a list of prompt token rows).  Raises
+        ValueError synchronously when prompt+budget cannot fit a slot —
+        the same contract as the sequential path's cache-length check.
+
+        ``tokens``/``prompt_mask`` optionally carry the already
+        right-padded device arrays (the serving layer validates and pads
+        every request anyway — re-padding the rows here would double the
+        O(total tokens) prep on the hot path); when absent the scheduler
+        pads ``rows`` itself (library use)."""
+        longest = max(len(r) for r in rows)
+        if longest + max_new_tokens > self.slot_len:
+            raise ValueError(
+                f"prompt_len ({longest}) + max_new_tokens "
+                f"({max_new_tokens}) = {longest + max_new_tokens} exceeds "
+                f"the scheduler slot length {self.slot_len}"
+            )
+        req = PendingRequest(
+            rows, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, eos_token=eos_token, seed=seed)
+        req.tokens = tokens
+        req.prompt_mask = prompt_mask
+        tel = self._telemetry()
+        with self._cond:
+            # Checked under the lock: a loop crash concurrent with this
+            # submit must either fail the request here or see it in the
+            # queue when _fail_outstanding drains — never neither (a
+            # hung client).
+            if self._dead is not None:
+                raise RuntimeError(
+                    "decode scheduler is dead") from self._dead
+            if self._stop_flag:
+                raise RuntimeError("decode scheduler is stopped")
+            self._queue.append(req)
+            if tel is not None:
+                tel.queue_depth.inc(len(rows))
+            self._cond.notify()
+        self.start()
+        return req
+
+    def start(self):
+        with self._cond:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            if self._dead is not None or self._stop_flag:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="kft-decode-scheduler")
+            self._thread.start()
+
+    def stop(self):
+        """Stop the loop; outstanding requests fail with RuntimeError."""
+        with self._cond:
+            self._stop_flag = True
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+
+    def stats(self) -> dict:
+        with self._cond:
+            queued = sum(len(r.rows) for r in self._queue) + len(
+                self._pending_rows)
+        return {
+            "queued_rows": queued,
+            "active_rows": sum(
+                s is not None for s in self._slot_state),
+            "admitted_total": self._admitted_total,
+            "evicted_total": self._evicted_total,
+            "steps_total": self._steps_total,
+            "slots": self.slots,
+            "slot_len": self.slot_len,
+        }
+
+    # -- loop thread ------------------------------------------------------
+
+    def _loop(self):
+        try:
+            self._ensure_pool()
+            while True:
+                with self._cond:
+                    while (not self._stop_flag and not self._queue
+                           and not self._pending_rows
+                           and all(s is None for s in self._slot_state)):
+                        self._cond.wait()
+                    if self._stop_flag:
+                        break
+                self._admit()
+                if any(s is not None for s in self._slot_state):
+                    self._run_quantum()
+        except BaseException as exc:  # noqa: BLE001 — fail every waiter
+            self._dead = exc
+            self._fail_outstanding(exc)
+            return
+        self._fail_outstanding(RuntimeError("scheduler stopped"))
+
+    def _ensure_pool(self):
+        if self._cache is not None:
+            return
+        self._cache = _init_pool(
+            self.model, self.params, slots=self.slots,
+            slot_len=self.slot_len)
+        self._rngs = jax.random.split(jax.random.key(0), self.slots)
+        self._pad_rows = jnp.full(
+            (self.slots, self.slot_len), _NEG_INF, jnp.float32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from kubeflow_tpu.parallel.sharding import data_axes
+
+            axes = data_axes(self.mesh)
+            if axes:
+                def place(x):
+                    spec = [None] * x.ndim
+                    spec[max(x.ndim - 4, 0)] = axes
+                    return jax.device_put(
+                        x, NamedSharding(self.mesh, P(*spec)))
+
+                self._cache = jax.tree.map(
+                    lambda x: place(x) if getattr(x, "ndim", 0) >= 4
+                    else x, self._cache)
+                self._pad_rows = jax.device_put(
+                    self._pad_rows, self._batch_ns)
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self._slot_state) if s is None]
+
+    def _admit(self):
+        """Fill free slots: first from prefilled pending rows, then by
+        prefilling queued requests (FIFO, no bypass).
+
+        Crash safety: rows live in ``_pending_rows`` (or still in the
+        queue) at every point a device call can raise — peeked, placed,
+        THEN popped — so ``_fail_outstanding`` can always reach their
+        requests; a row held only in a local variable would hang its
+        client forever."""
+        while True:
+            free = self._free_slots()
+            while free and self._pending_rows:
+                self._place(self._pending_rows[0], free.pop(0))
+                self._pending_rows.pop(0)
+            if not free or self._pending_rows:
+                return
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue.pop(0)
+            try:
+                self._pending_rows.extend(self._prefill(req))
+            except BaseException as exc:  # noqa: BLE001 — per-request
+                req._fail(exc)
+                tel = self._telemetry()
+                if tel is not None:
+                    tel.queue_depth.dec(len(req.rows))
+
+    def _prefill(self, req: PendingRequest) -> List[_Slot]:
+        """Admission prefill: EXACTLY the sequential request-batched
+        prompt pass (same ``generate_prefill`` jit the lock path uses,
+        same shapes — compile caches are shared), then peel the carry
+        into per-row slot states.  Rows already complete (budget 1, or
+        EOS on the first token) finish here without touching a slot."""
+        from kubeflow_tpu.models.generate import generate_prefill
+
+        rows = req.rows
+        if req.tokens is not None:
+            prompt, mask = req.tokens, req.prompt_mask
+        else:
+            longest = max(len(r) for r in rows)
+            prompt = jnp.array(
+                [r + [0] * (longest - len(r)) for r in rows], jnp.int32)
+            mask = jnp.array(
+                [[1] * len(r) + [0] * (longest - len(r)) for r in rows],
+                bool)
+        n = req.max_new_tokens
+        req.t_admitted = time.perf_counter()
+        req.admitted.set()
+        first, ((carry, pad_bias), _budget) = generate_prefill(
+            self.model, self.params, prompt, prompt_mask=mask,
+            rng=jax.random.key(req.seed), max_new_tokens=n,
+            temperature=req.temperature, top_k=req.top_k,
+            eos_token=req.eos_token,
+        )
+        cache, first_d, lengths, row_rngs, done0 = carry
+        first_h, lengths_h, done_h = jax.device_get(
+            (first_d, lengths, done0))
+        req.t_first = time.perf_counter()
+        req.first_token.set()
+        cache_len_req = pad_bias.shape[-1]
+        # Slot bias rows: the request's prompt-padding bias, extended
+        # with zeros to slot_len (the per-row causal mask hides the
+        # tail until it is really written).
+        pads = jnp.zeros(
+            (len(rows), self.slot_len), jnp.float32
+        ).at[:, :cache_len_req].set(pad_bias[:, 0, 0, :])
+
+        tel = self._telemetry()
+        out = []
+        eos = req.eos_token
+        for i in range(len(rows)):
+            tok0 = int(first_h[i])
+            if n == 1 or bool(done_h[i]):
+                # Complete at admission, no slot needed.  Counted
+                # admitted AND evicted here so the balance invariant
+                # (admitted == evicted + slots_active) holds at every
+                # instant; sequential semantics right-pad with EOS.
+                self._admitted_total += 1
+                self._evicted_total += 1
+                if tel is not None:
+                    tel.queue_depth.dec(1)
+                    tel.scheduler_admitted.inc()
+                    tel.scheduler_evicted.inc()
+                self._complete_row(req, i, [tok0] + [eos] * (n - 1))
+                continue
+            slot = _Slot(
+                req, i, token=tok0, pos=int(lengths_h[i]),
+                write=int(prompt.shape[1]), done=False, budget=n - 1)
+            slot._cache = cache          # request cache, sliced at place
+            slot._rng_src = (row_rngs, i)
+            slot._pad_row = pads[i]
+            out.append(slot)
+        return out
+
+    def _place(self, slot: _Slot, idx: int):
+        """Insert a prefilled row into pool slot ``idx``.  Admission is
+        counted HERE — a prefilled row waiting in the pending-insert
+        list still reads as queued (serve_queue_depth's 'not yet holding
+        a decode slot' contract), and admitted == evicted + slots_active
+        holds at every instant."""
+        row_rngs, i = slot._rng_src
+        self._cache, self._rngs, self._pad_rows = _place_row(
+            self._cache, self._rngs, self._pad_rows,
+            slot._cache, row_rngs, slot._pad_row,
+            jnp.int32(idx), jnp.int32(i))
+        self._admitted_total += 1
+        tel = self._telemetry()
+        if tel is not None:
+            tel.queue_depth.dec(1)
+            tel.scheduler_admitted.inc()
+            tel.slots_active.set(
+                1 + sum(s is not None for s in self._slot_state))
+        # Drop the device references so an evicted request's prefill
+        # cache can free once its last pending row is placed.
+        del slot._cache, slot._rng_src, slot._pad_row
+        self._slot_state[idx] = slot
+        # The device carry no longer reflects the pool: rebuild it from
+        # the slot bookkeeping at the next quantum.
+        self._carry = None
+
+    def _run_quantum(self):
+        """One compiled multi-step dispatch over the pool, then host-side
+        collection and eviction.
+
+        The device-side carry (token/pos/write/done + the per-row
+        sampling arrays) round-trips between quanta WITHOUT touching the
+        host: it is rebuilt from the slot bookkeeping only when an
+        admission changed the pool (``_place`` clears it).  Evictions
+        deliberately do NOT invalidate it — a vacated slot keeps
+        stepping as a zombie whose writes stay clamped inside its own
+        (masked) region and whose tokens the host discards; the next
+        occupant overwrites everything that matters at placement."""
+        state = self._slot_state
+        if self._carry is None:
+            def dev(vals, dtype):
+                arr = jnp.asarray(vals, dtype)
+                if self._batch_ns is not None:
+                    arr = jax.device_put(arr, self._batch_ns)
+                return arr
+
+            temps = [s.temp if s else 0.0 for s in state]
+            self._carry = (
+                dev([s.token if s else 0 for s in state], jnp.int32),
+                dev([s.pos if s else 0 for s in state], jnp.int32),
+                dev([s.write if s else 0 for s in state], jnp.int32),
+                dev([s.done if s else True for s in state], bool),
+                dev(temps, jnp.float32),
+                dev([s.top_k if s else 0 for s in state], jnp.int32),
+                dev([s.eos if s else 0 for s in state], jnp.int32),
+                dev([s.has_eos if s else False for s in state], bool),
+                any(t != 0.0 for t in temps),
+            )
+        (token, pos, write, done, temps_d, top_ks_d, eos_d, has_eos_d,
+         sampled) = self._carry
+        (self._cache, token, pos, write, self._rngs, done, toks,
+         dones) = _pool_steps(
+            self.model, self._cache, self.params,
+            token, pos, write, self._rngs, done,
+            self._pad_rows, temps_d, top_ks_d, eos_d, has_eos_d,
+            quantum=self.quantum, sampled=sampled,
+        )
+        self._carry = (token, pos, write, done, temps_d, top_ks_d, eos_d,
+                       has_eos_d, sampled)
+        toks_h, dones_h = jax.device_get((toks, dones))
+        self._steps_total += self.quantum
+        tel = self._telemetry()
+        active = sum(s is not None for s in state)
+        if tel is not None:
+            tel.batch_fill_ratio.observe(active / max(self.slots, 1))
+            tel.slots_active.set(active)
+        for i, slot in enumerate(state):
+            if slot is None:
+                continue
+            for t in range(self.quantum):
+                if len(slot.collected) >= slot.budget:
+                    break
+                slot.collected.append(int(toks_h[t, i]))
+                slot.done = bool(dones_h[t, i])
+            slot.token = int(toks_h[self.quantum - 1, i])
+            slot.pos += self.quantum
+            slot.write += self.quantum
+            if slot.done or len(slot.collected) >= slot.budget:
+                self._evict(i)
+
+    def _evict(self, idx: int):
+        slot = self._slot_state[idx]
+        self._slot_state[idx] = None
+        # Output rows are first-token + decode tokens, EOS-padded to the
+        # budget — exactly the sequential path's post-EOS right-padding.
+        fill = slot.req.eos_token
+        out = slot.collected + [fill] * (slot.budget - len(slot.collected))
+        self._complete_row(slot.req, slot.row, [slot.first] + out)
+        self._evicted_total += 1
+        tel = self._telemetry()
+        if tel is not None:
+            tel.scheduler_evicted.inc()
+            tel.slots_active.set(
+                sum(s is not None for s in self._slot_state))
+
+    def _complete_row(self, req: PendingRequest, row: int, tokens: list):
+        req.outputs[row] = tokens
+        req.remaining -= 1
+        if req.remaining == 0:
+            req.t_done = time.perf_counter()
+            req.done.set()
+
+    def _fail_outstanding(self, exc: BaseException):
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+            pending = list(self._pending_rows)
+            self._pending_rows.clear()
+        tel = self._telemetry()
+        for req in queued:
+            if tel is not None:
+                tel.queue_depth.dec(len(req.rows))
+            req._fail(exc)
+        # Pending-insert rows were never admitted (placement-time
+        # accounting), so they only drain the queue gauge; in-flight
+        # slot rows WERE admitted — count them evicted so
+        # admitted == evicted + slots_active stays true after a crash
+        # (the service keeps serving on the lock path and operators
+        # alert on that balance).  A row that crashed between _place and
+        # its pending-list pop is in both sets — count it once, as
+        # placed.
+        placed = {id(s) for s in self._slot_state if s}
+        pending = [s for s in pending if id(s) not in placed]
+        if tel is not None and pending:
+            tel.queue_depth.dec(len(pending))
+        seen = set()
+        for slot in pending + [s for s in self._slot_state if s]:
+            if id(slot.req) not in seen:
+                seen.add(id(slot.req))
+                slot.req._fail(exc)
+        in_flight = sum(s is not None for s in self._slot_state)
+        self._evicted_total += in_flight
+        self._slot_state = [None] * self.slots
+        if tel is not None:
+            if in_flight:
+                tel.scheduler_evicted.inc(in_flight)
+            tel.slots_active.set(0)

@@ -109,6 +109,12 @@ def _telemetry_request(service, rows, eos_token, validate, run):
     ``service.telemetry`` None (library use) every span/instrument is a
     no-op and the lock semantics are exactly the pre-telemetry ones.
 
+    The scheduler path (``GenerationService._generate_scheduled``)
+    mirrors this sequence against scheduler events instead of the lock;
+    if you change span names/order or counter semantics here, change it
+    there too — tests pin both engines to the same span tree and
+    counter values (tests/test_serve.py, tests/test_scheduler.py).
+
     ``validate`` returns the positional args ``run(tel, t_arrival, ...)``
     receives after the admit span; ``run`` executes under the lock and
     returns the row lists handed back to the caller."""
@@ -153,15 +159,92 @@ class GenerationService:
     telemetry: Optional[ServeTelemetry] = None
 
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
-                 max_batch_rows: int = 64):
+                 max_batch_rows: int = 64, mesh=None,
+                 use_scheduler: Optional[bool] = None):
         self.model = model
         self.params = params
         self.default_max_new_tokens = default_max_new_tokens
         self.max_batch_rows = max_batch_rows
+        # SPMD serving (load_service --mesh): params arrive sharded; the
+        # scheduler places its slot pool's batch axis with batch_sharding
+        # over the same mesh.
+        self.mesh = mesh
+        # Continuous batching (models/scheduler.py): instrumented
+        # services route through the cross-request scheduler unless
+        # KFT_SERVE_SCHEDULER=0 (or use_scheduler=False) pins the
+        # lock-serialized path.  Un-instrumented library use always
+        # takes the lock path — no background thread appears behind a
+        # plain GenerationService(model, params).generate() call.
+        self._use_scheduler = use_scheduler
+        self._scheduler = None
         # generate() donates nothing but jit compilation is per-shape; a
         # lock keeps concurrent requests from racing device memory on tiny
         # single-chip deployments.
         self._lock = threading.Lock()
+
+    def _scheduler_or_none(self):
+        """The DecodeScheduler to route through, or None for the
+        lock-serialized path.  A scheduler that died (loop crash) fails
+        over to the lock path instead of hanging clients."""
+        if self.telemetry is None:
+            return None
+        use = self._use_scheduler
+        if use is None:
+            from kubeflow_tpu.platform import config as _config
+
+            use = _config.env_bool("KFT_SERVE_SCHEDULER", True)
+        if not use:
+            return None
+        with self._lock:
+            if self._scheduler is None:
+                from kubeflow_tpu.models.scheduler import DecodeScheduler
+
+                self._scheduler = DecodeScheduler(
+                    self.model, self.params, mesh=self.mesh,
+                    telemetry=lambda: self.telemetry,
+                )
+            sched = self._scheduler
+        return sched if sched.alive else None
+
+    def _generate_scheduled(self, sched, rows, validate, *, temperature,
+                            top_k, eos_token, seed):
+        """Continuous-batched request lifecycle: submit to the scheduler
+        and wait, mapping the scheduler's admission/first-token/finish
+        events onto the SAME span sequence the lock path traces
+        (admit → queue → prefill → decode), so /debug/traces and the
+        TTFT/per-token series read identically under either engine."""
+        tel = self.telemetry
+        t_arrival = time.perf_counter()
+        tel.begin_request()
+        try:
+            with tel.span("admit"):
+                prompt, mask, n = validate()
+                tel.batch_rows.observe(len(rows))
+                tel.input_tokens.inc(sum(len(r) for r in rows))
+            tel.slots_total.set(sched.slots)
+            # The validated padded arrays ride along so the scheduler's
+            # admission prefill doesn't re-pad the rows (same arrays,
+            # half the host-side prep per request).
+            pending = sched.submit(
+                rows, max_new_tokens=n, temperature=temperature,
+                top_k=top_k, eos_token=eos_token, seed=seed,
+                tokens=prompt, prompt_mask=mask)
+            with tel.span("queue"):
+                pending.wait_admitted()
+            with tel.span("prefill", rows=len(rows)):
+                pending.wait_first_token()
+            tel.ttft.observe(pending.t_first - t_arrival)
+            with tel.span("decode", tokens=n):
+                result = pending.result()
+            if n > 1:
+                tel.per_token.observe(
+                    (pending.t_done - pending.t_first) / (n - 1))
+            tel.output_tokens.inc(_generated_token_count(result, eos_token))
+            tel.finish_request("ok")
+            return result
+        except BaseException:
+            tel.finish_request("error")
+            raise
 
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
@@ -187,6 +270,12 @@ class GenerationService:
                 top_k=top_k, eos_token=eos_token,
                 limit_rows=self.max_batch_rows,
             )
+
+        sched = self._scheduler_or_none()
+        if sched is not None:
+            return self._generate_scheduled(
+                sched, rows, validate, temperature=temperature,
+                top_k=top_k, eos_token=eos_token, seed=seed)
 
         def run(tel, t_arrival, prompt, mask, n):
             kw = dict(max_new_tokens=n, temperature=temperature,
@@ -232,7 +321,15 @@ class GenerationService:
 class Seq2SeqGenerationService:
     """Same request contract as GenerationService, encoder-decoder models:
     ``tokens`` rows are SOURCE sequences; the response is the generated
-    target continuation (T5 convention: BOS = pad id 0, EOS = 1)."""
+    target continuation (T5 convention: BOS = pad id 0, EOS = 1).
+
+    Deliberately EXEMPT from the continuous-batching scheduler: the
+    encoder pass is not a prompt-cache prefill — decoder slots would
+    each need their own cross-attention K/V against a different source
+    length, which the fixed slot pool cannot express.  This class has no
+    scheduler branch at all, so KFT_SERVE_SCHEDULER cannot mis-route it;
+    requests always take the lock-serialized path (pinned by
+    tests/test_scheduler.py)."""
 
     default_eos_token: Optional[int] = 1
     telemetry: Optional[ServeTelemetry] = None
@@ -491,7 +588,10 @@ def load_service(
         params = shard_params(params, mesh, rules)
     if seq2seq:
         return Seq2SeqGenerationService(model, params)
-    return GenerationService(model, params)
+    # The mesh rides on the service so the continuous-batching scheduler
+    # can batch-shard its slot pool over the same device mesh the params
+    # are sharded across.
+    return GenerationService(model, params, mesh=mesh)
 
 
 def main(argv=None) -> int:

@@ -13,9 +13,20 @@ request lifecycle maps to spans
 served by ``/debug/traces`` exactly like the control plane's reconcile
 traces; TTFT is observed at the prefill span's close (arrival → first
 token host-visible), per-token latency as decode seconds per generated
-token.  These are the series ROADMAP item 2's cross-request scheduler
-will be tuned against: queue depth and batch fill ratio are the
-continuous-batching headroom signals.
+token.
+
+Under the continuous-batching scheduler (models/scheduler.py, the
+default instrumented decoder-only path) the same span names map onto the
+scheduler lifecycle — queue = submit → admission, prefill = the
+admission prompt pass, decode = slot residency — and the series become
+the scheduler's tuning loop: ``serve_queue_depth`` gauges PENDING
+SCHEDULER QUEUE ROWS (not lock waiters), ``serve_batch_fill_ratio``
+observes per-step decode-slot occupancy, and the admitted/evicted
+counters balance against ``serve_decode_slots_active``
+(admitted == evicted + active, the serve-soak CI invariant).  The
+lock-serialized fallback path (KFT_SERVE_SCHEDULER=0, seq2seq) keeps the
+original semantics: queue depth counts lock waiters, fill ratio is
+request rows over max_batch_rows.
 """
 from __future__ import annotations
 
@@ -54,19 +65,46 @@ class ServeTelemetry:
         )
         self.queue_depth = Gauge(
             "serve_queue_depth",
-            "Requests currently waiting on the generation lock (the "
-            "continuous-batching backlog signal)",
+            "Prompt rows pending in the continuous-batching scheduler "
+            "queue (not yet holding a decode slot); on the lock-"
+            "serialized fallback path, requests waiting on the "
+            "generation lock",
             registry=registry,
         )
         self.batch_rows = Histogram(
-            "serve_batch_rows", "Rows admitted per generation batch",
+            "serve_batch_rows", "Rows admitted per generation request",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128), registry=registry,
         )
         self.batch_fill_ratio = Histogram(
             "serve_batch_fill_ratio",
-            "Admitted rows over the service's max_batch_rows (1.0 = the "
-            "batch axis is saturated)",
+            "Per-decode-step slot occupancy under the scheduler (active "
+            "slots over the pool size, observed once per decode "
+            "quantum); on the lock path, request rows over "
+            "max_batch_rows",
             buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+            registry=registry,
+        )
+        self.scheduler_admitted = Counter(
+            "serve_scheduler_admitted_rows_total",
+            "Prompt rows admitted into the decode slot pool (prefilled "
+            "and scheduled for decoding)",
+            registry=registry,
+        )
+        self.scheduler_evicted = Counter(
+            "serve_scheduler_evicted_rows_total",
+            "Rows evicted from the slot pool (EOS or budget exhausted); "
+            "admitted == evicted + serve_decode_slots_active at all "
+            "times",
+            registry=registry,
+        )
+        self.slots_active = Gauge(
+            "serve_decode_slots_active",
+            "Decode slots currently occupied by in-flight rows",
+            registry=registry,
+        )
+        self.slots_total = Gauge(
+            "serve_decode_slots",
+            "Decode slot pool size (KFT_SERVE_SLOTS)",
             registry=registry,
         )
         self.ttft = Histogram(
