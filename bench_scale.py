@@ -61,15 +61,20 @@ import time
 # fan-out, diff-and-patch writes): same-machine 600-notebook wave
 # converge 6.0 -> 2.2 ms/notebook and steady-state resync CPU
 # 0.55 -> 0.19 s measured on the 2-CPU container.
+# Whole dict re-pinned 2026-08-04 (same 2-CPU container, one run)
+# alongside the NEW sharded-HA bands (ISSUE 9) so the report stays one
+# coherent same-machine trajectory: converge 2.54 ms/nb, resync CPU
+# 0.237 s, cached gets 212k/s (the ownership-filter hook costs nothing
+# when sharding is off), alloc 0.64 KiB/obj, wire converge 6.35 s.
 BASELINE = {
-    "fleet_converge_ms_per_notebook": 2.2,    # 600-notebook wave
-    "fleet_resync_cpu_s": 0.2,                # min of 3 600-object cycles
+    "fleet_converge_ms_per_notebook": 2.5,    # 600-notebook wave
+    "fleet_resync_cpu_s": 0.24,               # min of 3 600-object cycles
     # Read-path microbench (zero-copy frozen views): informer get()
     # throughput and the resync cycle's peak tracemalloc footprint per
     # object.  Pre-frozen-view: ~62k gets/s and ~3 KB/object of copy
     # churn on this container.
-    "cached_get_per_s": 120_000.0,            # 600-object store
-    "resync_alloc_peak_kb_per_obj": 0.8,      # tracemalloc peak / N
+    "cached_get_per_s": 200_000.0,            # 600-object store
+    "resync_alloc_peak_kb_per_obj": 0.65,     # tracemalloc peak / N
 }
 BAND_FACTOR = 3.0
 # Large-fleet per-notebook converge time must stay within this factor of
@@ -94,7 +99,11 @@ CHAOS_RATE = 0.05
 # write-coalesced path: merge patches carry no resourceVersion, so the
 # storm's 409-on-update faults have almost nothing left to hit —
 # alternating same-machine A/B measured storm converge 11.7-52.2 s on
-# the full-update path vs 3.2-7.3 s on the patched path.
+# the full-update path vs 3.2-7.3 s on the patched path.  The 2026-08-04
+# re-pin run measured min-of-2 12.7 s (samples 23.5/12.7) — inside the
+# 3x band; deliberately NOT re-pinned upward: the storm tail is the
+# documented backoff lottery and loosening the tripwire to one noisy
+# draw would blunt it.
 CHAOS_CONVERGE_BASELINE_S = 7.0
 # Parallel-dispatch bands (ISSUE 5): the wave-converge-vs-workers sweep
 # and the wire-level converge both run over the HTTP transport — parallel
@@ -106,7 +115,35 @@ CHAOS_CONVERGE_BASELINE_S = 7.0
 WORKER_SWEEP_WORKERS = (1, 4)
 WORKER_SWEEP_MIN_SPEEDUP = 1.3   # workers=4 must beat workers=1 by >=30%
 WORKER_SWEEP_RTT_S = 0.002       # injected per-call apiserver RTT
-WIRE_CONVERGE_BASELINE_S = 5.5   # 80-nb wave, http, workers=4, QPS off
+WIRE_CONVERGE_BASELINE_S = 6.4   # 80-nb wave, http, workers=4, QPS off
+                                 # (re-pinned 2026-08-04: measured 6.35)
+# Sharded HA bands (ISSUE 9): a 10k-notebook wave across 4 simulated
+# replicas (runtime/sharding.py lease-owned keyspace shards over one
+# FakeKube; testing/shardfleet.py harness).  The converge band is
+# absolute wall seconds — in ONE process the replicas share the GIL, so
+# sharding buys no CPU here; what it buys (and what the second band
+# pins) is per-replica watch/cache load: each replica's informers hold
+# and process only its owned ranges, so the LARGEST per-replica cache
+# must stay under SHARDED_CACHE_FRAC_MAX of the full keyspace a
+# single-process controller would hold (4 replicas ≈ 0.25 + rebalance
+# slop).  The fencing invariant (no key written by two replicas in
+# overlapping ownership windows) is asserted on every bench run — a
+# perf harness that silently stopped exercising the fence would be
+# worthless as a regression tripwire.
+SHARDED_REPLICAS = 4
+SHARDED_SHARDS = 8
+SHARDED_LEASE_S = 2.0
+# Pinned 2026-08-04 on the 2-CPU dev container, full-run protocol (the
+# sharded phase runs after the fleet/chaos/sweep phases, in their
+# process): 10k-notebook wave over 4 replicas converged in 90.4 s
+# (9.0 ms/notebook; 65.3 s when run standalone — accumulated process
+# state, not algorithmic; the single-process 600-notebook band runs
+# ~2.5 ms/notebook and the remaining gap is 4x informer sets + lease
+# traffic sharing one GIL).  Same run: max per-replica cache 12.5k objs
+# of a 70k full keyspace (0.179), mean admit fraction 0.17, 88,930
+# fenced writes checked clean.
+SHARDED_CONVERGE_BASELINE_S = 90.0
+SHARDED_CACHE_FRAC_MAX = 0.5
 
 
 def _rss_mb() -> float:
@@ -526,6 +563,63 @@ def run_chaos(n: int, *, seed: int = CHAOS_SEED, rate: float = CHAOS_RATE,
     }
 
 
+def run_sharded(n: int, *, replicas: int = SHARDED_REPLICAS,
+                num_shards: int = SHARDED_SHARDS,
+                timeout: float = 900.0) -> dict:
+    """The sharded-HA band (ISSUE 9): an n-notebook wave across
+    ``replicas`` simulated controller replicas, each lease-owning its
+    hash-shard ranges with shard-filtered informers and fenced writes.
+    Reports converge wall time, per-replica cache/watch load against the
+    single-process full-keyspace baseline (= every object of the watched
+    kinds, which is exactly what one unsharded controller's informers
+    hold), and runs the fencing invariant over every write."""
+    import logging
+
+    from kubeflow_tpu.platform.k8s.types import (
+        EVENT, NOTEBOOK, POD, PODDISRUPTIONBUDGET, SERVICE, STATEFULSET,
+    )
+    from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+
+    logging.getLogger("kubeflow_tpu.runtime.sharding").setLevel(
+        logging.ERROR)
+    fleet = ShardedFleet(replicas=replicas, num_shards=num_shards,
+                         lease_seconds=SHARDED_LEASE_S,
+                         renew_seconds=SHARDED_LEASE_S / 10.0)
+    try:
+        converge_s = fleet.wave(n, timeout=timeout)
+        stats = fleet.cache_stats()
+        # Single-process baseline: a full-keyspace informer set caches
+        # every live object of the watched kinds.
+        watched = (NOTEBOOK, POD, STATEFULSET, SERVICE,
+                   PODDISRUPTIONBUDGET, EVENT)
+        full_keyspace = sum(
+            len(fleet.kube.list(g, None)) for g in watched)
+        cached = [s["cached_objects"] for s in stats.values()]
+        seen = [s["events_seen"] for s in stats.values()]
+        admitted = [s["events_admitted"] for s in stats.values()]
+        fenced_writes = fleet.assert_fencing_invariant()
+        shard_map = {r.index: sorted(r.coordinator.owned())
+                     for r in fleet.replicas}
+    finally:
+        fleet.close()
+    return {
+        "fleet": n,
+        "replicas": replicas,
+        "num_shards": num_shards,
+        "converge_s": round(converge_s, 3),
+        "full_keyspace_objs": full_keyspace,
+        "replica_cache_objs": cached,
+        "replica_cache_frac_max": round(
+            max(cached) / max(full_keyspace, 1), 4),
+        "replica_events_seen": seen,
+        "replica_events_admitted": admitted,
+        "replica_admit_frac_mean": round(
+            sum(admitted) / max(sum(seen), 1), 4),
+        "fenced_writes_checked": fenced_writes,
+        "shard_map": shard_map,
+    }
+
+
 def run_worker_sweep(n: int, *, workers=WORKER_SWEEP_WORKERS,
                      rtt_s: float = WORKER_SWEEP_RTT_S,
                      timeout: float = 300.0) -> dict:
@@ -575,6 +669,59 @@ def run_wire_converge(n: int, *, workers: int = 4,
             os.environ["K8S_CLIENT_QPS"] = saved
 
 
+def _run_and_report_sharded(args) -> bool:
+    """The two sharded-HA lines.  The converge band is valued only at
+    the full 10k fleet (a smoke-size wave says nothing about scale) but
+    both lines always self-report band fields so trending tooling never
+    hits a gap; the per-replica load band is size-independent (the cache
+    fraction is structural) and is asserted at any N."""
+    sharded = run_sharded(args.sharded_fleet,
+                          replicas=args.sharded_replicas)
+    per_nb_ms = sharded["converge_s"] / max(sharded["fleet"], 1) * 1e3
+    print(json.dumps({
+        "metric": "ctrlplane_sharded_converge_s",
+        "value": sharded["converge_s"],
+        "unit": f"s ({sharded['fleet']}-notebook wave, "
+                f"{sharded['replicas']} replicas x "
+                f"{sharded['num_shards']} shards, lease TTL "
+                f"{SHARDED_LEASE_S}s, memory transport)",
+        "ms_per_notebook": round(per_nb_ms, 2),
+        "fenced_writes_checked": sharded["fenced_writes_checked"],
+        "shard_map": sharded["shard_map"],
+        "vs_baseline": round(
+            SHARDED_CONVERGE_BASELINE_S
+            / max(sharded["converge_s"], 1e-9), 4),
+        "band": "pass" if (
+            sharded["converge_s"]
+            <= SHARDED_CONVERGE_BASELINE_S * BAND_FACTOR
+            and sharded["fenced_writes_checked"] > 0) else "REGRESSION",
+        "band_floor": round(1.0 / BAND_FACTOR, 3),
+    }), flush=True)
+    load_ok = (sharded["replica_cache_frac_max"] <= SHARDED_CACHE_FRAC_MAX
+               and sharded["replica_admit_frac_mean"] < 1.0)
+    print(json.dumps({
+        "metric": "ctrlplane_sharded_replica_load",
+        "value": sharded["replica_cache_frac_max"],
+        "unit": "max per-replica informer cache / single-process "
+                "full-keyspace cache (lower = better scale-out)",
+        "full_keyspace_objs": sharded["full_keyspace_objs"],
+        "replica_cache_objs": sharded["replica_cache_objs"],
+        "replica_events_seen": sharded["replica_events_seen"],
+        "replica_events_admitted": sharded["replica_events_admitted"],
+        "replica_admit_frac_mean": sharded["replica_admit_frac_mean"],
+        "band": "pass" if load_ok else "REGRESSION",
+        "band_floor": SHARDED_CACHE_FRAC_MAX,
+    }), flush=True)
+    converge_ok = (sharded["converge_s"]
+                   <= SHARDED_CONVERGE_BASELINE_S * BAND_FACTOR
+                   if sharded["fleet"] >= 1000 else True)
+    # Zero fenced writes = the bench silently stopped exercising the
+    # fence; that must fail the PROCESS (the ha-chaos lane gates on exit
+    # code), not just color a band string.
+    fence_ok = sharded["fenced_writes_checked"] > 0
+    return load_ok and converge_ok and fence_ok
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--small", type=int, default=150)
@@ -585,6 +732,14 @@ def main(argv=None) -> int:
                         "+ injected per-call RTT) and the wire-converge "
                         "band (http transport)")
     p.add_argument("--churn-seconds", type=float, default=3.0)
+    p.add_argument("--sharded-fleet", type=int, default=10_000,
+                   help="wave size for the sharded-HA band (ISSUE 9: "
+                        "10k objects across --sharded-replicas simulated "
+                        "replicas)")
+    p.add_argument("--sharded-replicas", type=int, default=SHARDED_REPLICAS)
+    p.add_argument("--sharded-only", action="store_true",
+                   help="run ONLY the sharded-HA phase (the ha-chaos "
+                        "lane's 4-replica smoke)")
     p.add_argument("--transport", choices=["memory", "http"],
                    default="memory",
                    help="http = real REST client against the fake served "
@@ -596,6 +751,10 @@ def main(argv=None) -> int:
                    help="http transport: shrink the client's bounded "
                         "watch windows (resume-path stress)")
     args = p.parse_args(argv)
+
+    if args.sharded_only:
+        ok = _run_and_report_sharded(args)
+        return 0 if ok else 1
 
     small = run_fleet(args.small, churn_s=args.churn_seconds,
                       transport=args.transport,
@@ -766,6 +925,7 @@ def main(argv=None) -> int:
         "band": _band(wire["converge_s"], WIRE_CONVERGE_BASELINE_S),
         "band_floor": round(1.0 / BAND_FACTOR, 3),
     }), flush=True)
+    _run_and_report_sharded(args)
     print(json.dumps({
         "metric": "ctrlplane_fleet_churn",
         "value": round(large["churn"]["achieved_hz"], 1), "unit": "updates/sec",

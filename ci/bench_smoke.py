@@ -38,6 +38,8 @@ REQUIRED_METRICS = {
     "ctrlplane_chaos_converge_s",
     "ctrlplane_wave_converge_workers",
     "ctrlplane_wire_converge_s",
+    "ctrlplane_sharded_converge_s",
+    "ctrlplane_sharded_replica_load",
     "ctrlplane_fleet_churn",
 }
 # Metrics whose full-run lines are banded; at smoke N they must still
@@ -48,6 +50,8 @@ BANDED_METRICS = {
     "ctrlplane_wave_converge_workers",
     "ctrlplane_wire_converge_s",
     "ctrlplane_chaos_converge_s",
+    "ctrlplane_sharded_converge_s",
+    "ctrlplane_sharded_replica_load",
 }
 
 
@@ -159,6 +163,7 @@ def main() -> int:
         sys.executable, "bench_scale.py",
         "--small", "6", "--large", "10", "--chaos-fleet", "6",
         "--sweep-fleet", "8", "--churn-seconds", "0.5",
+        "--sharded-fleet", "24",
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=560)
     seen = _parse_json_lines(proc.stdout, "bench_scale")
@@ -184,6 +189,20 @@ def main() -> int:
     for key in ("workers_1_converge_s", "workers_4_converge_s"):
         if not isinstance(sweep.get(key), (int, float)):
             print(f"sweep line missing {key}", file=sys.stderr)
+            return 1
+    # Sharded-HA lines (ISSUE 9): the per-replica load vectors and the
+    # fencing-invariant write count must keep riding — a zero count means
+    # the bench silently stopped exercising the fence.
+    sharded = seen["ctrlplane_sharded_converge_s"]
+    if not (isinstance(sharded.get("fenced_writes_checked"), int)
+            and sharded["fenced_writes_checked"] > 0):
+        print("sharded line: fenced_writes_checked missing/zero",
+              file=sys.stderr)
+        return 1
+    load = seen["ctrlplane_sharded_replica_load"]
+    for key in ("replica_cache_objs", "replica_events_admitted"):
+        if not isinstance(load.get(key), list) or not load[key]:
+            print(f"sharded load line missing {key}", file=sys.stderr)
             return 1
     print(f"bench-smoke ctrlplane OK: {len(seen)} metrics "
           f"({', '.join(sorted(seen))})")

@@ -159,6 +159,49 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # Sharded-HA presubmit slice (ISSUE 9): hash-assignment properties,
+    # coordinator/fencing units, and the FAST single-kill chaos variant
+    # on every control-plane change.  The full matrix (1k-object
+    # 4-replica kill, split-brain lease expiry, membership churn, the
+    # storm soak) runs in the ha-chaos postsubmit lane below.
+    name="ha-shard",
+    include_dirs=[
+        "kubeflow_tpu/platform/runtime/*", "kubeflow_tpu/platform/k8s/*",
+        "kubeflow_tpu/platform/testing/*",
+        "kubeflow_tpu/platform/controllers/*", "releasing/*",
+    ],
+    steps=[
+        Step("fast", _pytest("tests/ctrlplane/test_sharding.py")
+             + ["-m", "not slow", "-k", "not 1k_wave"]),
+    ],
+))
+
+_register(ComponentWorkflow(
+    # ha-chaos postsubmit lane (ISSUE 9): the replica-kill and
+    # lease-expiry chaos matrix in full — the 1k-object 4-replica kill
+    # (the acceptance-criteria test), split brain under a paused
+    # replica, membership churn mid-wave, and the storm soak that mixes
+    # all of it with seeded fault injection — plus the 4-replica
+    # bench_scale smoke asserting the sharded band lines still parse
+    # and the per-replica load band holds at smoke size.
+    name="ha-chaos",
+    include_dirs=[
+        "kubeflow_tpu/platform/runtime/*", "kubeflow_tpu/platform/k8s/*",
+        "kubeflow_tpu/platform/testing/*",
+        "kubeflow_tpu/platform/controllers/*", "bench_scale.py",
+        "releasing/*",
+    ],
+    job_types=["postsubmit"],
+    steps=[
+        Step("chaos-matrix", _pytest("tests/ctrlplane/test_sharding.py")),
+        Step("bench-4replica", [
+            sys.executable, "bench_scale.py", "--sharded-only",
+            "--sharded-fleet", "200",
+        ], depends="chaos-matrix"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="resilience-soak",
     include_dirs=[
         "kubeflow_tpu/platform/k8s/*", "kubeflow_tpu/platform/runtime/*",

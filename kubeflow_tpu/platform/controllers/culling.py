@@ -325,6 +325,7 @@ def make_controller(client, *, notebook_informer=None, **kwargs):
     # watches through (owned or shared) — zero-copy frozen views instead
     # of one apiserver GET per probe (reconcile thaws only on the cull
     # write).
+    shards = kwargs.pop("shards", None)
     owned = (Informer(client, NOTEBOOK)
              if notebook_informer is None else None)
     kwargs.setdefault("cache", notebook_informer
@@ -364,4 +365,5 @@ def make_controller(client, *, notebook_informer=None, **kwargs):
         # workers probe concurrently; the workqueue's per-key exclusion
         # keeps the single-reconciler-per-notebook guarantee.
         workers=8,
+        shards=shards,
     )

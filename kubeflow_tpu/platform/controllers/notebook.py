@@ -1031,6 +1031,10 @@ def make_controller(client, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
     from kubeflow_tpu.platform.runtime.informer import Informer
 
+    # Sharded HA: the coordinator is the Controller's concern, not the
+    # reconciler's (which just sees FencingError surface as a Conflict).
+    shards = kwargs.pop("shards", None)
+
     # EVERY watched kind is sourced from an informer cache (controller-
     # runtime's design: all sources go through the manager cache —
     # reference notebook_controller.go:684-733), and reconcile reads
@@ -1087,4 +1091,5 @@ def make_controller(client, **kwargs):
         # Safety net for drift no watch covers (and for the REST client's
         # bounded watch windows): re-list the primaries periodically.
         resync_period=300.0,
+        shards=shards,
     )

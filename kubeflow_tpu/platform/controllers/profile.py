@@ -474,6 +474,7 @@ def labels_file_watcher(path: str, *, poll_seconds: float = 1.0):
 def make_controller(client, *, heartbeat: bool = False, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
 
+    shards = kwargs.pop("shards", None)
     reconciler = ProfileReconciler(client, **kwargs)
     runnables = []
     if reconciler.labels_path:
@@ -498,4 +499,5 @@ def make_controller(client, *, heartbeat: bool = False, **kwargs):
         if heartbeat else None,
         on_stop=(lambda: metrics.stop_heartbeat("profile"))
         if heartbeat else None,
+        shards=shards,
     )

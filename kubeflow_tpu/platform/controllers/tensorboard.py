@@ -214,6 +214,7 @@ class TensorboardReconciler(Reconciler):
 def make_controller(client, **kwargs):
     from kubeflow_tpu.platform.runtime import Controller
 
+    shards = kwargs.pop("shards", None)
     return Controller(
         "tensorboard-controller",
         TensorboardReconciler(client, **kwargs),
@@ -227,4 +228,5 @@ def make_controller(client, **kwargs):
         # bounded-window full-replay cost the informer would have fixed
         # is fixed anyway.
         resync_period=300.0,
+        shards=shards,
     )

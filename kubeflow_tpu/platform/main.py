@@ -42,7 +42,7 @@ class _QuietHandler(WSGIRequestHandler):
 
 
 def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
-                  debug_traces: bool = None, client=None):
+                  debug_traces: bool = None, client=None, shards=None):
     """/healthz + /metrics + /debug/traces for the controller deployment.
 
     ``client``: when it exposes ``health()`` (RestKubeClient), /healthz
@@ -79,6 +79,20 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
 
             start_response("200 OK", [("Content-Type", "text/plain; version=0.0.4")])
             return [metrics.render()]
+        if path == "/debug/shards" and shards is not None:
+            # The live shard map (sharded HA, runtime/sharding.py): which
+            # shard leases this replica holds, the last-observed holder of
+            # every other shard, and the fencing identity — the first page
+            # to read when "who reconciles key X" is the question
+            # (docs/resilience.md "HA and shard ownership").
+            start_response("200 OK", [("Content-Type", "application/json")])
+            return [json.dumps({
+                "identity": shards.identity,
+                "num_shards": shards.num_shards,
+                "owned": sorted(shards.owned()),
+                "shards": {str(k): v
+                           for k, v in sorted(shards.shard_map().items())},
+            }).encode()]
         if path == "/debug/traces" and debug_traces:
             from urllib.parse import parse_qs
 
@@ -105,22 +119,51 @@ def run_controllers(args) -> int:
     from kubeflow_tpu.platform.runtime import Manager
 
     client = _client()
+    # Sharded HA (docs/resilience.md "HA and shard ownership"):
+    # CONTROLLER_SHARDS > 0 partitions the reconcile keyspace across every
+    # replica running with the same setting — each replica lease-owns a
+    # fair share of the shard ranges, shard-filters its informer caches to
+    # them, and fences its writes (the FencedClient below) so a stale
+    # replica can never double-write a key a survivor absorbed.  Replaces
+    # LEADER_ELECT (single-active) — every replica is active on its own
+    # ranges.  CONTROLLER_SHARD_LEASE_SECONDS bounds failover.
+    num_shards = config.env_int("CONTROLLER_SHARDS", 0)
+    shards = None
+    ctrl_client = client
+    if num_shards > 0:
+        from kubeflow_tpu.platform.runtime.sharding import (
+            FencedClient,
+            ShardCoordinator,
+        )
+
+        shards = ShardCoordinator(
+            client,  # lease traffic is never fenced: the raw client
+            num_shards=num_shards,
+            namespace=config.env("POD_NAMESPACE", "kubeflow"),
+            identity=config.env("POD_NAME", "") or None,
+        )
+        ctrl_client = FencedClient(client, shards)
     mgr = Manager(
-        client,
-        # Same knob as the reference's --leader-elect flag (main.go:64-76).
-        leader_election=config.env_bool("LEADER_ELECT", False),
+        ctrl_client,
+        # Same knob as the reference's --leader-elect flag (main.go:64-76);
+        # ignored when sharding is on (sharding IS the HA story).
+        leader_election=(config.env_bool("LEADER_ELECT", False)
+                         and shards is None),
         lease_namespace=config.env("POD_NAMESPACE", "kubeflow"),
+        shards=shards,
     )
     nb_ctrl = mgr.add(
-        make_controller(client, use_istio=config.env_bool("USE_ISTIO", True)))
+        make_controller(ctrl_client, shards=shards,
+                        use_istio=config.env_bool("USE_ISTIO", True)))
     mgr.add(profile.make_controller(
-        client,
+        ctrl_client,
         heartbeat=True,
+        shards=shards,
         default_namespace_labels_path=(
             config.env("NAMESPACE_LABELS_PATH", "") or None
         ),
     ))
-    mgr.add(tensorboard.make_controller(client))
+    mgr.add(tensorboard.make_controller(ctrl_client, shards=shards))
     if config.env_bool("ENABLE_CULLING", False):
         from kubeflow_tpu.platform.k8s.types import NOTEBOOK
 
@@ -128,18 +171,21 @@ def run_controllers(args) -> int:
         # LIST+WATCH stream and cache for the kind in this manager —
         # the controller-runtime shared-cache model).
         mgr.add(culling.make_controller(
-            client, notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
+            ctrl_client, shards=shards,
+            notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
     mgr.start()
-    _serve_health(mgr, args.health_port, client=client)
+    _serve_health(mgr, args.health_port, client=client, shards=shards)
     from kubeflow_tpu.platform.runtime.flight import shared_pool
 
     logging.info(
         "controllers running (health on :%d; workers: %s; "
-        "flight pool %d; client pool %d)",
+        "flight pool %d; client pool %d; shards %s)",
         args.health_port,
         ", ".join(f"{c.name}={c.workers}" for c in mgr.controllers),
         shared_pool().size,
         getattr(client, "pool_size", 0),
+        f"{num_shards} as {shards.identity}" if shards is not None
+        else "off",
     )
     _wait_for_term()
     mgr.stop()

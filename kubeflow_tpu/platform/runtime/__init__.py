@@ -7,6 +7,13 @@ from kubeflow_tpu.platform.runtime.controller import (
 )
 from kubeflow_tpu.platform.runtime.events import EventCorrelator, EventRecorder
 from kubeflow_tpu.platform.runtime.flight import FlightPool
+from kubeflow_tpu.platform.runtime.sharding import (
+    FencedClient,
+    FencingError,
+    ShardCoordinator,
+    shard_of,
+)
 
 __all__ = ["Controller", "Manager", "Reconciler", "Request", "Result",
-           "EventRecorder", "EventCorrelator", "FlightPool"]
+           "EventRecorder", "EventCorrelator", "FlightPool",
+           "ShardCoordinator", "FencedClient", "FencingError", "shard_of"]

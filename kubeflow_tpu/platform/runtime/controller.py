@@ -253,6 +253,7 @@ class Controller:
         on_stop: Optional[Callable[[], None]] = None,
         max_retries: Optional[int] = None,
         stuck_deadline: Optional[float] = None,
+        shards=None,
     ):
         self.name = name
         self.reconciler = reconciler
@@ -315,6 +316,16 @@ class Controller:
         self._inflight_lock = threading.Lock()
         self._client = None  # set by start(); dead-letter writes need it
         self._recorder = None  # lazy EventRecorder (shared correlator)
+        # Sharded HA (runtime/sharding.py): a ShardCoordinator partitions
+        # the keyspace across replicas.  The controller then (a) enqueues
+        # only owned keys, (b) drops unowned keys at dequeue (ownership
+        # may move while a key waits), (c) shard-filters its informers so
+        # the caches hold only owned ranges, and (d) resyncs a moved
+        # range when the coordinator reports an acquisition.  The WRITE
+        # invariant (one replica per key) is the FencedClient's job, not
+        # this filter's — the filter is the fast path, the fence is the
+        # proof.
+        self.shards = shards
 
     def busy_workers(self) -> int:
         """Reconciles in flight right now — the worker-utilization gauge
@@ -323,6 +334,12 @@ class Controller:
             return len(self._inflight)
 
     # -- event plumbing ------------------------------------------------------
+
+    def _owns(self, req: Request) -> bool:
+        """Enqueue/dequeue shard filter: unsharded controllers own every
+        key."""
+        return (self.shards is None
+                or self.shards.owns_key(req.namespace, req.name))
 
     def _primary_mapper(self, obj: Resource) -> List[Request]:
         return [Request(namespace_of(obj) or "", name_of(obj))]
@@ -354,7 +371,8 @@ class Controller:
                         self._stop.wait(1.0)
                         break
                     for req in mapper(obj):
-                        self.queue.add(req)
+                        if self._owns(req):
+                            self.queue.add(req)
                     new_rv = meta(obj).get("resourceVersion")
                     if new_rv is not None:
                         rv = new_rv
@@ -400,15 +418,21 @@ class Controller:
             # Cache-backed resync: the informer already holds the
             # primaries (and its own relist guards against missed
             # deltas) — a raw LIST here would hit the apiserver
-            # with the full kind every period.
+            # with the full kind every period.  Under sharding the cache
+            # is already filtered to the owned ranges; the _owns check is
+            # a second fence for the rebalance window between a release
+            # and the refilter.
             for ns, name in informer.keys(self.namespace):
-                self.queue.add(Request(ns, name))
-                n += 1
+                req = Request(ns, name)
+                if self._owns(req):
+                    self.queue.add(req)
+                    n += 1
         else:
             for obj in client.list(self.primary, self.namespace):
                 for req in self._primary_mapper(obj):
-                    self.queue.add(req)
-                    n += 1
+                    if self._owns(req):
+                        self.queue.add(req)
+                        n += 1
         return n
 
     def _resync_loop(self, client) -> None:
@@ -433,6 +457,22 @@ class Controller:
     def _reconcile_one(self, req: Request) -> None:
         from kubeflow_tpu.platform.runtime import metrics, trace
 
+        if not self._owns(req):
+            # Ownership moved while the key waited in the queue (shard
+            # rebalance / replica handover): the key belongs to another
+            # replica now — drop it without reconciling and without
+            # keeping any retry history that would greet it with a maxed
+            # backoff if the shard ever comes back.
+            self.queue.forget(req)
+            self._key_failures.pop(req, None)
+            return
+        if self.shards is not None:
+            from kubeflow_tpu.platform.runtime import sharding
+
+            # The fence context: every client write this reconcile makes
+            # (inline or FlightPool-fanned) is fenced on THIS key's shard
+            # by the replica's FencedClient.
+            sharding.set_current_request((req.namespace, req.name))
         # Per-reconcile trace: spans opened anywhere on this thread during
         # the reconcile (client calls, informer reads) attach to it.  The
         # dequeue span replays the workqueue wait the metrics shim observed
@@ -492,6 +532,10 @@ class Controller:
                 else:
                     self.queue.add_rate_limited(req)
         finally:
+            if self.shards is not None:
+                from kubeflow_tpu.platform.runtime import sharding
+
+                sharding.set_current_request(None)
             with self._inflight_lock:
                 self._inflight.pop(req, None)
             metrics.controller_runtime_reconcile_time_seconds.labels(
@@ -633,6 +677,95 @@ class Controller:
                     now - entry[0], self.stuck_deadline, dump,
                 )
 
+    # -- sharded HA ----------------------------------------------------------
+
+    def _wire_sharding(self, pairs: List[Tuple[GVK, EventMapper]]) -> None:
+        """Point every event-source informer's admit filter at the shard
+        map and subscribe to rebalances.  The filter routes an OBJECT
+        through the same mapper(s) the event path uses — an object is
+        cached iff at least one request it maps to falls in an owned
+        shard, so the caches hold exactly what this replica's reconciles
+        will read (secondaries included: a Pod is admitted by its owning
+        notebook's key, not its own)."""
+        mappers_by_gvk: Dict[GVK, List[EventMapper]] = {}
+        for gvk, mapper in pairs:
+            mappers_by_gvk.setdefault(gvk, []).append(mapper)
+        for gvk, mappers in mappers_by_gvk.items():
+            informer = self.informers.get(gvk)
+            if informer is None:
+                continue
+
+            def admit(obj, _mappers=tuple(mappers)) -> bool:
+                for mapper in _mappers:
+                    for req in mapper(obj):
+                        if self.shards.owns_key(req.namespace, req.name):
+                            return True
+                return False
+
+            if informer.admit is None:
+                # First sharer wins: a SHARED informer (e.g. culling over
+                # the notebook controller's Notebook cache) keeps the
+                # owner's filter — same-coordinator sharers map keys
+                # identically, and silently replacing another
+                # controller's predicate would be worse than keeping it.
+                informer.admit = admit
+            else:
+                log.debug("%s: informer %s already shard-filtered by its "
+                          "owner; keeping that filter", self.name, gvk.kind)
+        self.shards.add_listener(self._on_shard_change)
+        self.shards.add_drain_hook(self._shard_quiesced)
+
+    def _shard_quiesced(self, shard: int) -> bool:
+        """Drain hook for voluntary handover: True when no reconcile of a
+        key in ``shard`` is in flight on this controller — the
+        coordinator only releases a lease once every controller says so,
+        keeping a straggler's write from overlapping the acquirer's."""
+        from kubeflow_tpu.platform.runtime.sharding import shard_of
+
+        with self._inflight_lock:
+            return not any(
+                shard_of(r.namespace, r.name, self.shards.num_shards)
+                == shard
+                for r in self._inflight)
+
+    def _on_shard_change(self, acquired: set, released: set) -> None:
+        """Rebalance reaction (runs on the coordinator thread, or on the
+        worker that fenced itself).  Releases drop the moved ranges from
+        the caches; acquisitions additionally relist so the moved range
+        lands and its ADDED deltas enqueue — that relist IS the
+        moved-range resync, and it is the only resync a rebalance costs
+        (the kept ranges diff to no-ops)."""
+        if self._stop.is_set():
+            return
+        log.info("%s: shard map changed (acquired=%s released=%s)",
+                 self.name, sorted(acquired), sorted(released))
+        # The event epoch dedupes shared informers across sharers: two
+        # controllers over one cache → one relist per rebalance event.
+        token = getattr(self.shards, "current_event_epoch", None)
+        for informer in dict.fromkeys(self.informers.values()):
+            try:
+                informer.refilter(relist=bool(acquired), token=token)
+            except Exception:
+                log.exception("%s: refilter after shard change failed",
+                              self.name)
+        if acquired and self.informers.get(self.primary) is None:
+            # Raw-watch primary (no informer to relist): one LIST,
+            # enqueue only the acquired ranges.
+            from kubeflow_tpu.platform.runtime.sharding import shard_of
+
+            client = self._client
+            if client is None:
+                return
+            try:
+                for obj in client.list(self.primary, self.namespace):
+                    for req in self._primary_mapper(obj):
+                        if shard_of(req.namespace, req.name,
+                                    self.shards.num_shards) in acquired:
+                            self.queue.add(req)
+            except Exception:
+                log.warning("%s: moved-range list failed (resync will "
+                            "recover)", self.name, exc_info=True)
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, client) -> None:
@@ -647,12 +780,15 @@ class Controller:
         pairs: List[Tuple[GVK, EventMapper]] = [(self.primary, self._primary_mapper)]
         pairs += [(g, self._owner_mapper) for g in self.owns]
         pairs += self.watches
+        if self.shards is not None:
+            self._wire_sharding(pairs)
         for gvk, mapper in pairs:
             informer = self.informers.get(gvk)
             if informer is not None:
                 def on_delta(_etype, obj, _mapper=mapper):
                     for req in _mapper(obj):
-                        self.queue.add(req)
+                        if self._owns(req):
+                            self.queue.add(req)
 
                 informer.add_handler(on_delta)
                 continue
@@ -721,6 +857,9 @@ class Controller:
 
         self._stop.set()
         self.queue.shut_down()
+        if self.shards is not None:
+            self.shards.remove_listener(self._on_shard_change)
+            self.shards.remove_drain_hook(self._shard_quiesced)
         metrics.deregister_controller(self)
         for informer in self._owned_informers.values():
             informer.stop()
@@ -748,12 +887,25 @@ class Manager:
     def __init__(self, client, *, leader_election: bool = False,
                  lease_name: str = "kubeflow-tpu-controller-leader",
                  lease_namespace: str = "kubeflow",
-                 identity: Optional[str] = None):
+                 identity: Optional[str] = None,
+                 shards=None):
         self.client = client
         self.controllers: List[Controller] = []
         self._started = False
         self._lost_leadership = False
         self.elector = None
+        # Sharded HA (runtime/sharding.py): a ShardCoordinator shared by
+        # every controller in this manager — the manager starts it before
+        # the controllers (so leases can land while caches sync) and stops
+        # it FIRST on shutdown (releasing the leases hands the ranges to
+        # survivors immediately instead of after a TTL).  Mutually
+        # exclusive with single-leader election: sharding IS the
+        # multi-replica story, every replica is active on its own ranges.
+        self.shards = shards
+        if shards is not None and leader_election:
+            raise ValueError(
+                "leader_election and shards are mutually exclusive: "
+                "sharding replaces the single-leader model")
         if leader_election:
             from kubeflow_tpu.platform.runtime.leader import LeaderElector
 
@@ -794,12 +946,16 @@ class Manager:
             c.stop()
 
     def start(self) -> None:
+        if self.shards is not None:
+            self.shards.start()
         if self.elector is not None:
             self.elector.start()  # controllers start when the lease lands
         else:
             self._start_controllers()
 
     def stop(self) -> None:
+        if self.shards is not None:
+            self.shards.stop()  # release leases first: instant handover
         if self.elector is not None:
             self.elector.stop()
         for c in self.controllers:

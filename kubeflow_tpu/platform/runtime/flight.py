@@ -107,7 +107,24 @@ class FlightPool:
             return []
         if n == 1 or self.size <= 1 or getattr(_local, "in_flight", False):
             return self._run_inline(calls, return_exceptions)
-        from kubeflow_tpu.platform.runtime import metrics
+        from kubeflow_tpu.platform.runtime import metrics, sharding
+
+        # Carry the submitting reconcile's fence context onto the pool
+        # threads: a fanned-out secondary write must fence on the SAME
+        # key as its reconcile's inline writes (runtime/sharding.py), and
+        # thread-locals don't cross thread boundaries by themselves.
+        fence_req = sharding.current_request()
+        if fence_req is not None:
+            def _carry(fn, _req=fence_req):
+                def wrapped():
+                    sharding.set_current_request(_req)
+                    try:
+                        return fn()
+                    finally:
+                        sharding.set_current_request(None)
+                return wrapped
+
+            calls = [_carry(fn) for fn in calls]
 
         results: List[Any] = [None] * n
         errors: List[Optional[BaseException]] = [None] * n

@@ -123,13 +123,49 @@ def cache_or_client_get(cache, client, gvk: GVK, name: str,
 class Informer:
     def __init__(self, client, gvk: GVK, *, namespace: Optional[str] = None,
                  resync_period: float = 3600.0,
-                 indexers: Optional[Dict[str, IndexFunc]] = None):
+                 indexers: Optional[Dict[str, IndexFunc]] = None,
+                 admit: Optional[Callable[[Resource], bool]] = None):
         self.client = client
         self.gvk = gvk
         self.namespace = namespace
         self.resync_period = resync_period
+        # Shard filter (sharded HA control plane, runtime/sharding.py): a
+        # predicate over the OBJECT deciding whether this replica caches
+        # it.  Applied at relist AND per watch delta, so the store (and
+        # every index) holds only the owned keyspace ranges — per-replica
+        # cache memory and delta-processing scale as 1/replicas instead
+        # of full-keyspace.  The raw watch stream still arrives (a real
+        # apiserver cannot field-select on a hash; the label-based
+        # sharder variant would push this server-side); events_seen vs
+        # events_admitted quantify the split for bench_scale's
+        # per-replica load band.  The filter may change what it answers
+        # over time (shard rebalance): call refilter() after a change.
+        self.admit = admit
+        self.events_seen = 0       # relist items + watch deltas observed
+        self.events_admitted = 0   # ... that passed admit into the store
         self._store: Dict[Tuple[str, str], Resource] = {}
         self._lock = threading.RLock()
+        # Serializes whole MUTATIONS (one _apply, one _relist) against
+        # each other without blocking reads: refilter() relists from the
+        # coordinator thread while the watch thread keeps applying
+        # deltas, and an unserialized relist could swap in a LIST
+        # snapshot OVER deltas applied after it was taken — a silently
+        # stale cache until the next scheduled relist.  With the
+        # exclusion, deltas queued during the LIST apply after the swap
+        # in stream order, ending at the newest state.  _lock stays the
+        # read lock: a 10k-object LIST must not block informer.get().
+        self._mutate_lock = threading.RLock()
+        # Collapses concurrent refilter() calls (two controllers sharing
+        # one informer both react to the same shard-map change): the
+        # second caller finds the gate held and returns — the first
+        # pass is already re-applying the same filter, and a duplicate
+        # full LIST would double the rebalance cost for nothing.
+        self._refilter_gate = threading.Lock()
+        # Last refilter dedup token (the coordinator's change-event
+        # epoch): listeners run SEQUENTIALLY on the dispatch thread, so
+        # two sharers' refilters for one event never overlap — the gate
+        # alone can't collapse them, equality on the event token does.
+        self._last_refilter_token = None
         self._synced = threading.Event()
         self._stop = threading.Event()
         self._handlers: List[Handler] = []
@@ -294,11 +330,19 @@ class Informer:
         """Rebuild the store from a full LIST; returns the collection
         resourceVersion to resume the watch from (None when the client
         can't provide one — the watch then replays, deduped by _apply)."""
+        with self._mutate_lock:
+            return self._relist_locked()
+
+    def _relist_locked(self) -> Optional[str]:
         t0 = time.monotonic()
         if hasattr(self.client, "list_with_rv"):
             items, rv = self.client.list_with_rv(self.gvk, self.namespace)
         else:
             items, rv = self.client.list(self.gvk, self.namespace), None
+        self.events_seen += len(items)
+        if self.admit is not None:
+            items = [o for o in items if self._admitted(o)]
+        self.events_admitted += len(items)
         fresh = {self._key(o): o for o in items}
         by_ns: Dict[str, Dict[Tuple[str, str], Resource]] = {}
         for key, obj in fresh.items():
@@ -336,7 +380,89 @@ class Informer:
             except Exception:
                 log.exception("informer handler failed")
 
+    def _admitted(self, obj: Resource) -> bool:
+        """Shard-filter verdict for one object.  A failing filter admits
+        (never silently shrink the cache on a filter bug — over-caching is
+        benign, under-caching starves reconcilers)."""
+        try:
+            return self.admit is None or bool(self.admit(obj))
+        except Exception:
+            log.exception("informer %s: admit filter failed", self.gvk.kind)
+            return True
+
+    def refilter(self, *, relist: bool = True, token=None) -> int:
+        """Re-apply the admit filter after its answers changed (a shard
+        rebalance).  Keys the filter now rejects are dropped from the
+        store and indexes WITHOUT handler notifications — a shard moving
+        to another replica is not an object deletion, and reconcilers
+        must not see phantom DELETEDs.  With ``relist=True`` (an acquire
+        happened) one synchronous relist follows so newly-admitted ranges
+        land and notify as ADDED — which is exactly the moved-range
+        resync: the controller's delta handler enqueues them.  Returns
+        how many keys were dropped.
+
+        ``token`` (the coordinator's change-event epoch) dedupes the
+        SHARED-informer case: every controller sharing this cache reacts
+        to the same rebalance event, and only the first same-token call
+        does the work — one full LIST per rebalance, not one per
+        sharer."""
+        if self.admit is None:
+            return 0
+        if token is not None:
+            with self._lock:
+                if token == self._last_refilter_token:
+                    return 0
+                self._last_refilter_token = token
+        if not self._refilter_gate.acquire(blocking=False):
+            return 0  # a concurrent refilter is already doing this work
+        try:
+            return self._refilter_gated(relist=relist)
+        finally:
+            self._refilter_gate.release()
+
+    def _refilter_gated(self, *, relist: bool) -> int:
+        with self._mutate_lock:
+            with self._lock:
+                doomed = [key for key, o in self._store.items()
+                          if not self._admitted(o)]
+                for key in doomed:
+                    del self._store[key]
+                    bucket = self._by_ns.get(key[0])
+                    if bucket is not None:
+                        bucket.pop(key, None)
+                        if not bucket:
+                            del self._by_ns[key[0]]
+                    self._index_drop(key)
+            if relist:
+                try:
+                    # Runs on the coordinator thread while the watch
+                    # thread keeps streaming — _mutate_lock serializes the
+                    # two (see its comment), and deltas queued during the
+                    # LIST re-apply afterwards in stream order.
+                    self._relist_locked()
+                except Exception:
+                    log.warning("informer %s: refilter relist failed "
+                                "(the next scheduled relist recovers)",
+                                self.gvk.kind, exc_info=True)
+        return len(doomed)
+
     def _apply(self, etype: str, obj: Resource) -> None:
+        with self._mutate_lock:
+            self._apply_locked(etype, obj)
+
+    def _apply_locked(self, etype: str, obj: Resource) -> None:
+        self.events_seen += 1
+        if not self._admitted(obj):
+            # Not our shard: skip the delta WITHOUT evicting a stored
+            # copy.  Eviction belongs to refilter() (fired at the actual
+            # lease release): during a drain the filter already answers
+            # False while in-flight reconciles still read these objects,
+            # and evicting under them would feed empty cache reads to
+            # writes that legitimately hold the lease.  A stale entry
+            # left by a skipped delta lasts at most until the
+            # release-time refilter or the next relist.
+            return
+        self.events_admitted += 1
         with self._lock:
             handlers = list(self._handlers)
             key = self._key(obj)

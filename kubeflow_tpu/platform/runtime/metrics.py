@@ -374,6 +374,17 @@ event_recorder_events_total = Counter(
     "bucket exhausted — zero API calls)",
     ["action"], registry=registry,
 )
+controller_lease_transitions_total = Counter(
+    "controller_lease_transitions_total",
+    "Shard-lease lifecycle events by the sharded HA coordinator "
+    "(runtime/sharding.py): acquire (took a free/expired shard), renew "
+    "(periodic heartbeat on an owned shard), expire (lost a shard to "
+    "another replica after our lease lapsed), release (voluntarily shed "
+    "to rebalance toward a joiner / shutdown), fenced (refused our own "
+    "write on a stale lease and dropped the shard — the split-brain "
+    "guard firing)",
+    ["controller", "reason"], registry=registry,
+)
 informer_watch_restarts_total = Counter(
     "informer_watch_restarts_total",
     "Informer watch stream failures/expiries that forced a re-establish",
@@ -425,6 +436,22 @@ def deregister_controller(controller) -> None:
         _controllers.pop(id(controller), None)
 
 
+# id(coordinator) -> weakref, for the scrape-time shard-ownership gauge
+# (controller_shard_owned).  ShardCoordinator.start registers, stop/crash
+# deregister — the same lifecycle contract as controllers/informers.
+_shard_coords: Dict[int, object] = {}
+
+
+def register_shard_coordinator(coord) -> None:
+    with _wq_lock:
+        _shard_coords[id(coord)] = weakref.ref(coord)
+
+
+def deregister_shard_coordinator(coord) -> None:
+    with _wq_lock:
+        _shard_coords.pop(id(coord), None)
+
+
 class _RuntimeStateCollector:
     """Scrape-time gauges over live runtime objects: workqueue depth and
     unfinished-work seconds per queue, last-sync age per informer.  One
@@ -459,10 +486,19 @@ class _RuntimeStateCollector:
             "busy/workers",
             labels=["controller"],
         )
+        shard_owned = GaugeMetricFamily(
+            "controller_shard_owned",
+            "Shard-lease ownership by this replica's coordinator: 1 = "
+            "owned, 0 = not (every shard of every registered coordinator "
+            "is emitted, so a fleet-wide sum per shard > 1 is the "
+            "double-ownership alarm docs/resilience.md describes)",
+            labels=["controller", "shard"],
+        )
         with _wq_lock:
             shims = dict(_wq_shims)
             informers = dict(_informers)
             controllers = dict(_controllers)
+            shard_coords = dict(_shard_coords)
         for name, shim in sorted(shims.items()):
             d = shim.depth()
             if d is None:  # queue was garbage collected
@@ -502,11 +538,23 @@ class _RuntimeStateCollector:
                 continue
             workers.add_metric([ctrl.name], ctrl.workers)
             workers_busy.add_metric([ctrl.name], ctrl.busy_workers())
+        for key, ref in shard_coords.items():
+            coord = ref()
+            if coord is None:
+                with _wq_lock:
+                    if _shard_coords.get(key) is ref:
+                        del _shard_coords[key]
+                continue
+            owned = coord.owned()
+            for shard in range(coord.num_shards):
+                shard_owned.add_metric(
+                    [coord.name, str(shard)], 1.0 if shard in owned else 0.0)
         yield depth
         yield unfinished
         yield sync_age
         yield workers
         yield workers_busy
+        yield shard_owned
 
 
 registry.register(_RuntimeStateCollector())

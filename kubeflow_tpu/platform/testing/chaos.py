@@ -44,7 +44,14 @@ import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from kubeflow_tpu.platform.k8s import errors
-from kubeflow_tpu.platform.k8s.types import GVK, Resource, gvk_of
+from kubeflow_tpu.platform.k8s.types import (
+    GVK,
+    Resource,
+    gvk_of,
+    name_of,
+    namespace_of,
+)
+from kubeflow_tpu.platform.runtime.sharding import WRITE_VERBS
 
 # Fault kinds that apply to the watch STREAM (per delivered event) rather
 # than to the call itself.
@@ -122,6 +129,14 @@ class ChaosKube:
         # (verb, kind) -> call count — the write-path A/B assertions
         # ("fewer Event creates than the pre-patch path") read this.
         self.calls_by_kind: Dict[Tuple[str, str], int] = {}
+        # Every WRITE verb call, keyed and timestamped:
+        # (monotonic_t, verb, kind, namespace, name), oldest first, faulted
+        # calls included (the fault fires AFTER recording — the attempt is
+        # the observable).  The sharded-HA chaos suite joins one ChaosKube
+        # per replica against the coordinator's ownership windows to prove
+        # the fencing invariant: no key written by two replicas in
+        # overlapping ownership windows (tests/ctrlplane/test_sharding.py).
+        self.write_log: List[Tuple[float, str, str, str, str]] = []
         # Establishment kwargs per watch() call, for resume assertions.
         self.watch_establishments: List[dict] = []
         self._injections: Dict[int, int] = {}  # fault index -> times fired
@@ -143,11 +158,23 @@ class ChaosKube:
 
     # -- schedule ------------------------------------------------------------
 
-    def _record(self, verb: str, kind: str = "") -> None:
+    # THE write-verb set, shared with the fencing layer: the wire-log
+    # join in the sharding chaos suite must cover exactly the verbs the
+    # FencedClient fences — one definition (runtime/sharding.py) keeps a
+    # new write verb from silently escaping either side.
+    WRITE_VERBS = WRITE_VERBS
+
+    def _record(self, verb: str, kind: str = "", *,
+                namespace: Optional[str] = None,
+                name: Optional[str] = None) -> None:
         with self._lock:
             self.calls[verb] = self.calls.get(verb, 0) + 1
             key = (verb, kind)
             self.calls_by_kind[key] = self.calls_by_kind.get(key, 0) + 1
+            if verb in self.WRITE_VERBS:
+                self.write_log.append(
+                    (time.monotonic(), verb, kind, namespace or "",
+                     name or ""))
 
     def _pick(self, verb: str, kind: str, *, stream: bool = False
               ) -> Optional[Fault]:
@@ -228,37 +255,41 @@ class ChaosKube:
         return self.inner.list(gvk, namespace), None
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
-        self._record("create", gvk_of(obj).kind)
+        self._record("create", gvk_of(obj).kind,
+                     namespace=namespace_of(obj), name=name_of(obj))
         self._inject("create", gvk_of(obj).kind)
         return self.inner.create(obj, dry_run=dry_run)
 
     def update(self, obj: Resource) -> Resource:
-        self._record("update", gvk_of(obj).kind)
+        self._record("update", gvk_of(obj).kind,
+                     namespace=namespace_of(obj), name=name_of(obj))
         self._inject("update", gvk_of(obj).kind)
         return self.inner.update(obj)
 
     def update_status(self, obj: Resource) -> Resource:
-        self._record("update_status", gvk_of(obj).kind)
+        self._record("update_status", gvk_of(obj).kind,
+                     namespace=namespace_of(obj), name=name_of(obj))
         self._inject("update_status", gvk_of(obj).kind)
         return self.inner.update_status(obj)
 
     def patch(self, gvk, name, patch, namespace=None, *,
               patch_type: str = "merge") -> Resource:
-        self._record("patch", gvk.kind)
+        self._record("patch", gvk.kind, namespace=namespace, name=name)
         self._inject("patch", gvk.kind)
         return self.inner.patch(gvk, name, patch, namespace,
                                 patch_type=patch_type)
 
     def patch_status(self, gvk, name, patch, namespace=None, *,
                      patch_type: str = "merge") -> Resource:
-        self._record("patch_status", gvk.kind)
+        self._record("patch_status", gvk.kind, namespace=namespace,
+                     name=name)
         self._inject("patch_status", gvk.kind)
         return self.inner.patch_status(gvk, name, patch, namespace,
                                        patch_type=patch_type)
 
     def delete(self, gvk, name, namespace=None, *,
                propagation: str = "Background") -> None:
-        self._record("delete", gvk.kind)
+        self._record("delete", gvk.kind, namespace=namespace, name=name)
         self._inject("delete", gvk.kind)
         return self.inner.delete(gvk, name, namespace,
                                  propagation=propagation)

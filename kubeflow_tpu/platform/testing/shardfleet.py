@@ -1,0 +1,382 @@
+"""ShardedFleet: N simulated controller replicas over one apiserver.
+
+The sharded-HA chaos suite (tests/ctrlplane/test_sharding.py) and
+bench_scale's 4-replica converge band both need the same rig: one
+``FakeKube``, a kubelet simulator bringing worker pods Running, a
+convergence tracker on the Notebook watch stream, and R replicas — each a
+full notebook controller with its own ``ShardCoordinator``, its own
+``ChaosKube`` (the per-replica call log the fencing assertions join
+against; faults optional) and its own ``FencedClient`` write gate:
+
+    FencedClient( ChaosKube( FakeKube ) )
+       ^ fence decides        ^ logs what actually reached the wire
+
+so a fenced write appears in NEITHER log — which is exactly the
+invariant: the wire never sees a key written by two replicas in
+overlapping ownership windows.
+
+Replica lifecycle knobs mirror the failure modes the chaos matrix
+drives: ``kill()`` (controller down + coordinator crash — leases age
+out, survivors absorb), ``stop_replica()`` (graceful: leases released,
+instant handover), ``pause()/resume_replica()`` (renewals frozen with
+the replica alive — the split-brain case).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.platform.runtime.sharding import (
+    FencedClient,
+    ShardCoordinator,
+    shard_of,
+)
+from kubeflow_tpu.platform.testing.chaos import ChaosKube
+from kubeflow_tpu.platform.testing.fake import FakeKube
+
+
+@dataclasses.dataclass
+class Replica:
+    index: int
+    chaos: ChaosKube          # per-replica wire log (faults optional)
+    coordinator: ShardCoordinator
+    client: FencedClient      # what the controller writes through
+    controller: object
+    alive: bool = True
+
+
+class ShardedFleet:
+    def __init__(self, *, replicas: int = 4, num_shards: int = 8,
+                 workers: int = 4, lease_seconds: float = 0.5,
+                 renew_seconds: float = 0.05,
+                 chaos_faults: Optional[list] = None,
+                 chaos_seed: int = 0,
+                 namespace: str = "fleet"):
+        import logging
+
+        from kubeflow_tpu.platform.controllers.notebook import (
+            make_controller,
+        )
+
+        logging.getLogger("kubeflow_tpu.runtime").setLevel(logging.ERROR)
+        self.namespace = namespace
+        self.num_shards = num_shards
+        self.lease_seconds = lease_seconds
+        self.kube = FakeKube()
+        self.kube.add_namespace(namespace)
+        self.kube.add_namespace("kubeflow")  # shard/member leases
+        self.kube.add_tpu_node("tpu-node-1", topology="2x4")
+        self._stop = threading.Event()
+        self._converged: set = set()
+        self._converged_lock = threading.Lock()
+        self._conv_event = threading.Event()
+        self._target = 0
+        self._threads: List[threading.Thread] = []
+        for fn in (self._kubelet_loop, self._convergence_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.replicas: List[Replica] = []
+        for i in range(replicas):
+            chaos = ChaosKube(self.kube, chaos_faults or [],
+                              seed=chaos_seed + i)
+            coord = ShardCoordinator(
+                self.kube,  # lease traffic stays on the healthy store
+                num_shards=num_shards, identity=f"r{i}",
+                lease_seconds=lease_seconds, renew_seconds=renew_seconds,
+            )
+            fenced = FencedClient(chaos, coord, log_writes=True)
+            ctrl = make_controller(fenced, use_istio=False, shards=coord)
+            ctrl.workers = workers
+            self.replicas.append(Replica(i, chaos, coord, fenced, ctrl))
+        for r in self.replicas:
+            r.coordinator.start()
+            r.controller.start(r.client)
+
+    # -- lifecycle / chaos ----------------------------------------------------
+
+    def kill(self, index: int) -> None:
+        """The crash: controller threads down, coordinator stops renewing
+        WITHOUT releasing — survivors absorb after the lease TTL."""
+        r = self.replicas[index]
+        r.controller.stop()
+        r.coordinator.crash()
+        r.alive = False
+
+    def stop_replica(self, index: int) -> None:
+        """Graceful shutdown: leases released first, instant handover."""
+        r = self.replicas[index]
+        r.coordinator.stop()
+        r.controller.stop()
+        r.alive = False
+
+    def pause(self, index: int) -> None:
+        self.replicas[index].coordinator.pause()
+
+    def resume_replica(self, index: int) -> None:
+        self.replicas[index].coordinator.resume()
+
+    def add_replica(self) -> Replica:
+        """Membership churn: a joiner appears mid-flight; incumbents shed
+        toward the new fair share and the joiner resyncs the moved
+        ranges."""
+        from kubeflow_tpu.platform.controllers.notebook import (
+            make_controller,
+        )
+
+        i = len(self.replicas)
+        chaos = ChaosKube(self.kube, [], seed=1000 + i)
+        coord = ShardCoordinator(
+            self.kube, num_shards=self.num_shards, identity=f"r{i}",
+            lease_seconds=self.lease_seconds,
+            renew_seconds=self.replicas[0].coordinator.renew_seconds,
+        )
+        fenced = FencedClient(chaos, coord, log_writes=True)
+        ctrl = make_controller(fenced, use_istio=False, shards=coord)
+        ctrl.workers = self.replicas[0].controller.workers
+        r = Replica(i, chaos, coord, fenced, ctrl)
+        self.replicas.append(r)
+        coord.start()
+        ctrl.start(fenced)
+        return r
+
+    def close(self) -> None:
+        self._stop.set()
+        for r in self.replicas:
+            if r.alive:
+                r.coordinator.stop()
+                r.controller.stop()
+                r.alive = False
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- simulators (bench_scale.FleetHarness's, multi-replica) ---------------
+
+    def _kubelet_loop(self) -> None:
+        from kubeflow_tpu.platform.k8s import errors
+        from kubeflow_tpu.platform.k8s.types import STATEFULSET, deep_get
+
+        acked: Dict[str, int] = {}
+        for _etype, sts in self.kube.watch(STATEFULSET, self.namespace,
+                                           stop=self._stop):
+            name = sts["metadata"]["name"]
+            replicas = deep_get(sts, "spec", "replicas", default=0)
+            if acked.get(name) == replicas or not replicas:
+                continue
+            tmpl = deep_get(sts, "spec", "template")
+            for i in range(replicas):
+                pod = {
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {
+                        "name": f"{name}-{i}", "namespace": self.namespace,
+                        "labels": dict(
+                            deep_get(tmpl, "metadata", "labels",
+                                     default={}) or {}),
+                    },
+                    "spec": deep_get(tmpl, "spec"),
+                }
+                try:
+                    self.kube.create(pod)
+                except errors.AlreadyExists:
+                    pass
+                try:
+                    self.kube.set_pod_phase(self.namespace, f"{name}-{i}",
+                                            "Running", ready=True)
+                except errors.ApiError:
+                    pass
+            acked[name] = replicas
+
+    def _convergence_loop(self) -> None:
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK, deep_get
+
+        for _etype, nb in self.kube.watch(NOTEBOOK, self.namespace,
+                                          stop=self._stop):
+            ready = deep_get(nb, "status", "readyReplicas", default=0)
+            reps = deep_get(nb, "status", "replicas", default=0)
+            if reps and ready == reps:
+                with self._converged_lock:
+                    self._converged.add(nb["metadata"]["name"])
+                    if (self._target
+                            and len(self._converged) >= self._target):
+                        self._conv_event.set()
+
+    # -- phases ---------------------------------------------------------------
+
+    def create_wave(self, n: int, *, prefix: str = "nb") -> None:
+        with self._converged_lock:
+            self._target = n + len(self._converged)
+            self._conv_event.clear()
+        for i in range(n):
+            self.kube.create({
+                "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+                "metadata": {"name": f"{prefix}-{i:05d}",
+                             "namespace": self.namespace},
+                "spec": {
+                    "tpu": {"accelerator": "v5e", "topology": "2x4"},
+                    "template": {"spec": {"containers": [
+                        {"name": "notebook",
+                         "image": "ghcr.io/kubeflow-tpu/jupyter-jax-tpu"}]}},
+                },
+            })
+
+    def wait_converged(self, *, timeout: float = 300.0) -> None:
+        if not self._conv_event.wait(timeout):
+            with self._converged_lock:
+                missing = self._target - len(self._converged)
+            owners = {r.index: sorted(r.coordinator.owned())
+                      for r in self.replicas if r.alive}
+            raise TimeoutError(
+                f"{missing} notebooks unconverged after {timeout}s "
+                f"(live shard map: {owners})")
+
+    def wave(self, n: int, *, timeout: float = 300.0,
+             prefix: str = "nb") -> float:
+        t0 = time.perf_counter()
+        self.create_wave(n, prefix=prefix)
+        self.wait_converged(timeout=timeout)
+        return time.perf_counter() - t0
+
+    def wait_stable_shard_map(self, *, timeout: float = 15.0
+                              ) -> Dict[int, list]:
+        """Block until the live replicas' owned sets form a clean
+        partition of the keyspace (complete, disjoint, nothing mid-drain)
+        and return it.  Transient double-claims are EXPECTED during
+        handover — a replica that lost a lease learns it on its next
+        renew — so map assertions poll for the settled state instead of
+        racing it; writes are protected throughout by fencing, which is
+        asserted separately."""
+        deadline = time.monotonic() + timeout
+        want = set(range(self.num_shards))
+        while True:
+            per = {r.index: sorted(r.coordinator.owned())
+                   for r in self.replicas if r.alive}
+            draining = any(r.coordinator.draining()
+                           for r in self.replicas if r.alive)
+            flat = [s for owned in per.values() for s in owned]
+            # A clean partition alone is not settled: right after a
+            # join, incumbents may still cover ALL shards at the stale
+            # fair share (e.g. 4+4 of 8 while the joiner owns zero) — a
+            # kill test picking the empty replica would then test
+            # nothing.  Settled = partition + fair balance: every live
+            # replica holds between floor and ceil of S/replicas.
+            n_live = max(len(per), 1)
+            lo = self.num_shards // n_live
+            hi = -(-self.num_shards // n_live)
+            balanced = all(lo <= len(owned) <= hi
+                           for owned in per.values())
+            if (not draining and balanced
+                    and len(flat) == len(set(flat))
+                    and set(flat) == want):
+                return per
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"shard map never settled: {per} "
+                    f"(draining={draining})")
+            time.sleep(0.02)
+
+    # -- invariant assertions -------------------------------------------------
+
+    def ownership_windows(self) -> Dict[int, List[Tuple[int, float, float]]]:
+        """Per shard: (replica, open_t, close_write_deadline) windows from
+        every coordinator's ownership log.  A still-open window closes at
+        +inf for a live replica, and at ``last_renew + lease_seconds`` for
+        crashed ones (the log's crash record carries it)."""
+        windows: Dict[int, List[Tuple[int, float, float]]] = {}
+        for r in self.replicas:
+            open_at: Dict[int, float] = {}
+            for entry in list(r.coordinator.ownership_log):
+                shard, action, t, deadline = entry
+                if action == "acquire":
+                    open_at[shard] = t
+                else:
+                    t0 = open_at.pop(shard, None)
+                    if t0 is not None:
+                        windows.setdefault(shard, []).append(
+                            (r.index, t0, deadline if deadline is not None
+                             else t))
+            for shard, t0 in open_at.items():
+                windows.setdefault(shard, []).append(
+                    (r.index, t0, float("inf")))
+        return windows
+
+    def assert_fencing_invariant(self, *, kinds: Optional[set] = None,
+                                 namespace: Optional[str] = None) -> int:
+        """THE cross-process exclusion proof, from the logs:
+
+        1. every write that reached the wire (per-replica ChaosKube
+           write_log, Lease traffic excluded) was fenced — it appears in
+           that replica's FencedClient log with a shard + token;
+        2. every fenced write's timestamp falls inside one of its
+           replica's ownership windows for that shard;
+        3. for each shard, windows of DIFFERENT replicas never overlap
+           (close uses the write deadline — ``last_renew + TTL`` for
+           crashes — so a successor's acquire can't predate it).
+
+        Returns the number of writes checked (callers assert > 0 so a
+        silent no-write run can't vacuously pass)."""
+        ns = namespace or self.namespace
+        windows = self.ownership_windows()
+        checked = 0
+        for r in self.replicas:
+            fenced_writes = [w for w in list(r.client.write_log)
+                             if w["namespace"] == ns
+                             and (kinds is None or w["kind"] in kinds)]
+            wire_writes = [w for w in list(r.chaos.write_log)
+                           if w[3] == ns
+                           and (kinds is None or w[2] in kinds)]
+            # 1: the wire never saw more of this replica's writes than the
+            # fence authorized (faulted calls are logged on the wire but
+            # raised before reaching FencedClient's success log, so wire
+            # count can only be >=; equality holds with no faults).
+            assert len(wire_writes) >= len(fenced_writes), (
+                f"replica {r.index}: {len(fenced_writes)} fenced writes "
+                f"but only {len(wire_writes)} on the wire")
+            for w in fenced_writes:
+                assert w.get("shard") is not None, (
+                    f"replica {r.index}: unfenced write {w}")
+                spans = [s for s in windows.get(w["shard"], ())
+                         if s[0] == r.index and s[1] <= w["t"] <= s[2]]
+                assert spans, (
+                    f"replica {r.index} wrote {w['kind']} "
+                    f"{w['namespace']}/{w['name']} (key {w['key']}, shard "
+                    f"{w['shard']}) at t={w['t']:.3f} outside every "
+                    f"ownership window {windows.get(w['shard'])}")
+                checked += 1
+        for shard, spans in windows.items():
+            spans = sorted(spans, key=lambda s: s[1])
+            for (ra, a0, a1), (rb, b0, b1) in zip(spans, spans[1:]):
+                if ra == rb:
+                    continue
+                assert b0 >= a1, (
+                    f"shard {shard}: replica {rb}'s window opens at "
+                    f"{b0:.3f} before replica {ra}'s write deadline "
+                    f"{a1:.3f} — overlapping ownership")
+        return checked
+
+    def assert_no_writes_after(self, index: int, t: float, *,
+                               kinds: Optional[set] = None) -> None:
+        """Split-brain assertion: replica ``index``'s wire log shows no
+        write at/after monotonic time ``t`` (Lease traffic excluded by
+        construction — the coordinator bypasses the ChaosKube)."""
+        r = self.replicas[index]
+        late = [w for w in list(r.chaos.write_log)
+                if w[0] >= t and (kinds is None or w[2] in kinds)]
+        assert not late, (
+            f"replica {index} wrote after t={t:.3f}: {late[:5]}")
+
+    def cache_stats(self) -> Dict[int, dict]:
+        """Per-replica informer load: cached objects and deltas admitted
+        vs seen — the per-replica watch/cache numbers bench_scale bands
+        against the full-keyspace baseline."""
+        out = {}
+        for r in self.replicas:
+            informers = dict.fromkeys(r.controller.informers.values())
+            out[r.index] = {
+                "cached_objects": sum(len(i) for i in informers),
+                "events_seen": sum(i.events_seen for i in informers),
+                "events_admitted": sum(i.events_admitted
+                                       for i in informers),
+            }
+        return out

