@@ -257,6 +257,32 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # TPUJob presubmit lane (ISSUE 10): the gang reconciler + API matrix
+    # (gang creation, MEGASCALE round-trip vs parallel/dist.py, restart/
+    # backoff semantics, CRD yaml-vs-api pin) plus the storm invariants on
+    # every change to the controller, the env contract, or the trainer
+    # pieces the gang resumes through.  The tpujob-train-converge
+    # conformance check (gang submit → mid-run kill → checkpoint-resume →
+    # Succeeded) rides the existing `conformance` postsubmit lane, whose
+    # kubeflow_tpu/* + conformance/* globs already cover this subsystem.
+    name="tpujob",
+    include_dirs=[
+        "kubeflow_tpu/platform/controllers/*", "kubeflow_tpu/platform/apis/*",
+        "kubeflow_tpu/parallel/envspec.py", "kubeflow_tpu/parallel/dist.py",
+        "kubeflow_tpu/train/*", "kubeflow_tpu/platform/testing/*",
+        "manifests/*", "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest(
+            "tests/ctrlplane/test_tpujob_controller.py",
+            "tests/ctrlplane/test_manifests.py",
+        )),
+        Step("storm", _pytest("tests/ctrlplane/test_chaos.py")
+             + ["-m", "not slow", "-k", "tpujob"], depends="unit"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

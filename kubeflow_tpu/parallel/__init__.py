@@ -13,21 +13,11 @@ In the TPU rebuild the compute-side story is explicit and first-class:
   ``shard_map`` + ``ppermute``.
 * ``dist``     — multi-host bring-up: ``jax.distributed.initialize`` from the
   TPU worker env the platform's webhook injects into notebook pods.
+* ``envspec``  — the worker env contract shared with the platform controllers;
+  deliberately jax-free, which is why EVERYTHING here is lazy: the platform
+  half does ``from kubeflow_tpu.parallel import envspec`` on reconcile paths
+  that must not pay (or even have) the jax import.
 """
-
-from kubeflow_tpu.parallel.mesh import MeshConfig, make_mesh
-from kubeflow_tpu.parallel.mesh import default_mesh_config
-from kubeflow_tpu.parallel.sharding import (
-    batch_sharding,
-    bert_rules,
-    infer_state_shardings,
-    llama_rules,
-    resnet_rules,
-    shard_params,
-    t5_rules,
-    vit_rules,
-)
-from kubeflow_tpu.parallel.train import make_sharded_train_step
 
 __all__ = [
     "MeshConfig",
@@ -47,18 +37,30 @@ __all__ = [
     "pipeline_apply",
 ]
 
+_LAZY = {
+    "MeshConfig": "mesh",
+    "make_mesh": "mesh",
+    "default_mesh_config": "mesh",
+    "batch_sharding": "sharding",
+    "bert_rules": "sharding",
+    "infer_state_shardings": "sharding",
+    "llama_rules": "sharding",
+    "resnet_rules": "sharding",
+    "shard_params": "sharding",
+    "t5_rules": "sharding",
+    "vit_rules": "sharding",
+    "make_sharded_train_step": "train",
+    "ring_attention": "ring",
+    "ulysses_attention": "ulysses",
+    "pipeline_apply": "pipeline",
+}
 
-def __getattr__(name):  # lazy: ring/ulysses/pipeline pull in shard_map deps
-    if name == "ring_attention":
-        from kubeflow_tpu.parallel.ring import ring_attention
 
-        return ring_attention
-    if name == "ulysses_attention":
-        from kubeflow_tpu.parallel.ulysses import ulysses_attention
+def __getattr__(name):  # PEP 562: every symbol lazy — see envspec note above
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(name)
+    import importlib
 
-        return ulysses_attention
-    if name == "pipeline_apply":
-        from kubeflow_tpu.parallel.pipeline import pipeline_apply
-
-        return pipeline_apply
-    raise AttributeError(name)
+    return getattr(
+        importlib.import_module(f"kubeflow_tpu.parallel.{module}"), name)

@@ -15,20 +15,17 @@ from typing import Optional
 
 import jax
 
-DEFAULT_COORDINATOR_PORT = 8476
+from kubeflow_tpu.parallel import envspec
+
+# Single-sourced with the controllers' coordinator Service port.
+DEFAULT_COORDINATOR_PORT = envspec.DEFAULT_COORDINATOR_PORT
 
 
 def worker_env() -> dict:
-    return {
-        "worker_id": os.environ.get("TPU_WORKER_ID"),
-        "hostnames": os.environ.get("TPU_WORKER_HOSTNAMES"),
-        "topology": os.environ.get("TPU_TOPOLOGY"),
-        "accelerator": os.environ.get("TPU_ACCELERATOR_TYPE"),
-        "hosts_per_slice": os.environ.get("TPU_HOSTS_PER_SLICE"),
-        "num_slices": os.environ.get("MEGASCALE_NUM_SLICES"),
-        "slice_id": os.environ.get("MEGASCALE_SLICE_ID"),
-        "coordinator": os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"),
-    }
+    # The variable names live in parallel/envspec.py — the SAME constants
+    # the platform controllers inject from, so discovery and injection
+    # cannot drift (round-tripped in tests/ctrlplane/test_tpujob_controller).
+    return envspec.worker_env_from(os.environ)
 
 
 def num_slices() -> int:

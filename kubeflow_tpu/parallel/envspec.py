@@ -1,0 +1,96 @@
+"""The TPU worker env contract, single-sourced.
+
+Both halves of the repo speak this vocabulary: the platform controllers
+(notebook.py, tpujob.py) INJECT these variables into worker pods, and the
+compute side (parallel/dist.py) DISCOVERS them to join the
+``jax.distributed`` barrier.  Before this module the strings were
+free-floating in both places and could silently drift — a renamed variable
+on either side would strand every multi-host worker at the rendezvous with
+no error.  Now the controller builds its env list from these constants and
+``dist.worker_env`` parses through ``worker_env_from`` below; the
+round-trip is pinned by tests/ctrlplane/test_tpujob_controller.py.
+
+Deliberately dependency-free (no jax import): the platform half imports
+this from reconcile hot paths where pulling in jax would cost seconds of
+import time and hundreds of MB of RSS.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# -- per-slice libtpu ICI bootstrap (the GKE TPU-webhook contract) -----------
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_ACCELERATOR_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TPU_CHIPS_PER_HOST = "TPU_CHIPS_PER_HOST"
+ENV_TPU_HOSTS_PER_SLICE = "TPU_HOSTS_PER_SLICE"
+
+# -- cross-slice (DCN) identity: GKE multislice / MEGASCALE parity -----------
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
+ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+
+# -- platform → trainer plumbing (kubeflow-tpu specific, not libtpu) ---------
+# Checkpoint directory a TPUJob's gang resumes from (train/run.py reads it
+# as the --checkpoint-dir default; docs/jobs.md "checkpoint-resume").
+ENV_KFT_CHECKPOINT_DIR = "KFT_CHECKPOINT_DIR"
+
+# The jax.distributed rendezvous port — what dist.initialize dials and the
+# controllers' headless coordinator Services expose.  Lives here (not in
+# dist.py, which re-exports it) because the controllers cannot afford the
+# jax import; one constant on both sides of the wire.
+DEFAULT_COORDINATOR_PORT = 8476
+
+# StatefulSet pods carry their ordinal in this label; the downward-API
+# fieldRef below turns it into TPU_WORKER_ID.
+_POD_INDEX_FIELD = "metadata.labels['apps.kubernetes.io/pod-index']"
+
+
+def tpu_bootstrap_env(*, topology: str, accelerator: str, chips: int,
+                      chips_per_host: int, num_hosts: int,
+                      hostnames: str) -> List[dict]:
+    """The per-slice libtpu ICI bootstrap block a controller injects into
+    every worker of one slice — k8s EnvVar-shaped dicts, value formats
+    exactly what ``worker_env_from`` reads back (e.g. the
+    ``<accelerator>-<chips>`` accelerator-type string).  Shared by the
+    notebook and TPUJob reconcilers so the formatting cannot drift between
+    workloads."""
+    return [
+        {"name": ENV_TPU_WORKER_ID, "valueFrom": {"fieldRef": {
+            "fieldPath": _POD_INDEX_FIELD}}},
+        {"name": ENV_TPU_WORKER_HOSTNAMES, "value": hostnames},
+        {"name": ENV_TPU_TOPOLOGY, "value": topology},
+        {"name": ENV_TPU_ACCELERATOR_TYPE,
+         "value": f"{accelerator}-{chips}"},
+        {"name": ENV_TPU_CHIPS_PER_HOST, "value": str(chips_per_host)},
+        {"name": ENV_TPU_HOSTS_PER_SLICE, "value": str(num_hosts)},
+    ]
+
+
+def megascale_env(slice_id: int, num_slices: int,
+                  coordinator_address: str) -> List[dict]:
+    """The cross-slice env block a controller injects into every worker of
+    slice ``slice_id`` — k8s EnvVar-shaped dicts, values stringified the
+    way ``worker_env_from`` will read them back."""
+    return [
+        {"name": ENV_MEGASCALE_SLICE_ID, "value": str(slice_id)},
+        {"name": ENV_MEGASCALE_NUM_SLICES, "value": str(num_slices)},
+        {"name": ENV_MEGASCALE_COORDINATOR_ADDRESS,
+         "value": coordinator_address},
+    ]
+
+
+def worker_env_from(environ: Dict[str, str]) -> Dict[str, Optional[str]]:
+    """Parse the injected contract out of an environ mapping — the ONE
+    discovery implementation (dist.worker_env binds it to os.environ)."""
+    return {
+        "worker_id": environ.get(ENV_TPU_WORKER_ID),
+        "hostnames": environ.get(ENV_TPU_WORKER_HOSTNAMES),
+        "topology": environ.get(ENV_TPU_TOPOLOGY),
+        "accelerator": environ.get(ENV_TPU_ACCELERATOR_TYPE),
+        "hosts_per_slice": environ.get(ENV_TPU_HOSTS_PER_SLICE),
+        "num_slices": environ.get(ENV_MEGASCALE_NUM_SLICES),
+        "slice_id": environ.get(ENV_MEGASCALE_SLICE_ID),
+        "coordinator": environ.get(ENV_MEGASCALE_COORDINATOR_ADDRESS),
+    }

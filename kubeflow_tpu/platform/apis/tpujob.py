@@ -1,0 +1,186 @@
+"""TPUJob CRD schema: defaulting, validation, well-known labels.
+
+The training workload the reference platform never grew (SURVEY §5.7/§5.8
+— no training operator): a gang-scheduled, multi-slice batch job over the
+same ``spec.tpu`` vocabulary Notebooks use (ROADMAP item 4).
+
+    apiVersion: kubeflow.org/v1alpha1
+    kind: TPUJob
+    spec:
+      tpu:
+        accelerator: v5e        # key into platform.tpu.ACCELERATORS
+        topology: "4x4"         # optional; accelerator default otherwise
+        slices: 2               # DCN-joined ICI slices (default 1)
+      template:
+        spec: {containers: [...]}   # worker PodSpec; containers[0] trains
+      restartPolicy: OnFailure  # or Never
+      backoffLimit: 3           # max whole-gang restarts before Failed
+      checkpointDir: gs://...   # injected as KFT_CHECKPOINT_DIR; a
+                                # restarted gang resumes from its latest step
+    status:
+      phase: Pending|Running|Restarting|Succeeded|Failed
+      restarts: int             # gang generations consumed
+      slices: [{slice, ready, total}]
+      conditions: [...]
+
+Gang semantics are all-or-nothing: one worker pod failing tears down and
+recreates EVERY slice's StatefulSet (docs/jobs.md).  Unlike Notebooks,
+``spec.tpu`` is REQUIRED — a TPUJob without chips is a plain Job and does
+not belong to this controller.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from kubeflow_tpu.platform.k8s.types import Resource, deep_get
+from kubeflow_tpu.platform.tpu import SliceSpec, slice_spec
+
+GROUP = "kubeflow.org"
+VERSION = "v1alpha1"
+
+LABEL_TPUJOB_NAME = "tpujob-name"
+# Every TPUJob worker pod carries this label so admins can target the whole
+# training fleet with one PodDefault selector (manifests/tpujob-poddefault.yaml).
+LABEL_TPUJOB_WORKER = "tpujob-worker"
+# Gang generation: stamped on each generation's StatefulSets and pods; a
+# restart bumps it, so stragglers of a torn-down generation are identifiable
+# (and never read as the new gang's members).
+LABEL_GENERATION = "tpujob-generation"
+
+RESTART_POLICIES = ("OnFailure", "Never")
+DEFAULT_BACKOFF_LIMIT = 3
+
+PHASE_PENDING = "Pending"
+PHASE_RUNNING = "Running"
+PHASE_RESTARTING = "Restarting"
+PHASE_SUCCEEDED = "Succeeded"
+PHASE_FAILED = "Failed"
+TERMINAL_PHASES = (PHASE_SUCCEEDED, PHASE_FAILED)
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate(job: Resource) -> None:
+    name = deep_get(job, "metadata", "name", default="")
+    if not name or len(name) > 52:
+        # 52 = 63-char DNS label minus room for "-s<i>-<ordinal>" suffixes.
+        raise ValidationError("metadata.name required, max 52 chars")
+    containers = deep_get(job, "spec", "template", "spec", "containers")
+    if not containers:
+        raise ValidationError("spec.template.spec.containers must be non-empty")
+    tpu = deep_get(job, "spec", "tpu")
+    if not tpu or not tpu.get("accelerator"):
+        raise ValidationError("spec.tpu.accelerator is required for a TPUJob")
+    try:
+        slice_spec(tpu.get("accelerator", ""), tpu.get("topology"),
+                   tpu.get("slices"))
+    except ValueError as e:
+        raise ValidationError(str(e)) from None
+    policy = deep_get(job, "spec", "restartPolicy")
+    if policy is not None and policy not in RESTART_POLICIES:
+        raise ValidationError(
+            f"spec.restartPolicy must be one of {RESTART_POLICIES}, "
+            f"got {policy!r}")
+    backoff = deep_get(job, "spec", "backoffLimit")
+    if backoff is not None and (not isinstance(backoff, int) or backoff < 0):
+        raise ValidationError("spec.backoffLimit must be a non-negative integer")
+
+
+def tpu_slice(job: Resource) -> SliceSpec:
+    tpu = deep_get(job, "spec", "tpu", default={}) or {}
+    return slice_spec(tpu.get("accelerator", ""), tpu.get("topology"),
+                      tpu.get("slices"))
+
+
+def tpu_slice_or_none(job: Resource) -> Optional[SliceSpec]:
+    """``tpu_slice`` for aggregation paths: a stored-invalid spec (possible
+    via kubectl — its own reconcile parks it Degraded) yields None instead
+    of crashing the caller."""
+    try:
+        return tpu_slice(job)
+    except ValueError:
+        return None
+
+
+def restart_policy(job: Resource) -> str:
+    return deep_get(job, "spec", "restartPolicy", default="OnFailure") \
+        or "OnFailure"
+
+
+def backoff_limit(job: Resource) -> int:
+    limit = deep_get(job, "spec", "backoffLimit")
+    return DEFAULT_BACKOFF_LIMIT if limit is None else int(limit)
+
+
+def checkpoint_dir(job: Resource) -> Optional[str]:
+    return deep_get(job, "spec", "checkpointDir") or None
+
+
+def phase_of(job: Resource) -> str:
+    return deep_get(job, "status", "phase", default=PHASE_PENDING) \
+        or PHASE_PENDING
+
+
+def restarts_of(job: Resource) -> int:
+    return int(deep_get(job, "status", "restarts", default=0) or 0)
+
+
+def crd_manifest() -> Resource:
+    """The CustomResourceDefinition to install — kept in sync with
+    manifests/crds/tpujob.yaml (pinned by tests/ctrlplane/test_manifests.py)."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpujobs.kubeflow.org"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": "TPUJob", "plural": "tpujobs",
+                      "singular": "tpujob"},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION,
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "properties": {
+                        "spec": {
+                            "type": "object",
+                            "required": ["tpu", "template"],
+                            "properties": {
+                                "tpu": {
+                                    "type": "object",
+                                    "required": ["accelerator"],
+                                    "properties": {
+                                        "accelerator": {"type": "string"},
+                                        "topology": {"type": "string"},
+                                        "slices": {"type": "integer",
+                                                   "minimum": 1},
+                                    },
+                                },
+                                "template": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields":
+                                        True,
+                                },
+                                "restartPolicy": {
+                                    "type": "string",
+                                    "enum": list(RESTART_POLICIES),
+                                },
+                                "backoffLimit": {"type": "integer",
+                                                 "minimum": 0},
+                                "checkpointDir": {"type": "string"},
+                            },
+                        },
+                        "status": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                }},
+            }],
+        },
+    }

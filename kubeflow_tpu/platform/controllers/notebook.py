@@ -44,6 +44,7 @@ from kubeflow_tpu.platform.k8s.types import (
     deep_get,
     meta,
     name_of,
+    pod_ready,
     set_owner,
     thaw,
 )
@@ -336,27 +337,27 @@ class NotebookReconciler(Reconciler):
         )
         env = container.setdefault("env", [])
         have = {e.get("name") for e in env}
-        injected = [
-            {"name": "TPU_WORKER_ID", "valueFrom": {"fieldRef": {
-                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
-            }}},
-            {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
-            {"name": "TPU_TOPOLOGY", "value": tpu.topology},
-            {"name": "TPU_ACCELERATOR_TYPE",
-             "value": f"{tpu.accelerator.name}-{tpu.chips}"},
-            {"name": "TPU_CHIPS_PER_HOST", "value": str(tpu.chips_per_pod)},
-            {"name": "TPU_HOSTS_PER_SLICE", "value": str(tpu.num_hosts)},
-        ]
+        # The whole block (names AND value formats) comes from
+        # parallel/envspec.py — what parallel/dist.py discovers with,
+        # shared with the TPUJob controller, so injection and discovery
+        # cannot drift between workloads.
+        from kubeflow_tpu.parallel import envspec
+
+        injected = envspec.tpu_bootstrap_env(
+            topology=tpu.topology,
+            accelerator=tpu.accelerator.name,
+            chips=tpu.chips,
+            chips_per_host=tpu.chips_per_pod,
+            num_hosts=tpu.num_hosts,
+            hostnames=hostnames,
+        )
         if tpu.multi_slice:
             # DCN mesh contract (GKE multislice parity): every worker learns
             # its slice, the slice count, and the coordinator — worker 0 of
             # slice 0 (pod <name>-0, stable across slice STSes).
-            injected += [
-                {"name": "MEGASCALE_SLICE_ID", "value": str(slice_idx)},
-                {"name": "MEGASCALE_NUM_SLICES", "value": str(tpu.num_slices)},
-                {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value":
-                    f"{name}-0.{name}-workers.{ns}.svc.{self.cluster_domain}"},
-            ]
+            injected += envspec.megascale_env(
+                slice_idx, tpu.num_slices,
+                f"{name}-0.{name}-workers.{ns}.svc.{self.cluster_domain}")
         env.extend(e for e in injected if e["name"] not in have)
 
     def _reconcile_statefulsets(
@@ -884,7 +885,7 @@ class NotebookReconciler(Reconciler):
     def _update_status(self, notebook: Resource, stses: List[Resource]) -> None:
         ns, name = meta(notebook)["namespace"], name_of(notebook)
         pods = self._pods_of(ns, name)
-        ready = sum(1 for p in pods if _pod_ready(p))
+        ready = sum(1 for p in pods if pod_ready(p))
         worker0 = next(
             (p for p in pods if name_of(p) == f"{name}-0"), None
         )
@@ -926,13 +927,6 @@ def _seconds_since(timestamp: Optional[str]) -> Optional[float]:
     except ValueError:
         return None
     return max(0.0, time.time() - then)
-
-
-def _pod_ready(pod: Resource) -> bool:
-    for cond in deep_get(pod, "status", "conditions", default=[]):
-        if cond.get("type") == "Ready":
-            return cond.get("status") == "True"
-    return False
 
 
 def pods_to_notebook_requests(obj: Resource) -> List[Request]:

@@ -71,12 +71,13 @@ NOTEBOOK = GVK("kubeflow.org", "v1beta1", "Notebook", "notebooks")
 PROFILE = GVK("kubeflow.org", "v1", "Profile", "profiles", namespaced=False)
 PODDEFAULT = GVK("kubeflow.org", "v1alpha1", "PodDefault", "poddefaults")
 TENSORBOARD = GVK("tensorboard.kubeflow.org", "v1alpha1", "Tensorboard", "tensorboards")
+TPUJOB = GVK("kubeflow.org", "v1alpha1", "TPUJob", "tpujobs")
 
 WELL_KNOWN: tuple[GVK, ...] = (
     POD, SERVICE, NAMESPACE, NODE, EVENT, SECRET, CONFIGMAP, SERVICEACCOUNT,
     PVC, RESOURCEQUOTA, STATEFULSET, PODDISRUPTIONBUDGET, DEPLOYMENT,
     ROLEBINDING, CLUSTERROLE, STORAGECLASS, LEASE, VIRTUALSERVICE,
-    AUTHORIZATIONPOLICY, NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD,
+    AUTHORIZATIONPOLICY, NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD, TPUJOB,
 )
 
 
@@ -212,6 +213,15 @@ def deep_get(obj: Resource, *path: str, default: Any = None) -> Any:
             return default
         cur = cur[p]
     return cur
+
+
+def pod_ready(pod: Resource) -> bool:
+    """True when the pod's Ready condition is True — the readiness read
+    every controller aggregates worker status from."""
+    for cond in deep_get(pod, "status", "conditions", default=[]):
+        if cond.get("type") == "Ready":
+            return cond.get("status") == "True"
+    return False
 
 
 def copy_resource(x: Any) -> Any:

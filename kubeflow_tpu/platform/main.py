@@ -114,7 +114,12 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
 
 
 def run_controllers(args) -> int:
-    from kubeflow_tpu.platform.controllers import culling, profile, tensorboard
+    from kubeflow_tpu.platform.controllers import (
+        culling,
+        profile,
+        tensorboard,
+        tpujob,
+    )
     from kubeflow_tpu.platform.controllers.notebook import make_controller
     from kubeflow_tpu.platform.runtime import Manager
 
@@ -164,6 +169,10 @@ def run_controllers(args) -> int:
         ),
     ))
     mgr.add(tensorboard.make_controller(ctrl_client, shards=shards))
+    # Training workloads (docs/jobs.md): the TPUJob gang reconciler runs in
+    # the same manager, under the same sharding/fencing regime as the
+    # other controllers — a gang write is fenced on its job's shard lease.
+    mgr.add(tpujob.make_controller(ctrl_client, shards=shards))
     if config.env_bool("ENABLE_CULLING", False):
         from kubeflow_tpu.platform.k8s.types import NOTEBOOK
 

@@ -107,6 +107,78 @@ def register_fleet_collector(client) -> None:
     _fleet_collector.client = client
 
 
+class TpuJobCollector:
+    """Scrape-time TPUJob fleet gauges (docs/observability.md):
+    ``tpujob_jobs{phase}`` — jobs per lifecycle phase fleet-wide — and the
+    per-namespace slice-readiness pair ``tpujob_slices_ready`` /
+    ``tpujob_slices`` summed from job statuses.  Same single-slot
+    swappable-client shape as NotebookFleetCollector: one TPUJob list per
+    scrape, never per reconcile."""
+
+    def __init__(self):
+        self.client = None
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        jobs = GaugeMetricFamily(
+            "tpujob_jobs", "TPUJobs by lifecycle phase", labels=["phase"])
+        ready = GaugeMetricFamily(
+            "tpujob_slices_ready",
+            "ready TPUJob slice workers, per namespace",
+            labels=["namespace"])
+        total = GaugeMetricFamily(
+            "tpujob_slices",
+            "expected TPUJob slice workers, per namespace",
+            labels=["namespace"])
+        client = self.client
+        if client is not None:
+            from kubeflow_tpu.platform.k8s.types import TPUJOB, namespace_of
+
+            by_phase: dict = {}
+            per_ns: dict = {}
+            try:
+                tpujobs = client.list(TPUJOB, None)
+            except Exception:  # scrape must not take /metrics down
+                tpujobs = []
+            for job in tpujobs:
+                status = job.get("status") or {}
+                phase = status.get("phase") or "Pending"
+                by_phase[phase] = by_phase.get(phase, 0) + 1
+                ns = namespace_of(job) or ""
+                n_ready, n_total = per_ns.get(ns, (0, 0))
+                for s in status.get("slices") or []:
+                    n_ready += int(s.get("ready", 0) or 0)
+                    n_total += int(s.get("total", 0) or 0)
+                per_ns[ns] = (n_ready, n_total)
+            for phase, n in sorted(by_phase.items()):
+                jobs.add_metric([phase], n)
+            for ns, (n_ready, n_total) in sorted(per_ns.items()):
+                ready.add_metric([ns], n_ready)
+                total.add_metric([ns], n_total)
+        yield jobs
+        yield ready
+        yield total
+
+
+_tpujob_collector = TpuJobCollector()
+registry.register(_tpujob_collector)
+
+
+def register_tpujob_collector(client) -> None:
+    """Point the scrape-time TPUJob gauges at ``client`` (idempotent; None
+    unhooks — wired to the tpujob controller's start/stop)."""
+    _tpujob_collector.client = client
+
+
+tpujob_restarts_total = Counter(
+    "tpujob_restarts_total",
+    "whole-gang TPUJob restarts (any worker pod failure tears down and "
+    "recreates every slice)",
+    registry=registry,
+)
+
+
 reconcile_errors_total = Counter(
     "reconcile_errors_total",
     "Reconcile errors by controller",

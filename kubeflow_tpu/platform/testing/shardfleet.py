@@ -53,7 +53,8 @@ class ShardedFleet:
                  renew_seconds: float = 0.05,
                  chaos_faults: Optional[list] = None,
                  chaos_seed: int = 0,
-                 namespace: str = "fleet"):
+                 namespace: str = "fleet",
+                 controller_factory=None):
         import logging
 
         from kubeflow_tpu.platform.controllers.notebook import (
@@ -61,6 +62,13 @@ class ShardedFleet:
         )
 
         logging.getLogger("kubeflow_tpu.runtime").setLevel(logging.ERROR)
+        # Which controller each replica runs: default is the notebook
+        # reconciler; the TPUJob sharded-gang test passes
+        # tpujob.make_controller — any factory with the standard
+        # (client, shards=) signature works.
+        self._controller_factory = controller_factory or (
+            lambda client, **kw: make_controller(
+                client, use_istio=False, **kw))
         self.namespace = namespace
         self.num_shards = num_shards
         self.lease_seconds = lease_seconds
@@ -88,7 +96,7 @@ class ShardedFleet:
                 lease_seconds=lease_seconds, renew_seconds=renew_seconds,
             )
             fenced = FencedClient(chaos, coord, log_writes=True)
-            ctrl = make_controller(fenced, use_istio=False, shards=coord)
+            ctrl = self._controller_factory(fenced, shards=coord)
             ctrl.workers = workers
             self.replicas.append(Replica(i, chaos, coord, fenced, ctrl))
         for r in self.replicas:
@@ -122,10 +130,6 @@ class ShardedFleet:
         """Membership churn: a joiner appears mid-flight; incumbents shed
         toward the new fair share and the joiner resyncs the moved
         ranges."""
-        from kubeflow_tpu.platform.controllers.notebook import (
-            make_controller,
-        )
-
         i = len(self.replicas)
         chaos = ChaosKube(self.kube, [], seed=1000 + i)
         coord = ShardCoordinator(
@@ -134,7 +138,7 @@ class ShardedFleet:
             renew_seconds=self.replicas[0].coordinator.renew_seconds,
         )
         fenced = FencedClient(chaos, coord, log_writes=True)
-        ctrl = make_controller(fenced, use_istio=False, shards=coord)
+        ctrl = self._controller_factory(fenced, shards=coord)
         ctrl.workers = self.replicas[0].controller.workers
         r = Replica(i, chaos, coord, fenced, ctrl)
         self.replicas.append(r)
@@ -159,9 +163,14 @@ class ShardedFleet:
         from kubeflow_tpu.platform.k8s.types import STATEFULSET, deep_get
 
         acked: Dict[str, int] = {}
-        for _etype, sts in self.kube.watch(STATEFULSET, self.namespace,
-                                           stop=self._stop):
+        for etype, sts in self.kube.watch(STATEFULSET, self.namespace,
+                                          stop=self._stop):
             name = sts["metadata"]["name"]
+            if etype == "DELETED":
+                # Gang teardown (TPUJob restart): forget the ack so the
+                # recreated same-name StatefulSet gets its pods again.
+                acked.pop(name, None)
+                continue
             replicas = deep_get(sts, "spec", "replicas", default=0)
             if acked.get(name) == replicas or not replicas:
                 continue

@@ -71,11 +71,19 @@ def train_loop(
     eval_fn: Optional[Callable] = None,
     eval_batches: Optional[Callable[[], Iterable]] = None,
     on_log: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    stop=None,
 ):
     """Run ``step_fn(state, batch) -> (state, metrics)`` for
     ``cfg.total_steps`` optimizer steps (counted from the restored step
     when resuming).  Returns ``(state, history)`` where history is a list
     of ``{"step": n, **metrics}`` dicts from log/eval points.
+
+    ``stop``: optional ``threading.Event``-like object checked between
+    steps — the graceful-preemption hook.  When set, the loop exits after
+    the in-flight step and the ``finally`` block force-saves the current
+    state (``CheckpointManager.save(..., force=True)`` + ``wait()``), so a
+    SIGTERM'd pod (``train/run.py`` installs the handler) leaves a
+    restorable checkpoint for the gang's next generation to resume from.
     """
     manager = None
     start_step = 0
@@ -113,6 +121,10 @@ def train_loop(
 
     try:
         for step in range(start_step, cfg.total_steps):
+            if stop is not None and stop.is_set():
+                log.info("stop requested at step %d; checkpointing and "
+                         "exiting", step)
+                break
             now = step + 1
             t_iter = time.perf_counter()
             # The run's first step pays jit compilation (for a freshly
@@ -198,7 +210,11 @@ def train_loop(
                     profile_next = True
     finally:
         if manager is not None:
-            final = step + 1
+            # The state's own counter, not the loop variable: a stop-event
+            # break happens at the TOP of an iteration, where step is one
+            # past what the state actually contains — saving under step+1
+            # would mislabel the checkpoint one step ahead.
+            final = int(state.step)
             if manager.latest_step() != final:
                 # Final save unless the interval save already covered it.
                 manager.save(final, state, force=True)

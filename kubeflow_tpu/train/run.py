@@ -13,10 +13,37 @@ count via ``default_mesh_config``.
 from __future__ import annotations
 
 import argparse
+import os
+import signal
 import sys
+import threading
 from typing import Optional
 
 import jax
+
+from kubeflow_tpu.parallel import envspec
+
+
+def install_preemption_handler(stop: threading.Event,
+                               signals=(signal.SIGTERM,)) -> bool:
+    """Graceful-preemption hook: on SIGTERM (what a TPU preemption or a
+    gang teardown delivers to the pod) set ``stop`` so the train loop
+    exits between steps and its ``finally`` force-saves + waits on a
+    checkpoint — the piece that makes the TPUJob controller's
+    "restart resumes from latest_step()" honest on real preemptions.
+
+    Returns False (and installs nothing) when not on the main thread —
+    Python only delivers signals there, and library callers embedding the
+    trainer in a worker thread handle termination themselves."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _handler(signum, frame):
+        stop.set()
+
+    for sig in signals:
+        signal.signal(sig, _handler)
+    return True
 
 
 def parse_mesh(spec: str, n_devices: int):
@@ -198,7 +225,12 @@ def main(argv: Optional[list] = None) -> int:
                          "padding-free rows with segment ids")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mesh", default="auto")
-    ap.add_argument("--checkpoint-dir", default=None)
+    # KFT_CHECKPOINT_DIR is the TPUJob controller's injection path
+    # (parallel/envspec.py): a gang worker resumes from the job's stable
+    # checkpoint dir without the image's command line knowing about it.
+    ap.add_argument(
+        "--checkpoint-dir",
+        default=os.environ.get(envspec.ENV_KFT_CHECKPOINT_DIR) or None)
     ap.add_argument("--checkpoint-every", type=int, default=100)
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--distributed", action="store_true",
@@ -240,6 +272,8 @@ def main(argv: Optional[list] = None) -> int:
             flops_per_token=ctel.lm_train_flops_per_token(
                 probe.cfg, args.seq),
         )
+    stop = threading.Event()
+    install_preemption_handler(stop)
     with global_mesh(mesh):
         state, step, batches = build(args, mesh)
         state, history = train_loop(
@@ -251,7 +285,13 @@ def main(argv: Optional[list] = None) -> int:
                 checkpoint_every=args.checkpoint_every,
                 **telemetry_kwargs,
             ),
+            stop=stop,
         )
+    if stop.is_set():
+        print(f"preempted at step {int(state.step)}: checkpoint saved"
+              if args.checkpoint_dir else
+              f"preempted at step {int(state.step)} (no checkpoint dir)",
+              flush=True)
     if history:
         last = history[-1]
         print(f"done: step {last['step']} "

@@ -50,7 +50,7 @@ def test_deployment_commands_are_real_services():
 
 def test_crds_match_api_layer():
     from kubeflow_tpu.platform.k8s.types import (
-        NOTEBOOK, PODDEFAULT, PROFILE, TENSORBOARD,
+        NOTEBOOK, PODDEFAULT, PROFILE, TENSORBOARD, TPUJOB,
     )
 
     by_plural = {}
@@ -61,11 +61,41 @@ def test_crds_match_api_layer():
                 spec["group"],
                 {v["name"] for v in spec["versions"] if v.get("served")},
             )
-    for gvk in (NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD):
+    for gvk in (NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD, TPUJOB):
         assert gvk.plural in by_plural, f"no CRD for {gvk.kind}"
         group, versions = by_plural[gvk.plural]
         assert group == gvk.group
         assert gvk.version in versions
+
+
+def test_tpujob_crd_yaml_matches_api_manifest():
+    """manifests/crds/tpujob.yaml and apis/tpujob.crd_manifest() describe
+    ONE schema: same group/names/served versions, same required spec
+    fields, same restartPolicy enum — the yaml cannot drift from what the
+    controller validates."""
+    from kubeflow_tpu.platform.apis import tpujob as jobapi
+
+    with open(MANIFESTS / "crds" / "tpujob.yaml") as f:
+        from_yaml = yaml.safe_load(f)
+    from_api = jobapi.crd_manifest()
+    assert from_yaml["spec"]["group"] == from_api["spec"]["group"]
+    assert (from_yaml["spec"]["names"]["kind"]
+            == from_api["spec"]["names"]["kind"] == "TPUJob")
+    for doc in (from_yaml, from_api):
+        (version,) = doc["spec"]["versions"]
+        assert version["name"] == jobapi.VERSION
+        assert version["storage"] is True
+        assert version["subresources"] == {"status": {}}
+        spec_schema = version["schema"]["openAPIV3Schema"][
+            "properties"]["spec"]
+        assert sorted(spec_schema["required"]) == ["template", "tpu"]
+        assert spec_schema["properties"]["tpu"]["required"] == [
+            "accelerator"]
+        assert (spec_schema["properties"]["restartPolicy"]["enum"]
+                == list(jobapi.RESTART_POLICIES))
+        assert set(spec_schema["properties"]) == {
+            "tpu", "template", "restartPolicy", "backoffLimit",
+            "checkpointDir"}
 
 
 def test_release_pinning_roundtrip(tmp_path):

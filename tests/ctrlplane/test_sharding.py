@@ -391,6 +391,73 @@ def test_fleet_kill_replica_mid_wave_fast():
         fleet.close()
 
 
+def test_tpujob_gang_writes_fenced_across_replica_kill():
+    """The fifth controller under sharded HA (ISSUE 10): a TPUJob fleet
+    over 2 replicas survives a replica kill mid-lifecycle.  After the
+    kill, EVERY gang loses a worker — the survivor must run the heaviest
+    write burst a controller makes (whole-gang teardown + recreate +
+    status) for gangs it owned AND gangs it absorbed, and the per-replica
+    wire logs joined with the ownership windows must show every
+    StatefulSet/Service/TPUJob write fenced, with no overlapping-window
+    double writes and no dead-letters."""
+    from kubeflow_tpu.platform.apis import tpujob as jobapi
+    from kubeflow_tpu.platform.controllers import tpujob as jobctrl
+    from kubeflow_tpu.platform.k8s.types import TPUJOB
+
+    fleet = ShardedFleet(replicas=2, num_shards=4, workers=2,
+                         lease_seconds=TTL, renew_seconds=RENEW,
+                         controller_factory=jobctrl.make_controller)
+    n = 12
+
+    def all_jobs_at(phase, restarts):
+        js = fleet.kube.list(TPUJOB, fleet.namespace)
+        return len(js) == n and all(
+            jobapi.phase_of(j) == phase
+            and jobapi.restarts_of(j) == restarts for j in js)
+
+    def wait(pred, what, timeout=120.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        js = fleet.kube.list(TPUJOB, fleet.namespace)
+        raise TimeoutError(f"{what}: {[(j['metadata']['name'], j.get('status')) for j in js[:4]]}")
+
+    try:
+        fleet.wait_stable_shard_map()
+        for i in range(n):
+            fleet.kube.create({
+                "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+                "metadata": {"name": f"tj-{i:03d}",
+                             "namespace": fleet.namespace},
+                "spec": {
+                    "tpu": {"accelerator": "v5e", "topology": "2x4",
+                            "slices": 2},
+                    "template": {"spec": {"containers": [
+                        {"name": "worker", "image": "trainer"}]}},
+                },
+            })
+        wait(lambda: all_jobs_at("Running", 0), "initial gang converge")
+        fleet.kill(0)
+        # Preempt slice 1's worker of EVERY job — including jobs whose
+        # shard the dead replica owned (the survivor sees those pods only
+        # after absorb + refilter-relist).
+        for i in range(n):
+            fleet.kube.set_pod_phase(fleet.namespace,
+                                     f"tj-{i:03d}-s1-0", "Failed")
+        wait(lambda: all_jobs_at("Running", 1),
+             "every gang restarted by the survivor", timeout=180.0)
+        checked = fleet.assert_fencing_invariant(
+            kinds={"StatefulSet", "Service", "TPUJob"})
+        assert checked > 0
+        for r in fleet.replicas:
+            if r.alive:
+                assert not r.controller.dead_letters
+    finally:
+        fleet.close()
+
+
 def test_fleet_kill_replica_1k_wave_4_replicas():
     """The acceptance-criteria chaos test: a converge wave over 1000
     notebooks across 4 replicas; one replica is killed mid-wave.  All

@@ -1,0 +1,388 @@
+"""TPUJob gang reconciler: gang creation, env contract, all-or-nothing
+restarts, backoff/Never semantics, status aggregation — plus the
+MEGASCALE round-trip pin: ``parallel/dist.py`` must discover exactly what
+the controller injects (both read parallel/envspec.py; this test fails if
+either side drifts off the shared constants)."""
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.apis import tpujob as jobapi
+from kubeflow_tpu.platform.controllers.tpujob import (
+    TPUJobReconciler,
+    make_controller,
+    pods_to_tpujob_requests,
+)
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    POD,
+    SERVICE,
+    STATEFULSET,
+    TPUJOB,
+    deep_get,
+    name_of,
+)
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_job(name="tjob", ns="jobs", *, topology="4x4", slices=2,
+             restart_policy=None, backoff_limit=None, checkpoint_dir=None):
+    spec = {
+        "tpu": {"accelerator": "v5e", "topology": topology,
+                "slices": slices},
+        "template": {"spec": {"containers": [{
+            "name": "worker", "image": "trainer",
+            "command": ["python", "-m", "kubeflow_tpu.train.run"],
+        }]}},
+    }
+    if restart_policy is not None:
+        spec["restartPolicy"] = restart_policy
+    if backoff_limit is not None:
+        spec["backoffLimit"] = backoff_limit
+    if checkpoint_dir is not None:
+        spec["checkpointDir"] = checkpoint_dir
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("jobs")
+    k.add_tpu_node("tpu-1", topology="4x4")
+    return k
+
+
+def reconcile(kube, name="tjob", ns="jobs"):
+    TPUJobReconciler(kube).reconcile(Request(ns, name))
+
+
+def set_gang_running(kube, job, *, ns="jobs"):
+    """Kubelet-sim: every expected worker pod of the CURRENT generation
+    exists and is Running/ready."""
+    name = name_of(job)
+    gen = jobapi.restarts_of(kube.get(TPUJOB, name, ns))
+    spec = jobapi.tpu_slice(job)
+    for s in range(spec.num_slices):
+        sts_name = TPUJobReconciler.slice_sts_name(name, s)
+        sts = kube.get(STATEFULSET, sts_name, ns)
+        tmpl = deep_get(sts, "spec", "template")
+        for i in range(spec.num_hosts):
+            pod_name = f"{sts_name}-{i}"
+            try:
+                kube.create({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": pod_name, "namespace": ns,
+                                 "labels": dict(deep_get(
+                                     tmpl, "metadata", "labels",
+                                     default={}) or {})},
+                    "spec": deep_get(tmpl, "spec"),
+                })
+            except errors.AlreadyExists:
+                pass
+            kube.set_pod_phase(ns, pod_name, "Running", ready=True)
+    return gen
+
+
+# -- gang creation ------------------------------------------------------------
+
+
+def test_gang_creates_one_sts_per_slice_and_coordinator_service(kube):
+    kube.create(make_job())
+    reconcile(kube)
+    # v5e 4x4 = 16 chips / 8 per host = 2 hosts per slice, 2 slices.
+    for sts_name, slice_idx in (("tjob", 0), ("tjob-s1", 1)):
+        sts = kube.get(STATEFULSET, sts_name, "jobs")
+        assert deep_get(sts, "spec", "replicas") == 2, sts_name
+        assert deep_get(sts, "spec", "podManagementPolicy") == "Parallel"
+        refs = sts["metadata"]["ownerReferences"]
+        assert refs and refs[0]["kind"] == "TPUJob"
+        labels = deep_get(sts, "spec", "template", "metadata", "labels")
+        assert labels[jobapi.LABEL_TPUJOB_NAME] == "tjob"
+        assert labels[jobapi.LABEL_TPUJOB_WORKER] == "true"
+        assert labels[jobapi.LABEL_GENERATION] == "0"
+        main = deep_get(sts, "spec", "template", "spec", "containers")[0]
+        limits = main["resources"]["limits"]
+        assert limits["google.com/tpu"] == "8"
+        selectors = deep_get(sts, "spec", "template", "spec",
+                             "nodeSelector")
+        assert selectors["cloud.google.com/gke-tpu-topology"] == "4x4"
+        env = {e["name"]: e.get("value") for e in main["env"]}
+        assert env["MEGASCALE_SLICE_ID"] == str(slice_idx)
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+    svc = kube.get(SERVICE, "tjob-workers", "jobs")
+    assert deep_get(svc, "spec", "clusterIP") == "None"
+    assert deep_get(svc, "spec", "publishNotReadyAddresses") is True
+    assert deep_get(svc, "spec", "selector") == {
+        jobapi.LABEL_TPUJOB_NAME: "tjob"}
+    # Fresh gang, no pods yet: Pending with zeroed per-slice counts.
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Pending"
+    assert deep_get(job, "status", "slices") == [
+        {"slice": 0, "ready": 0, "total": 2},
+        {"slice": 1, "ready": 0, "total": 2},
+    ]
+
+
+def test_megascale_env_roundtrips_through_dist_discovery(kube, monkeypatch):
+    """THE drift pin: inject with the controller, discover with dist.py —
+    the (coordinator, num_processes, process_id) grid must come out
+    slice-major, exactly as make_hybrid_mesh assumes."""
+    from kubeflow_tpu.parallel import dist
+
+    kube.create(make_job())
+    r = TPUJobReconciler(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    sts = r.generate_statefulset(job, slice_idx=1, generation=0)
+    env_list = deep_get(sts, "spec", "template", "spec", "containers")[0]["env"]
+    for e in env_list:
+        if "value" in e:
+            monkeypatch.setenv(e["name"], e["value"])
+    # The downward-API ordinal (valueFrom in the manifest): worker 1.
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+
+    env = dist.worker_env()
+    assert env["topology"] == "4x4"
+    assert env["num_slices"] == "2" and env["slice_id"] == "1"
+    grid = dist.process_grid()
+    assert grid is not None
+    coordinator, num_processes, process_id = grid
+    assert coordinator == (
+        "tjob-0.tjob-workers.jobs.svc.cluster.local:8476")
+    assert num_processes == 4          # 2 hosts x 2 slices
+    assert process_id == 1 * 2 + 1     # slice-major: slice 1, worker 1
+    # And the per-slice hostnames list only THIS slice's workers.
+    hosts = env["hostnames"].split(",")
+    assert hosts == [
+        "tjob-s1-0.tjob-workers.jobs.svc.cluster.local",
+        "tjob-s1-1.tjob-workers.jobs.svc.cluster.local",
+    ]
+
+
+def test_checkpoint_dir_rides_as_kft_env(kube):
+    kube.create(make_job(checkpoint_dir="/ckpt/run1"))
+    reconcile(kube)
+    sts = kube.get(STATEFULSET, "tjob", "jobs")
+    env = {e["name"]: e.get("value") for e in deep_get(
+        sts, "spec", "template", "spec", "containers")[0]["env"]}
+    assert env["KFT_CHECKPOINT_DIR"] == "/ckpt/run1"
+
+
+def test_invalid_spec_parks_degraded(kube):
+    bad = make_job(name="bad")
+    bad["spec"]["tpu"]["topology"] = "3x3"  # does not pack into v5e hosts
+    kube.create(bad)
+    reconcile(kube, "bad")
+    job = kube.get(TPUJOB, "bad", "jobs")
+    conds = {c["type"]: c for c in deep_get(
+        job, "status", "conditions", default=[])}
+    assert conds["Degraded"]["reason"] == "InvalidSpec"
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "bad", "jobs")
+
+
+def test_slice_name_conflict_parks_instead_of_fighting(kube):
+    # A sibling workload legally owns the name this job's slice 1 needs.
+    kube.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "tjob-s1", "namespace": "jobs",
+                     "labels": {"notebook-name": "tjob-s1"}},
+        "spec": {"replicas": 1},
+    })
+    kube.create(make_job())
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    conds = {c["type"]: c for c in deep_get(
+        job, "status", "conditions", default=[])}
+    assert conds["Degraded"]["reason"] == "SliceNameConflict"
+    # Nothing partial: slice 0 was NOT created either (a partial gang
+    # would hold TPU hosts forever at the barrier).
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "tjob", "jobs")
+
+
+# -- status aggregation -------------------------------------------------------
+
+
+def test_status_running_when_every_worker_ready(kube):
+    kube.create(make_job())
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    set_gang_running(kube, job)
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Running"
+    assert deep_get(job, "status", "slices") == [
+        {"slice": 0, "ready": 2, "total": 2},
+        {"slice": 1, "ready": 2, "total": 2},
+    ]
+    assert jobapi.restarts_of(job) == 0
+
+
+def test_succeeded_when_all_workers_succeed_and_chips_free(kube):
+    kube.create(make_job())
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    set_gang_running(kube, job)
+    for pod in kube.list(POD, "jobs"):
+        kube.set_pod_phase("jobs", name_of(pod), "Succeeded", ready=False)
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Succeeded"
+    # Chips freed: the gang's StatefulSets are gone; pods stay for logs.
+    for sts_name in ("tjob", "tjob-s1"):
+        with pytest.raises(errors.NotFound):
+            kube.get(STATEFULSET, sts_name, "jobs")
+    assert len(kube.list(POD, "jobs")) == 4
+    # Terminal is sticky: another reconcile does not resurrect the gang.
+    reconcile(kube)
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "tjob", "jobs")
+
+
+# -- gang restart semantics ---------------------------------------------------
+
+
+def test_worker_failure_restarts_the_whole_gang(kube):
+    kube.create(make_job(checkpoint_dir="/ckpt"))
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    set_gang_running(kube, job)
+    reconcile(kube)
+    before = metrics.tpujob_restarts_total._value.get()
+    # ONE worker of slice 1 fails: all-or-nothing semantics must condemn
+    # every slice's StatefulSet and every worker pod.
+    kube.set_pod_phase("jobs", "tjob-s1-0", "Failed")
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Restarting"
+    assert jobapi.restarts_of(job) == 1
+    assert metrics.tpujob_restarts_total._value.get() == before + 1
+    assert kube.list(POD, "jobs") == []  # old generation fully gone
+    # Next reconcile recreates the gang under generation 1.
+    reconcile(kube)
+    for sts_name in ("tjob", "tjob-s1"):
+        sts = kube.get(STATEFULSET, sts_name, "jobs")
+        assert deep_get(sts, "metadata", "annotations",
+                        "tpujobs.kubeflow.org/generation") == "1"
+    # The recreated gang converges back to Running at generation 1.
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    set_gang_running(kube, job)
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Running"
+    assert jobapi.restarts_of(job) == 1
+
+
+def test_backoff_limit_exhausted_goes_terminally_failed(kube):
+    kube.create(make_job(backoff_limit=0))
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    set_gang_running(kube, job)
+    kube.set_pod_phase("jobs", "tjob-0", "Failed")
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Failed"
+    conds = {c["type"]: c for c in deep_get(
+        job, "status", "conditions", default=[])}
+    assert conds["Failed"]["reason"] == "BackoffLimitExceeded"
+    # Chips freed, pods kept for post-mortem logs.
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "tjob", "jobs")
+    assert len(kube.list(POD, "jobs")) == 4
+    # Sticky: nothing recreates the gang.
+    reconcile(kube)
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "tjob", "jobs")
+
+
+def test_restart_policy_never_fails_on_first_worker_failure(kube):
+    kube.create(make_job(restart_policy="Never", backoff_limit=5))
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    set_gang_running(kube, job)
+    kube.set_pod_phase("jobs", "tjob-s1-1", "Failed")
+    reconcile(kube)
+    job = kube.get(TPUJOB, "tjob", "jobs")
+    assert jobapi.phase_of(job) == "Failed"
+    assert jobapi.restarts_of(job) == 0
+
+
+def test_stale_generation_pods_are_garbage_collected(kube):
+    """A straggler pod from a torn-down generation (its delete lost a
+    race) must be GC'd and never counted into the new gang's status."""
+    kube.create(make_job())
+    reconcile(kube)
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "tjob-ghost", "namespace": "jobs",
+                     "labels": {jobapi.LABEL_TPUJOB_NAME: "tjob",
+                                jobapi.LABEL_GENERATION: "7"}},
+        "spec": {"containers": [{"name": "worker"}]},
+    })
+    reconcile(kube)
+    names = {name_of(p) for p in kube.list(POD, "jobs")}
+    assert "tjob-ghost" not in names
+
+
+# -- api validation -----------------------------------------------------------
+
+
+def test_validate_rejects_bad_specs():
+    for mutate, msg in [
+        (lambda j: j["spec"].pop("tpu"), "accelerator"),
+        (lambda j: j["spec"]["tpu"].pop("accelerator"), "accelerator"),
+        (lambda j: j["spec"].update(restartPolicy="Always"),
+         "restartPolicy"),
+        (lambda j: j["spec"].update(backoffLimit=-1), "backoffLimit"),
+        (lambda j: j["spec"]["template"]["spec"].update(containers=[]),
+         "containers"),
+        (lambda j: j["metadata"].update(name="x" * 53), "52"),
+    ]:
+        job = make_job()
+        mutate(job)
+        with pytest.raises(jobapi.ValidationError, match=msg):
+            jobapi.validate(job)
+    jobapi.validate(make_job())  # the base shape is valid
+
+
+def test_pod_mapper_routes_by_job_label():
+    pod = {"metadata": {"namespace": "jobs",
+                        "labels": {jobapi.LABEL_TPUJOB_NAME: "tjob"}}}
+    assert pods_to_tpujob_requests(pod) == [Request("jobs", "tjob")]
+    assert pods_to_tpujob_requests({"metadata": {"labels": {}}}) == []
+
+
+# -- end to end with real controller threads ----------------------------------
+
+
+def test_controller_converges_with_gang_sim(kube):
+    """Full loop: controller threads + the gang sim playing kubelet — a
+    submitted job reaches Running with every slice ready, through watch
+    events alone (no reconcile_now)."""
+    from kubeflow_tpu.platform.testing.jobsim import TpuJobGangSim
+
+    sim = TpuJobGangSim(kube, "jobs")
+    ctrl = make_controller(kube)
+    ctrl.start(kube)
+    try:
+        kube.create(make_job(name="e2e-job"))
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            job = kube.get(TPUJOB, "e2e-job", "jobs")
+            if jobapi.phase_of(job) == "Running":
+                break
+            time.sleep(0.05)
+        job = kube.get(TPUJOB, "e2e-job", "jobs")
+        assert jobapi.phase_of(job) == "Running", job.get("status")
+        assert all(s["ready"] == s["total"] == 2
+                   for s in deep_get(job, "status", "slices", default=[]))
+    finally:
+        ctrl.stop()
+        sim.close()

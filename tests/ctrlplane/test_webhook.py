@@ -129,6 +129,96 @@ def test_tpu_pod_default_injects_runtime_env():
     assert {"name": "tpu-shm", "mountPath": "/dev/shm"} in mounts
 
 
+def _tpujob_worker_pod():
+    """A TPUJob-shaped worker pod exactly as the controller generates it:
+    template labels (incl. the tpujob-worker selector label) + the
+    injected TPU_*/MEGASCALE_* env."""
+    from kubeflow_tpu.platform.controllers.tpujob import TPUJobReconciler
+    from kubeflow_tpu.platform.k8s.types import deep_get
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    job = {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "train", "namespace": "user1"},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "4x4", "slices": 2},
+            "template": {"spec": {"containers": [
+                {"name": "worker", "image": "trainer"}]}},
+        },
+    }
+    sts = TPUJobReconciler(FakeKube()).generate_statefulset(
+        job, slice_idx=1, generation=0)
+    tmpl = deep_get(sts, "spec", "template")
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "train-s1-0", "namespace": "user1",
+                     "labels": dict(deep_get(tmpl, "metadata", "labels"))},
+        "spec": deep_get(tmpl, "spec"),
+    }
+
+
+def _tpujob_manifest_pod_default():
+    import pathlib
+
+    import yaml
+
+    path = (pathlib.Path(__file__).resolve().parents[2]
+            / "manifests" / "tpujob-poddefault.yaml")
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def test_tpujob_pod_default_layers_env_without_clobbering_megascale():
+    """The shipped manifests/tpujob-poddefault.yaml applies to a
+    controller-generated TPUJob worker pod: libtpu/JAX env layered on,
+    the controller-injected MEGASCALE/TPU env byte-identical after the
+    merge (ISSUE 10 satellite)."""
+    pod = _tpujob_worker_pod()
+    pd = _tpujob_manifest_pod_default()
+    before = {e["name"]: e for e in pod["spec"]["containers"][0]["env"]}
+    assert safe_to_apply(pod, [pd]) is None
+    out = apply_pod_defaults(pod, [pd])
+    env = {e["name"]: e for e in out["spec"]["containers"][0]["env"]}
+    # Layered by the PodDefault...
+    assert env["JAX_PLATFORMS"]["value"] == "tpu,cpu"
+    assert env["TPU_PREMAPPED_BUFFER_SIZE"]["value"] == "17179869184"
+    mounts = out["spec"]["containers"][0]["volumeMounts"]
+    assert {"name": "tpu-shm", "mountPath": "/dev/shm"} in mounts
+    # ...with every controller-injected variable untouched.
+    for name in ("MEGASCALE_SLICE_ID", "MEGASCALE_NUM_SLICES",
+                 "MEGASCALE_COORDINATOR_ADDRESS", "TPU_TOPOLOGY",
+                 "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID"):
+        assert env[name] == before[name], name
+    anns = out["metadata"]["annotations"]
+    assert any("tpujob-worker-runtime" in k for k in anns), anns
+
+
+def test_tpujob_pod_default_megascale_conflict_is_rejected():
+    """A PodDefault that names a controller-owned MEGASCALE variable with
+    a DIFFERENT value must hit the MergeConflict path: the webhook skips
+    the whole merge (pod admitted unmutated) instead of silently
+    rewriting the gang's cross-slice identity."""
+    pod = _tpujob_worker_pod()
+    evil = {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "PodDefault",
+        "metadata": {"name": "evil", "namespace": "user1",
+                     "resourceVersion": "9"},
+        "spec": {"selector": {"matchLabels": {"tpujob-worker": "true"}},
+                 "env": [{"name": "MEGASCALE_NUM_SLICES", "value": "99"}]},
+    }
+    msg = safe_to_apply(pod, [evil])
+    assert msg and "MEGASCALE_NUM_SLICES" in msg
+    with pytest.raises(MergeConflict):
+        apply_pod_defaults(pod, [evil])
+    review = {"request": {
+        "uid": "u-tpujob", "namespace": "user1",
+        "resource": {"resource": "pods"}, "object": pod,
+    }}
+    out = mutate_admission_review(review, [evil])
+    assert out["response"]["allowed"]
+    assert "patch" not in out["response"]
+
+
 def test_admission_review_roundtrip():
     pod = make_pod(labels={"use-pd": "true"})
     review = {

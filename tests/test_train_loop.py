@@ -66,6 +66,60 @@ def test_loop_checkpoint_resume(tmp_path):
     assert not jnp.allclose(restored_leaf, fresh_leaf, atol=1e-6)
 
 
+def test_sigterm_mid_loop_saves_restorable_checkpoint(tmp_path):
+    """Graceful preemption (ISSUE 10 satellite): run.py's SIGTERM handler
+    sets the loop's stop event; the loop exits between steps and its
+    finally block force-saves + waits — a real signal mid-loop must leave
+    a checkpoint a fresh process can resume from, even when the save
+    interval alone would never have written one."""
+    import signal
+    import threading
+
+    from kubeflow_tpu.train.run import install_preemption_handler
+
+    ckpt = str(tmp_path / "preempted")
+    stop = threading.Event()
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    assert install_preemption_handler(stop) is True
+
+    def on_log(step, vals):
+        # Deliver a REAL signal mid-run (handler runs in this main
+        # thread at the next bytecode boundary — exactly a preemption).
+        signal.raise_signal(signal.SIGTERM)
+
+    state, _ = tiny_state()
+    step_fn = jax.jit(make_lm_train_step())
+    # checkpoint_every is huge: the ONLY checkpoint can come from the
+    # preemption save in the loop's finally block.
+    try:
+        state, _ = train_loop(
+            state, step_fn, batches(),
+            LoopConfig(total_steps=500, log_every=3, checkpoint_dir=ckpt,
+                       checkpoint_every=10_000),
+            on_log=on_log, stop=stop,
+        )
+    finally:
+        # Don't leak the handler into the rest of the pytest process: a
+        # runner's graceful-shutdown SIGTERM would be silently swallowed.
+        signal.signal(signal.SIGTERM, prev_handler)
+    assert stop.is_set()
+    stopped_at = int(state.step)
+    assert 0 < stopped_at < 500  # preempted mid-loop, not ran out
+    trained_leaf = jax.tree_util.tree_leaves(state.params)[0]
+
+    # "The gang's next generation": fresh init, same dir — must resume
+    # from the preemption checkpoint, not from scratch.
+    state2, _ = tiny_state(seed=99)
+    state2, _ = train_loop(
+        state2, step_fn, batches(),
+        LoopConfig(total_steps=stopped_at + 2, log_every=0,
+                   checkpoint_dir=ckpt, checkpoint_every=10_000),
+    )
+    assert int(state2.step) == stopped_at + 2
+    fresh_leaf = jax.tree_util.tree_leaves(tiny_state(seed=99)[0].params)[0]
+    assert not jnp.allclose(trained_leaf, fresh_leaf, atol=1e-6)
+
+
 def test_grad_accum_matches_full_batch_step():
     """Mean-of-microbatch-grads == full-batch grad (equal microbatches), so
     the accumulated step must match the plain step bit-for-bit-ish."""
