@@ -41,6 +41,7 @@ REQUIRED_METRICS = {
     "ctrlplane_sharded_converge_s",
     "ctrlplane_sharded_replica_load",
     "ctrlplane_fleet_churn",
+    "tpujob_queue_decisions_per_s",
 }
 # Metrics whose full-run lines are banded; at smoke N they must still
 # carry the self-report fields so trending tooling never hits a gap.
@@ -52,6 +53,7 @@ BANDED_METRICS = {
     "ctrlplane_chaos_converge_s",
     "ctrlplane_sharded_converge_s",
     "ctrlplane_sharded_replica_load",
+    "tpujob_queue_decisions_per_s",
 }
 
 
@@ -204,6 +206,15 @@ def main() -> int:
         if not isinstance(load.get(key), list) or not load[key]:
             print(f"sharded load line missing {key}", file=sys.stderr)
             return 1
+    # TPUJob queue band (ISSUE 11): the decision loop must actually have
+    # decided — a zero count means the drain silently stopped exercising
+    # the ledger.
+    jobq = seen["tpujob_queue_decisions_per_s"]
+    if not (isinstance(jobq.get("decisions"), int)
+            and jobq["decisions"] > 0 and jobq.get("value", 0) > 0):
+        print(f"jobqueue line missing/zero decisions: {jobq}",
+              file=sys.stderr)
+        return 1
     print(f"bench-smoke ctrlplane OK: {len(seen)} metrics "
           f"({', '.join(sorted(seen))})")
     return check_compute_bench()

@@ -257,17 +257,22 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
-    # TPUJob presubmit lane (ISSUE 10): the gang reconciler + API matrix
-    # (gang creation, MEGASCALE round-trip vs parallel/dist.py, restart/
-    # backoff semantics, CRD yaml-vs-api pin) plus the storm invariants on
-    # every change to the controller, the env contract, or the trainer
-    # pieces the gang resumes through.  The tpujob-train-converge
-    # conformance check (gang submit → mid-run kill → checkpoint-resume →
-    # Succeeded) rides the existing `conformance` postsubmit lane, whose
-    # kubeflow_tpu/* + conformance/* globs already cover this subsystem.
+    # TPUJob presubmit lane (ISSUE 10, extended by ISSUE 11): the gang
+    # reconciler + API matrix (gang creation, MEGASCALE round-trip vs
+    # parallel/dist.py, restart/backoff semantics, CRD yaml-vs-api pin,
+    # the queue validation matrix) PLUS the fast queue/preemption matrix
+    # (ledger units, park-with-reason, priority-then-FIFO drain, the
+    # two-phase checkpoint-then-evict, elastic admit + grow-back,
+    # crashloop-cannot-starve) on every change to the controller, the
+    # queue, the env contract, or the trainer pieces the gang resumes
+    # through.  The tpujob-train-converge and queue-preempt-elastic
+    # conformance checks ride the existing `conformance` postsubmit
+    # lane, whose kubeflow_tpu/* + conformance/* globs already cover
+    # this subsystem.
     name="tpujob",
     include_dirs=[
         "kubeflow_tpu/platform/controllers/*", "kubeflow_tpu/platform/apis/*",
+        "kubeflow_tpu/platform/runtime/*",
         "kubeflow_tpu/parallel/envspec.py", "kubeflow_tpu/parallel/dist.py",
         "kubeflow_tpu/train/*", "kubeflow_tpu/platform/testing/*",
         "manifests/*", "releasing/*",
@@ -277,8 +282,31 @@ _register(ComponentWorkflow(
             "tests/ctrlplane/test_tpujob_controller.py",
             "tests/ctrlplane/test_manifests.py",
         )),
+        Step("queue", _pytest("tests/ctrlplane/test_jobqueue.py")
+             + ["-m", "not slow"], depends="unit"),
         Step("storm", _pytest("tests/ctrlplane/test_chaos.py")
-             + ["-m", "not slow", "-k", "tpujob"], depends="unit"),
+             + ["-m", "not slow", "-k", "tpujob"], depends="queue"),
+    ],
+))
+
+_register(ComponentWorkflow(
+    # queue-chaos postsubmit lane (ISSUE 11): the queue's heavy invariant
+    # pins — a 9-job priority storm under seeded faults into a 2-slot
+    # budget (drain order, zero dead-letters, zero half-gangs) and the
+    # ShardedFleet replica kill mid-drain (survivor preserves
+    # priority-then-FIFO order with every write fenced).
+    name="queue-chaos",
+    include_dirs=[
+        "kubeflow_tpu/platform/controllers/*", "kubeflow_tpu/platform/apis/*",
+        "kubeflow_tpu/platform/runtime/*",
+        "kubeflow_tpu/platform/testing/*", "releasing/*",
+    ],
+    job_types=["postsubmit"],
+    steps=[
+        Step("fast-matrix", _pytest("tests/ctrlplane/test_jobqueue.py")
+             + ["-m", "not slow"]),
+        Step("storm-and-kill", _pytest("tests/ctrlplane/test_jobqueue.py")
+             + ["-m", "slow"], depends="fast-matrix"),
     ],
 ))
 

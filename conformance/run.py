@@ -414,7 +414,10 @@ def tpujob_train_converge():
 
     kube = FakeKube()
     kube.add_namespace("train")
-    kube.add_tpu_node("tpu-train-1", topology="4x4")
+    # 4 hosts of 4x4 (2 hosts/slice) = 2 slice slots: the 2-slice gang
+    # fits whole under the capacity-gated admission queue.
+    for i in range(4):
+        kube.add_tpu_node(f"tpu-train-{i + 1}", topology="4x4")
     ckpt = tempfile.mkdtemp(prefix="tpujob-ckpt-")
     histories = []
     mid_run = threading.Event()
@@ -525,6 +528,280 @@ def tpujob_train_converge():
     assert resumed[-1]["step"] == 24, resumed[-1]
     assert resumed[-1]["loss"] < first_gen[0]["loss"], (
         first_gen[0]["loss"], resumed[-1]["loss"])
+
+
+@check("tpujob-queue-preempt-elastic")
+def tpujob_queue_preempt_elastic():
+    """ISSUE 11 acceptance: three profiles submit six TPUJobs into a
+    4-slice budget under a seeded ChaosKube storm.  The queue must drain
+    in priority-then-FIFO order; one high-priority job preempts the
+    low-priority gang, which checkpoint-saves through the REAL train
+    loop, resumes elastically at minSlices, and grows back to its full
+    slices after the preemptor finishes — never a half-admitted gang,
+    zero lost jobs, zero duplicate gangs, zero dead-letters.  (The
+    replica-kill half of the invariant set is pinned by
+    tests/ctrlplane/test_jobqueue.py::
+    test_sharded_replica_kill_preserves_drain_order.)"""
+    import dataclasses
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+
+    from kubeflow_tpu.platform.apis import tpujob as jobapi
+    from kubeflow_tpu.platform.controllers import tpujob as jobctrl
+    from kubeflow_tpu.platform.k8s.types import STATEFULSET, TPUJOB, deep_get
+    from kubeflow_tpu.platform.runtime.controller import make_workqueue
+    from kubeflow_tpu.platform.testing import FakeKube
+    from kubeflow_tpu.platform.testing.chaos import ChaosKube, storm
+    from kubeflow_tpu.platform.testing.jobsim import TpuJobGangSim
+
+    kube = FakeKube()
+    for ns in ("team-a", "team-b", "team-c"):
+        kube.add_namespace(ns)
+        kube.create({
+            "apiVersion": "v1", "kind": "ResourceQuota",
+            "metadata": {"name": "kf-resource-quota", "namespace": ns},
+            "spec": {"hard": {"google.com/tpu": "32"}},
+        })
+    # The 4-slice budget: 4 single-host v5e 2x4 nodes.
+    for i in range(4):
+        kube.add_tpu_node(f"tpu-q-{i + 1}", topology="2x4")
+    ckpt = tempfile.mkdtemp(prefix="tpujob-elastic-ckpt-")
+    histories = []
+    parked = {0: threading.Event(), 1: threading.Event()}
+    done = {name: threading.Event()
+            for name in ("mid", "q1", "q2", "q3", "high")}
+
+    def train_low(job_name, generation, stop):
+        # The preemption victim trains the REAL loop: generation 0 parks
+        # mid-run awaiting the eviction, generation 1 (elastic, 1 slice)
+        # parks awaiting the grow-back, generation 2 runs to completion.
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from kubeflow_tpu.models.llama import CONFIGS, Llama
+        from kubeflow_tpu.train import create_train_state, make_lm_train_step
+        from kubeflow_tpu.train.loop import LoopConfig, train_loop
+
+        cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=32)
+        model = Llama(cfg)
+        tokens = jnp.ones((4, 32), jnp.int32)
+        state = create_train_state(
+            jax.random.key(generation), model, tokens, optax.adamw(1e-3))
+        step_fn = jax.jit(make_lm_train_step())
+
+        def batches(start=0):
+            def gen():
+                i = start
+                while True:
+                    yield jax.random.randint(
+                        jax.random.fold_in(jax.random.key(7), i),
+                        (4, 32), 0, cfg.vocab_size)
+                    i += 1
+            return gen()
+
+        def on_log(s, vals):
+            if generation in parked and (generation > 0 or s >= 8):
+                parked[generation].set()
+                stop.wait(60)
+
+        _, history = train_loop(
+            state, step_fn, batches,
+            LoopConfig(total_steps=24, log_every=4,
+                       checkpoint_dir=ckpt, checkpoint_every=4),
+            on_log=on_log, stop=stop)
+        histories.append(history)
+
+    def gated(name):
+        def work(job_name, generation, stop):
+            done[name].wait(120)
+        return work
+
+    def team_work(mapping):
+        def work(job_name, generation, stop):
+            return mapping[job_name](job_name, generation, stop)
+        return work
+
+    sims = [
+        TpuJobGangSim(kube, "team-a", work=team_work(
+            {"low": train_low, "q1": gated("q1")})),
+        TpuJobGangSim(kube, "team-b", work=team_work(
+            {"mid": gated("mid"), "q2": gated("q2")})),
+        TpuJobGangSim(kube, "team-c", work=team_work(
+            {"q3": gated("q3"), "high": gated("high")})),
+    ]
+    # Seeded storm on the controller's entire apiserver path; the sims
+    # keep talking to the healthy store (only the control plane flakes).
+    chaos = ChaosKube(kube, storm(rate=0.03, max_injections=60),
+                      seed=20260811)
+    ctrl = jobctrl.make_controller(chaos, preemption_grace=1.0,
+                                   queue_poll=0.2)
+    ctrl.queue = make_workqueue(base_delay=0.05, max_delay=2.0)
+
+    admissions = []       # (name, generation) on first sight admitted
+    sts_events = []       # (etype, name, generation-label)
+    stop_watch = threading.Event()
+
+    def job_watch():
+        seen = set()
+        for _etype, job in kube.watch(TPUJOB, None, stop=stop_watch):
+            if jobapi.allocated_slices(job) is not None:
+                key = (job["metadata"]["name"], jobapi.generation_of(job))
+                if key not in seen:
+                    seen.add(key)
+                    admissions.append(key)
+
+    def sts_watch():
+        for etype, sts in kube.watch(STATEFULSET, None, stop=stop_watch):
+            labels = deep_get(sts, "metadata", "labels", default={}) or {}
+            sts_events.append((etype, sts["metadata"]["name"],
+                               labels.get(jobapi.LABEL_GENERATION)))
+
+    for fn in (job_watch, sts_watch):
+        threading.Thread(target=fn, daemon=True).start()
+    ctrl.start(chaos)
+
+    def wait(fn, what, timeout=90.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if fn():
+                return
+            _time.sleep(0.05)
+        raise TimeoutError(f"tpujob-queue conformance: timed out on {what}")
+
+    def job(name, ns):
+        return kube.get(TPUJOB, name, ns)
+
+    def submit(name, ns, *, priority, slices, min_slices=None, ckpt_dir=None):
+        spec = {
+            "tpu": {"accelerator": "v5e", "topology": "2x4",
+                    "slices": slices},
+            "template": {"spec": {"containers": [{
+                "name": "worker", "image": "trainer",
+                "command": ["python", "-m", "kubeflow_tpu.train.run"],
+            }]}},
+            "priority": priority,
+        }
+        if min_slices is not None:
+            spec["tpu"]["minSlices"] = min_slices
+        if ckpt_dir is not None:
+            spec["checkpointDir"] = ckpt_dir
+        kube.create({
+            "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": ns}, "spec": spec,
+        })
+
+    try:
+        # Phase 1 — fill the budget: low (3 slices, elastic to 1) + mid.
+        # Priorities: only high (500) outranks low (150) — the parked
+        # jobs (100-120) must WAIT behind the running fleet, not preempt
+        # it themselves.
+        submit("low", "team-a", priority=150, slices=3, min_slices=1,
+               ckpt_dir=ckpt)
+        wait(lambda: jobapi.phase_of(job("low", "team-a")) == "Running",
+             "low Running")
+        wait(parked[0].is_set, "low mid-run")
+        submit("mid", "team-b", priority=300, slices=1)
+        wait(lambda: jobapi.phase_of(job("mid", "team-b")) == "Running",
+             "mid Running")
+        # Phase 2 — the queue forms: q3 outranks q1/q2; FIFO inside 100.
+        submit("q3", "team-c", priority=120, slices=1)
+        submit("q1", "team-a", priority=100, slices=1)
+        submit("q2", "team-b", priority=100, slices=1)
+        for name, ns in (("q3", "team-c"), ("q1", "team-a"),
+                         ("q2", "team-b")):
+            wait(lambda n=name, s=ns:
+                 jobapi.phase_of(job(n, s)) == "Queued",
+                 f"{name} Queued")
+        # Phase 3 — the preemptor: high (500) needs 3 slices.  Victim
+        # selection is lowest-priority-first and MINIMAL: low (150)
+        # alone frees 3 slices, so mid (300) is never touched.
+        submit("high", "team-c", priority=500, slices=3)
+        wait(lambda: jobapi.phase_of(job("low", "team-a")) == "Queued",
+             "low preempted after checkpoint")
+        wait(lambda: (jobapi.phase_of(job("high", "team-c")) == "Running"
+                      and jobapi.allocated_slices(
+                          job("high", "team-c")) == 3),
+             "high admitted whole")
+        assert jobapi.phase_of(job("mid", "team-b")) == "Running"
+        # Phase 4 — elastic resume: mid finishes, freeing ONE slice; the
+        # re-queued low (150) is the head and re-admits at minSlices=1,
+        # its REAL train loop restoring the checkpoint.
+        done["mid"].set()
+        wait(lambda: (jobapi.allocated_slices(job("low", "team-a")) == 1
+                      and jobapi.phase_of(
+                          job("low", "team-a")) == "Running"),
+             "low resumed elastically at 1 slice")
+        wait(parked[1].is_set, "low gen-1 mid-run")
+        sts = kube.get(STATEFULSET, "low", "team-a")
+        env = {e["name"]: e.get("value") for e in deep_get(
+            sts, "spec", "template", "spec", "containers")[0]["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "1", env
+        assert env["KFT_SPEC_SLICES"] == "3", env
+        # Phase 5 — the preemptor finishes; the rest of the queue drains
+        # in rank order (q3 before the FIFO pair q1, q2).
+        done["high"].set()
+        wait(lambda: jobapi.phase_of(job("q3", "team-c")) == "Running",
+             "q3 admitted")
+        done["q3"].set()
+        wait(lambda: jobapi.phase_of(job("q1", "team-a")) == "Running",
+             "q1 admitted")
+        done["q1"].set()
+        wait(lambda: jobapi.phase_of(job("q2", "team-b")) == "Running",
+             "q2 admitted")
+        done["q2"].set()
+        # Phase 6 — with the queue empty, low grows back to its full 3
+        # slices via a graceful checkpoint-restart and completes.
+        wait(lambda: jobapi.allocated_slices(job("low", "team-a")) == 3,
+             "low grown back to 3 slices", timeout=120.0)
+        wait(lambda: jobapi.phase_of(job("low", "team-a")) == "Succeeded",
+             "low Succeeded", timeout=180.0)
+    finally:
+        stop_watch.set()
+        ctrl.stop()
+        for sim in sims:
+            sim.close()
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+    for sim in sims:
+        assert not sim.errors, sim.errors
+    # Drain order: priority-then-FIFO across the three profiles — the
+    # re-queued low (150) resumes ahead of the 100-120 band, the band
+    # drains q3-first then FIFO, and low's grow-back is the final gang.
+    assert admissions == [
+        ("low", 0), ("mid", 0), ("high", 0), ("low", 1), ("q3", 0),
+        ("q1", 0), ("q2", 0), ("low", 2),
+    ], admissions
+    # Never half-admitted: high's first StatefulSet appears only AFTER
+    # every generation-0 low StatefulSet was torn down (the checkpoint
+    # eviction completed first).
+    high_first = min(i for i, (e, n, _g) in enumerate(sts_events)
+                     if n.startswith("high") and e == "ADDED")
+    low_gen0_deletes = [i for i, (e, n, g) in enumerate(sts_events)
+                        if n.startswith("low") and e == "DELETED"
+                        and g == "0"]
+    assert len(low_gen0_deletes) >= 3, sts_events
+    assert sorted(low_gen0_deletes)[2] < high_first, (
+        low_gen0_deletes, high_first)
+    # The victim really resumed: three generations of the real train
+    # loop, monotonically advancing steps, loss improved end to end.
+    assert len(histories) == 3, [len(h) for h in histories]
+    gen0, gen1, gen2 = histories
+    assert gen1[0]["step"] > gen0[0]["step"], (gen0[0], gen1[0])
+    assert gen2[-1]["step"] == 24, gen2[-1]
+    assert gen2[-1]["loss"] < gen0[0]["loss"], (gen0[0], gen2[-1])
+    final = job("low", "team-a")
+    assert jobapi.restarts_of(final) == 0, final.get("status")  # no failures
+    assert jobapi.generation_of(final) == 2, final.get("status")
+    # Zero lost jobs / duplicate gangs / dead-letters under the storm.
+    assert not ctrl.dead_letters
+    for ns in ("team-a", "team-b", "team-c"):
+        for j in kube.list(TPUJOB, ns):
+            assert jobapi.phase_of(j) == "Succeeded", (
+                j["metadata"]["name"], j.get("status"))
+    assert chaos.injected() > 0, "the storm never stormed"
 
 
 @check("api-authn-authz")

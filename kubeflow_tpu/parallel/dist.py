@@ -39,6 +39,23 @@ def slice_id() -> int:
     return int(worker_env()["slice_id"] or 0)
 
 
+def elastic_slices() -> tuple:
+    """(allocated, declared) slice counts for elastic TPUJob gangs.
+
+    The TPUJob queue admits a gang at ``allocated <= spec.tpu.slices``
+    slices (down to ``minSlices``) and injects the GRANTED width as
+    MEGASCALE_NUM_SLICES — so ``process_grid`` above already remaps the
+    dcn(dp) axis to the shrunk world size and the same checkpoint resumes
+    at fewer slices with zero trainer changes.  This helper exposes the
+    declared width (KFT_SPEC_SLICES) next to it so a trainer can log or
+    export "running shrunk at k/N"; outside a queue-admitted gang the two
+    are equal."""
+    env = worker_env()
+    allocated = int(env["num_slices"] or 1)
+    declared = int(env["spec_slices"] or allocated)
+    return allocated, declared
+
+
 def process_grid(
     env: Optional[dict] = None, *,
     coordinator_port: int = DEFAULT_COORDINATOR_PORT,

@@ -35,6 +35,13 @@ ENV_MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
 # Checkpoint directory a TPUJob's gang resumes from (train/run.py reads it
 # as the --checkpoint-dir default; docs/jobs.md "checkpoint-resume").
 ENV_KFT_CHECKPOINT_DIR = "KFT_CHECKPOINT_DIR"
+# Elastic capacity (docs/jobs.md "Queueing, priority, and preemption"):
+# MEGASCALE_NUM_SLICES always carries the GRANTED gang width, so
+# ``dist.process_grid`` remaps the dcn(dp) axis for free when a preempted
+# or shrunk gang resumes at fewer slices.  KFT_SPEC_SLICES rides along
+# with the job's FULL spec.tpu.slices so the trainer can tell it is
+# running shrunk (``dist.elastic_slices``) and log/export it.
+ENV_KFT_SPEC_SLICES = "KFT_SPEC_SLICES"
 
 # The jax.distributed rendezvous port — what dist.initialize dials and the
 # controllers' headless coordinator Services expose.  Lives here (not in
@@ -81,6 +88,15 @@ def megascale_env(slice_id: int, num_slices: int,
     ]
 
 
+def elastic_env(spec_slices: int) -> List[dict]:
+    """The elastic-capacity marker a controller injects next to the
+    MEGASCALE block: the job's full DECLARED width (the granted width is
+    already MEGASCALE_NUM_SLICES via ``megascale_env``), so a shrunk
+    gang's trainer knows ``allocated < spec`` (discovery:
+    ``worker_env_from``'s ``spec_slices`` / ``dist.elastic_slices``)."""
+    return [{"name": ENV_KFT_SPEC_SLICES, "value": str(spec_slices)}]
+
+
 def worker_env_from(environ: Dict[str, str]) -> Dict[str, Optional[str]]:
     """Parse the injected contract out of an environ mapping — the ONE
     discovery implementation (dist.worker_env binds it to os.environ)."""
@@ -93,4 +109,5 @@ def worker_env_from(environ: Dict[str, str]) -> Dict[str, Optional[str]]:
         "num_slices": environ.get(ENV_MEGASCALE_NUM_SLICES),
         "slice_id": environ.get(ENV_MEGASCALE_SLICE_ID),
         "coordinator": environ.get(ENV_MEGASCALE_COORDINATOR_ADDRESS),
+        "spec_slices": environ.get(ENV_KFT_SPEC_SLICES),
     }

@@ -11,15 +11,23 @@ same ``spec.tpu`` vocabulary Notebooks use (ROADMAP item 4).
         accelerator: v5e        # key into platform.tpu.ACCELERATORS
         topology: "4x4"         # optional; accelerator default otherwise
         slices: 2               # DCN-joined ICI slices (default 1)
+        minSlices: 1            # elastic floor: may run at fewer slices
+                                # (default = slices: the gang is rigid)
       template:
         spec: {containers: [...]}   # worker PodSpec; containers[0] trains
       restartPolicy: OnFailure  # or Never
       backoffLimit: 3           # max whole-gang restarts before Failed
+      priority: 100             # queue rank; higher preempts lower (>= 1)
       checkpointDir: gs://...   # injected as KFT_CHECKPOINT_DIR; a
                                 # restarted gang resumes from its latest step
     status:
-      phase: Pending|Running|Restarting|Succeeded|Failed
-      restarts: int             # gang generations consumed
+      phase: Pending|Queued|Running|Restarting|Preempting|Succeeded|Failed
+      restarts: int             # FAILURE restarts consumed (backoffLimit)
+      generation: int           # gang generations (restarts + resizes +
+                                # preemption re-admissions)
+      allocatedSlices: int      # granted gang width while holding chips
+      reason: str               # structured queue reason (REASON column)
+      queuedAt: float           # epoch secs of the last Queued transition
       slices: [{slice, ready, total}]
       conditions: [...]
 
@@ -49,13 +57,23 @@ LABEL_GENERATION = "tpujob-generation"
 
 RESTART_POLICIES = ("OnFailure", "Never")
 DEFAULT_BACKOFF_LIMIT = 3
+# Queue rank when spec.priority is unset; explicit priorities must be >= 1
+# (validated at admission — a non-positive priority parks Degraded).
+DEFAULT_PRIORITY = 100
 
 PHASE_PENDING = "Pending"
+PHASE_QUEUED = "Queued"
 PHASE_RUNNING = "Running"
 PHASE_RESTARTING = "Restarting"
+PHASE_PREEMPTING = "Preempting"
 PHASE_SUCCEEDED = "Succeeded"
 PHASE_FAILED = "Failed"
 TERMINAL_PHASES = (PHASE_SUCCEEDED, PHASE_FAILED)
+# Phases in which a job HOLDS its allocated chips (the jobqueue ledger
+# charges status.allocatedSlices against quota + topology capacity);
+# Queued/terminal jobs hold nothing.
+HOLDING_PHASES = (PHASE_PENDING, PHASE_RUNNING, PHASE_RESTARTING,
+                  PHASE_PREEMPTING)
 
 
 class ValidationError(ValueError):
@@ -84,8 +102,27 @@ def validate(job: Resource) -> None:
             f"spec.restartPolicy must be one of {RESTART_POLICIES}, "
             f"got {policy!r}")
     backoff = deep_get(job, "spec", "backoffLimit")
-    if backoff is not None and (not isinstance(backoff, int) or backoff < 0):
+    if backoff is not None and (not isinstance(backoff, int)
+                                or isinstance(backoff, bool) or backoff < 0):
         raise ValidationError("spec.backoffLimit must be a non-negative integer")
+    priority = deep_get(job, "spec", "priority")
+    if priority is not None and (not isinstance(priority, int)
+                                 or isinstance(priority, bool)
+                                 or priority < 1):
+        raise ValidationError(
+            f"spec.priority must be a positive integer, got {priority!r}")
+    min_slices = deep_get(job, "spec", "tpu", "minSlices")
+    if min_slices is not None:
+        if (not isinstance(min_slices, int) or isinstance(min_slices, bool)
+                or min_slices < 1):
+            raise ValidationError(
+                f"spec.tpu.minSlices must be a positive integer, "
+                f"got {min_slices!r}")
+        slices = int(tpu.get("slices") or 1)
+        if slices < min_slices:
+            raise ValidationError(
+                f"spec.tpu.slices ({slices}) must be >= spec.tpu.minSlices "
+                f"({min_slices})")
 
 
 def tpu_slice(job: Resource) -> SliceSpec:
@@ -118,6 +155,21 @@ def checkpoint_dir(job: Resource) -> Optional[str]:
     return deep_get(job, "spec", "checkpointDir") or None
 
 
+def priority_of(job: Resource) -> int:
+    p = deep_get(job, "spec", "priority")
+    return DEFAULT_PRIORITY if p is None else int(p)
+
+
+def min_slices_of(job: Resource) -> int:
+    """Elastic floor: the fewest slices the gang may run at.  Defaults to
+    ``spec.tpu.slices`` — a job that never declared elasticity is rigid."""
+    m = deep_get(job, "spec", "tpu", "minSlices")
+    if m is None:
+        tpu = deep_get(job, "spec", "tpu", default={}) or {}
+        return int(tpu.get("slices") or 1)
+    return int(m)
+
+
 def phase_of(job: Resource) -> str:
     return deep_get(job, "status", "phase", default=PHASE_PENDING) \
         or PHASE_PENDING
@@ -125,6 +177,30 @@ def phase_of(job: Resource) -> str:
 
 def restarts_of(job: Resource) -> int:
     return int(deep_get(job, "status", "restarts", default=0) or 0)
+
+
+def generation_of(job: Resource) -> int:
+    """Gang generation (the label stamped on every generation's
+    StatefulSets/pods).  Distinct from ``restarts`` since the queue PR:
+    failure restarts bump BOTH, but a preemption re-admission or an
+    elastic resize bumps only the generation — they are not failures and
+    must never eat into ``backoffLimit``."""
+    gen = deep_get(job, "status", "generation")
+    if gen is None:
+        return restarts_of(job)
+    return int(gen)
+
+
+def allocated_slices(job: Resource) -> Optional[int]:
+    """Granted gang width while the job holds chips (set at admission,
+    cleared when a preemption completes); None = not admitted."""
+    alloc = deep_get(job, "status", "allocatedSlices")
+    return None if alloc is None else int(alloc)
+
+
+def queued_at(job: Resource) -> Optional[float]:
+    t = deep_get(job, "status", "queuedAt")
+    return None if t is None else float(t)
 
 
 def crd_manifest() -> Resource:
@@ -144,6 +220,20 @@ def crd_manifest() -> Resource:
                 "served": True,
                 "storage": True,
                 "subresources": {"status": {}},
+                # `kubectl get tpujobs` shows the queue state at a glance
+                # (PHASE/PRIORITY/SLICES/REASON/AGE — docs/jobs.md).
+                "additionalPrinterColumns": [
+                    {"name": "Phase", "type": "string",
+                     "jsonPath": ".status.phase"},
+                    {"name": "Priority", "type": "integer",
+                     "jsonPath": ".spec.priority"},
+                    {"name": "Slices", "type": "integer",
+                     "jsonPath": ".status.allocatedSlices"},
+                    {"name": "Reason", "type": "string",
+                     "jsonPath": ".status.reason"},
+                    {"name": "Age", "type": "date",
+                     "jsonPath": ".metadata.creationTimestamp"},
+                ],
                 "schema": {"openAPIV3Schema": {
                     "type": "object",
                     "properties": {
@@ -159,6 +249,8 @@ def crd_manifest() -> Resource:
                                         "topology": {"type": "string"},
                                         "slices": {"type": "integer",
                                                    "minimum": 1},
+                                        "minSlices": {"type": "integer",
+                                                      "minimum": 1},
                                     },
                                 },
                                 "template": {
@@ -172,6 +264,8 @@ def crd_manifest() -> Resource:
                                 },
                                 "backoffLimit": {"type": "integer",
                                                  "minimum": 0},
+                                "priority": {"type": "integer",
+                                             "minimum": 1},
                                 "checkpointDir": {"type": "string"},
                             },
                         },

@@ -25,6 +25,23 @@ multi-slice ``jax.distributed`` job actually needs:
 * **Status aggregation** — Pending → Running → Succeeded/Failed/Restarting
   with per-slice ready counts and the restart counter, computed from pod
   phases read through the shard-filterable informer caches.
+* **Quota-aware gang queueing** (ROADMAP item 4, the multi-tenant PR) —
+  admission is a queue decision over the ``runtime/jobqueue.py`` capacity
+  ledger (free chips per profile quota + free topology slots): a gang that
+  does not fit WHOLE parks ``Queued`` with a structured ``Unschedulable``
+  reason instead of racing its siblings for chips; the queue drains in
+  priority-then-FIFO order.  ``spec.priority`` adds preemption: a
+  higher-priority head waiter makes the lowest-priority running gang
+  checkpoint-then-evict over the PR-9 SIGTERM path — two-phase (mark
+  ``Preempting``, wait out the checkpoint grace, then free), and the
+  preemptor is never half-admitted.  ``spec.tpu.minSlices`` adds elastic
+  capacity: a preempted/shrunk gang resumes the SAME checkpoint at fewer
+  slices (the granted width rides as MEGASCALE_NUM_SLICES, so
+  ``dist.process_grid`` remaps the dcn(dp) axis for free) and grows back
+  when capacity frees.  All decisions are pure functions of watch state —
+  under sharded HA every replica computes the same schedule from the
+  unsharded queue feed and acts only on owned keys (a victim preempts
+  ITSELF; there are no cross-key writes to fence).
 
 Terminal phases are sticky, and a finished gang's StatefulSets are deleted
 so the chips free immediately (pods are left for log retrieval, like a
@@ -32,6 +49,7 @@ completed Job's).
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 from kubeflow_tpu.parallel import envspec
@@ -52,12 +70,25 @@ from kubeflow_tpu.platform.k8s.types import (
     thaw,
 )
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
+from kubeflow_tpu.platform.runtime import jobqueue as jq
 from kubeflow_tpu.platform.runtime import metrics
 from kubeflow_tpu.platform.runtime.apply import patch_status_diff
 from kubeflow_tpu.platform.runtime.flight import shared_pool
 from kubeflow_tpu.platform.tpu import SliceSpec
 
 GENERATION_ANNOTATION = "tpujobs.kubeflow.org/generation"
+
+# How long a Preempting gang gets to checkpoint before its chips are
+# reclaimed (phase 2 completes early if every worker pod is already gone
+# or terminal).  Mirrors the kubelet's terminationGracePeriod role: the
+# STS teardown delivers SIGTERM, train/run.py's handler force-saves, and
+# this deadline bounds how long the queue waits for it.
+DEFAULT_PREEMPTION_GRACE_S = 30.0
+# Queued / shrunk jobs poll the ledger on this cadence as a backstop for
+# missed kick events — progress must never depend on a watch delta
+# arriving (chaos storms drop them; sharded replicas only see owned
+# deltas on the controller informers).
+DEFAULT_QUEUE_POLL_S = 1.0
 
 
 class _SliceNameConflict(Exception):
@@ -66,7 +97,10 @@ class _SliceNameConflict(Exception):
 
 class TPUJobReconciler(Reconciler):
     def __init__(self, client, *, cluster_domain: Optional[str] = None,
-                 informers: Optional[dict] = None):
+                 informers: Optional[dict] = None,
+                 queue: Optional[jq.JobQueue] = None,
+                 preemption_grace: Optional[float] = None,
+                 queue_poll: Optional[float] = None):
         self.client = client
         # GVK -> Informer (make_controller wires them): pod/STS reads come
         # from the indexed caches — shard-filtered under sharded HA, so a
@@ -77,6 +111,18 @@ class TPUJobReconciler(Reconciler):
         self.flights = shared_pool()
         self.cluster_domain = cluster_domain or config.env(
             "CLUSTER_DOMAIN", "cluster.local")
+        # The admission ledger.  make_controller passes an informer-fed
+        # instance; bare construction gets a client-backed one that
+        # rebuilds from lists per decision (unit-test mode).
+        self.queue = queue if queue is not None else jq.JobQueue(client)
+        self.preemption_grace = (
+            preemption_grace if preemption_grace is not None
+            else config.env_float("TPUJOB_PREEMPTION_GRACE_SECONDS",
+                                  DEFAULT_PREEMPTION_GRACE_S))
+        self.queue_poll = (
+            queue_poll if queue_poll is not None
+            else config.env_float("TPUJOB_QUEUE_POLL_SECONDS",
+                                  DEFAULT_QUEUE_POLL_S))
 
     # -- cache-backed reads ---------------------------------------------------
 
@@ -122,24 +168,61 @@ class TPUJobReconciler(Reconciler):
                 patch_status_diff(self.client, TPUJOB, job, status)
             return None
 
+        ns, name = meta(job)["namespace"], name_of(job)
         if jobapi.phase_of(job) in jobapi.TERMINAL_PHASES:
             # Terminal is sticky; a new run is a new CR.  But finish any
             # chip-freeing teardown a transient fault interrupted after
             # the terminal status landed — otherwise the StatefulSets
             # would hold their TPU hosts forever.
-            ns, name = meta(job)["namespace"], name_of(job)
+            self.queue.forget(ns, name)
             if self._stses_of(ns, name):
                 self._teardown_gang(ns, name, delete_pods=False)
             return None
 
+        # Read-your-writes for the ledger: the clientless queue rebuilds
+        # from lists; the informer-fed one just folds in THIS job's live
+        # truth (our own status writes may outrun the watch stream).
+        self.queue.ensure_fresh()
+        self.queue.observe(job)
         spec = jobapi.tpu_slice(job)
-        ns, name = meta(job)["namespace"], name_of(job)
-        generation = jobapi.restarts_of(job)
+        phase = jobapi.phase_of(job)
+
+        if phase == jobapi.PHASE_PREEMPTING:
+            return self._finish_preemption(job, spec)
+
+        alloc = jobapi.allocated_slices(job)
+        if alloc is None:
+            # Not holding chips: admission is a queue decision.  Either
+            # the whole gang is granted (possibly elastically, at
+            # minSlices <= k <= slices) and we fall through to create it
+            # THIS reconcile, or the job parks Queued with a structured
+            # reason and polls the ledger.
+            admitted = self._admission(job, spec)
+            if isinstance(admitted, Result):
+                return admitted
+            job, alloc = admitted
+
+        generation = jobapi.generation_of(job)
+
+        # A higher-priority head waiter (or a shrunk node pool) claimed
+        # this gang's chips: begin the two-phase checkpoint-then-evict.
+        yielding = self.queue.should_yield(ns, name)
+        if yielding is not None and phase in (
+                jobapi.PHASE_RUNNING, jobapi.PHASE_PENDING,
+                jobapi.PHASE_RESTARTING):
+            return self._begin_preemption(job, spec, yielding)
+
+        # Elastic grow-back: a shrunk Running gang resizes up when
+        # capacity frees and nothing is waiting (waiters first).
+        if phase == jobapi.PHASE_RUNNING and alloc < spec.num_slices:
+            grow = self.queue.grow_target(ns, name)
+            if grow is not None and grow > alloc:
+                return self._begin_resize(job, alloc, grow)
 
         # Conflict-check every slice name BEFORE writing anything: a
         # partial gang would hold TPU hosts forever at the barrier.
         try:
-            for s in range(spec.num_slices):
+            for s in range(alloc):
                 self._check_sts_ownership(ns, name,
                                           self.slice_sts_name(name, s))
         except _SliceNameConflict as e:
@@ -182,10 +265,253 @@ class TPUJobReconciler(Reconciler):
         if failed:
             return self._handle_gang_failure(job, spec, generation, failed)
 
-        self._reconcile_statefulsets(job, spec, generation)
+        self._reconcile_statefulsets(job, spec, generation, alloc)
         self._reconcile_headless_service(job)
-        self._update_status(job, spec, generation, current)
+        self._update_status(job, spec, generation, alloc, current)
+        if alloc < spec.num_slices:
+            # Shrunk gang: poll for grow-back capacity (kick events are
+            # the fast path, this is the guarantee).
+            return Result(requeue_after=max(self.queue_poll, 2.0))
         return None
+
+    # -- admission / queueing -------------------------------------------------
+
+    def _admission(self, job: Resource, spec: SliceSpec):
+        """Decide admission for a job holding no chips.  Returns a
+        ``Result`` (parked Queued, polling) or ``(fresh_job, alloc)``
+        after committing the claim — allocatedSlices is written BEFORE
+        any StatefulSet exists, so a rebuilt ledger (restart, other
+        replica) always accounts a gang that might be mid-creation and
+        the fleet can never oversubscribe through a crash window."""
+        ns, name = meta(job)["namespace"], name_of(job)
+        decision = self.queue.decide(ns, name)
+        if decision.action == "admit":
+            # Commit-time confirm under the admission mutex: the fast
+            # decide above ran on watch state, which a fault storm can
+            # hold seconds stale — two workers deciding off the same
+            # stale snapshot would both admit into one free slot.  The
+            # confirm rebuilds from LIVE lists and the commit lands
+            # inside the same critical section, so the next confirm is
+            # guaranteed to see it.
+            with self.queue.admission_mutex:
+                decision = self.queue.confirm(self.client, ns, name)
+                if decision.action == "admit":
+                    queued_since = jobapi.queued_at(job)
+                    if queued_since is not None:
+                        metrics.tpujob_queue_wait_seconds.observe(
+                            max(0.0, time.time() - queued_since))
+                    # Re-admissions (a preemption wrote status.generation
+                    # before) start a NEW gang generation; a first-ever
+                    # admission keeps generation == restarts so a legacy
+                    # pre-queue job's live workers never read as stale.
+                    prior_gen = deep_get(job, "status", "generation")
+                    new_gen = (jobapi.generation_of(job) + 1
+                               if prior_gen is not None
+                               else jobapi.generation_of(job))
+                    status = {
+                        "phase": jobapi.PHASE_PENDING,
+                        "restarts": jobapi.restarts_of(job),
+                        "generation": new_gen,
+                        "allocatedSlices": decision.slices,
+                        "slices": self._slice_counts_named(
+                            name, spec, {}, decision.slices),
+                    }
+                    patch_status_diff(self.client, TPUJOB, job, status)
+                    fresh = self.client.get(TPUJOB, name, ns)
+                    self.queue.observe(fresh)
+        if decision.action == "admit":
+            self.recorder.event(
+                job, "Normal", "Admitted",
+                f"granted {decision.slices}/{spec.num_slices} slice(s) "
+                f"(generation {new_gen})"
+                + (" — elastic" if decision.slices < spec.num_slices
+                   else ""))
+            return fresh, decision.slices
+        if decision.action != "wait":
+            # "admitted": the live rebuild found allocatedSlices already
+            # set — this reconcile read the job through a lagging cache.
+            # "unknown": the entry vanished mid-decision (delete race).
+            # Neither is a reason to park a possibly-running gang under
+            # a Queued status; re-read and retry shortly.
+            if decision.action == "admitted":
+                fresh = self.client.get(TPUJOB, name, ns)
+                self.queue.observe(fresh)
+                alloc = jobapi.allocated_slices(fresh)
+                if alloc is not None:
+                    return fresh, alloc
+            return Result(requeue_after=min(self.queue_poll, 0.25))
+        # Park Queued with the structured reason.  The Unschedulable
+        # condition carries the human-readable detail; status.reason is
+        # the REASON printer column.
+        queued_since = jobapi.queued_at(job)
+        status = {
+            "phase": jobapi.PHASE_QUEUED,
+            "restarts": jobapi.restarts_of(job),
+            "reason": decision.reason,
+            "queuedAt": (queued_since if queued_since is not None
+                         else round(time.time(), 3)),
+            "conditions": [{
+                "type": "Unschedulable", "status": "True",
+                "reason": decision.reason, "message": decision.message,
+            }],
+        }
+        prior_gen = deep_get(job, "status", "generation")
+        if prior_gen is not None:
+            status["generation"] = int(prior_gen)
+        if deep_get(job, "status", "reason") != decision.reason:
+            self.recorder.event(
+                job, "Normal", "Queued",
+                f"{decision.reason}: {decision.message}")
+        if job.get("status") != status:
+            patch_status_diff(self.client, TPUJOB, job, status)
+            self.queue.observe(self.client.get(TPUJOB, name, ns))
+        return Result(requeue_after=self.queue_poll)
+
+    # -- preemption (two-phase checkpoint-then-evict) -------------------------
+
+    def _begin_preemption(self, job: Resource, spec: SliceSpec,
+                          yielding) -> Optional[Result]:
+        """Phase 1: commit the Preempting intent, then tear down the
+        slice StatefulSets — on a real cluster the cascade delivers
+        SIGTERM + grace to every worker, and train/run.py's handler
+        force-saves a checkpoint (the provably-safe PR-9 path).  The
+        chips stay CHARGED to this job (allocatedSlices kept) until
+        phase 2 confirms the drain, so the preemptor can never be
+        half-admitted into capacity the victim still holds."""
+        by, why = yielding
+        ns, name = meta(job)["namespace"], name_of(job)
+        status = dict(job.get("status") or {})
+        status.update({
+            "phase": jobapi.PHASE_PREEMPTING,
+            "reason": (jq.REASON_PREEMPTED if why == "priority"
+                       else "CapacityShrunk"),
+            "preemption": {"by": by, "reason": why,
+                           "at": round(time.time(), 3)},
+            "conditions": [{
+                "type": "Preempted", "status": "True",
+                "reason": "PreemptedBy" if why == "priority"
+                          else "CapacityShrunk",
+                "message": (f"checkpoint-then-evict for {by}" if by
+                            else "node pool shrank under the gang"),
+            }],
+        })
+        patch_status_diff(self.client, TPUJOB, job, status)
+        metrics.tpujob_preemptions_total.labels(reason=why).inc()
+        self.recorder.event(
+            job, "Warning", "Preempting",
+            (f"higher-priority job {by} claims this gang's chips; "
+             if by else "node pool shrank; ")
+            + "checkpointing then releasing "
+            f"{jobapi.allocated_slices(job)} slice(s)")
+        self.queue.observe(self.client.get(TPUJOB, name, ns))
+        # The SIGTERM: tear down the StatefulSets only — worker pods ride
+        # out their grace period checkpointing.
+        for sts in self._stses_of(ns, name):
+            try:
+                self.client.delete(STATEFULSET, name_of(sts), ns)
+            except errors.NotFound:
+                pass
+        return Result(requeue_after=min(self.queue_poll, 0.25))
+
+    def _begin_resize(self, job: Resource, alloc: int,
+                      target: int) -> Optional[Result]:
+        """Elastic grow-back = a voluntary self-preemption: same graceful
+        drain (checkpoint over SIGTERM), but phase 2 re-admits at the
+        recomputed width instead of parking Queued.  Never consumes
+        backoffLimit — a resize is not a failure."""
+        ns, name = meta(job)["namespace"], name_of(job)
+        status = dict(job.get("status") or {})
+        status.update({
+            "phase": jobapi.PHASE_PREEMPTING,
+            "reason": jq.REASON_RESIZING,
+            "resize": {"to": target, "at": round(time.time(), 3)},
+            "conditions": [{
+                "type": "Preempted", "status": "True",
+                "reason": jq.REASON_RESIZING,
+                "message": f"growing from {alloc} to {target} slice(s); "
+                           "checkpointing for the restart",
+            }],
+        })
+        patch_status_diff(self.client, TPUJOB, job, status)
+        self.recorder.event(
+            job, "Normal", "Resizing",
+            f"capacity freed: growing from {alloc} to {target} slice(s) "
+            "via checkpoint-restart")
+        self.queue.observe(self.client.get(TPUJOB, name, ns))
+        for sts in self._stses_of(ns, name):
+            try:
+                self.client.delete(STATEFULSET, name_of(sts), ns)
+            except errors.NotFound:
+                pass
+        return Result(requeue_after=min(self.queue_poll, 0.25))
+
+    def _finish_preemption(self, job: Resource,
+                           spec: SliceSpec) -> Optional[Result]:
+        """Phase 2: wait for the checkpoint drain — every current-
+        generation worker pod gone/terminal, or the grace deadline — then
+        reclaim the chips.  A preemption parks the job back in the queue
+        (it re-admits elastically when capacity allows); a resize
+        re-admits immediately at the recomputed width."""
+        ns, name = meta(job)["namespace"], name_of(job)
+        generation = jobapi.generation_of(job)
+        intent = (deep_get(job, "status", "resize")
+                  or deep_get(job, "status", "preemption") or {})
+        started = float(intent.get("at") or 0.0)
+        deadline = started + self.preemption_grace
+        pods = self._pods_of(ns, name)
+        current, _stale = self._split_by_generation(pods, generation)
+        active = [p for p in current
+                  if deep_get(p, "status", "phase")
+                  not in ("Succeeded", "Failed")]
+        now = time.time()
+        if active and now < deadline:
+            return Result(requeue_after=min(
+                max(deadline - now, 0.05), 0.25))
+        # Drain confirmed (or deadline passed): clear the slate.
+        self._teardown_gang(ns, name, delete_pods=True)
+        resize = deep_get(job, "status", "resize")
+        if resize is not None:
+            # Recompute against the CURRENT ledger — capacity may have
+            # moved (or a waiter arrived) during the drain.  The stored
+            # resize.to is intent, not entitlement: a None grow_target
+            # now means the growth lost its window, so the gang simply
+            # recreates at the width it already holds.  Never below the
+            # held width, never above the spec.
+            alloc = jobapi.allocated_slices(job) or 1
+            target = self.queue.grow_target(ns, name)
+            new_alloc = min(max(target if target is not None else alloc,
+                                alloc), spec.num_slices)
+            status = {
+                "phase": jobapi.PHASE_PENDING,
+                "restarts": jobapi.restarts_of(job),
+                "generation": generation + 1,
+                "allocatedSlices": new_alloc,
+                "slices": self._slice_counts_named(
+                    name, spec, {}, new_alloc),
+            }
+            patch_status_diff(self.client, TPUJOB, job, status)
+        else:
+            status = {
+                "phase": jobapi.PHASE_QUEUED,
+                "restarts": jobapi.restarts_of(job),
+                "generation": generation,
+                "reason": jq.REASON_PREEMPTED,
+                "queuedAt": round(time.time(), 3),
+                "conditions": [{
+                    "type": "Unschedulable", "status": "True",
+                    "reason": jq.REASON_PREEMPTED,
+                    "message": "gang evicted after checkpoint; waiting "
+                               "to resume (elastically at minSlices "
+                               "when capacity allows)",
+                }],
+            }
+            patch_status_diff(self.client, TPUJOB, job, status)
+            self.recorder.event(
+                job, "Normal", "PreemptionComplete",
+                "checkpoint drain finished; chips released, job "
+                "re-queued for elastic resume")
+        self.queue.observe(self.client.get(TPUJOB, name, ns))
+        return Result(requeue_after=self.queue_poll)
 
     # -- gang restart ---------------------------------------------------------
 
@@ -198,8 +524,10 @@ class TPUJobReconciler(Reconciler):
         backoff exhausted / restartPolicy Never, go terminally Failed."""
         ns, name = meta(job)["namespace"], name_of(job)
         who = ", ".join(sorted(name_of(p) for p in failed))
+        restarts = jobapi.restarts_of(job)
+        alloc = jobapi.allocated_slices(job) or spec.num_slices
         exhausted = (jobapi.restart_policy(job) == "Never"
-                     or generation >= jobapi.backoff_limit(job))
+                     or restarts >= jobapi.backoff_limit(job))
         if exhausted:
             self._teardown_gang(ns, name, delete_pods=False)
             self.recorder.event(
@@ -207,29 +535,45 @@ class TPUJobReconciler(Reconciler):
                 f"worker pod(s) {who} failed; restartPolicy="
                 f"{jobapi.restart_policy(job)} backoffLimit="
                 f"{jobapi.backoff_limit(job)} exhausted after "
-                f"{generation} restart(s)")
+                f"{restarts} restart(s)")
             status = {
                 "phase": jobapi.PHASE_FAILED,
-                "restarts": generation,
-                "slices": self._slice_counts_named(name, spec, {}),
+                "restarts": restarts,
+                "slices": self._slice_counts_named(name, spec, {}, alloc),
                 "conditions": [{
                     "type": "Failed", "status": "True",
                     "reason": "BackoffLimitExceeded",
                     "message": f"worker pod(s) {who} failed",
                 }],
             }
+            if deep_get(job, "status", "generation") is not None:
+                status["generation"] = generation
             patch_status_diff(self.client, TPUJOB, job, status)
+            # Terminal Failed frees the chips in the ledger — THIS is why
+            # a crashlooping high-priority job can never starve the
+            # queue: backoffLimit turns it terminal and the next waiter
+            # admits into the freed capacity.
+            self.queue.observe(self.client.get(TPUJOB, name, ns))
             return None
         self.recorder.event(
             job, "Warning", "GangRestart",
             f"worker pod(s) {who} failed; tearing down all "
-            f"{spec.num_slices} slice(s) and restarting the gang "
+            f"{alloc} slice(s) and restarting the gang "
             f"(generation {generation + 1})")
         status = {
             "phase": jobapi.PHASE_RESTARTING,
-            "restarts": generation + 1,
-            "slices": self._slice_counts_named(name, spec, {}),
+            "restarts": restarts + 1,
+            "slices": self._slice_counts_named(name, spec, {}, alloc),
         }
+        if deep_get(job, "status", "generation") is not None:
+            # Failure restarts bump BOTH counters; resizes/re-admissions
+            # bump only the generation (they never eat backoffLimit).
+            # The gang KEEPS its allocation across a restart — a crash is
+            # not a queue event, and dropping allocatedSlices here would
+            # send the job back through admission (racing the queue for
+            # chips it already holds).
+            status["generation"] = generation + 1
+            status["allocatedSlices"] = alloc
         # Persist the bumped counter BEFORE tearing anything down: the
         # teardown deletes the Failed pods (the evidence), so a crash or
         # transient status-write fault after it would replay this restart
@@ -278,9 +622,15 @@ class TPUJobReconciler(Reconciler):
         return name if slice_idx == 0 else f"{name}-s{slice_idx}"
 
     def generate_statefulset(self, job: Resource, slice_idx: int = 0,
-                             generation: int = 0) -> Resource:
+                             generation: int = 0,
+                             num_slices: Optional[int] = None) -> Resource:
+        """``num_slices`` is the GRANTED gang width (elastic admission may
+        run fewer slices than spec.tpu.slices); default = the full spec,
+        preserving the pre-queue contract for direct callers."""
         ns, name = meta(job)["namespace"], name_of(job)
         spec = jobapi.tpu_slice(job)
+        if num_slices is None:
+            num_slices = spec.num_slices
         sts_name = self.slice_sts_name(name, slice_idx)
 
         pod_spec = thaw(
@@ -288,7 +638,8 @@ class TPUJobReconciler(Reconciler):
         containers = pod_spec.get("containers") or [{}]
         main = containers[0]
         main.setdefault("name", "worker")
-        self._inject_tpu(pod_spec, main, ns, name, spec, slice_idx)
+        self._inject_tpu(pod_spec, main, ns, name, spec, slice_idx,
+                         num_slices)
         ckpt = jobapi.checkpoint_dir(job)
         if ckpt:
             env = main.setdefault("env", [])
@@ -327,7 +678,8 @@ class TPUJobReconciler(Reconciler):
         return sts
 
     def _inject_tpu(self, pod_spec: dict, container: dict, ns: str,
-                    name: str, spec: SliceSpec, slice_idx: int) -> None:
+                    name: str, spec: SliceSpec, slice_idx: int,
+                    num_slices: int) -> None:
         resources = container.setdefault("resources", {})
         resources.setdefault("limits", {}).update(spec.pod_resources())
         resources.setdefault("requests", {}).update(spec.pod_resources())
@@ -343,7 +695,11 @@ class TPUJobReconciler(Reconciler):
         # built by the shared envspec helpers.  Unlike the notebook path,
         # MEGASCALE_* is injected even at num_slices=1: a TPUJob's trainer
         # always runs dist.initialize_from_env, and the uniform contract
-        # keeps the round-trip test one shape.
+        # keeps the round-trip test one shape.  MEGASCALE_NUM_SLICES is
+        # the GRANTED width — an elastically-shrunk gang's trainer sees a
+        # smaller dcn(dp) axis through dist.process_grid and resumes the
+        # same checkpoint at fewer slices; KFT_SPEC_SLICES rides along so
+        # it can report it is running shrunk (envspec.elastic_env).
         injected = envspec.tpu_bootstrap_env(
             topology=spec.topology,
             accelerator=spec.accelerator.name,
@@ -352,8 +708,9 @@ class TPUJobReconciler(Reconciler):
             num_hosts=spec.num_hosts,
             hostnames=hostnames,
         ) + envspec.megascale_env(
-            slice_idx, spec.num_slices,
-            f"{name}-0.{name}-workers.{ns}.svc.{self.cluster_domain}")
+            slice_idx, num_slices,
+            f"{name}-0.{name}-workers.{ns}.svc.{self.cluster_domain}"
+        ) + envspec.elastic_env(spec.num_slices)
         env.extend(e for e in injected if e["name"] not in have)
 
     def _check_sts_ownership(self, ns: str, job_name: str,
@@ -370,27 +727,28 @@ class TPUJobReconciler(Reconciler):
                 f"not TPUJob {job_name}; rename one of them")
 
     def _reconcile_statefulsets(self, job: Resource, spec: SliceSpec,
-                                generation: int) -> None:
+                                generation: int, alloc: int) -> None:
         """Gang-create: every missing slice StatefulSet of the CURRENT
-        generation, concurrently (independent names, one owner).  A
-        leftover from an older generation (a teardown delete that lost a
-        race) is deleted and recreated."""
+        generation, concurrently (independent names, one owner), at the
+        GRANTED width ``alloc``.  A leftover from an older generation (a
+        teardown delete that lost a race) is deleted and recreated."""
         ns, name = meta(job)["namespace"], name_of(job)
         created = self.flights.run([
             (lambda s=s: self._reconcile_one_statefulset(
-                job, s, generation))
-            for s in range(spec.num_slices)
+                job, s, generation, alloc))
+            for s in range(alloc)
         ])
         if any(created):
             self.recorder.event(
                 job, "Normal", "GangCreated",
-                f"created {spec.num_slices} slice StatefulSet(s) x "
+                f"created {alloc} slice StatefulSet(s) x "
                 f"{spec.num_hosts} worker(s) (generation {generation})")
 
     def _reconcile_one_statefulset(self, job: Resource, slice_idx: int,
-                                   generation: int) -> bool:
+                                   generation: int, alloc: int) -> bool:
         """Returns True when this pass created the slice's StatefulSet."""
-        desired = self.generate_statefulset(job, slice_idx, generation)
+        desired = self.generate_statefulset(job, slice_idx, generation,
+                                            num_slices=alloc)
         ns, name = meta(desired)["namespace"], name_of(desired)
         current = self._cached_get(STATEFULSET, name, ns)
         if current is not None:
@@ -466,11 +824,12 @@ class TPUJobReconciler(Reconciler):
         return current, stale
 
     def _update_status(self, job: Resource, spec: SliceSpec,
-                       generation: int, current: List[Resource]) -> None:
+                       generation: int, alloc: int,
+                       current: List[Resource]) -> None:
         ns, name = meta(job)["namespace"], name_of(job)
         expected = [
             f"{self.slice_sts_name(name, s)}-{i}"
-            for s in range(spec.num_slices)
+            for s in range(alloc)
             for i in range(spec.num_hosts)
         ]
         by_name = {name_of(p): p for p in current}
@@ -480,25 +839,28 @@ class TPUJobReconciler(Reconciler):
         ready = sum(1 for n in expected
                     if n in by_name and pod_ready(by_name[n]))
 
-        if succeeded == len(expected):
+        if expected and succeeded == len(expected):
             phase = jobapi.PHASE_SUCCEEDED
-        elif ready + succeeded == len(expected):
+        elif expected and ready + succeeded == len(expected):
             # Workers finish at slightly different times (the collective
             # tears down rank by rank): a pod that already exited 0 is no
             # longer Ready but must keep counting toward Running, or a
             # completing job would read as Pending/Restarting for its last
             # few seconds.
             phase = jobapi.PHASE_RUNNING
-        elif generation > 0:
+        elif jobapi.restarts_of(job) > 0:
             phase = jobapi.PHASE_RESTARTING
         else:
             phase = jobapi.PHASE_PENDING
 
         status: dict = {
             "phase": phase,
-            "restarts": generation,
-            "slices": self._slice_counts_named(name, spec, by_name),
+            "restarts": jobapi.restarts_of(job),
+            "slices": self._slice_counts_named(name, spec, by_name, alloc),
         }
+        if deep_get(job, "status", "generation") is not None:
+            status["generation"] = generation
+            status["allocatedSlices"] = alloc
         if job.get("status") != status:
             patch_status_diff(self.client, TPUJOB, job, status)
         if phase == jobapi.PHASE_SUCCEEDED:
@@ -510,15 +872,18 @@ class TPUJobReconciler(Reconciler):
             # teardown faults instead, the terminal-sticky branch in
             # reconcile() finishes the sweep.
             self._teardown_gang(ns, name, delete_pods=False)
+            self.queue.observe(self.client.get(TPUJOB, name, ns))
             self.recorder.event(
                 job, "Normal", "JobSucceeded",
-                f"all {len(expected)} worker(s) across {spec.num_slices} "
-                f"slice(s) succeeded after {generation} restart(s)")
+                f"all {len(expected)} worker(s) across {alloc} "
+                f"slice(s) succeeded after "
+                f"{jobapi.restarts_of(job)} restart(s)")
 
     def _slice_counts_named(self, name: str, spec: SliceSpec,
-                            by_name: Dict[str, Resource]) -> List[dict]:
+                            by_name: Dict[str, Resource],
+                            alloc: Optional[int] = None) -> List[dict]:
         out = []
-        for s in range(spec.num_slices):
+        for s in range(alloc if alloc is not None else spec.num_slices):
             sts = self.slice_sts_name(name, s)
             ready = sum(
                 1 for i in range(spec.num_hosts)
@@ -549,6 +914,7 @@ def _job_label_index(obj: Resource) -> List[str]:
 
 
 def make_controller(client, **kwargs):
+    from kubeflow_tpu.platform.k8s.types import NODE, RESOURCEQUOTA
     from kubeflow_tpu.platform.runtime import Controller
     from kubeflow_tpu.platform.runtime.informer import Informer
 
@@ -564,18 +930,83 @@ def make_controller(client, **kwargs):
                               indexers={"tpujob": _job_label_index}),
         SERVICE: Informer(client, SERVICE),
     }
-    return Controller(
+    # The admission ledger's feed is deliberately UNSHARDED (and therefore
+    # kept out of the controller's informer dict, whose admit filters the
+    # coordinator rewires): the queue is a global priority-then-FIFO order
+    # over every job + quota + node, and each replica must compute the
+    # SAME schedule to act consistently on the keys it owns.  Low churn:
+    # the job feed is one watch of a bounded CR kind, quotas and nodes are
+    # near-static.
+    queue = jq.JobQueue()
+    queue.informer_backed = True
+    queue_informers = {
+        TPUJOB: Informer(client, TPUJOB),
+        RESOURCEQUOTA: Informer(client, RESOURCEQUOTA),
+        NODE: Informer(client, NODE),
+    }
+
+    def _on_job_delta(etype, obj):
+        if etype == "DELETED":
+            queue.forget(deep_get(obj, "metadata", "namespace",
+                                  default="") or "",
+                         name_of(obj))
+        else:
+            queue.observe(obj)
+
+    queue_informers[TPUJOB].add_handler(_on_job_delta)
+    queue_informers[RESOURCEQUOTA].add_handler(
+        lambda _e, _o: queue.set_quotas(
+            queue_informers[RESOURCEQUOTA].list()))
+    queue_informers[NODE].add_handler(
+        lambda _e, _o: queue.set_nodes(queue_informers[NODE].list()))
+
+    reconciler = TPUJobReconciler(client, informers=informers,
+                                  queue=queue, **kwargs)
+
+    def on_start():
+        metrics.register_tpujob_collector(client)
+        jq.register_debug_queue(queue)
+        for informer in queue_informers.values():
+            informer.start()
+        for informer in queue_informers.values():
+            # Best-effort: an unsynced ledger degrades to permissive
+            # admission (exactly the pre-queue behavior) until the feed
+            # lands — never a startup failure.
+            informer.wait_for_sync(30.0)
+
+    def on_stop():
+        metrics.register_tpujob_collector(None)
+        jq.register_debug_queue(None)
+        for informer in queue_informers.values():
+            informer.stop()
+
+    ctrl = Controller(
         "tpujob-controller",
-        TPUJobReconciler(client, informers=informers, **kwargs),
+        reconciler,
         primary=TPUJOB,
         owns=[STATEFULSET, SERVICE],
         watches=[(POD, pods_to_tpujob_requests)],
         informers=informers,
         # Scrape-time fleet gauges (tpujob_jobs{phase}, slice-ready counts)
-        # hook/unhook with the controller lifecycle, like the notebook
-        # fleet collector.
-        on_start=lambda: metrics.register_tpujob_collector(client),
-        on_stop=lambda: metrics.register_tpujob_collector(None),
+        # + the /debug/queue ledger hook/unhook with the controller
+        # lifecycle, like the notebook fleet collector.
+        on_start=on_start,
+        on_stop=on_stop,
         resync_period=300.0,
         shards=shards,
     )
+
+    def _kick(_etype, obj):
+        # Capacity-change fan-out: any job delta on the GLOBAL feed wakes
+        # the keys that can act on the new state — the head waiters
+        # (admission), the current preemption targets (yield), and shrunk
+        # gangs (grow-back) — filtered to this replica's owned shards.
+        # The Queued-job poll (Result.requeue_after) is the guarantee;
+        # this is the latency path.
+        for ns, name in queue.kick_requests():
+            req = Request(ns, name)
+            if ctrl._owns(req):
+                ctrl.queue.add(req)
+
+    queue_informers[TPUJOB].add_handler(_kick)
+    return ctrl

@@ -93,6 +93,20 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
                 "shards": {str(k): v
                            for k, v in sorted(shards.shard_map().items())},
             }).encode()]
+        if path == "/debug/queue":
+            # The TPUJob gang admission ledger (runtime/jobqueue.py):
+            # waiting order, admitted allocations, pool/quota tallies and
+            # live preemption targets — the first page to read when "why
+            # is my job Queued" is the question (docs/jobs.md "Queueing,
+            # priority, and preemption").  404 until the tpujob
+            # controller has registered its queue.
+            from kubeflow_tpu.platform.runtime import jobqueue
+
+            snap = jobqueue.debug_snapshot()
+            if snap is not None:
+                start_response("200 OK",
+                               [("Content-Type", "application/json")])
+                return [json.dumps(snap).encode()]
         if path == "/debug/traces" and debug_traces:
             from urllib.parse import parse_qs
 

@@ -1,0 +1,676 @@
+"""TpuJobQueue: the cluster-level gang admission ledger (ROADMAP item 4).
+
+The TPUJob controller used to gang-create on first reconcile — first
+reconcile to race wins arbitrarily on a fleet with more jobs than chips.
+This module turns admission into a *queue decision*: every non-terminal
+TPUJob is an entry in one priority-then-FIFO ledger of
+
+* **topology capacity** — free slice slots per ``(accelerator, topology)``
+  pool, derived from the TPU node inventory (``hosts // hosts_per_slice``;
+  a pool with NO matching nodes is *unlimited* — a cluster that doesn't
+  feed node objects must not deadlock every job),
+* **profile quota** — free ``google.com/tpu`` chips per namespace from the
+  profile controller's ResourceQuota, charged with the *declared* chips of
+  admitted gangs (pod-level enforcement stays with the apiserver's quota
+  plugin; see docs/jobs.md "Queueing, priority, and preemption"),
+
+and a decision function over it.  Everything here is REBUILT from watch
+state (job statuses + quotas + nodes), never from in-process bookkeeping
+alone — so the queue survives controller restarts and, under sharded HA,
+every replica computes the same global schedule from the same unsharded
+informer feed while acting only on the keys it owns (no cross-key writes:
+a victim preempts *itself* when ``should_yield`` says a higher-priority
+waiter is entitled to its chips).
+
+Ordering contract (pinned by tests/ctrlplane/test_jobqueue.py):
+
+* rank = (priority DESC, creationTimestamp ASC, name ASC) — priority then
+  FIFO.  ISO-8601 creationTimestamps compare lexicographically.
+* Head-of-line: a job admits only if every better-ranked waiter does NOT
+  currently fit at its own ``minSlices`` — so the queue provably drains in
+  rank order as capacity frees (a small job never jumps an admissible
+  head; a crashlooper at the head can't starve the queue because
+  ``backoffLimit`` turns it terminal, which frees its entry).
+* Preemption rights belong to the HEAD waiter only: victims are admitted
+  gangs of strictly lower priority, picked lowest-priority/youngest-first,
+  minimally — never a gang of equal or higher priority.
+* Elastic: admission grants ``k = min(spec.slices, free)`` down to
+  ``minSlices``; a shrunk running gang grows back only when the waiting
+  queue is empty (waiters first).
+
+Decision cost: admitting the head is O(1) against the incrementally
+maintained sorted index + per-pool/per-namespace tallies — the
+``tpujob_queue_decisions_per_s`` bench band (bench_scale.py) pins that
+the decision loop never rescans the full queue per event.
+"""
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.platform.k8s.types import Resource, deep_get
+from kubeflow_tpu.platform.tpu import ACCELERATORS
+from kubeflow_tpu.platform.tpu.topology import LABEL_ACCELERATOR, LABEL_TOPOLOGY
+
+# Structured Unschedulable reasons (status.reason + the REASON printer
+# column + the Unschedulable condition's reason).
+REASON_QUOTA = "InsufficientQuota"
+REASON_CAPACITY = "InsufficientCapacity"
+REASON_QUEUED_BEHIND = "QueuedBehind"
+REASON_AWAITING_PREEMPTION = "AwaitingPreemption"
+REASON_PREEMPTED = "Preempted"
+REASON_RESIZING = "Resizing"
+
+_TPU_QUOTA_KEY = "requests.google.com/tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class GangDemand:
+    """One TPUJob's parsed resource demand, as the ledger accounts it."""
+
+    namespace: str
+    name: str
+    priority: int
+    slices: int
+    min_slices: int
+    chips_per_slice: int
+    hosts_per_slice: int
+    accelerator: str        # short name ("v5e")
+    topology: str
+    created: str            # ISO creationTimestamp (lexicographic == temporal)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def pool(self) -> Tuple[str, str]:
+        return (self.accelerator, self.topology)
+
+    @property
+    def rank(self) -> Tuple:
+        return (-self.priority, self.created, self.namespace, self.name)
+
+
+@dataclasses.dataclass
+class Decision:
+    """Outcome of one admission decision for one job."""
+
+    action: str                       # admit | wait | admitted | unknown
+    slices: int = 0                   # granted gang width on admit
+    reason: str = ""                  # structured Unschedulable reason
+    message: str = ""
+
+
+class _Entry:
+    __slots__ = ("demand", "alloc")
+
+    def __init__(self, demand: GangDemand, alloc: Optional[int]):
+        self.demand = demand
+        self.alloc = alloc            # None = waiting; int = admitted slices
+
+
+def demand_of(job: Resource) -> Optional[GangDemand]:
+    """Parse a TPUJob into its ledger demand; None for stored-invalid
+    specs (their own reconcile parks them Degraded — they hold nothing
+    and wait for nothing)."""
+    from kubeflow_tpu.platform.apis import tpujob as jobapi
+
+    spec = jobapi.tpu_slice_or_none(job)
+    if spec is None:
+        return None
+    try:
+        priority = jobapi.priority_of(job)
+        min_slices = jobapi.min_slices_of(job)
+    except (TypeError, ValueError):
+        return None
+    if priority < 1 or not (1 <= min_slices <= spec.num_slices):
+        return None
+    return GangDemand(
+        namespace=deep_get(job, "metadata", "namespace", default="") or "",
+        name=deep_get(job, "metadata", "name", default="") or "",
+        priority=priority,
+        slices=spec.num_slices,
+        min_slices=min_slices,
+        chips_per_slice=spec.chips,
+        hosts_per_slice=spec.num_hosts,
+        accelerator=spec.accelerator.name,
+        topology=spec.topology,
+        created=deep_get(job, "metadata", "creationTimestamp",
+                         default="") or "",
+    )
+
+
+class JobQueue:
+    """The admission ledger.  Thread-safe; fed either incrementally from
+    informer deltas (``observe``/``forget`` — the production path wired by
+    ``controllers/tpujob.make_controller``) or rebuilt on demand from a
+    client (``ensure_fresh`` — the bare unit-test path).  All decisions
+    are pure functions of the current state."""
+
+    def __init__(self, client=None, *, now=time.time):
+        self._client = client
+        self._now = now
+        self._lock = threading.RLock()
+        self._entries: Dict[str, _Entry] = {}
+        self._waiting: List[Tuple[Tuple, str]] = []    # sorted (rank, key)
+        self._pool_alloc: Dict[Tuple[str, str], int] = {}
+        self._ns_chips: Dict[str, float] = {}
+        # Incremental tallies — every per-event read (gauges, kick fan-out)
+        # must stay O(1)-ish, never a rescan of the queue.
+        self._waiting_by_ns: Dict[str, int] = {}
+        self._alloc_total = 0
+        self._shrunk: set = set()      # admitted keys with alloc < slices
+        # (gke accelerator label, topology) -> TPU host count, from nodes.
+        self._pool_hosts: Dict[Tuple[str, str], int] = {}
+        self._ns_quota: Dict[str, float] = {}          # ns -> hard chips
+        # ns -> stored google.com/tpu status.used — chips held by LIVE
+        # pods of EVERY consumer (notebooks, serving, gang workers), kept
+        # by the apiserver's quota bookkeeping.  The effective commitment
+        # is max(declared gang chips, stored used): ignoring stored would
+        # over-admit gangs into chips a notebook already holds, and the
+        # apiserver plugin would then 403 PART of the gang's pods — the
+        # half-scheduled-gang deadlock this queue exists to prevent.
+        self._ns_used: Dict[str, float] = {}
+        self._epoch = 0
+        self._targets_cache: Tuple[int, Dict[str, Tuple[str, str]]] = (-1, {})
+        # (epoch, (rank, key) of the best-ranked currently-admissible
+        # waiter, or None): the head-of-line check in decide() reads
+        # this instead of rescanning the prefix per call — one scan per
+        # STATE CHANGE (observe() is a no-op for unchanged jobs), so a
+        # fully-parked 1k queue polling itself costs O(N) per capacity
+        # change, not O(N^2) per poll round.
+        self._first_adm_cache: Tuple[int, Optional[Tuple]] = (-1, None)
+        self.informer_backed = False
+        self.decisions = 0          # decide() calls, for the bench
+        # Serializes commit-time admissions within one controller: the
+        # confirm() live rebuild + the status commit happen atomically so
+        # two workers can never admit two gangs into one free slot off
+        # the same stale snapshot (see TPUJobReconciler._admission).
+        self.admission_mutex = threading.Lock()
+
+    # -- feeding -------------------------------------------------------------
+
+    def ensure_fresh(self) -> None:
+        """Clientless informers absent (bare reconciler construction):
+        rebuild the whole ledger from live lists.  Informer-backed queues
+        skip this — their deltas keep the state current."""
+        if self.informer_backed or self._client is None:
+            return
+        from kubeflow_tpu.platform.k8s.types import NODE, RESOURCEQUOTA, TPUJOB
+
+        jobs = self._client.list(TPUJOB, None)
+        quotas = self._client.list(RESOURCEQUOTA, None)
+        nodes = self._client.list(NODE, None)
+        self.refresh(jobs, quotas, nodes)
+
+    def confirm(self, client, namespace: str, name: str) -> Decision:
+        """Commit-time double check for an ``admit`` verdict: rebuild the
+        ledger from LIVE lists (read-your-writes — not the watch cache,
+        which can lag sibling admissions under a fault storm) and decide
+        again.  Callers hold ``admission_mutex`` across this and the
+        status commit.  Admissions are rare relative to decisions, so the
+        full LIST here never rides the per-event hot path."""
+        from kubeflow_tpu.platform.k8s.types import NODE, RESOURCEQUOTA, TPUJOB
+
+        self.refresh(client.list(TPUJOB, None),
+                     client.list(RESOURCEQUOTA, None),
+                     client.list(NODE, None))
+        return self.decide(namespace, name)
+
+    def refresh(self, jobs, quotas, nodes) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._waiting = []
+            self._pool_alloc.clear()
+            self._ns_chips.clear()
+            self._waiting_by_ns.clear()
+            self._alloc_total = 0
+            self._shrunk.clear()
+            self.set_nodes(nodes)
+            self.set_quotas(quotas)
+            for job in jobs:
+                self._observe_locked(job)
+            self._bump()
+
+    def set_nodes(self, nodes) -> None:
+        with self._lock:
+            self._pool_hosts = {}
+            for node in nodes or ():
+                labels = deep_get(node, "metadata", "labels",
+                                  default={}) or {}
+                acc = labels.get(LABEL_ACCELERATOR)
+                topo = labels.get(LABEL_TOPOLOGY)
+                cap = deep_get(node, "status", "capacity",
+                               "google.com/tpu")
+                if not acc or not topo or not cap:
+                    continue
+                self._pool_hosts[(acc, topo)] = (
+                    self._pool_hosts.get((acc, topo), 0) + 1)
+            self._bump()
+
+    def set_quotas(self, quotas) -> None:
+        from kubeflow_tpu.platform.k8s import quota as quota_mod
+
+        with self._lock:
+            self._ns_quota = {}
+            self._ns_used = {}
+            for q in quotas or ():
+                ns = deep_get(q, "metadata", "namespace", default="") or ""
+                hard = deep_get(q, "spec", "hard", default={}) or {}
+                used_map = deep_get(q, "status", "used", default={}) or {}
+                for key, val in hard.items():
+                    if quota_mod.usage_key(key) != _TPU_QUOTA_KEY:
+                        continue
+                    try:
+                        limit = quota_mod.parse_quantity(val)
+                    except (ValueError, TypeError):
+                        continue
+                    cur = self._ns_quota.get(ns)
+                    self._ns_quota[ns] = (limit if cur is None
+                                          else min(cur, limit))
+                    try:
+                        used = quota_mod.parse_quantity(
+                            used_map.get(key, 0.0) or 0.0)
+                    except (ValueError, TypeError):
+                        used = 0.0
+                    self._ns_used[ns] = max(self._ns_used.get(ns, 0.0),
+                                            used)
+            self._bump()
+
+    def observe(self, job: Resource) -> None:
+        """Upsert one job's entry from its current spec+status (informer
+        delta, or the reconciler's read-your-writes refresh).  A no-op —
+        no epoch bump, caches stay warm — when nothing changed: steady-
+        state requeue polls must not invalidate the per-epoch decision
+        caches."""
+        with self._lock:
+            if self._observe_locked(job):
+                self._bump()
+
+    def _observe_locked(self, job: Resource) -> bool:
+        from kubeflow_tpu.platform.apis import tpujob as jobapi
+
+        ns = deep_get(job, "metadata", "namespace", default="") or ""
+        name = deep_get(job, "metadata", "name", default="") or ""
+        key = f"{ns}/{name}"
+        phase = jobapi.phase_of(job)
+        demand = (None if phase in jobapi.TERMINAL_PHASES
+                  else demand_of(job))
+        if demand is None:
+            had = key in self._entries
+            self._drop_locked(key)
+            return had
+        alloc = jobapi.allocated_slices(job)
+        if alloc is not None and phase not in jobapi.HOLDING_PHASES:
+            alloc = None
+        cur = self._entries.get(key)
+        if cur is not None and cur.demand == demand and cur.alloc == alloc:
+            return False
+        self._drop_locked(key)
+        entry = _Entry(demand, alloc)
+        self._entries[key] = entry
+        if alloc is None:
+            bisect.insort(self._waiting, (demand.rank, key))
+            self._waiting_by_ns[ns] = self._waiting_by_ns.get(ns, 0) + 1
+        else:
+            self._pool_alloc[demand.pool] = (
+                self._pool_alloc.get(demand.pool, 0) + alloc)
+            self._ns_chips[ns] = (self._ns_chips.get(ns, 0.0)
+                                  + alloc * demand.chips_per_slice)
+            self._alloc_total += alloc
+            if alloc < demand.slices:
+                self._shrunk.add(key)
+        return True
+
+    def forget(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if self._drop_locked(f"{namespace}/{name}"):
+                self._bump()
+
+    def _drop_locked(self, key: str) -> bool:
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return False
+        ns = entry.demand.namespace
+        if entry.alloc is None:
+            i = bisect.bisect_left(self._waiting, (entry.demand.rank, key))
+            if i < len(self._waiting) and self._waiting[i][1] == key:
+                del self._waiting[i]
+            left = self._waiting_by_ns.get(ns, 0) - 1
+            if left > 0:
+                self._waiting_by_ns[ns] = left
+            else:
+                self._waiting_by_ns.pop(ns, None)
+        else:
+            pool = entry.demand.pool
+            self._pool_alloc[pool] = max(
+                0, self._pool_alloc.get(pool, 0) - entry.alloc)
+            self._ns_chips[ns] = max(
+                0.0, self._ns_chips.get(ns, 0.0)
+                - entry.alloc * entry.demand.chips_per_slice)
+            self._alloc_total = max(0, self._alloc_total - entry.alloc)
+            self._shrunk.discard(key)
+        return True
+
+    def _bump(self) -> None:
+        self._epoch += 1
+        self._update_gauges()
+
+    # -- capacity math -------------------------------------------------------
+
+    def _pool_capacity(self, d: GangDemand) -> Optional[int]:
+        """Slice slots the cluster can host for this demand's pool, or
+        None when the node inventory says nothing about it (unlimited —
+        documented in docs/jobs.md: no node feed, no topology gating)."""
+        label = ACCELERATORS[d.accelerator].gke_accelerator
+        hosts = self._pool_hosts.get((label, d.topology))
+        if hosts is None:
+            return None
+        return hosts // max(d.hosts_per_slice, 1)
+
+    def _k_max(self, d: GangDemand, *, extra_pool: int = 0,
+               extra_chips: float = 0.0, own_alloc: int = 0) -> int:
+        """Largest gang width currently grantable to ``d`` given free pool
+        slots and free namespace chips (``own_alloc``: capacity the job
+        itself already holds, counted as free for resize decisions)."""
+        cap = self._pool_capacity(d)
+        if cap is None:
+            pool_avail = d.slices
+        else:
+            pool_avail = (cap - self._pool_alloc.get(d.pool, 0)
+                          + extra_pool + own_alloc)
+        hard = self._ns_quota.get(d.namespace)
+        if hard is None:
+            chip_avail = d.slices
+        else:
+            chip_avail = int((hard - self._ns_effective_used(
+                d.namespace, own_chips=own_alloc * d.chips_per_slice)
+                + extra_chips) // max(d.chips_per_slice, 1))
+        return max(0, min(d.slices, pool_avail, chip_avail))
+
+    def _ns_effective_used(self, ns: str, *, own_chips: float = 0.0
+                           ) -> float:
+        """Chips committed in ``ns``: max(declared gang chips, the
+        quota's stored status.used) — declared covers admitted gangs
+        whose pods haven't landed yet, stored covers every OTHER
+        consumer's live pods (notebooks, serving).  ``own_chips`` (resize
+        decisions) is subtracted from both sides: the job's own
+        allocation is free capacity to itself and its own running pods
+        are inside stored."""
+        declared = self._ns_chips.get(ns, 0.0) - own_chips
+        stored = self._ns_used.get(ns, 0.0) - own_chips
+        return max(declared, stored, 0.0)
+
+    def _admissible(self, d: GangDemand) -> bool:
+        return self._k_max(d) >= d.min_slices
+
+    # -- decisions -----------------------------------------------------------
+
+    def _first_admissible(self) -> Optional[Tuple]:
+        """(rank, key) of the best-ranked waiter that currently fits, or
+        None.  Cached per state epoch: the head-of-line check in decide()
+        must not rescan the waiting prefix on every steady-state poll."""
+        epoch, cached = self._first_adm_cache
+        if epoch == self._epoch:
+            return cached
+        found = None
+        for rank, key in self._waiting:
+            if self._admissible(self._entries[key].demand):
+                found = (rank, key)
+                break
+        self._first_adm_cache = (self._epoch, found)
+        return found
+
+    def decide(self, namespace: str, name: str) -> Decision:
+        """The admission decision for one job.  Head-of-line: a job waits
+        while any better-ranked waiter currently fits — that job will be
+        admitted by its own reconcile; this one queues behind it."""
+        with self._lock:
+            self.decisions += 1
+            key = f"{namespace}/{name}"
+            entry = self._entries.get(key)
+            if entry is None:
+                return Decision("unknown")
+            if entry.alloc is not None:
+                return Decision("admitted", slices=entry.alloc)
+            d = entry.demand
+            first = self._first_admissible()
+            if first is not None and first[0] < d.rank:
+                other = self._entries[first[1]].demand
+                return Decision(
+                    "wait", reason=REASON_QUEUED_BEHIND,
+                    message=f"queued behind {first[1]} "
+                            f"(priority {other.priority})")
+            k = self._k_max(d)
+            if k >= d.min_slices:
+                return Decision("admit", slices=k)
+            targets = self._targets()
+            victims = sorted(v for v, (by, _r) in targets.items()
+                             if by == key)
+            if victims:
+                return Decision(
+                    "wait", reason=REASON_AWAITING_PREEMPTION,
+                    message="preempting " + ", ".join(victims))
+            # Which constraint binds, for the structured reason.
+            cap = self._pool_capacity(d)
+            pool_free = (d.slices if cap is None
+                         else cap - self._pool_alloc.get(d.pool, 0))
+            if pool_free >= d.min_slices:
+                hard = self._ns_quota.get(d.namespace)
+                used = self._ns_effective_used(d.namespace)
+                return Decision(
+                    "wait", reason=REASON_QUOTA,
+                    message=f"namespace {d.namespace} google.com/tpu "
+                            f"quota {hard:g} chips, {used:g} committed; "
+                            f"need {d.min_slices * d.chips_per_slice}")
+            return Decision(
+                "wait", reason=REASON_CAPACITY,
+                message=f"pool {d.accelerator}/{d.topology}: "
+                        f"{max(pool_free, 0)} free slice slot(s), "
+                        f"need {d.min_slices}")
+
+    def _targets(self) -> Dict[str, Tuple[str, str]]:
+        """victim key -> (preemptor key or "", reason).  Cached per state
+        epoch: one O(admitted) scan per mutation, not per query."""
+        epoch, cached = self._targets_cache
+        if epoch == self._epoch:
+            return cached
+        targets: Dict[str, Tuple[str, str]] = {}
+        admitted = [e for e in self._entries.values()
+                    if e.alloc is not None]
+        # Capacity shrink: a pool whose allocation exceeds its (shrunk)
+        # node inventory sheds its lowest-ranked gangs until it fits —
+        # they re-queue and resume elastically at whatever still fits.
+        by_pool: Dict[Tuple[str, str], List[_Entry]] = {}
+        for e in admitted:
+            by_pool.setdefault(e.demand.pool, []).append(e)
+        for pool, entries in by_pool.items():
+            cap = self._pool_capacity(entries[0].demand)
+            if cap is None:
+                continue
+            over = self._pool_alloc.get(pool, 0) - cap
+            if over <= 0:
+                continue
+            for e in sorted(entries,
+                            key=lambda e: (-e.demand.priority,
+                                           e.demand.created,
+                                           e.demand.name),
+                            reverse=True):
+                if over <= 0:
+                    break
+                targets[e.demand.key] = ("", "capacity")
+                over -= e.alloc
+        # Priority preemption: rights belong to the head waiter only.
+        if self._waiting:
+            head = self._entries[self._waiting[0][1]].demand
+            if not self._admissible(head):
+                freed_pool, freed_chips = 0, 0.0
+                chosen: List[str] = []
+                k_before = self._k_max(head)
+                for e in sorted(
+                        (e for e in admitted
+                         if e.demand.priority < head.priority
+                         and e.demand.key not in targets),
+                        key=lambda e: (-e.demand.priority,
+                                       e.demand.created, e.demand.name),
+                        reverse=True):
+                    v = e.demand
+                    same_pool = v.pool == head.pool
+                    same_ns = v.namespace == head.namespace
+                    if not same_pool and not same_ns:
+                        continue
+                    next_pool = freed_pool + (e.alloc if same_pool else 0)
+                    next_chips = freed_chips + (
+                        e.alloc * v.chips_per_slice if same_ns else 0.0)
+                    k_after = self._k_max(head, extra_pool=next_pool,
+                                          extra_chips=next_chips)
+                    if k_after <= k_before:
+                        # Minimal set: a candidate that relaxes no
+                        # BINDING constraint (e.g. frees chips when only
+                        # pool slots bind) must never be evicted.
+                        continue
+                    chosen.append(v.key)
+                    freed_pool, freed_chips = next_pool, next_chips
+                    k_before = k_after
+                    if k_after >= head.min_slices:
+                        for vk in chosen:
+                            targets[vk] = (head.key, "priority")
+                        break
+        self._targets_cache = (self._epoch, targets)
+        return targets
+
+    def should_yield(self, namespace: str, name: str
+                     ) -> Optional[Tuple[str, str]]:
+        """For an ADMITTED job: (preemptor key or "", reason) when the
+        schedule says this gang must checkpoint-and-release its chips —
+        either a higher-priority head waiter claimed them ("priority") or
+        the pool shrank under it ("capacity").  None otherwise."""
+        with self._lock:
+            entry = self._entries.get(f"{namespace}/{name}")
+            if entry is None or entry.alloc is None:
+                return None
+            return self._targets().get(entry.demand.key)
+
+    def grow_target(self, namespace: str, name: str) -> Optional[int]:
+        """For an elastically-shrunk ADMITTED job: the larger gang width
+        it may resize to, or None.  Waiters first: growth never races the
+        queue — it is only offered while no job is waiting at all."""
+        with self._lock:
+            entry = self._entries.get(f"{namespace}/{name}")
+            if entry is None or entry.alloc is None:
+                return None
+            d = entry.demand
+            if entry.alloc >= d.slices or self._waiting:
+                return None
+            k = self._k_max(d, own_alloc=entry.alloc)
+            return k if k > entry.alloc else None
+
+    # -- event fan-out + introspection ---------------------------------------
+
+    def kick_requests(self, limit: int = 4) -> List[Tuple[str, str]]:
+        """Keys whose reconciles could act on the CURRENT state: the head
+        waiters (admission candidates), current preemption targets, and
+        shrunk gangs (growth candidates).  The controller maps every
+        TPUJob delta through this so a capacity change wakes exactly the
+        keys that can use it, instead of rescanning the queue."""
+        with self._lock:
+            out: List[Tuple[str, str]] = []
+            for _rank, key in self._waiting[:limit]:
+                d = self._entries[key].demand
+                out.append((d.namespace, d.name))
+            for vk in self._targets():
+                ns, _, name = vk.partition("/")
+                out.append((ns, name))
+            for key in list(self._shrunk)[:limit]:
+                e = self._entries.get(key)
+                if e is not None:
+                    out.append((e.demand.namespace, e.demand.name))
+            return out
+
+    def depth_by_namespace(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._waiting_by_ns)
+
+    def allocated_total(self) -> int:
+        with self._lock:
+            return self._alloc_total
+
+    def snapshot(self) -> dict:
+        """The /debug/queue page (platform/main.py): the live ledger —
+        waiting order, admitted allocations, pool + quota tallies."""
+        with self._lock:
+            waiting = []
+            for _rank, key in self._waiting:
+                d = self._entries[key].demand
+                waiting.append({
+                    "key": key, "priority": d.priority,
+                    "slices": d.slices, "minSlices": d.min_slices,
+                    "pool": f"{d.accelerator}/{d.topology}",
+                    "chipsPerSlice": d.chips_per_slice,
+                })
+            admitted = []
+            for key, e in sorted(self._entries.items()):
+                if e.alloc is None:
+                    continue
+                admitted.append({
+                    "key": key, "priority": e.demand.priority,
+                    "allocatedSlices": e.alloc,
+                    "specSlices": e.demand.slices,
+                    "pool": f"{e.demand.accelerator}/{e.demand.topology}",
+                })
+            # Key BOTH pool maps by the short accelerator name so the
+            # free-slot math (hosts // hosts_per_slice - allocated) — the
+            # page's whole purpose — joins without reading ACCELERATORS
+            # source; nodes whose label matches no known accelerator keep
+            # the raw label as the key.
+            short_by_label = {a.gke_accelerator: a.name
+                              for a in ACCELERATORS.values()}
+            pools = {}
+            for (label, topo), hosts in sorted(self._pool_hosts.items()):
+                short = short_by_label.get(label, label)
+                pools[f"{short}/{topo}"] = {"hosts": hosts,
+                                            "gkeAccelerator": label}
+            return {
+                "waiting": waiting,
+                "admitted": admitted,
+                "pools": pools,
+                "poolAllocatedSlices": {
+                    f"{a}/{t}": n
+                    for (a, t), n in sorted(self._pool_alloc.items())},
+                "namespaceQuotaChips": dict(sorted(self._ns_quota.items())),
+                "namespaceCommittedChips": {
+                    ns: round(self._ns_effective_used(ns), 1)
+                    for ns in sorted(set(self._ns_chips) |
+                                     set(self._ns_used))
+                    if self._ns_effective_used(ns)},
+                "preemptionTargets": {
+                    vk: {"by": by, "reason": r}
+                    for vk, (by, r) in sorted(self._targets().items())},
+            }
+
+    def _update_gauges(self) -> None:
+        from kubeflow_tpu.platform.runtime import metrics
+
+        metrics.set_tpujob_queue_depth(dict(self._waiting_by_ns))
+        metrics.tpujob_slices_allocated.set(self._alloc_total)
+
+
+# -- /debug/queue registry (same single-slot shape as the metric
+#    collectors: the tpujob controller registers its queue on start and
+#    unhooks on stop; platform/main.py serves the snapshot). -----------------
+
+_debug_queue: Optional[JobQueue] = None
+
+
+def register_debug_queue(queue: Optional[JobQueue]) -> None:
+    global _debug_queue
+    _debug_queue = queue
+
+
+def debug_snapshot() -> Optional[dict]:
+    q = _debug_queue
+    return q.snapshot() if q is not None else None

@@ -178,6 +178,53 @@ tpujob_restarts_total = Counter(
     registry=registry,
 )
 
+# -- TPUJob gang admission queue (runtime/jobqueue.py; docs/observability.md
+#    "TPUJob queue") ----------------------------------------------------------
+
+tpujob_queue_depth = Gauge(
+    "tpujob_queue_depth",
+    "TPUJobs parked Queued waiting for quota/topology capacity, per "
+    "profile namespace",
+    ["profile"], registry=registry,
+)
+tpujob_queue_wait_seconds = Histogram(
+    "tpujob_queue_wait_seconds",
+    "Seconds a TPUJob waited in the admission queue before its gang was "
+    "granted capacity (observed at admission; re-admissions after a "
+    "preemption measure from the Queued transition)",
+    buckets=(0.5, 1, 5, 15, 60, 300, 1800, 7200),
+    registry=registry,
+)
+tpujob_preemptions_total = Counter(
+    "tpujob_preemptions_total",
+    "TPUJob gangs preempted, by reason: 'priority' (a higher-priority "
+    "head waiter claimed the chips) or 'capacity' (the node pool shrank "
+    "under the gang).  Both ride the SIGTERM-checkpoint path",
+    ["reason"], registry=registry,
+)
+tpujob_slices_allocated = Gauge(
+    "tpujob_slices_allocated",
+    "TPU slices currently granted to admitted TPUJob gangs, fleet-wide "
+    "(the jobqueue ledger's allocation tally)",
+    registry=registry,
+)
+
+_queue_depth_namespaces: set = set()
+
+
+def set_tpujob_queue_depth(depths: Dict[str, int]) -> None:
+    """Refresh the per-profile queue-depth gauge from one ledger snapshot,
+    zeroing namespaces that drained (a vanished label would read as a
+    frozen last value on dashboards)."""
+    global _queue_depth_namespaces
+    with _wq_lock:
+        stale = _queue_depth_namespaces - set(depths)
+        _queue_depth_namespaces = set(depths)
+    for ns in stale:
+        tpujob_queue_depth.labels(profile=ns).set(0)
+    for ns, depth in depths.items():
+        tpujob_queue_depth.labels(profile=ns).set(depth)
+
 
 reconcile_errors_total = Counter(
     "reconcile_errors_total",

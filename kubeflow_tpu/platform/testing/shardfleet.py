@@ -54,7 +54,8 @@ class ShardedFleet:
                  chaos_faults: Optional[list] = None,
                  chaos_seed: int = 0,
                  namespace: str = "fleet",
-                 controller_factory=None):
+                 controller_factory=None,
+                 tpu_nodes: int = 1):
         import logging
 
         from kubeflow_tpu.platform.controllers.notebook import (
@@ -75,7 +76,11 @@ class ShardedFleet:
         self.kube = FakeKube()
         self.kube.add_namespace(namespace)
         self.kube.add_namespace("kubeflow")  # shard/member leases
-        self.kube.add_tpu_node("tpu-node-1", topology="2x4")
+        # TPU node inventory: one 2x4 host per node.  TPUJob fleets size
+        # this to their slice demand — the jobqueue ledger gates gang
+        # admission on free topology slots (hosts // hosts_per_slice).
+        for i in range(max(tpu_nodes, 1)):
+            self.kube.add_tpu_node(f"tpu-node-{i + 1}", topology="2x4")
         self._stop = threading.Event()
         self._converged: set = set()
         self._converged_lock = threading.Lock()

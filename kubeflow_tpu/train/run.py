@@ -244,9 +244,18 @@ def main(argv: Optional[list] = None) -> int:
         ap.error("--grad-dtype/--ce-chunk apply to the lm task only")
 
     if args.distributed:
-        from kubeflow_tpu.parallel.dist import initialize_from_env
+        from kubeflow_tpu.parallel.dist import elastic_slices, initialize_from_env
 
         initialize_from_env()
+        allocated, declared = elastic_slices()
+        if allocated < declared:
+            # Elastic TPUJob gang running shrunk: the queue granted fewer
+            # slices than spec.tpu.slices — same checkpoint, smaller
+            # dcn(dp) axis; the controller grows the gang back when
+            # capacity frees (docs/jobs.md).
+            print(f"elastic: running at {allocated}/{declared} slices "
+                  "(shrunk; will grow back via checkpoint-restart)",
+                  flush=True)
 
     from kubeflow_tpu.parallel.context import global_mesh
     from kubeflow_tpu.train.loop import LoopConfig, train_loop

@@ -257,7 +257,10 @@ class ChaosHarness:
 def fleet_kube():
     kube = FakeKube()
     kube.add_namespace("fleet")
-    kube.add_tpu_node("tpu-node-1", topology="2x4")
+    # 16 single-host 2x4 nodes = 16 slice slots: the 8-gang x 2-slice
+    # tpujob storm fits whole (gang admission is capacity-gated now).
+    for i in range(16):
+        kube.add_tpu_node(f"tpu-node-{i + 1}", topology="2x4")
     return kube
 
 

@@ -1,0 +1,824 @@
+"""TpuJobQueue (ISSUE 11): quota-aware gang queueing, priority preemption,
+and elastic capacity for TPUJob.
+
+Three layers, bottom up:
+
+* ledger units — rank order, elastic k_max math, head-of-line blocking,
+  minimal victim selection, incremental-vs-rebuilt equivalence;
+* controller flows over FakeKube — park-with-reason, priority-then-FIFO
+  drain, quota park, the two-phase checkpoint-then-evict, elastic admit
+  at minSlices + grow-back, crashloop-cannot-starve (backoffLimit binds);
+* the chaos/HA pins (slow, the queue-chaos postsubmit lane) — a priority
+  storm under seeded faults and a ShardedFleet replica kill, both of
+  which must preserve the drain-order and no-half-gang invariants.
+"""
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.apis import tpujob as jobapi
+from kubeflow_tpu.platform.controllers.tpujob import TPUJobReconciler
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    POD,
+    STATEFULSET,
+    TPUJOB,
+    deep_get,
+    name_of,
+)
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.runtime import jobqueue as jq
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_job(name, ns="fleet", *, slices=1, min_slices=None, priority=None,
+             topology="2x4", backoff_limit=None, created=None,
+             checkpoint_dir=None):
+    spec = {
+        "tpu": {"accelerator": "v5e", "topology": topology,
+                "slices": slices},
+        "template": {"spec": {"containers": [{
+            "name": "worker", "image": "trainer",
+            "command": ["python", "-m", "kubeflow_tpu.train.run"],
+        }]}},
+    }
+    if min_slices is not None:
+        spec["tpu"]["minSlices"] = min_slices
+    if priority is not None:
+        spec["priority"] = priority
+    if backoff_limit is not None:
+        spec["backoffLimit"] = backoff_limit
+    if checkpoint_dir is not None:
+        spec["checkpointDir"] = checkpoint_dir
+    job = {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": spec,
+    }
+    if created is not None:
+        job["metadata"]["creationTimestamp"] = created
+    return job
+
+
+def make_quota(ns, chips):
+    return {
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": ns},
+        "spec": {"hard": {"google.com/tpu": str(chips)}},
+    }
+
+
+def kube_with_slots(slots, *, ns="fleet"):
+    """FakeKube with ``slots`` single-host v5e 2x4 nodes = that many free
+    slice slots in the (v5e, 2x4) pool (8 chips per slice)."""
+    k = FakeKube()
+    k.add_namespace(ns)
+    for i in range(slots):
+        k.add_tpu_node(f"tpu-{i + 1}", topology="2x4")
+    return k
+
+
+def reconcile(kube, name, ns="fleet", **kwargs):
+    kwargs.setdefault("preemption_grace", 0.05)
+    kwargs.setdefault("queue_poll", 0.05)
+    return TPUJobReconciler(kube, **kwargs).reconcile(Request(ns, name))
+
+
+def drive(kube, names, ns="fleet", rounds=6, **kwargs):
+    """A few level-triggered passes over every key (the controller's
+    event+poll loop, compressed and deterministic)."""
+    for _ in range(rounds):
+        for name in names:
+            reconcile(kube, name, ns, **kwargs)
+
+
+def phase(kube, name, ns="fleet"):
+    return jobapi.phase_of(kube.get(TPUJOB, name, ns))
+
+
+def alloc(kube, name, ns="fleet"):
+    return jobapi.allocated_slices(kube.get(TPUJOB, name, ns))
+
+
+def finish_gang(kube, name, ns="fleet"):
+    """Kubelet-sim: every worker pod of the job exits 0."""
+    for pod in kube.list(POD, ns,
+                         label_selector={jobapi.LABEL_TPUJOB_NAME: name}):
+        kube.set_pod_phase(ns, name_of(pod), "Succeeded", ready=False)
+
+
+def run_gang(kube, name, ns="fleet"):
+    """Kubelet-sim: bring every expected worker pod Running/ready."""
+    job = kube.get(TPUJOB, name, ns)
+    gen = jobapi.generation_of(job)
+    k = jobapi.allocated_slices(job)
+    assert k, f"{name} not admitted: {job.get('status')}"
+    for s in range(k):
+        sts_name = TPUJobReconciler.slice_sts_name(name, s)
+        sts = kube.get(STATEFULSET, sts_name, ns)
+        tmpl = deep_get(sts, "spec", "template")
+        for i in range(deep_get(sts, "spec", "replicas", default=0)):
+            pod_name = f"{sts_name}-{i}"
+            try:
+                kube.create({
+                    "apiVersion": "v1", "kind": "Pod",
+                    "metadata": {"name": pod_name, "namespace": ns,
+                                 "labels": dict(deep_get(
+                                     tmpl, "metadata", "labels",
+                                     default={}) or {})},
+                    "spec": deep_get(tmpl, "spec"),
+                })
+            except errors.AlreadyExists:
+                pass
+            kube.set_pod_phase(ns, pod_name, "Running", ready=True)
+    return gen
+
+
+# -- ledger units -------------------------------------------------------------
+
+
+def entry(q, kube, job):
+    kube.create(job)
+    q.observe(kube.get(TPUJOB, job["metadata"]["name"],
+                       job["metadata"]["namespace"]))
+
+
+def test_rank_is_priority_then_fifo_then_name():
+    q = jq.JobQueue()
+    jobs = [
+        make_job("b", priority=100, created="2026-01-01T00:00:01Z"),
+        make_job("a", priority=100, created="2026-01-01T00:00:02Z"),
+        make_job("z", priority=500, created="2026-01-01T00:00:09Z"),
+        make_job("c", priority=100, created="2026-01-01T00:00:01Z"),
+    ]
+    for j in jobs:
+        q.observe(j)
+    order = [key for _r, key in q._waiting]
+    # priority DESC, then creationTimestamp ASC, then name ASC.
+    assert order == ["fleet/z", "fleet/b", "fleet/c", "fleet/a"]
+
+
+def test_k_max_elastic_against_pool_and_quota():
+    q = jq.JobQueue()
+    q.set_nodes([{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}] * 3)
+    q.set_quotas([make_quota("fleet", 16)])  # 2 slices' worth of chips
+    q.observe(make_job("big", slices=4, min_slices=1))
+    d = q._entries["fleet/big"].demand
+    # pool allows 3, quota allows 2 -> elastic grant is 2.
+    assert q._k_max(d) == 2
+    assert q.decide("fleet", "big").action == "admit"
+    assert q.decide("fleet", "big").slices == 2
+
+
+def test_quota_accounting_counts_other_consumers_stored_usage():
+    """The ledger must charge max(declared gang chips, the quota's
+    stored status.used): a notebook holding 24 of 32 chips (visible only
+    in status.used) leaves room for ONE 8-chip slice, not four — over-
+    admitting here would create a gang whose pods the apiserver plugin
+    partially 403s, the half-scheduled deadlock this queue prevents."""
+    q = jq.JobQueue()
+    quota = make_quota("fleet", 32)
+    quota["status"] = {"used": {"google.com/tpu": "24"}}
+    q.set_quotas([quota])
+    q.observe(make_job("wants-four", slices=4, min_slices=1))
+    d = q.decide("fleet", "wants-four")
+    assert d.action == "admit" and d.slices == 1  # 32-24 = one 8-chip slice
+    quota["status"]["used"]["google.com/tpu"] = "32"
+    q.set_quotas([quota])
+    d = q.decide("fleet", "wants-four")
+    assert d.action == "wait" and d.reason == jq.REASON_QUOTA
+
+
+def test_unknown_pool_is_unlimited_but_empty_pool_blocks():
+    q = jq.JobQueue()
+    q.observe(make_job("nofeed", slices=3))
+    # No node inventory at all: admission must not deadlock.
+    assert q.decide("fleet", "nofeed").action == "admit"
+    # A known pool with too few hosts DOES block.
+    q2 = jq.JobQueue()
+    q2.set_nodes([{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}])
+    q2.observe(make_job("toobig", slices=3, min_slices=2))
+    d2 = q2.decide("fleet", "toobig")
+    assert d2.action == "wait" and d2.reason == jq.REASON_CAPACITY
+
+
+def test_head_of_line_blocks_smaller_lower_rank_job():
+    kube = kube_with_slots(2)
+    q = jq.JobQueue()
+    q.set_nodes(kube.list(
+        __import__("kubeflow_tpu.platform.k8s.types",
+                   fromlist=["NODE"]).NODE, None))
+    q.observe(make_job("head", slices=4, min_slices=4, priority=200,
+                       created="2026-01-01T00:00:01Z"))
+    q.observe(make_job("small", slices=1, priority=100,
+                       created="2026-01-01T00:00:02Z"))
+    # head does not fit (needs 4 of 2) and cannot preempt (nothing
+    # admitted): it waits on capacity...
+    d = q.decide("fleet", "head")
+    assert d.action == "wait" and d.reason == jq.REASON_CAPACITY
+    # ...and small, though it fits, must NOT jump an inadmissible head?
+    # No — head-of-line blocks only behind an ADMISSIBLE better-ranked
+    # waiter; a head that cannot fit at all does not dam the queue.
+    assert q.decide("fleet", "small").action == "admit"
+    # Once the head CAN fit, the small job yields the right of way.
+    for i in range(2):
+        kube.add_tpu_node(f"extra-{i}", topology="2x4")
+    q.set_nodes(kube.list(
+        __import__("kubeflow_tpu.platform.k8s.types",
+                   fromlist=["NODE"]).NODE, None))
+    assert q.decide("fleet", "head").action == "admit"
+    d = q.decide("fleet", "small")
+    assert d.action == "wait" and d.reason == jq.REASON_QUEUED_BEHIND
+
+
+def test_preemption_picks_lowest_priority_youngest_minimal_set():
+    q = jq.JobQueue()
+    q.set_nodes([{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}] * 4)
+
+    def admitted(name, *, priority, alloc, created):
+        j = make_job(name, priority=priority, slices=alloc,
+                     created=created)
+        j["status"] = {"phase": "Running", "allocatedSlices": alloc,
+                       "generation": 0, "restarts": 0}
+        q.observe(j)
+
+    admitted("old-low", priority=50, alloc=1,
+             created="2026-01-01T00:00:01Z")
+    admitted("young-low", priority=50, alloc=1,
+             created="2026-01-01T00:00:05Z")
+    admitted("mid", priority=100, alloc=2,
+             created="2026-01-01T00:00:02Z")
+    q.observe(make_job("high", priority=500, slices=1, min_slices=1,
+                       created="2026-01-01T00:00:09Z"))
+    # One slice needed: exactly ONE victim — the YOUNGEST lowest-priority
+    # gang — never mid (higher priority), never both lows.
+    assert q.should_yield("fleet", "young-low") == ("fleet/high",
+                                                   "priority")
+    assert q.should_yield("fleet", "old-low") is None
+    assert q.should_yield("fleet", "mid") is None
+    d = q.decide("fleet", "high")
+    assert d.action == "wait" and d.reason == jq.REASON_AWAITING_PREEMPTION
+
+
+def test_preemption_skips_victims_that_relax_no_binding_constraint():
+    """A pool-blocked head must never evict a gang from ANOTHER pool just
+    because it shares the head's namespace (freeing chips the head
+    doesn't need) — minimality means every victim moves k_max."""
+    q = jq.JobQueue()
+    nodes_2x4 = [{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}]
+    nodes_4x4 = [{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}] * 2
+    q.set_nodes(nodes_2x4 + nodes_4x4)
+    # Same namespace, OTHER pool (4x4), lowest priority — useless to a
+    # 2x4-blocked head, must not be chosen.
+    other_pool = make_job("other-pool", priority=10, slices=1,
+                          topology="4x4", created="2026-01-01T00:00:05Z")
+    other_pool["status"] = {"phase": "Running", "allocatedSlices": 1,
+                            "generation": 0, "restarts": 0}
+    q.observe(other_pool)
+    same_pool = make_job("same-pool", priority=50, slices=1,
+                         created="2026-01-01T00:00:01Z")
+    same_pool["status"] = {"phase": "Running", "allocatedSlices": 1,
+                           "generation": 0, "restarts": 0}
+    q.observe(same_pool)
+    q.observe(make_job("head", priority=500, slices=1))
+    assert q.should_yield("fleet", "same-pool") == ("fleet/head",
+                                                    "priority")
+    assert q.should_yield("fleet", "other-pool") is None
+
+
+def test_equal_priority_never_preempts():
+    q = jq.JobQueue()
+    q.set_nodes([{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}])
+    j = make_job("incumbent", priority=100, slices=1)
+    j["status"] = {"phase": "Running", "allocatedSlices": 1,
+                   "generation": 0, "restarts": 0}
+    q.observe(j)
+    q.observe(make_job("rival", priority=100, slices=1))
+    assert q.should_yield("fleet", "incumbent") is None
+    d = q.decide("fleet", "rival")
+    assert d.action == "wait" and d.reason == jq.REASON_CAPACITY
+
+
+def test_capacity_shrink_yields_lowest_ranked_gang():
+    nodes = [{"metadata": {"labels": {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}},
+        "status": {"capacity": {"google.com/tpu": "8"}}}] * 2
+    q = jq.JobQueue()
+    q.set_nodes(nodes)
+    for name, prio in (("keep", 200), ("shed", 50)):
+        j = make_job(name, priority=prio, slices=1, min_slices=1)
+        j["status"] = {"phase": "Running", "allocatedSlices": 1,
+                       "generation": 0, "restarts": 0}
+        q.observe(j)
+    assert q.should_yield("fleet", "shed") is None
+    q.set_nodes(nodes[:1])  # the fleet shrank under the gangs
+    assert q.should_yield("fleet", "shed") == ("", "capacity")
+    assert q.should_yield("fleet", "keep") is None
+
+
+def test_incremental_observe_matches_full_rebuild():
+    kube = kube_with_slots(3)
+    kube.create(make_quota("fleet", 24))
+    jobs = [make_job(f"j{i}", slices=1 + i % 2, min_slices=1,
+                     priority=(i % 3 + 1) * 100) for i in range(8)]
+    inc = jq.JobQueue()
+    from kubeflow_tpu.platform.k8s.types import NODE, RESOURCEQUOTA
+    inc.set_nodes(kube.list(NODE, None))
+    inc.set_quotas(kube.list(RESOURCEQUOTA, None))
+    for j in jobs:
+        kube.create(j)
+        inc.observe(kube.get(TPUJOB, j["metadata"]["name"], "fleet"))
+    full = jq.JobQueue(kube)
+    full.ensure_fresh()
+    assert [k for _r, k in inc._waiting] == [k for _r, k in full._waiting]
+    for name in ("j0", "j3", "j7"):
+        assert inc.decide("fleet", name).action == \
+            full.decide("fleet", name).action
+    assert inc.depth_by_namespace() == full.depth_by_namespace()
+    assert inc.allocated_total() == full.allocated_total()
+
+
+def test_snapshot_shape_for_debug_endpoint():
+    q = jq.JobQueue()
+    q.observe(make_job("waiting-job", slices=2, min_slices=1,
+                       priority=300))
+    j = make_job("running-job", slices=2)
+    j["status"] = {"phase": "Running", "allocatedSlices": 2,
+                   "generation": 0, "restarts": 0}
+    q.observe(j)
+    snap = q.snapshot()
+    assert snap["waiting"][0]["key"] == "fleet/waiting-job"
+    assert snap["waiting"][0]["priority"] == 300
+    assert snap["admitted"][0]["key"] == "fleet/running-job"
+    assert snap["admitted"][0]["allocatedSlices"] == 2
+    jq.register_debug_queue(q)
+    try:
+        assert jq.debug_snapshot() == snap
+    finally:
+        jq.register_debug_queue(None)
+    assert jq.debug_snapshot() is None
+
+
+# -- controller flows ---------------------------------------------------------
+
+
+def test_job_parks_queued_with_structured_reason_then_admits():
+    kube = kube_with_slots(1)
+    kube.create(make_job("holder", slices=1))
+    drive(kube, ["holder"])
+    assert phase(kube, "holder") == "Pending" and alloc(kube, "holder") == 1
+    kube.create(make_job("parked", slices=1))
+    result = reconcile(kube, "parked")
+    job = kube.get(TPUJOB, "parked", "fleet")
+    assert jobapi.phase_of(job) == "Queued"
+    assert deep_get(job, "status", "reason") == jq.REASON_CAPACITY
+    conds = {c["type"]: c for c in deep_get(
+        job, "status", "conditions", default=[])}
+    assert conds["Unschedulable"]["status"] == "True"
+    assert "free slice slot" in conds["Unschedulable"]["message"]
+    assert jobapi.queued_at(job) is not None
+    assert result is not None and result.requeue_after  # polls the ledger
+    # Nothing was created: no half-gang ever.
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "parked", "fleet")
+    # Capacity frees (holder completes) -> parked admits, reason cleared.
+    run_gang(kube, "holder")
+    drive(kube, ["holder"])
+    finish_gang(kube, "holder")
+    drive(kube, ["holder", "parked"])
+    job = kube.get(TPUJOB, "parked", "fleet")
+    assert jobapi.allocated_slices(job) == 1
+    assert deep_get(job, "status", "reason") is None
+    assert not deep_get(job, "status", "conditions", default=[])
+    kube.get(STATEFULSET, "parked", "fleet")
+
+
+def test_queue_drains_in_priority_then_fifo_order():
+    kube = kube_with_slots(1)
+    kube.create(make_job("holder", slices=1))
+    drive(kube, ["holder"])
+    run_gang(kube, "holder")
+    # Same-priority FIFO pair + one later high-priority jumper.
+    kube.create(make_job("fifo-1", slices=1, priority=100,
+                         created="2026-01-01T00:00:01Z"))
+    kube.create(make_job("fifo-2", slices=1, priority=100,
+                         created="2026-01-01T00:00:02Z"))
+    kube.create(make_job("vip", slices=1, priority=400,
+                         created="2026-01-01T00:00:09Z"))
+    names = ["holder", "fifo-1", "fifo-2", "vip"]
+    drive(kube, names)
+    assert [phase(kube, n) for n in ("fifo-1", "fifo-2", "vip")] == \
+        ["Queued"] * 3
+
+    admitted_order = []
+
+    def drain_one(finishing):
+        finish_gang(kube, finishing)
+        drive(kube, names)
+        for n in ("vip", "fifo-1", "fifo-2"):
+            if n not in admitted_order and alloc(kube, n) is not None:
+                admitted_order.append(n)
+                run_gang(kube, n)
+                drive(kube, names)
+
+    drain_one("holder")
+    drain_one(admitted_order[0])
+    drain_one(admitted_order[1])
+    assert admitted_order == ["vip", "fifo-1", "fifo-2"]
+
+
+def test_quota_park_reports_insufficient_quota_and_lifts():
+    kube = kube_with_slots(4)
+    kube.create(make_quota("fleet", 8))  # one 8-chip slice
+    kube.create(make_job("first", slices=1))
+    drive(kube, ["first"])
+    assert alloc(kube, "first") == 1
+    kube.create(make_job("second", slices=1))
+    drive(kube, ["second"])
+    job = kube.get(TPUJOB, "second", "fleet")
+    assert jobapi.phase_of(job) == "Queued"
+    assert deep_get(job, "status", "reason") == jq.REASON_QUOTA
+    # Admin raises the quota -> the job admits on its next poll.
+    quota = kube.get(
+        __import__("kubeflow_tpu.platform.k8s.types",
+                   fromlist=["RESOURCEQUOTA"]).RESOURCEQUOTA,
+        "kf-resource-quota", "fleet")
+    quota["spec"]["hard"]["google.com/tpu"] = "16"
+    kube.update(quota)
+    drive(kube, ["second"])
+    assert alloc(kube, "second") == 1
+
+
+def test_two_phase_preemption_checkpoints_then_frees_never_half_admits():
+    kube = kube_with_slots(2)
+    kube.create(make_job("victim", slices=2, min_slices=1, priority=50,
+                         checkpoint_dir="/ckpt/victim"))
+    drive(kube, ["victim"])
+    run_gang(kube, "victim")
+    drive(kube, ["victim"])
+    assert phase(kube, "victim") == "Running"
+
+    kube.create(make_job("vip", slices=2, min_slices=2, priority=500))
+    names = ["victim", "vip"]
+    # First pass: vip waits on preemption; victim marks Preempting and
+    # tears its StatefulSets (the SIGTERM path) but KEEPS its chips.
+    reconcile(kube, "vip")
+    job = kube.get(TPUJOB, "vip", "fleet")
+    assert jobapi.phase_of(job) == "Queued"
+    assert deep_get(job, "status", "reason") == \
+        jq.REASON_AWAITING_PREEMPTION
+    reconcile(kube, "victim")
+    job = kube.get(TPUJOB, "victim", "fleet")
+    assert jobapi.phase_of(job) == "Preempting"
+    assert jobapi.allocated_slices(job) == 2  # still charged: phase 1
+    assert deep_get(job, "status", "preemption", "by") == "fleet/vip"
+    for sts_name in ("victim", "victim-s1"):
+        with pytest.raises(errors.NotFound):
+            kube.get(STATEFULSET, sts_name, "fleet")
+    # The preemptor is NEVER half-admitted into still-held capacity.
+    reconcile(kube, "vip")
+    assert alloc(kube, "vip") is None
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "vip", "fleet")
+    # Phase 2 after the checkpoint grace: chips released, victim
+    # re-queued, vip admitted WHOLE.
+    time.sleep(0.08)
+    drive(kube, names)
+    victim = kube.get(TPUJOB, "victim", "fleet")
+    assert jobapi.phase_of(victim) == "Queued"
+    assert jobapi.allocated_slices(victim) is None
+    # The reason is LIVE: "Preempted" right after eviction, then the
+    # current blocking cause once the poll re-evaluates the ledger.
+    assert deep_get(victim, "status", "reason") in (
+        jq.REASON_PREEMPTED, jq.REASON_CAPACITY)
+    assert alloc(kube, "vip") == 2
+    kube.get(STATEFULSET, "vip", "fleet")
+    kube.get(STATEFULSET, "vip-s1", "fleet")
+    # Restarts untouched: a preemption is not a failure.
+    assert jobapi.restarts_of(victim) == 0
+    assert jobapi.generation_of(victim) == 0
+
+
+def test_preempted_job_resumes_elastically_then_grows_back():
+    kube = kube_with_slots(3)
+    kube.create(make_job("low", slices=3, min_slices=1, priority=50,
+                         checkpoint_dir="/ckpt/low"))
+    drive(kube, ["low"])
+    run_gang(kube, "low")
+    drive(kube, ["low"])
+    # vip (2 slices) preempts low (holds all 3).
+    kube.create(make_job("vip", slices=2, priority=500))
+    names = ["low", "vip"]
+    drive(kube, names)
+    time.sleep(0.08)
+    drive(kube, names)
+    run_gang(kube, "vip")
+    drive(kube, names)
+    # low resumed ELASTICALLY at 1 of 3 slices (generation bumped — a new
+    # gang resumes the same checkpoint), vip holds 2.
+    low = kube.get(TPUJOB, "low", "fleet")
+    assert jobapi.allocated_slices(low) == 1
+    assert jobapi.generation_of(low) == 1
+    assert jobapi.restarts_of(low) == 0
+    sts = kube.get(STATEFULSET, "low", "fleet")
+    env = {e["name"]: e.get("value") for e in deep_get(
+        sts, "spec", "template", "spec", "containers")[0]["env"]}
+    assert env["MEGASCALE_NUM_SLICES"] == "1"
+    assert env["KFT_SPEC_SLICES"] == "3"
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "low-s1", "fleet")
+    run_gang(kube, "low")
+    drive(kube, names)
+    assert phase(kube, "low") == "Running"
+    # vip finishes -> low grows back to its full 3 slices via a graceful
+    # checkpoint-restart (generation bumps again, restarts still 0).
+    finish_gang(kube, "vip")
+    drive(kube, names)
+    time.sleep(0.08)
+    drive(kube, names)
+    low = kube.get(TPUJOB, "low", "fleet")
+    assert jobapi.allocated_slices(low) == 3, low.get("status")
+    assert jobapi.generation_of(low) == 2
+    assert jobapi.restarts_of(low) == 0
+    for s in ("low", "low-s1", "low-s2"):
+        sts = kube.get(STATEFULSET, s, "fleet")
+        env = {e["name"]: e.get("value") for e in deep_get(
+            sts, "spec", "template", "spec", "containers")[0]["env"]}
+        assert env["MEGASCALE_NUM_SLICES"] == "3"
+
+
+def test_crashlooping_high_priority_job_cannot_starve_queue():
+    kube = kube_with_slots(1)
+    kube.create(make_job("looper", slices=1, priority=900,
+                         backoff_limit=1))
+    kube.create(make_job("patient", slices=1, priority=100))
+    drive(kube, ["looper", "patient"])
+    assert alloc(kube, "looper") == 1
+    assert phase(kube, "patient") == "Queued"
+    # The looper's worker crashes, restarts (backoffLimit 1), crashes
+    # again -> terminally Failed -> the queue drains to patient.
+    for _ in range(2):
+        run_gang(kube, "looper")
+        for pod in kube.list(
+                POD, "fleet",
+                label_selector={jobapi.LABEL_TPUJOB_NAME: "looper"}):
+            kube.set_pod_phase("fleet", name_of(pod), "Failed")
+        drive(kube, ["looper", "patient"])
+    looper = kube.get(TPUJOB, "looper", "fleet")
+    assert jobapi.phase_of(looper) == "Failed"
+    assert jobapi.restarts_of(looper) == 1
+    assert alloc(kube, "patient") == 1
+    assert phase(kube, "patient") == "Pending"
+
+
+def test_queue_state_survives_controller_restart():
+    """Every decision input lives in statuses/quotas/nodes: a brand-new
+    reconciler (fresh ledger) must reach the same schedule — the rebuilt-
+    from-informer-caches contract that makes the queue HA-safe."""
+    kube = kube_with_slots(1)
+    kube.create(make_job("holder", slices=1, priority=100,
+                         created="2026-01-01T00:00:00Z"))
+    kube.create(make_job("waiter-b", slices=1, priority=100,
+                         created="2026-01-01T00:00:02Z"))
+    kube.create(make_job("waiter-a", slices=1, priority=100,
+                         created="2026-01-01T00:00:01Z"))
+    drive(kube, ["holder", "waiter-a", "waiter-b"])
+    assert alloc(kube, "holder") == 1
+    # "Restart": all further reconciles use fresh reconcilers anyway (the
+    # drive() helper constructs one per call) — free capacity and check
+    # FIFO held across the rebuild.
+    run_gang(kube, "holder")
+    finish_gang(kube, "holder")
+    drive(kube, ["holder", "waiter-b", "waiter-a"])
+    assert alloc(kube, "waiter-a") == 1
+    assert phase(kube, "waiter-b") == "Queued"
+
+
+def test_queue_metrics_depth_wait_preemptions_allocated():
+    from kubeflow_tpu.platform.runtime import metrics
+
+    kube = kube_with_slots(1)
+    kube.create(make_job("m-holder", slices=1, priority=50))
+    drive(kube, ["m-holder"])
+    run_gang(kube, "m-holder")
+    before = metrics.tpujob_preemptions_total.labels(
+        reason="priority")._value.get()
+    wait_before = metrics.tpujob_queue_wait_seconds._sum.get()
+    kube.create(make_job("m-waiter", slices=1, priority=100))
+    drive(kube, ["m-holder", "m-waiter"])
+    assert metrics.tpujob_queue_depth.labels(
+        profile="fleet")._value.get() == 1
+    assert metrics.tpujob_slices_allocated._value.get() == 1
+    # The waiter preempts (higher priority), the victim drains, the
+    # waiter admits -> wait histogram observed, preemption counted.
+    drive(kube, ["m-holder", "m-waiter"])
+    time.sleep(0.08)
+    drive(kube, ["m-holder", "m-waiter"])
+    assert metrics.tpujob_preemptions_total.labels(
+        reason="priority")._value.get() == before + 1
+    assert alloc(kube, "m-waiter") == 1
+    assert metrics.tpujob_queue_wait_seconds._sum.get() >= wait_before
+    assert metrics.tpujob_queue_depth.labels(
+        profile="fleet")._value.get() == 1  # the evicted holder re-queued
+
+
+# -- chaos + HA pins (the queue-chaos postsubmit lane) ------------------------
+
+
+def _watch_admission_order(kube, ns, stop, order, lock):
+    for _etype, job in kube.watch(TPUJOB, ns, stop=stop):
+        if jobapi.allocated_slices(job) is not None:
+            name = job["metadata"]["name"]
+            with lock:
+                if name not in order:
+                    order.append(name)
+
+
+@pytest.mark.slow
+def test_priority_storm_drains_in_order_with_invariants():
+    """The queue under fire (queue-chaos lane): 9 jobs of three
+    priorities into a 2-slot budget with a seeded ChaosKube storm on the
+    controller's whole apiserver path.  The queue must drain in
+    priority-then-FIFO order, with zero dead-letters, zero half-gangs,
+    and no job lost."""
+    from kubeflow_tpu.platform.controllers import tpujob as jobctrl
+    from kubeflow_tpu.platform.runtime.controller import make_workqueue
+    from kubeflow_tpu.platform.testing.chaos import ChaosKube, storm
+    from kubeflow_tpu.platform.testing.jobsim import TpuJobGangSim
+
+    kube = kube_with_slots(2)
+    chaos = ChaosKube(kube, storm(rate=0.05, max_injections=60),
+                      seed=20260804)
+    sim = TpuJobGangSim(kube, "fleet")  # kubelet: pods come up Running
+    ctrl = jobctrl.make_controller(
+        chaos, preemption_grace=0.1, queue_poll=0.1)
+    ctrl.workers = 4
+    ctrl.queue = make_workqueue(base_delay=0.05, max_delay=2.0)
+    stop = threading.Event()
+    order, lock = [], threading.Lock()
+    watcher = threading.Thread(
+        target=_watch_admission_order,
+        args=(kube, "fleet", stop, order, lock), daemon=True)
+    watcher.start()
+    ctrl.start(chaos)
+    names = []
+    try:
+        # Fill the budget FIRST with unpreemptable holders so a real
+        # queue forms (admission order is only defined among jobs that
+        # actually wait together — the queue is not clairvoyant about
+        # jobs submitted later).
+        for h in range(2):
+            kube.create(make_job(f"holder-{h}", slices=1, priority=900))
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(jobapi.allocated_slices(
+                    kube.get(TPUJOB, f"holder-{h}", "fleet")) is not None
+                   for h in range(2)):
+                break
+            time.sleep(0.05)
+        for i, prio in enumerate([100, 100, 100, 300, 300, 300,
+                                  500, 500, 500]):
+            name = f"sj-{prio}-{i}"
+            names.append(name)
+            kube.create(make_job(name, slices=1, priority=prio,
+                                 created=f"2026-01-01T00:00:{i:02d}Z"))
+
+        def drained():
+            with lock:
+                return len([n for n in order
+                            if n.startswith("sj-")]) >= len(names)
+
+        def all_succeeded():
+            return all(jobapi.phase_of(j) == "Succeeded"
+                       for j in kube.list(TPUJOB, "fleet"))
+
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline and not (
+                drained() and all_succeeded()):
+            # Complete whatever is currently admitted so the queue moves.
+            for job in kube.list(TPUJOB, "fleet"):
+                if (jobapi.phase_of(job) == "Running"):
+                    finish_gang(kube, job["metadata"]["name"])
+            time.sleep(0.1)
+        chaos.pause()
+        assert drained(), (order, [
+            (j["metadata"]["name"], j.get("status"))
+            for j in kube.list(TPUJOB, "fleet")])
+        with lock:
+            got = [n for n in order if n.startswith("sj-")]
+        # Priority bands drain high-to-low; FIFO inside each band.
+        expected = sorted(
+            names, key=lambda n: (-int(n.split("-")[1]),
+                                  int(n.split("-")[2])))
+        assert got == expected, (got, expected)
+        assert not ctrl.dead_letters
+        # No half-gangs anywhere: every admitted generation materialized
+        # exactly its granted StatefulSet count before completing.
+        for job in kube.list(TPUJOB, "fleet"):
+            assert jobapi.phase_of(job) == "Succeeded", job.get("status")
+    finally:
+        stop.set()
+        ctrl.stop()
+        sim.close()
+    assert chaos.injected() > 0, "the storm never stormed"
+
+
+@pytest.mark.slow
+def test_sharded_replica_kill_preserves_drain_order():
+    """ISSUE 11 acceptance (HA half): the queue is a pure function of
+    watch state, so a replica kill mid-drain must not reorder it — the
+    survivor absorbs the dead replica's keys and keeps admitting in
+    priority-then-FIFO order, with every write fenced and zero
+    dead-letters / lost jobs / duplicate gangs."""
+    from kubeflow_tpu.platform.controllers import tpujob as jobctrl
+    from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+
+    fleet = ShardedFleet(
+        replicas=2, num_shards=4, workers=2,
+        lease_seconds=0.5, renew_seconds=0.05,
+        controller_factory=lambda client, **kw: jobctrl.make_controller(
+            client, preemption_grace=0.1, queue_poll=0.1, **kw),
+        tpu_nodes=2)  # 2-slot budget: a real queue forms
+    ns = fleet.namespace
+    stop = threading.Event()
+    order, lock = [], threading.Lock()
+    watcher = threading.Thread(
+        target=_watch_admission_order,
+        args=(fleet.kube, ns, stop, order, lock), daemon=True)
+    watcher.start()
+    names = []
+    try:
+        fleet.wait_stable_shard_map()
+        # Fill the 2-slot budget with unpreemptable holders so the six
+        # test jobs all queue TOGETHER (admission order is only defined
+        # among jobs waiting at the same time — the queue is not
+        # clairvoyant about later submissions).
+        for h in range(2):
+            fleet.kube.create(make_job(f"holder-{h}", ns=ns, slices=1,
+                                       priority=900))
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if all(jobapi.allocated_slices(fleet.kube.get(
+                    TPUJOB, f"holder-{h}", ns)) is not None
+                   for h in range(2)):
+                break
+            time.sleep(0.05)
+        for i, prio in enumerate([100, 100, 400, 400, 200, 200]):
+            name = f"kj-{prio}-{i}"
+            names.append(name)
+            fleet.kube.create(make_job(
+                name, ns=ns, slices=1, priority=prio,
+                created=f"2026-01-01T00:00:{i:02d}Z"))
+
+        def admitted_count():
+            with lock:
+                return len([n for n in order if n.startswith("kj-")])
+
+        # THE kill: one replica dies while the whole queue is parked —
+        # the survivor absorbs its shards and must drain everything in
+        # rank order.
+        fleet.kill(0)
+        deadline = time.monotonic() + 180.0
+        while time.monotonic() < deadline and admitted_count() < len(names):
+            for job in fleet.kube.list(TPUJOB, ns):
+                if jobapi.phase_of(job) == "Running":
+                    finish_gang(fleet.kube, job["metadata"]["name"], ns)
+            time.sleep(0.1)
+        assert admitted_count() == len(names), (order, [
+            (j["metadata"]["name"], j.get("status"))
+            for j in fleet.kube.list(TPUJOB, ns)])
+        with lock:
+            got = [n for n in order if n.startswith("kj-")]
+        expected = sorted(
+            names, key=lambda n: (-int(n.split("-")[1]),
+                                  int(n.split("-")[2])))
+        assert got == expected, (got, expected)
+        checked = fleet.assert_fencing_invariant(
+            kinds={"StatefulSet", "Service", "TPUJob"})
+        assert checked > 0
+        for r in fleet.replicas:
+            if r.alive:
+                assert not r.controller.dead_letters
+    finally:
+        stop.set()
+        fleet.close()

@@ -95,7 +95,25 @@ def test_tpujob_crd_yaml_matches_api_manifest():
                 == list(jobapi.RESTART_POLICIES))
         assert set(spec_schema["properties"]) == {
             "tpu", "template", "restartPolicy", "backoffLimit",
-            "checkpointDir"}
+            "priority", "checkpointDir"}
+        # Queue-era spec surface: priority >= 1 and the elastic floor.
+        assert spec_schema["properties"]["priority"]["minimum"] == 1
+        tpu_props = spec_schema["properties"]["tpu"]["properties"]
+        assert set(tpu_props) == {"accelerator", "topology", "slices",
+                                  "minSlices"}
+        assert tpu_props["minSlices"]["minimum"] == 1
+        # Printer columns: `kubectl get tpujobs` must show the queue
+        # state (PHASE/PRIORITY/SLICES/REASON/AGE) — names, types and
+        # jsonPaths pinned on BOTH sides of the drift fence.
+        cols = [(c["name"], c["type"], c["jsonPath"])
+                for c in version["additionalPrinterColumns"]]
+        assert cols == [
+            ("Phase", "string", ".status.phase"),
+            ("Priority", "integer", ".spec.priority"),
+            ("Slices", "integer", ".status.allocatedSlices"),
+            ("Reason", "string", ".status.reason"),
+            ("Age", "date", ".metadata.creationTimestamp"),
+        ]
 
 
 def test_release_pinning_roundtrip(tmp_path):

@@ -406,7 +406,10 @@ def test_tpujob_gang_writes_fenced_across_replica_kill():
 
     fleet = ShardedFleet(replicas=2, num_shards=4, workers=2,
                          lease_seconds=TTL, renew_seconds=RENEW,
-                         controller_factory=jobctrl.make_controller)
+                         controller_factory=jobctrl.make_controller,
+                         # 12 gangs x 2 slices of 2x4 (1 host each): the
+                         # queue admits all 24 slices only with 24 slots.
+                         tpu_nodes=24)
     n = 12
 
     def all_jobs_at(phase, restarts):

@@ -29,7 +29,8 @@ from kubeflow_tpu.platform.testing import FakeKube
 
 
 def make_job(name="tjob", ns="jobs", *, topology="4x4", slices=2,
-             restart_policy=None, backoff_limit=None, checkpoint_dir=None):
+             restart_policy=None, backoff_limit=None, checkpoint_dir=None,
+             priority=None, min_slices=None):
     spec = {
         "tpu": {"accelerator": "v5e", "topology": topology,
                 "slices": slices},
@@ -44,6 +45,10 @@ def make_job(name="tjob", ns="jobs", *, topology="4x4", slices=2,
         spec["backoffLimit"] = backoff_limit
     if checkpoint_dir is not None:
         spec["checkpointDir"] = checkpoint_dir
+    if priority is not None:
+        spec["priority"] = priority
+    if min_slices is not None:
+        spec["tpu"]["minSlices"] = min_slices
     return {
         "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
         "metadata": {"name": name, "namespace": ns},
@@ -55,7 +60,11 @@ def make_job(name="tjob", ns="jobs", *, topology="4x4", slices=2,
 def kube():
     k = FakeKube()
     k.add_namespace("jobs")
-    k.add_tpu_node("tpu-1", topology="4x4")
+    # 4 hosts of v5e 4x4 (2 hosts per slice) = 2 free slice slots: the
+    # default 2-slice job fits WHOLE — the jobqueue ledger gates gang
+    # admission on node-derived topology capacity now.
+    for i in range(4):
+        k.add_tpu_node(f"tpu-{i + 1}", topology="4x4")
     return k
 
 
@@ -67,7 +76,7 @@ def set_gang_running(kube, job, *, ns="jobs"):
     """Kubelet-sim: every expected worker pod of the CURRENT generation
     exists and is Running/ready."""
     name = name_of(job)
-    gen = jobapi.restarts_of(kube.get(TPUJOB, name, ns))
+    gen = jobapi.generation_of(kube.get(TPUJOB, name, ns))
     spec = jobapi.tpu_slice(job)
     for s in range(spec.num_slices):
         sts_name = TPUJobReconciler.slice_sts_name(name, s)
@@ -338,18 +347,63 @@ def test_validate_rejects_bad_specs():
     for mutate, msg in [
         (lambda j: j["spec"].pop("tpu"), "accelerator"),
         (lambda j: j["spec"]["tpu"].pop("accelerator"), "accelerator"),
+        (lambda j: j["spec"]["tpu"].update(accelerator="v9z"),
+         "unknown TPU accelerator"),
         (lambda j: j["spec"].update(restartPolicy="Always"),
          "restartPolicy"),
         (lambda j: j["spec"].update(backoffLimit=-1), "backoffLimit"),
         (lambda j: j["spec"]["template"]["spec"].update(containers=[]),
          "containers"),
         (lambda j: j["metadata"].update(name="x" * 53), "52"),
+        # Queue-era matrix (ISSUE 11 satellite): non-positive priority,
+        # non-integer priority, an elastic floor above the declared
+        # width, and junk minSlices all park Degraded at admission
+        # instead of crash-looping the reconciler.
+        (lambda j: j["spec"].update(priority=0), "priority"),
+        (lambda j: j["spec"].update(priority=-3), "priority"),
+        (lambda j: j["spec"].update(priority="high"), "priority"),
+        (lambda j: j["spec"].update(priority=True), "priority"),
+        (lambda j: j["spec"]["tpu"].update(minSlices=3), "minSlices"),
+        (lambda j: j["spec"]["tpu"].update(minSlices=0), "minSlices"),
+        (lambda j: j["spec"]["tpu"].update(minSlices="one"), "minSlices"),
     ]:
         job = make_job()
         mutate(job)
         with pytest.raises(jobapi.ValidationError, match=msg):
             jobapi.validate(job)
     jobapi.validate(make_job())  # the base shape is valid
+    jobapi.validate(make_job(priority=500, min_slices=1))  # queue knobs OK
+
+
+def test_invalid_priority_parks_degraded_with_warning_event(kube):
+    """The reconcile-side contract for the validation matrix: a stored
+    non-positive priority (possible via kubectl — the CRD minimum only
+    guards the happy path) parks Degraded with a Warning event and never
+    reaches gang creation."""
+    from kubeflow_tpu.platform.k8s.types import EVENT
+
+    bad = make_job(name="badprio")
+    bad["spec"]["priority"] = 0
+    kube.create(bad)
+    reconcile(kube, "badprio")
+    job = kube.get(TPUJOB, "badprio", "jobs")
+    conds = {c["type"]: c for c in deep_get(
+        job, "status", "conditions", default=[])}
+    assert conds["Degraded"]["reason"] == "InvalidSpec"
+    assert "priority" in conds["Degraded"]["message"]
+    with pytest.raises(errors.NotFound):
+        kube.get(STATEFULSET, "badprio", "jobs")
+    events = [e for e in kube.list(EVENT, "jobs")
+              if deep_get(e, "involvedObject", "name") == "badprio"]
+    assert any(e.get("type") == "Warning"
+               and e.get("reason") == "InvalidTPUJob" for e in events)
+
+
+def test_elastic_spec_helpers_default_to_rigid():
+    job = make_job()
+    assert jobapi.priority_of(job) == jobapi.DEFAULT_PRIORITY
+    assert jobapi.min_slices_of(job) == 2  # = slices: rigid by default
+    assert jobapi.min_slices_of(make_job(min_slices=1)) == 1
 
 
 def test_pod_mapper_routes_by_job_label():
