@@ -42,6 +42,7 @@ REQUIRED_METRICS = {
     "ctrlplane_sharded_replica_load",
     "ctrlplane_fleet_churn",
     "tpujob_queue_decisions_per_s",
+    "inferenceservice_scale_converge_s",
 }
 # Metrics whose full-run lines are banded; at smoke N they must still
 # carry the self-report fields so trending tooling never hits a gap.
@@ -54,6 +55,7 @@ BANDED_METRICS = {
     "ctrlplane_sharded_converge_s",
     "ctrlplane_sharded_replica_load",
     "tpujob_queue_decisions_per_s",
+    "inferenceservice_scale_converge_s",
 }
 
 
@@ -165,7 +167,7 @@ def main() -> int:
         sys.executable, "bench_scale.py",
         "--small", "6", "--large", "10", "--chaos-fleet", "6",
         "--sweep-fleet", "8", "--churn-seconds", "0.5",
-        "--sharded-fleet", "24",
+        "--sharded-fleet", "24", "--inference-services", "6",
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=560)
     seen = _parse_json_lines(proc.stdout, "bench_scale")
@@ -213,6 +215,20 @@ def main() -> int:
     if not (isinstance(jobq.get("decisions"), int)
             and jobq["decisions"] > 0 and jobq.get("value", 0) > 0):
         print(f"jobqueue line missing/zero decisions: {jobq}",
+              file=sys.stderr)
+        return 1
+    # InferenceService autoscale band (ISSUE 12): both wave legs must
+    # have run (a zero leg means the fleet never actually scaled) and the
+    # zero-dead-letter invariant must ride the line.
+    inference = seen["inferenceservice_scale_converge_s"]
+    for key in ("wave_converge_s", "drain_converge_s"):
+        if not (isinstance(inference.get(key), (int, float))
+                and inference[key] > 0):
+            print(f"inference scale line missing/zero {key}: {inference}",
+                  file=sys.stderr)
+            return 1
+    if inference.get("dead_letters") != 0:
+        print(f"inference scale line dead_letters != 0: {inference}",
               file=sys.stderr)
         return 1
     print(f"bench-smoke ctrlplane OK: {len(seen)} metrics "

@@ -311,6 +311,36 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # InferenceService presubmit lane (ISSUE 12): the serving controller
+    # + API unit matrix (revisioned Deployments, rolling readiness-gated
+    # flips, scale-to-zero/wake, quota clamp, CRD drift pins) with the
+    # pure-unit autoscaler decision matrix and the shared-ledger pin,
+    # then the seeded inferenceservice storm.  The
+    # inferenceservice-autoscale-rollout conformance scenario rides the
+    # existing `conformance` postsubmit lane, whose kubeflow_tpu/* +
+    # conformance/* globs already cover this subsystem.
+    name="inferenceservice",
+    include_dirs=[
+        "kubeflow_tpu/platform/controllers/*", "kubeflow_tpu/platform/apis/*",
+        "kubeflow_tpu/platform/runtime/*", "kubeflow_tpu/platform/testing/*",
+        "kubeflow_tpu/models/serve.py", "kubeflow_tpu/telemetry/*",
+        "manifests/*", "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest(
+            "tests/ctrlplane/test_inferenceservice_controller.py",
+            "tests/ctrlplane/test_autoscale.py",
+            "tests/ctrlplane/test_manifests.py",
+        )),
+        Step("ledger", _pytest("tests/ctrlplane/test_jobqueue.py")
+             + ["-m", "not slow", "-k", "inference"], depends="unit"),
+        Step("storm", _pytest("tests/ctrlplane/test_chaos.py")
+             + ["-m", "not slow", "-k", "inferenceservice"],
+             depends="ledger"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

@@ -804,6 +804,295 @@ def tpujob_queue_preempt_elastic():
     assert chaos.injected() > 0, "the storm never stormed"
 
 
+@check("inferenceservice-autoscale-rollout")
+def inferenceservice_autoscale_rollout():
+    """ISSUE 12 acceptance: an InferenceService serving a REAL llama_debug
+    model server scales 2→N under synthetic client load (the serve series
+    scraped over real HTTP from the replicas' live /metrics pages), rolls
+    a new checkpoint revision — written through train/checkpoint.py,
+    warmed by the real /readyz one-token generate(), traffic flipped only
+    after it passes — with ZERO dropped requests, scales to zero when the
+    traffic stops, and wakes on the next request via the activator
+    annotation.  All of it under a seeded ChaosKube storm on BOTH
+    controller replicas of a ShardedFleet, with one replica KILLED
+    mid-wave and the fencing invariant held across the handover."""
+    import dataclasses
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+    import time as _time
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from werkzeug.serving import make_server
+
+    from kubeflow_tpu.platform.apis import inferenceservice as svcapi
+    from kubeflow_tpu.platform.controllers import (
+        inferenceservice as svcctrl,
+    )
+    from kubeflow_tpu.platform.k8s.types import (
+        INFERENCESERVICE,
+        SERVICE,
+        deep_get,
+    )
+    from kubeflow_tpu.platform.testing.chaos import storm
+    from kubeflow_tpu.platform.testing.servesim import InferenceFleetSim
+    from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+
+    # The lock-serialized serve path keeps the scenario CPU-budget-friendly
+    # (no pool-decode compile per revision); queue depth and TTFT are the
+    # scraped series either way.
+    os.environ["KFT_SERVE_SCHEDULER"] = "0"
+
+    # -- real model backends, one per revision ----------------------------
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.models.serve import create_app, load_service
+
+    servers = []          # (server, thread) for teardown
+    backends = {}         # revision str -> base url
+
+    def start_backend(revision: int, service_obj):
+        app = create_app(service_obj, model_name="llama_debug",
+                         revision=revision)
+        server = make_server("127.0.0.1", 0, app, threaded=True)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        servers.append((server, t))
+        backends[str(revision)] = f"http://127.0.0.1:{server.server_port}"
+
+    start_backend(1, load_service("llama_debug", max_seq_len=64))
+
+    # -- the cluster: 2 sharded controller replicas under a seeded storm --
+    fleet = ShardedFleet(
+        replicas=2, num_shards=4, namespace="serve",
+        chaos_faults=storm(rate=0.03, max_injections=60),
+        chaos_seed=20260812,
+        controller_factory=lambda client, **kw: svcctrl.make_controller(
+            client, sync_period=0.25, **kw),
+    )
+    kube = fleet.kube
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": "serve"},
+        "spec": {"hard": {"google.com/tpu": "64"}},
+    })
+
+    def http_ok(url, timeout=120.0):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                return resp.status == 200
+        except Exception:
+            return False
+
+    # The kubelet half: pods come up per Deployment, Ready gated on the
+    # REAL server's /readyz (the warm one-token generate actually runs).
+    sim = InferenceFleetSim(
+        kube, "serve",
+        endpoint_for=lambda svc, rev, i: backends.get(rev),
+        ready_gate=lambda svc, rev, i: (rev in backends
+                                        and http_ok(backends[rev]
+                                                    + "/readyz")),
+    )
+
+    # -- traffic: every request must succeed, flip or storm or not --------
+    stop_traffic = threading.Event()
+    failures = []
+    served = {"count": 0}
+
+    def resolved_backend():
+        try:
+            service = kube.get(SERVICE, "llm", "serve")
+        except Exception:
+            return None
+        rev = deep_get(service, "spec", "selector",
+                       svcapi.LABEL_REVISION)
+        return backends.get(rev)
+
+    def traffic_loop():
+        body = _json.dumps({"tokens": [[5, 9, 2, 7]],
+                            "max_new_tokens": 4}).encode()
+        while not stop_traffic.is_set():
+            base = resolved_backend()
+            if base is None:
+                failures.append("no backend resolvable")
+                break
+            try:
+                req = urllib.request.Request(
+                    base + "/v1/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    out = _json.loads(resp.read())
+                    assert len(out["tokens"][0]) == 4
+                    served["count"] += 1
+            except Exception as e:  # noqa: BLE001 — the count IS the check
+                failures.append(f"{type(e).__name__}: {e}")
+            _time.sleep(0.03)
+
+    def wait(fn, what, timeout=120.0):
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            if fn():
+                return
+            _time.sleep(0.05)
+        status = {}
+        try:
+            status = kube.get(INFERENCESERVICE, "llm",
+                              "serve").get("status") or {}
+        except Exception:
+            pass
+        raise TimeoutError(
+            f"inferenceservice conformance: timed out on {what} "
+            f"(status {status}, failures {failures[:3]})")
+
+    def status():
+        return kube.get(INFERENCESERVICE, "llm", "serve").get(
+            "status") or {}
+
+    ckpt = tempfile.mkdtemp(prefix="isvc-ckpt-")
+    traffic_threads = []
+    try:
+        kube.create({
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "InferenceService",
+            "metadata": {"name": "llm", "namespace": "serve"},
+            "spec": {
+                "model": "llama_debug",
+                "maxSeqLen": 64,
+                "tpu": {"accelerator": "v5e", "topology": "2x4"},
+                "replicas": {"min": 0, "max": 4, "initial": 2},
+                "scale": {
+                    # Any real CPU TTFT (≥ ~1 ms) is far above this
+                    # ceiling, so sustained traffic deterministically
+                    # drives the width to its max.
+                    "ttftP99TargetSeconds": 0.0005,
+                    "queueDepthTarget": 4.0,
+                    # Long while traffic flows; phase 5 shortens it by a
+                    # spec patch (scale knobs never roll a revision).
+                    "idleSeconds": 300.0,
+                    "cooldownSeconds": 0.2,
+                },
+            },
+        })
+        # Phase 1 — the initial pool warms through the real /readyz
+        # (2 replicas requested; traffic starts the moment the first is
+        # Ready, before the no-load autoscaler can draw the pool down).
+        wait(lambda: status().get("phase") == "Ready"
+             and status().get("readyReplicas", 0) >= 1,
+             "initial replicas Ready")
+
+        # Phase 2 — the wave: real clients; TTFT scraped over real HTTP
+        # scales the service to its 4-replica ceiling.
+        for _ in range(2):
+            t = threading.Thread(target=traffic_loop, daemon=True)
+            t.start()
+            traffic_threads.append(t)
+        wait(lambda: status().get("replicas") == 4
+             and status().get("readyReplicas") == 4,
+             "traffic wave scale-up 2->4")
+
+        # Phase 3 — kill controller replica 0 MID-WAVE: the survivor
+        # absorbs the shards; scaling and the coming rollout continue.
+        kill_t = _time.monotonic()
+        fleet.kill(0)
+
+        # Phase 4 — rolling weight update: a REAL checkpoint written
+        # through train/checkpoint.py becomes revision 2; it warms, the
+        # real readiness generate() passes, traffic flips, revision 1
+        # drains — with the clients still hammering and zero failures.
+        import optax
+
+        from kubeflow_tpu.train import create_train_state
+        from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+        cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=64)
+        model = Llama(cfg)
+        state = create_train_state(
+            jax.random.key(7), model, jnp.ones((1, 8), jnp.int32),
+            optax.sgd(1e-3))
+        with CheckpointManager(ckpt, max_to_keep=1) as mgr:
+            mgr.save(1, state, force=True)
+        start_backend(2, load_service("llama_debug", max_seq_len=64,
+                                      checkpoint_dir=ckpt))
+        svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+        svc["spec"] = dict(svc["spec"], checkpointDir=ckpt)
+        kube.update(svc)
+        wait(lambda: status().get("revision") == 2
+             and status().get("readyReplicas", 0) >= 1,
+             "rolling update flips to revision 2")
+        # The Service now routes to replicas that really serve the new
+        # revision (their own /metrics says so).
+        page = urllib.request.urlopen(
+            resolved_backend() + "/metrics", timeout=10).read().decode()
+        assert "serve_replica_revision 2.0" in page, page[-500:]
+
+        # Phase 5 — traffic stops: the width drains, and with the idle
+        # window shortened (an operator knob edit, NOT a revision — the
+        # pods never restart) the service scales to ZERO.
+        stop_traffic.set()
+        for t in traffic_threads:
+            t.join(timeout=70)
+        svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+        svc["spec"] = dict(svc["spec"], scale={
+            **svc["spec"]["scale"], "idleSeconds": 1.5})
+        kube.update(svc)
+        wait(lambda: status().get("replicas") == 0
+             and status().get("phase") == "Idle",
+             "idle scale-to-zero")
+        assert status().get("revision") == 2  # the knob edit rolled nothing
+
+        # Phase 6 — the next request wakes it: the activator stamps the
+        # wake annotation; the service comes back (cold start through
+        # the real warm generate) and serves the request.
+        svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+        svc["metadata"] = dict(svc["metadata"], annotations={
+            **(svc["metadata"].get("annotations") or {}),
+            svcapi.ANNOTATION_WAKE: str(_time.time()),
+        })
+        kube.update(svc)
+        wait(lambda: status().get("phase") == "Ready"
+             and status().get("readyReplicas", 0) >= 1,
+             "cold-start wake to Ready")
+        base = resolved_backend()
+        req = urllib.request.Request(
+            base + "/v1/generate",
+            data=_json.dumps({"tokens": [[5, 9, 2, 7]],
+                              "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+
+        # The killed replica never wrote after its lease deadline, and
+        # every write that reached the wire was fenced inside an
+        # ownership window — across the kill.
+        checked = fleet.assert_fencing_invariant(
+            kinds={"InferenceService", "Deployment", "Service",
+                   "VirtualService"})
+        assert checked > 0, "no fenced writes checked"
+        fleet.assert_no_writes_after(
+            0, kill_t + fleet.lease_seconds + 0.5,
+            kinds={"InferenceService", "Deployment", "Service",
+                   "VirtualService"})
+    finally:
+        stop_traffic.set()
+        fleet.close()
+        sim.close()
+        for server, t in servers:
+            server.shutdown()
+            t.join(timeout=5)
+        shutil.rmtree(ckpt, ignore_errors=True)
+        os.environ.pop("KFT_SERVE_SCHEDULER", None)
+
+    # Zero dropped requests, real traffic actually flowed, the storm
+    # actually stormed, the sim saw no errors.
+    assert not failures, failures[:5]
+    assert served["count"] > 20, served
+    assert not sim.errors, sim.errors
+    assert sum(r.chaos.injected() for r in fleet.replicas) > 0, (
+        "the storm never stormed")
+
+
 @check("api-authn-authz")
 def api_authn_authz():
     """Identity comes from the trusted header; requests without it are 401

@@ -8,6 +8,12 @@ with one command:
 
 Endpoints:
   GET  /healthz             liveness
+  GET  /readyz              readiness: runs (and caches) a one-token warm
+                            generate() — 200 only after the model has
+                            actually produced a token, so a controller's
+                            rolling update never routes traffic to a
+                            replica that would compile-stall or crash on
+                            its first request
   GET  /v1/model            model name/config summary
   POST /v1/generate         {"tokens": [[...]], "max_new_tokens": 32,
                              "temperature": 0.8, "top_k": 40, "seed": 0}
@@ -383,10 +389,17 @@ class Seq2SeqGenerationService:
         return _telemetry_request(self, rows, eos_token, validate, run)
 
 
-def create_app(service: GenerationService, *, model_name: str = "model"):
+def create_app(service: GenerationService, *, model_name: str = "model",
+               revision: Optional[int] = None):
+    """``revision``: the serving revision this replica runs (the
+    InferenceService controller injects KFT_SERVE_REVISION; standalone
+    servers default to 0) — exported as ``serve_replica_revision`` so
+    rollout tests and dashboards can see which weights a replica
+    actually serves."""
     from prometheus_client import (
         CollectorRegistry,
         Counter,
+        Gauge,
         Histogram,
         generate_latest,
     )
@@ -415,6 +428,17 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     tokens_total = Counter(
         "generate_tokens_total", "Tokens generated", registry=registry,
     )
+    if revision is None:
+        from kubeflow_tpu.platform import config as _cfg
+
+        revision = _cfg.env_int("KFT_SERVE_REVISION", 0)
+    replica_revision = Gauge(
+        "serve_replica_revision",
+        "InferenceService revision this replica serves "
+        "(KFT_SERVE_REVISION; 0 for standalone servers)",
+        registry=registry,
+    )
+    replica_revision.set(revision)
     # Serve-path telemetry (telemetry/serve.py): queue/batch/TTFT/
     # per-token series in the same per-app registry, plus the per-request
     # tracer /debug/traces serves.  Attached to the service because the
@@ -425,6 +449,36 @@ def create_app(service: GenerationService, *, model_name: str = "model"):
     @app.route("/healthz")
     def healthz(request):
         return success({"healthy": True})
+
+    # One-token warm generate, run once and cached: Ready means "this
+    # process has actually produced a token" — weights restored, the
+    # decode path compiled for a minimal shape.  The InferenceService
+    # rolling update gates its traffic flip on this (readinessProbe +
+    # the controller's own pre-flip probe), so a replica that would
+    # crash or compile-stall on its first request never takes traffic.
+    warm = {"done": False, "seconds": None, "error": None}
+    warm_lock = threading.Lock()
+
+    @app.route("/readyz")
+    def readyz(request):
+        with warm_lock:
+            if not warm["done"]:
+                t0 = time.perf_counter()
+                try:
+                    service.generate([[1]], max_new_tokens=1)
+                except Exception as e:  # noqa: BLE001 — readiness must
+                    # report the failure, not 500 with a stack dump
+                    warm["error"] = f"{type(e).__name__}: {e}"
+                else:
+                    # Success is cached; a failure is retried on the next
+                    # probe (a transient fault must not wedge readiness).
+                    warm["error"] = None
+                    warm["done"] = True
+                warm["seconds"] = round(time.perf_counter() - t0, 3)
+        if warm["error"] is not None:
+            raise HttpError(503, f"warm generate failed: {warm['error']}")
+        return success({"ready": True, "revision": revision,
+                        "warm_generate_seconds": warm["seconds"]})
 
     # Same contract as the controllers' /debug/traces (platform/main.py),
     # including the DEBUG_TRACES=false opt-out: this port is as

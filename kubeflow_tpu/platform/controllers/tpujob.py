@@ -914,7 +914,11 @@ def _job_label_index(obj: Resource) -> List[str]:
 
 
 def make_controller(client, **kwargs):
-    from kubeflow_tpu.platform.k8s.types import NODE, RESOURCEQUOTA
+    from kubeflow_tpu.platform.k8s.types import (
+        INFERENCESERVICE,
+        NODE,
+        RESOURCEQUOTA,
+    )
     from kubeflow_tpu.platform.runtime import Controller
     from kubeflow_tpu.platform.runtime.informer import Informer
 
@@ -943,6 +947,10 @@ def make_controller(client, **kwargs):
         TPUJOB: Informer(client, TPUJOB),
         RESOURCEQUOTA: Informer(client, RESOURCEQUOTA),
         NODE: Informer(client, NODE),
+        # Serving shares the chip ledger (docs/serving.md "One quota
+        # truth"): InferenceService replica targets are declared charges,
+        # so a gang is never promised chips a model server holds.
+        INFERENCESERVICE: Informer(client, INFERENCESERVICE),
     }
 
     def _on_job_delta(etype, obj):
@@ -954,6 +962,15 @@ def make_controller(client, **kwargs):
             queue.observe(obj)
 
     queue_informers[TPUJOB].add_handler(_on_job_delta)
+
+    def _on_service_delta(etype, obj):
+        ns = deep_get(obj, "metadata", "namespace", default="") or ""
+        if etype == "DELETED":
+            queue.forget_service(ns, name_of(obj))
+        else:
+            queue.observe_service(obj)
+
+    queue_informers[INFERENCESERVICE].add_handler(_on_service_delta)
     queue_informers[RESOURCEQUOTA].add_handler(
         lambda _e, _o: queue.set_quotas(
             queue_informers[RESOURCEQUOTA].list()))

@@ -72,12 +72,15 @@ PROFILE = GVK("kubeflow.org", "v1", "Profile", "profiles", namespaced=False)
 PODDEFAULT = GVK("kubeflow.org", "v1alpha1", "PodDefault", "poddefaults")
 TENSORBOARD = GVK("tensorboard.kubeflow.org", "v1alpha1", "Tensorboard", "tensorboards")
 TPUJOB = GVK("kubeflow.org", "v1alpha1", "TPUJob", "tpujobs")
+INFERENCESERVICE = GVK("kubeflow.org", "v1alpha1", "InferenceService",
+                       "inferenceservices")
 
 WELL_KNOWN: tuple[GVK, ...] = (
     POD, SERVICE, NAMESPACE, NODE, EVENT, SECRET, CONFIGMAP, SERVICEACCOUNT,
     PVC, RESOURCEQUOTA, STATEFULSET, PODDISRUPTIONBUDGET, DEPLOYMENT,
     ROLEBINDING, CLUSTERROLE, STORAGECLASS, LEASE, VIRTUALSERVICE,
     AUTHORIZATIONPOLICY, NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD, TPUJOB,
+    INFERENCESERVICE,
 )
 
 

@@ -130,6 +130,7 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
 def run_controllers(args) -> int:
     from kubeflow_tpu.platform.controllers import (
         culling,
+        inferenceservice,
         profile,
         tensorboard,
         tpujob,
@@ -187,6 +188,11 @@ def run_controllers(args) -> int:
     # the same manager, under the same sharding/fencing regime as the
     # other controllers — a gang write is fenced on its job's shard lease.
     mgr.add(tpujob.make_controller(ctrl_client, shards=shards))
+    # Serving workloads (docs/serving.md "InferenceService"): the sixth
+    # controller — autoscaled model-server fleets under the same
+    # sharding/fencing regime, charging replica chips into the same
+    # ledger the gang queue admits against.
+    mgr.add(inferenceservice.make_controller(ctrl_client, shards=shards))
     if config.env_bool("ENABLE_CULLING", False):
         from kubeflow_tpu.platform.k8s.types import NOTEBOOK
 

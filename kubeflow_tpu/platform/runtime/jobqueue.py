@@ -175,6 +175,13 @@ class JobQueue:
         # apiserver plugin would then 403 PART of the gang's pods — the
         # half-scheduled-gang deadlock this queue exists to prevent.
         self._ns_used: Dict[str, float] = {}
+        # InferenceService replica chips, DECLARED from watch state
+        # (status.replicas × slice chips — apis/inferenceservice.chips_of).
+        # Declared-not-stored for the same reason admitted gangs are: a
+        # model server mid-scale-up holds its chips in intent before its
+        # pods land, and a gang promised those chips would half-schedule.
+        self._svc_chips: Dict[str, float] = {}       # "ns/name" -> chips
+        self._svc_ns_chips: Dict[str, float] = {}    # ns -> tally
         self._epoch = 0
         self._targets_cache: Tuple[int, Dict[str, Tuple[str, str]]] = (-1, {})
         # (epoch, (rank, key) of the best-ranked currently-admissible
@@ -205,7 +212,7 @@ class JobQueue:
         jobs = self._client.list(TPUJOB, None)
         quotas = self._client.list(RESOURCEQUOTA, None)
         nodes = self._client.list(NODE, None)
-        self.refresh(jobs, quotas, nodes)
+        self.refresh(jobs, quotas, nodes, self._list_services(self._client))
 
     def confirm(self, client, namespace: str, name: str) -> Decision:
         """Commit-time double check for an ``admit`` verdict: rebuild the
@@ -218,10 +225,23 @@ class JobQueue:
 
         self.refresh(client.list(TPUJOB, None),
                      client.list(RESOURCEQUOTA, None),
-                     client.list(NODE, None))
+                     client.list(NODE, None),
+                     self._list_services(client))
         return self.decide(namespace, name)
 
-    def refresh(self, jobs, quotas, nodes) -> None:
+    @staticmethod
+    def _list_services(client) -> list:
+        """Live InferenceService list for ledger rebuilds; empty on a
+        cluster without the CRD (the serving charge simply stays zero)."""
+        from kubeflow_tpu.platform.k8s import errors as k8s_errors
+        from kubeflow_tpu.platform.k8s.types import INFERENCESERVICE
+
+        try:
+            return client.list(INFERENCESERVICE, None)
+        except k8s_errors.ApiError:
+            return []
+
+    def refresh(self, jobs, quotas, nodes, services=None) -> None:
         with self._lock:
             self._entries.clear()
             self._waiting = []
@@ -230,8 +250,12 @@ class JobQueue:
             self._waiting_by_ns.clear()
             self._alloc_total = 0
             self._shrunk.clear()
+            self._svc_chips.clear()
+            self._svc_ns_chips.clear()
             self.set_nodes(nodes)
             self.set_quotas(quotas)
+            for svc in services or ():
+                self._observe_service_locked(svc)
             for job in jobs:
                 self._observe_locked(job)
             self._bump()
@@ -331,6 +355,64 @@ class JobQueue:
             if self._drop_locked(f"{namespace}/{name}"):
                 self._bump()
 
+    # -- InferenceService charges (the serving-side quota weld) ---------------
+
+    def observe_service(self, svc: Resource) -> None:
+        """Upsert one InferenceService's chip charge from its current
+        spec+status (informer delta, or the serving reconciler's
+        read-your-writes refresh).  No-op when the charge is unchanged."""
+        with self._lock:
+            if self._observe_service_locked(svc):
+                self._bump()
+
+    def _observe_service_locked(self, svc: Resource) -> bool:
+        from kubeflow_tpu.platform.apis import inferenceservice as svcapi
+
+        ns = deep_get(svc, "metadata", "namespace", default="") or ""
+        name = deep_get(svc, "metadata", "name", default="") or ""
+        key = f"{ns}/{name}"
+        chips = svcapi.chips_of(svc)
+        cur = self._svc_chips.get(key)
+        if cur == chips or (cur is None and chips == 0.0):
+            return False
+        if cur is not None:
+            self._svc_ns_chips[ns] = max(
+                0.0, self._svc_ns_chips.get(ns, 0.0) - cur)
+        if chips > 0.0:
+            self._svc_chips[key] = chips
+            self._svc_ns_chips[ns] = self._svc_ns_chips.get(ns, 0.0) + chips
+        else:
+            self._svc_chips.pop(key, None)
+            if self._svc_ns_chips.get(ns, 0.0) <= 0.0:
+                self._svc_ns_chips.pop(ns, None)
+        return True
+
+    def forget_service(self, namespace: str, name: str) -> None:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            chips = self._svc_chips.pop(key, None)
+            if chips is None:
+                return
+            left = self._svc_ns_chips.get(namespace, 0.0) - chips
+            if left > 0.0:
+                self._svc_ns_chips[namespace] = left
+            else:
+                self._svc_ns_chips.pop(namespace, None)
+            self._bump()
+
+    def service_headroom(self, namespace: str, *,
+                         own_chips: float = 0.0) -> float:
+        """Free chips in ``namespace`` for a serving scale-up: quota hard
+        minus the effective commitment (admitted gangs + other services +
+        stored live pods), with the caller's own current charge counted as
+        free to itself.  ``inf`` when the namespace has no TPU quota."""
+        with self._lock:
+            hard = self._ns_quota.get(namespace)
+            if hard is None:
+                return float("inf")
+            return max(0.0, hard - self._ns_effective_used(
+                namespace, own_chips=own_chips))
+
     def _drop_locked(self, key: str) -> bool:
         entry = self._entries.pop(key, None)
         if entry is None:
@@ -394,14 +476,15 @@ class JobQueue:
 
     def _ns_effective_used(self, ns: str, *, own_chips: float = 0.0
                            ) -> float:
-        """Chips committed in ``ns``: max(declared gang chips, the
-        quota's stored status.used) — declared covers admitted gangs
-        whose pods haven't landed yet, stored covers every OTHER
-        consumer's live pods (notebooks, serving).  ``own_chips`` (resize
-        decisions) is subtracted from both sides: the job's own
-        allocation is free capacity to itself and its own running pods
-        are inside stored."""
-        declared = self._ns_chips.get(ns, 0.0) - own_chips
+        """Chips committed in ``ns``: max(declared chips, the quota's
+        stored status.used) — declared covers admitted gangs AND
+        InferenceService replica targets whose pods haven't landed yet,
+        stored covers every OTHER consumer's live pods (notebooks).
+        ``own_chips`` (resize / serving scale decisions) is subtracted
+        from both sides: the caller's own allocation is free capacity to
+        itself and its own running pods are inside stored."""
+        declared = (self._ns_chips.get(ns, 0.0)
+                    + self._svc_ns_chips.get(ns, 0.0) - own_chips)
         stored = self._ns_used.get(ns, 0.0) - own_chips
         return max(declared, stored, 0.0)
 
@@ -645,8 +728,16 @@ class JobQueue:
                 "namespaceCommittedChips": {
                     ns: round(self._ns_effective_used(ns), 1)
                     for ns in sorted(set(self._ns_chips) |
-                                     set(self._ns_used))
+                                     set(self._ns_used) |
+                                     set(self._svc_ns_chips))
                     if self._ns_effective_used(ns)},
+                # Serving's share of the commitment (docs/serving.md
+                # "One quota truth"): InferenceService replica chips,
+                # per service — the rows that explain an
+                # InsufficientQuota park when no gang holds the chips.
+                "inferenceServiceChips": {
+                    key: round(chips, 1)
+                    for key, chips in sorted(self._svc_chips.items())},
                 "preemptionTargets": {
                     vk: {"by": by, "reason": r}
                     for vk, (by, r) in sorted(self._targets().items())},

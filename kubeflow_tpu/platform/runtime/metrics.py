@@ -171,6 +171,103 @@ def register_tpujob_collector(client) -> None:
     _tpujob_collector.client = client
 
 
+class InferenceServiceCollector:
+    """Scrape-time InferenceService fleet gauges (docs/observability.md):
+    ``inferenceservice_services{phase}`` — services per lifecycle phase
+    fleet-wide — and the per-namespace pair ``inferenceservice_replicas``
+    / ``inferenceservice_ready_replicas`` summed from statuses (the
+    replica gauge is also the serving side of the chip-ledger charge:
+    replicas × slice chips).  Same single-slot swappable-client shape as
+    the other fleet collectors: one list per scrape, never per
+    reconcile."""
+
+    def __init__(self):
+        self.client = None
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        services = GaugeMetricFamily(
+            "inferenceservice_services",
+            "InferenceServices by lifecycle phase", labels=["phase"])
+        replicas = GaugeMetricFamily(
+            "inferenceservice_replicas",
+            "target model-server replicas, per namespace (the chip-"
+            "ledger charge is this times the slice's chips)",
+            labels=["namespace"])
+        ready = GaugeMetricFamily(
+            "inferenceservice_ready_replicas",
+            "serving-revision replicas Ready, per namespace",
+            labels=["namespace"])
+        client = self.client
+        if client is not None:
+            from kubeflow_tpu.platform.k8s.types import (
+                INFERENCESERVICE,
+                namespace_of,
+            )
+
+            by_phase: dict = {}
+            per_ns: dict = {}
+            try:
+                items = client.list(INFERENCESERVICE, None)
+            except Exception:  # scrape must not take /metrics down
+                items = []
+            for svc in items:
+                status = svc.get("status") or {}
+                phase = status.get("phase") or "Pending"
+                by_phase[phase] = by_phase.get(phase, 0) + 1
+                ns = namespace_of(svc) or ""
+                n_target, n_ready = per_ns.get(ns, (0, 0))
+                per_ns[ns] = (
+                    n_target + int(status.get("replicas", 0) or 0),
+                    n_ready + int(status.get("readyReplicas", 0) or 0))
+            for phase, n in sorted(by_phase.items()):
+                services.add_metric([phase], n)
+            for ns, (n_target, n_ready) in sorted(per_ns.items()):
+                replicas.add_metric([ns], n_target)
+                ready.add_metric([ns], n_ready)
+        yield services
+        yield replicas
+        yield ready
+
+
+_inferenceservice_collector = InferenceServiceCollector()
+registry.register(_inferenceservice_collector)
+
+
+def register_inferenceservice_collector(client) -> None:
+    """Point the scrape-time InferenceService gauges at ``client``
+    (idempotent; None unhooks — wired to the serving controller's
+    start/stop)."""
+    _inferenceservice_collector.client = client
+
+
+inferenceservice_scale_events_total = Counter(
+    "inferenceservice_scale_events_total",
+    "autoscaler width changes by direction: 'up' (target tracking), "
+    "'down' (cooldown-limited), 'to_zero' (idle window elapsed)",
+    ["direction"], registry=registry,
+)
+inferenceservice_cold_starts_total = Counter(
+    "inferenceservice_cold_starts_total",
+    "scale-from-zero wakes (activator annotation or traffic observed "
+    "while parked at zero)",
+    registry=registry,
+)
+inferenceservice_rollouts_total = Counter(
+    "inferenceservice_rollouts_total",
+    "revision rollouts started (pod-spec-affecting spec change hashed "
+    "to a new revision)",
+    registry=registry,
+)
+inferenceservice_scrape_errors_total = Counter(
+    "inferenceservice_scrape_errors_total",
+    "replica /metrics scrapes that failed (the replica is absent from "
+    "that autoscaling pass; an all-fail pass holds width)",
+    registry=registry,
+)
+
+
 tpujob_restarts_total = Counter(
     "tpujob_restarts_total",
     "whole-gang TPUJob restarts (any worker pod failure tears down and "

@@ -1,0 +1,281 @@
+"""The InferenceService autoscaler decision function in isolation
+(runtime/autoscale.py): target-tracking math, scale-to-zero idle window,
+cooldown/hysteresis on a noisy series, max-replica clamp.  Pure unit —
+no cluster, no controller, no clock."""
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.platform.runtime.autoscale import (
+    ScaleState,
+    ScaleTargets,
+    ServeSample,
+    decide_scale,
+    state_from_status,
+    state_to_status,
+    targets_from_spec,
+)
+
+
+def _targets(**kw):
+    base = dict(min_replicas=1, max_replicas=8, queue_depth=4.0,
+                ttft_p99_s=None, slot_occupancy=0.8,
+                idle_seconds=60.0, cooldown_seconds=30.0)
+    base.update(kw)
+    return ScaleTargets(**base)
+
+
+def _sample(**kw):
+    base = dict(replicas_scraped=2, queue_depth=0.0, ttft_p99_s=None,
+                slot_occupancy=None, requests_total=0.0)
+    base.update(kw)
+    return ServeSample(**base)
+
+
+def test_target_tracking_scales_up_proportionally():
+    # 2 replicas at mean queue depth 12 against a target of 4 → ceil(2*3)=6.
+    d = decide_scale(2, _sample(queue_depth=12.0), _targets(),
+                     ScaleState(), now=100.0)
+    assert d.replicas == 6 and d.reason == "ScaleUp"
+
+
+def test_most_pressured_signal_wins():
+    # Queue depth alone says hold; occupancy says double.
+    d = decide_scale(2, _sample(queue_depth=4.0, slot_occupancy=1.6),
+                     _targets(), ScaleState(), now=100.0)
+    assert d.replicas == 4 and d.reason == "ScaleUp"
+
+
+def test_ttft_ceiling_drives_scale_up():
+    d = decide_scale(2, _sample(ttft_p99_s=6.0),
+                     _targets(ttft_p99_s=2.0), ScaleState(), now=100.0)
+    assert d.replicas == 6 and d.reason == "ScaleUp"
+
+
+def test_max_replica_clamp():
+    d = decide_scale(4, _sample(queue_depth=400.0), _targets(max_replicas=8),
+                     ScaleState(), now=100.0)
+    assert d.replicas == 8 and d.reason == "ScaleUp"
+    # Already at the ceiling: pressure changes nothing (no reason churn).
+    d2 = decide_scale(8, _sample(queue_depth=400.0),
+                      _targets(max_replicas=8), d.state, now=110.0)
+    assert d2.replicas == 8 and d2.reason == ""
+
+
+def test_min_replica_floor():
+    d = decide_scale(4, _sample(queue_depth=0.0), _targets(min_replicas=2),
+                     ScaleState(last_scale_down_at=0.0), now=1000.0)
+    assert d.replicas >= 2 and d.reason == "ScaleDown"
+
+
+def test_scale_down_respects_cooldown():
+    state = ScaleState(last_scale_down_at=90.0)
+    d = decide_scale(6, _sample(queue_depth=0.5),
+                     _targets(cooldown_seconds=30.0), state, now=100.0)
+    assert d.replicas == 6 and d.reason == "Cooldown"
+    # Cooldown elapsed → one bounded step.
+    d2 = decide_scale(6, _sample(queue_depth=0.5),
+                      _targets(cooldown_seconds=30.0), state, now=121.0)
+    assert d2.reason == "ScaleDown" and d2.replicas < 6
+
+
+def test_scale_down_never_more_than_halves():
+    # Desired from a near-zero sample would be 1; one step only halves.
+    d = decide_scale(8, _sample(queue_depth=0.01), _targets(),
+                     ScaleState(), now=1000.0)
+    assert d.replicas == 4 and d.reason == "ScaleDown"
+
+
+def test_noisy_series_does_not_flap():
+    """Alternating deep/empty samples: width may step up with pressure but
+    must never see-saw — at most ONE scale-down inside a cooldown window,
+    and never below half the peak in that window."""
+    targets = _targets(cooldown_seconds=1000.0)
+    state = ScaleState()
+    width = 4
+    widths = [width]
+    for i, depth in enumerate([16.0, 0.0, 16.0, 0.0, 16.0, 0.0]):
+        d = decide_scale(width, _sample(queue_depth=depth), targets,
+                         state, now=100.0 + i)
+        width, state = d.replicas, d.state
+        widths.append(width)
+    downs = sum(1 for a, b in zip(widths, widths[1:]) if b < a)
+    assert downs <= 1, widths
+    assert min(widths) >= max(widths) // 2, widths
+
+
+def test_spec_bounds_are_authoritative_and_immediate():
+    """An operator edit to replicas.min/max takes effect this pass —
+    no cooldown, no sample needed (the hold-on-silence rule must not
+    freeze an out-of-bounds width)."""
+    d = decide_scale(6, _sample(replicas_scraped=0),
+                     _targets(max_replicas=2),
+                     ScaleState(last_scale_down_at=99.0), now=100.0)
+    assert d.replicas == 2 and d.reason == "ScaleDown"
+    d2 = decide_scale(1, _sample(replicas_scraped=0),
+                      _targets(min_replicas=3), ScaleState(), now=100.0)
+    assert d2.replicas == 3 and d2.reason == "ScaleUp"
+    # Parked at zero when min is raised above zero: comes back up.
+    d3 = decide_scale(0, _sample(replicas_scraped=0),
+                      _targets(min_replicas=2),
+                      ScaleState(idle_since_zero=True), now=100.0)
+    assert d3.replicas == 2 and d3.reason == "ScaleUp"
+    assert not d3.state.idle_since_zero
+
+
+def test_scale_to_zero_after_idle_window():
+    targets = _targets(min_replicas=0, idle_seconds=60.0)
+    # Traffic at t=100; window not elapsed at t=150 (queue at target →
+    # the load signals are neutral; idleness is what's under test).
+    state = ScaleState(last_traffic_at=100.0, last_requests_total=10.0,
+                       scraped=True)
+    d = decide_scale(2, _sample(queue_depth=4.0, requests_total=10.0),
+                     targets, state, now=150.0)
+    assert d.replicas == 2
+    # Window elapsed at t=161 → straight to zero (no staged drain).
+    d2 = decide_scale(2, _sample(queue_depth=4.0, requests_total=10.0),
+                      targets, d.state, now=161.0)
+    assert d2.replicas == 0 and d2.reason == "ScaleToZero"
+    assert d2.state.idle_since_zero
+
+
+def test_traffic_resets_idle_window():
+    targets = _targets(min_replicas=0, idle_seconds=60.0)
+    state = ScaleState(last_traffic_at=100.0, last_requests_total=10.0,
+                       scraped=True)
+    # The counter moved at t=155: the window restarts from there.
+    d = decide_scale(2, _sample(queue_depth=4.0, requests_total=11.0),
+                     targets, state, now=155.0)
+    assert d.replicas == 2
+    d2 = decide_scale(2, _sample(queue_depth=4.0, requests_total=11.0),
+                      targets, d.state, now=214.0)
+    assert d2.replicas == 2  # only 59 s since the last traffic
+    d3 = decide_scale(2, _sample(queue_depth=4.0, requests_total=11.0),
+                      targets, d2.state, now=216.0)
+    assert d3.replicas == 0
+
+
+def test_fresh_service_gets_full_idle_window():
+    """A just-created service (zero state) must not scale to zero on its
+    first decision — the window counts from the first decision, not the
+    epoch."""
+    targets = _targets(min_replicas=0, idle_seconds=60.0)
+    d = decide_scale(1, _sample(), targets, ScaleState(), now=1e9)
+    assert d.replicas == 1
+
+
+def test_counter_regression_rebaselines_instead_of_reading_idle():
+    """A scale-down (or pod restart) shrinks the fleet-wide summed
+    request counter; a frozen high-water baseline would then read steady
+    traffic as idleness and scale an ACTIVE service to zero.  The
+    baseline must follow the sum down (without counting as traffic) so
+    the very next real request reads as movement."""
+    targets = _targets(min_replicas=0, idle_seconds=60.0,
+                       cooldown_seconds=0.0)
+    # Established at 4 replicas, sum 1000.
+    state = ScaleState(last_traffic_at=100.0, last_requests_total=1000.0,
+                       scraped=True)
+    # Halved to 2 replicas: the sum drops to ~500.  Not traffic — but
+    # the baseline re-anchors.
+    d = decide_scale(2, _sample(queue_depth=4.0, requests_total=500.0),
+                     targets, state, now=110.0)
+    assert d.state.last_requests_total == 500.0
+    assert d.state.last_traffic_at == 100.0  # regression is not traffic
+    # Real traffic on the survivors now reads as movement immediately,
+    # keeping the service alive past the idle window.
+    d2 = decide_scale(2, _sample(queue_depth=4.0, requests_total=510.0),
+                      targets, d.state, now=165.0)
+    assert d2.replicas == 2
+    assert d2.state.last_traffic_at == 165.0
+
+
+def test_wake_from_zero_on_annotation():
+    targets = _targets(min_replicas=0)
+    state = ScaleState(last_scale_down_at=100.0, idle_since_zero=True,
+                       last_traffic_at=40.0)
+    # Stale wake stamp (predates the idle transition): stays at zero.
+    d = decide_scale(0, _sample(replicas_scraped=0), targets, state,
+                     now=200.0, wake_requested_at=90.0)
+    assert d.replicas == 0
+    # Fresh stamp: wakes to max(min, 1) immediately — no cooldown.
+    d2 = decide_scale(0, _sample(replicas_scraped=0), targets, state,
+                      now=200.0, wake_requested_at=150.0)
+    assert d2.replicas == 1 and d2.reason == "Wake"
+    assert not d2.state.idle_since_zero
+
+
+def test_wake_from_zero_on_traffic_counter():
+    """Traffic observed while at zero (a lingering scrape target, or the
+    activator's own probe) also wakes — the annotation is the contract,
+    the counter is the backstop."""
+    targets = _targets(min_replicas=0)
+    state = ScaleState(last_requests_total=5.0, idle_since_zero=True,
+                       last_scale_down_at=100.0, last_traffic_at=40.0)
+    d = decide_scale(0, _sample(requests_total=6.0), targets, state,
+                     now=200.0)
+    assert d.replicas == 1 and d.reason == "Wake"
+
+
+def test_empty_scrape_holds_width():
+    """No replica answered (warming, or the scrape path is down): silence
+    must not scale anything in either direction."""
+    d = decide_scale(3, _sample(replicas_scraped=0, queue_depth=0.0),
+                     _targets(), ScaleState(last_traffic_at=50.0),
+                     now=100.0)
+    assert d.replicas == 3 and d.reason == ""
+
+
+def test_warm_up_never_idles_to_zero():
+    """A cold pool whose replicas are still warming must not idle out to
+    zero, however long the warm-up takes — and the idle window restarts
+    at FIRST scrape contact, so a warm-up slower than idle_seconds never
+    reads as an idle service (the conformance cold-start regression
+    pin)."""
+    targets = _targets(min_replicas=0, idle_seconds=60.0)
+    state = ScaleState(last_traffic_at=10.0)
+    # Warming: nothing scraped for ages → hold.
+    d = decide_scale(2, _sample(replicas_scraped=0), targets, state,
+                     now=10_000.0)
+    assert d.replicas == 2 and d.reason == ""
+    # First contact: the window restarts NOW, not at creation.
+    d2 = decide_scale(2, _sample(queue_depth=4.0), targets, d.state,
+                      now=10_100.0)
+    assert d2.replicas == 2 and d2.state.scraped
+    # Only a full idle window past first contact scales to zero.
+    d3 = decide_scale(2, _sample(queue_depth=4.0), targets, d2.state,
+                      now=10_159.0)
+    assert d3.replicas == 2
+    d4 = decide_scale(2, _sample(queue_depth=4.0), targets, d3.state,
+                      now=10_161.0)
+    assert d4.replicas == 0 and d4.reason == "ScaleToZero"
+    assert not d4.state.scraped  # next episode gets its own window
+
+
+def test_state_status_roundtrip():
+    state = ScaleState(last_traffic_at=12.5, last_requests_total=99.0,
+                       last_scale_down_at=10.0, idle_since_zero=True,
+                       scraped=True)
+    assert state_from_status(state_to_status(state)) == state
+    assert state_from_status({}) == ScaleState()
+    assert state_from_status(None) == ScaleState()
+
+
+def test_targets_from_spec_defaults_and_overrides():
+    svc = {"spec": {"model": "llama_125m",
+                    "tpu": {"accelerator": "v5e"},
+                    "replicas": {"min": 0, "max": 6},
+                    "scale": {"queueDepthTarget": 2.0,
+                              "ttftP99TargetSeconds": 1.5,
+                              "idleSeconds": 10}}}
+    t = targets_from_spec(svc)
+    assert (t.min_replicas, t.max_replicas) == (0, 6)
+    assert t.queue_depth == 2.0 and t.ttft_p99_s == 1.5
+    assert t.idle_seconds == 10.0
+    assert t.slot_occupancy == 0.8      # default
+    assert t.cooldown_seconds == 30.0   # default
+    # Decisions are pure: same inputs, same outputs, inputs untouched.
+    s = _sample(queue_depth=8.0)
+    a = decide_scale(2, s, t, ScaleState(), now=50.0)
+    b = decide_scale(2, s, t, ScaleState(), now=50.0)
+    assert a == b and s == _sample(queue_depth=8.0)
+    assert dataclasses.asdict(a.state)  # state is a plain value

@@ -1,0 +1,454 @@
+"""InferenceService controller (ISSUE 12): revisioned TPU serving
+Deployments behind a Service/VirtualService, telemetry-driven autoscaling
+over the real scrape path, rolling readiness-gated revision flips,
+scale-to-zero + cold-start wake, and the shared chip ledger."""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.platform.apis import inferenceservice as api
+from kubeflow_tpu.platform.controllers.inferenceservice import (
+    InferenceServiceReconciler,
+    parse_serve_sample,
+)
+from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s.types import (
+    DEPLOYMENT,
+    INFERENCESERVICE,
+    POD,
+    SERVICE,
+    TPUJOB,
+    VIRTUALSERVICE,
+    deep_get,
+)
+from kubeflow_tpu.platform.runtime import Request
+from kubeflow_tpu.platform.testing import FakeKube
+
+
+def make_service(name="llm", ns="serve", *, replicas=None, scale=None,
+                 checkpoint=None, model="llama_125m", port=None):
+    spec = {"model": model, "tpu": {"accelerator": "v5e",
+                                    "topology": "2x4"}}
+    if replicas is not None:
+        spec["replicas"] = replicas
+    if scale is not None:
+        spec["scale"] = scale
+    if checkpoint is not None:
+        spec["checkpointDir"] = checkpoint
+    if port is not None:
+        spec["port"] = port
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": ns}, "spec": spec,
+    }
+
+
+def metrics_text(*, queue_depth=0.0, requests=0.0, slots_active=None,
+                 slots=None, revision=None):
+    lines = [f"serve_queue_depth {queue_depth}",
+             f'generate_requests_total{{outcome="ok"}} {requests}']
+    if slots is not None:
+        lines += [f"serve_decode_slots {slots}",
+                  f"serve_decode_slots_active {slots_active or 0}"]
+    if revision is not None:
+        lines.append(f"serve_replica_revision {revision}")
+    return "\n".join(lines) + "\n"
+
+
+def add_replica_pod(kube, ns, name, revision, ordinal, *, ready=True,
+                    endpoint=None):
+    pod_name = f"{name}-v{revision}-{ordinal}"
+    annotations = {}
+    if endpoint is not None:
+        annotations[api.ANNOTATION_ENDPOINT] = endpoint
+    kube.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": pod_name, "namespace": ns,
+                     "labels": {api.LABEL_SERVICE_NAME: name,
+                                api.LABEL_REVISION: str(revision)},
+                     "annotations": annotations},
+        "spec": {"containers": [{"name": "server"}]},
+    })
+    kube.set_pod_phase(ns, pod_name, "Running", ready=ready)
+    return pod_name
+
+
+@pytest.fixture
+def kube():
+    k = FakeKube()
+    k.add_namespace("serve")
+    return k
+
+
+def make_reconciler(kube, *, scraper=None, now=None):
+    return InferenceServiceReconciler(
+        kube,
+        scraper=scraper or (lambda url: None),
+        sync_period=0.01,
+        now=now or (lambda: 1000.0),
+    )
+
+
+def test_invalid_spec_parks_degraded(kube):
+    svc = make_service()
+    svc["spec"]["tpu"]["topology"] = "4x4"  # 2 hosts: not a serving shape
+    kube.create(svc)
+    make_reconciler(kube).reconcile(Request("serve", "llm"))
+    stored = kube.get(INFERENCESERVICE, "llm", "serve")
+    cond = deep_get(stored, "status", "conditions")[0]
+    assert cond["type"] == "Degraded" and cond["reason"] == "InvalidSpec"
+    assert "single-host" in cond["message"]
+    with pytest.raises(errors.NotFound):
+        kube.get(DEPLOYMENT, "llm-v1", "serve")
+
+
+def test_first_reconcile_creates_revisioned_serving_stack(kube):
+    kube.create(make_service(replicas={"min": 1, "max": 4, "initial": 2},
+                             checkpoint="gs://ckpts/llm", port=9000))
+    make_reconciler(kube).reconcile(Request("serve", "llm"))
+
+    dep = kube.get(DEPLOYMENT, "llm-v1", "serve")
+    assert deep_get(dep, "spec", "replicas") == 2
+    tmpl = deep_get(dep, "spec", "template", "spec")
+    container = tmpl["containers"][0]
+    # One single-host v5e 2x4 slice per replica: 8 chips + selectors.
+    assert container["resources"]["limits"]["google.com/tpu"] == "8"
+    assert tmpl["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x4"}
+    cmd = container["command"]
+    assert cmd[:3] == ["python", "-m", "kubeflow_tpu.models.serve"]
+    assert ["--model", "llama_125m"] == cmd[3:5]
+    assert "--checkpoint-dir" in cmd and "gs://ckpts/llm" in cmd
+    assert "--port" in cmd and "9000" in cmd
+    # The readiness generate() probe + the revision env the /metrics
+    # gauge exports.
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert {"name": "KFT_SERVE_REVISION", "value": "1"} \
+        in container["env"]
+
+    svc = kube.get(SERVICE, "llm", "serve")
+    assert deep_get(svc, "spec", "selector") == {
+        api.LABEL_SERVICE_NAME: "llm", api.LABEL_REVISION: "1"}
+    assert deep_get(svc, "spec", "ports")[0]["targetPort"] == 9000
+    vs = kube.get(VIRTUALSERVICE, "inferenceservice-serve-llm", "serve")
+    assert deep_get(vs, "spec", "http")[0]["match"][0]["uri"][
+        "prefix"] == "/serve/serve/llm/"
+
+    stored = kube.get(INFERENCESERVICE, "llm", "serve")
+    status = stored["status"]
+    assert status["phase"] == "Pending"
+    assert status["replicas"] == 2 and status["readyReplicas"] == 0
+    assert status["revision"] == status["targetRevision"] == 1
+    assert status["selector"] == "inferenceservice-name=llm"
+
+
+def test_ready_replicas_flip_phase_ready(kube):
+    kube.create(make_service(replicas={"min": 2, "max": 4}))
+    r = make_reconciler(kube)
+    r.reconcile(Request("serve", "llm"))
+    for i in range(2):
+        add_replica_pod(kube, "serve", "llm", 1, i)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["phase"] == "Ready"
+    assert status["readyReplicas"] == 2
+
+
+def test_autoscale_scales_up_through_real_scrape_path(kube):
+    """Deep per-replica queues on the scraped /metrics pages widen the
+    Deployment — the serve series drive the decision, not pod counts."""
+    kube.create(make_service(replicas={"min": 2, "max": 8},
+                             scale={"queueDepthTarget": 4.0}))
+    pages = {}
+    r = make_reconciler(kube, scraper=lambda url: pages.get(url))
+    r.reconcile(Request("serve", "llm"))
+    for i in range(2):
+        add_replica_pod(kube, "serve", "llm", 1, i,
+                        endpoint=f"http://replica-{i}")
+        pages[f"http://replica-{i}/metrics"] = metrics_text(
+            queue_depth=12.0, requests=10.0)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    # ceil(2 * 12/4) = 6
+    assert status["replicas"] == 6
+    dep = kube.get(DEPLOYMENT, "llm-v1", "serve")
+    assert deep_get(dep, "spec", "replicas") == 6
+    # Slot occupancy alone can also drive it (the second signal).
+    for i in range(2):
+        pages[f"http://replica-{i}/metrics"] = metrics_text(
+            queue_depth=0.0, requests=20.0, slots=8, slots_active=8)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["replicas"] == 8  # ceil(6 * 1.0/0.8) = 8 (max clamp)
+
+
+def test_scale_up_clamped_to_profile_quota(kube):
+    """The serving side of the one-quota-truth weld: a scale-up may only
+    target replicas the namespace's free google.com/tpu chips can pay
+    for; the clamp is visible as status.reason."""
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": "serve"},
+        "spec": {"hard": {"google.com/tpu": "24"}},
+    })
+    kube.create(make_service(replicas={"min": 2, "max": 8}))
+    pages = {}
+    r = make_reconciler(kube, scraper=lambda url: pages.get(url))
+    r.reconcile(Request("serve", "llm"))
+    for i in range(2):
+        add_replica_pod(kube, "serve", "llm", 1, i,
+                        endpoint=f"http://replica-{i}")
+        pages[f"http://replica-{i}/metrics"] = metrics_text(
+            queue_depth=20.0, requests=5.0)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    # Wanted ceil(2*5)=8, but 24 chips = 3 replicas total.
+    assert status["replicas"] == 3
+    assert status["reason"] == api.REASON_QUOTA_CLAMPED
+
+
+def test_rolling_update_flips_only_after_readiness_generate(kube):
+    """A checkpoint change warms revision 2 NEXT TO revision 1; the
+    Service keeps selecting revision 1 until a revision-2 pod is Ready
+    AND answers the controller's /readyz probe; then traffic flips and
+    revision 1 drains.  (docs/resilience.md: 'revision fails readiness'
+    row — the old revision keeps serving indefinitely.)"""
+    kube.create(make_service(replicas={"min": 1, "max": 2}))
+    pages = {}
+    r = make_reconciler(kube, scraper=lambda url: pages.get(url))
+    r.reconcile(Request("serve", "llm"))
+    add_replica_pod(kube, "serve", "llm", 1, 0, endpoint="http://r1")
+
+    svc = kube.get(INFERENCESERVICE, "llm", "serve")
+    svc = dict(svc)
+    svc["spec"] = dict(svc["spec"], checkpointDir="gs://ckpts/new")
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+
+    # Both revisions stand; traffic stays on 1 — and revision 1's POD
+    # TEMPLATE is untouched: the new checkpoint must never leak into the
+    # serving Deployment (that would roll the old pods onto unproven
+    # weights before the readiness gate).
+    v1 = kube.get(DEPLOYMENT, "llm-v1", "serve")
+    v1_cmd = deep_get(v1, "spec", "template", "spec",
+                      "containers")[0]["command"]
+    assert "--checkpoint-dir" not in v1_cmd, v1_cmd
+    v2 = kube.get(DEPLOYMENT, "llm-v2", "serve")
+    v2_cmd = deep_get(v2, "spec", "template", "spec",
+                      "containers")[0]["command"]
+    assert "gs://ckpts/new" in v2_cmd
+    assert deep_get(kube.get(SERVICE, "llm", "serve"),
+                    "spec", "selector")[api.LABEL_REVISION] == "1"
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["phase"] == "Rolling"
+    assert (status["revision"], status["targetRevision"]) == (1, 2)
+
+    # Revision 2's pod comes up but FAILS the readiness probe (scraper
+    # has no /readyz page for it): still no flip.
+    add_replica_pod(kube, "serve", "llm", 2, 0, endpoint="http://r2")
+    r.reconcile(Request("serve", "llm"))
+    assert deep_get(kube.get(SERVICE, "llm", "serve"),
+                    "spec", "selector")[api.LABEL_REVISION] == "1"
+
+    # The probe passes: flip, and revision 1 drains.
+    pages["http://r2/readyz"] = '{"ready": true}'
+    r.reconcile(Request("serve", "llm"))
+    assert deep_get(kube.get(SERVICE, "llm", "serve"),
+                    "spec", "selector")[api.LABEL_REVISION] == "2"
+    with pytest.raises(errors.NotFound):
+        kube.get(DEPLOYMENT, "llm-v1", "serve")
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["revision"] == status["targetRevision"] == 2
+    assert status["phase"] == "Ready"
+    # The new revision's pods carry the bumped KFT_SERVE_REVISION.
+    dep = kube.get(DEPLOYMENT, "llm-v2", "serve")
+    env = deep_get(dep, "spec", "template", "spec",
+                   "containers")[0]["env"]
+    assert {"name": "KFT_SERVE_REVISION", "value": "2"} in env
+
+
+def test_spec_revert_mid_rollout_abandons_target_revision(kube):
+    """A revert while revision 2 warms (e.g. the new checkpoint turned
+    out bad) abandons the in-flight revision: its Deployment is swept,
+    the serving revision never stopped serving, no flip happens."""
+    kube.create(make_service(replicas={"min": 1, "max": 2}))
+    r = make_reconciler(kube)
+    r.reconcile(Request("serve", "llm"))
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    original_spec = dict(svc["spec"])
+    svc["spec"] = dict(svc["spec"], checkpointDir="gs://ckpts/bad")
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+    assert kube.get(DEPLOYMENT, "llm-v2", "serve")
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    svc["spec"] = original_spec
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["revision"] == status["targetRevision"] == 1
+    assert status["phase"] != "Rolling"
+    with pytest.raises(errors.NotFound):
+        kube.get(DEPLOYMENT, "llm-v2", "serve")
+    assert deep_get(kube.get(SERVICE, "llm", "serve"),
+                    "spec", "selector")[api.LABEL_REVISION] == "1"
+
+
+def test_replica_bound_change_is_not_a_rollout(kube):
+    """Only pod-spec-affecting fields roll a revision: widening
+    spec.replicas.max must scale in place, never warm a second
+    Deployment."""
+    kube.create(make_service(replicas={"min": 1, "max": 2}))
+    r = make_reconciler(kube)
+    r.reconcile(Request("serve", "llm"))
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    svc["spec"] = dict(svc["spec"], replicas={"min": 1, "max": 8})
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["revision"] == status["targetRevision"] == 1
+    with pytest.raises(errors.NotFound):
+        kube.get(DEPLOYMENT, "llm-v2", "serve")
+
+
+def test_scale_to_zero_and_cold_start_wake(kube):
+    """min=0 + idle window elapsed → zero replicas (phase Idle); the
+    activator's wake annotation brings it back to one (phase Waking)
+    with no cooldown in the way."""
+    now_box = [1000.0]
+    kube.create(make_service(
+        replicas={"min": 0, "max": 4, "initial": 1},
+        scale={"idleSeconds": 60.0}))
+    pages = {}
+    r = make_reconciler(kube, scraper=lambda url: pages.get(url),
+                        now=lambda: now_box[0])
+    r.reconcile(Request("serve", "llm"))
+    add_replica_pod(kube, "serve", "llm", 1, 0, endpoint="http://r0")
+    pages["http://r0/metrics"] = metrics_text(queue_depth=0.0,
+                                              requests=5.0)
+    r.reconcile(Request("serve", "llm"))  # traffic observed at t=1000
+
+    now_box[0] = 1061.0  # idle window elapsed, counter unchanged
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["replicas"] == 0 and status["phase"] == "Idle"
+    assert deep_get(kube.get(DEPLOYMENT, "llm-v1", "serve"),
+                    "spec", "replicas") == 0
+    kube.delete(POD, "llm-v1-0", "serve")
+
+    # First request hits the scaled-to-zero service: the activator
+    # stamps the wake annotation (docs/serving.md "Scale-to-zero").
+    now_box[0] = 1100.0
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    svc["metadata"] = dict(svc["metadata"], annotations={
+        api.ANNOTATION_WAKE: "1099.0"})
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["replicas"] == 1 and status["phase"] == "Waking"
+    assert deep_get(kube.get(DEPLOYMENT, "llm-v1", "serve"),
+                    "spec", "replicas") == 1
+
+
+def test_inference_scale_up_parks_tpujob_insufficient_quota(kube):
+    """The satellite pin, end to end across BOTH controllers: after a
+    serving scale-up commits 24 of 32 chips, a 2-slice (16-chip) TPUJob
+    reconciles to Queued/InsufficientQuota — the gang is never promised
+    chips the model servers hold.  Scaling the service down lifts it."""
+    from kubeflow_tpu.platform.apis import tpujob as jobapi
+    from kubeflow_tpu.platform.controllers.tpujob import TPUJobReconciler
+
+    kube.create({
+        "apiVersion": "v1", "kind": "ResourceQuota",
+        "metadata": {"name": "kf-resource-quota", "namespace": "serve"},
+        "spec": {"hard": {"google.com/tpu": "32"}},
+    })
+    kube.create(make_service(replicas={"min": 3, "max": 3}))
+    make_reconciler(kube).reconcile(Request("serve", "llm"))
+    assert kube.get(INFERENCESERVICE, "llm", "serve")[
+        "status"]["replicas"] == 3  # 24 chips committed
+
+    kube.create({
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "train", "namespace": "serve"},
+        "spec": {
+            "tpu": {"accelerator": "v5e", "topology": "2x4", "slices": 2},
+            "template": {"spec": {"containers": [{"name": "w"}]}},
+        },
+    })
+    jr = TPUJobReconciler(kube)
+    jr.reconcile(Request("serve", "train"))
+    job = kube.get(TPUJOB, "train", "serve")
+    assert jobapi.phase_of(job) == "Queued"
+    assert deep_get(job, "status", "reason") == "InsufficientQuota"
+
+    # Scale the service to one replica: 16 chips free — the gang admits.
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    svc["spec"] = dict(svc["spec"],
+                       replicas={"min": 1, "max": 1, "initial": 1})
+    kube.update(svc)
+    make_reconciler(kube).reconcile(Request("serve", "llm"))
+    jr.reconcile(Request("serve", "train"))
+    job = kube.get(TPUJOB, "train", "serve")
+    assert jobapi.allocated_slices(job) == 2
+
+
+def test_invalid_spec_edit_preserves_status_record(kube):
+    """A transiently invalid spec edit marks Degraded by MERGING into the
+    stored status — the revision/replica record survives, so a revert
+    resumes the real revision instead of cold-restarting at revision 1."""
+    kube.create(make_service(replicas={"min": 2, "max": 4}))
+    r = make_reconciler(kube)
+    r.reconcile(Request("serve", "llm"))
+    before = dict(kube.get(INFERENCESERVICE, "llm", "serve")["status"])
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    svc["spec"] = dict(svc["spec"], quantize="int4")  # invalid
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["conditions"][0]["type"] == "Degraded"
+    assert status["revision"] == before["revision"] == 1
+    assert status["replicas"] == before["replicas"] == 2
+    # Revert: the service resumes at its real revision — no v2, no cold
+    # restart.
+    svc = dict(kube.get(INFERENCESERVICE, "llm", "serve"))
+    spec = dict(svc["spec"])
+    spec.pop("quantize")
+    svc["spec"] = spec
+    kube.update(svc)
+    r.reconcile(Request("serve", "llm"))
+    status = kube.get(INFERENCESERVICE, "llm", "serve")["status"]
+    assert status["revision"] == status["targetRevision"] == 1
+    assert kube.get(DEPLOYMENT, "llm-v1", "serve")
+
+
+def test_parse_serve_sample_merges_pages():
+    pages = [
+        metrics_text(queue_depth=6.0, requests=10.0, slots=8,
+                     slots_active=4),
+        metrics_text(queue_depth=2.0, requests=5.0, slots=8,
+                     slots_active=8),
+    ]
+    s = parse_serve_sample(pages)
+    assert s.replicas_scraped == 2
+    assert s.queue_depth == 4.0          # mean per replica
+    assert s.requests_total == 15.0      # summed counter
+    assert s.slot_occupancy == 0.75      # 12 active / 16 slots
+    empty = parse_serve_sample([])
+    assert empty.replicas_scraped == 0
+
+
+def test_crd_manifest_shape():
+    crd = api.crd_manifest()
+    (version,) = crd["spec"]["versions"]
+    assert version["subresources"]["scale"][
+        "statusReplicasPath"] == ".status.replicas"
+    schema = version["schema"]["openAPIV3Schema"]["properties"]["spec"]
+    assert sorted(schema["required"]) == ["model", "tpu"]
+    api.validate(make_service(replicas={"min": 0, "max": 2}))
+    with pytest.raises(api.ValidationError):
+        api.validate(make_service(replicas={"min": 3, "max": 2}))
+    with pytest.raises(api.ValidationError):
+        bad = make_service()
+        bad["spec"]["tpu"]["slices"] = 2
+        api.validate(bad)

@@ -193,6 +193,106 @@ def test_quota_accounting_counts_other_consumers_stored_usage():
     assert d.action == "wait" and d.reason == jq.REASON_QUOTA
 
 
+def make_inference_service(name, ns="fleet", *, replicas, topology="2x4"):
+    """An InferenceService holding ``replicas`` one-slice v5e replicas
+    (8 chips each at 2x4), as the serving controller would have committed
+    it (status.replicas is the ledger charge)."""
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"model": "llama_125m",
+                 "tpu": {"accelerator": "v5e", "topology": topology}},
+        "status": {"phase": "Ready", "replicas": replicas},
+    }
+
+
+def test_inference_service_scale_up_parks_tpujob_insufficient_quota():
+    """THE serving-side quota weld (ISSUE 12 satellite): a model server's
+    replica chips are declared charges in the SAME per-namespace ledger
+    gangs admit against — a scale-up parks a pending TPUJob Queued with
+    InsufficientQuota, and the scale-down lifts it."""
+    q = jq.JobQueue()
+    q.set_quotas([make_quota("fleet", 32)])
+    # 2 serving replicas x 8 chips = 16 committed; a 2-slice gang (16
+    # chips) still fits in the 32-chip profile.
+    q.observe_service(make_inference_service("llm", replicas=2))
+    q.observe(make_job("train", slices=2))
+    assert q.decide("fleet", "train").action == "admit"
+    # The autoscaler scales serving to 3 replicas (24 chips): the gang's
+    # 16 no longer fit — it must park with the quota reason, never be
+    # promised chips the model server holds.
+    q.observe_service(make_inference_service("llm", replicas=3))
+    d = q.decide("fleet", "train")
+    assert d.action == "wait" and d.reason == jq.REASON_QUOTA
+    assert "committed" in d.message
+    # Scale back down (or to zero): the gang admits into the freed chips.
+    q.observe_service(make_inference_service("llm", replicas=1))
+    assert q.decide("fleet", "train").action == "admit"
+    q.forget_service("fleet", "llm")
+    assert q.decide("fleet", "train").slices == 2
+
+
+def test_inference_service_headroom_clamps_serving_scale():
+    """The reverse direction of the weld: serving scale-ups are clamped
+    to the profile's free chips, with the service's own charge counted
+    as free to itself."""
+    q = jq.JobQueue()
+    q.set_quotas([make_quota("fleet", 32)])
+    q.observe(make_job("train", slices=2))        # waiting: holds nothing
+    assert q.service_headroom("fleet") == 32.0
+    # An admitted 2-slice gang commits 16 chips.
+    admitted = make_job("train", slices=2)
+    admitted["status"] = {"phase": "Running", "allocatedSlices": 2,
+                          "generation": 0, "restarts": 0}
+    q.observe(admitted)
+    assert q.service_headroom("fleet") == 16.0
+    # The service's own 8-chip replica is free capacity to itself.
+    q.observe_service(make_inference_service("llm", replicas=1))
+    assert q.service_headroom("fleet") == 8.0
+    assert q.service_headroom("fleet", own_chips=8.0) == 16.0
+    # No quota feed = unlimited (same contract as gang admission).
+    assert q.service_headroom("other-ns") == float("inf")
+
+
+def test_inference_service_rollout_charges_both_revisions():
+    """While a rollout is in flight both revision Deployments run side
+    by side — the ledger must charge the overlap, or a gang admits into
+    chips the warming revision's pods hold."""
+    from kubeflow_tpu.platform.apis import inferenceservice as svcapi
+
+    svc = make_inference_service("llm", replicas=2)
+    assert svcapi.chips_of(svc) == 16.0
+    svc["status"]["targetRevision"] = 2
+    svc["status"]["revision"] = 1
+    assert svcapi.chips_of(svc) == 32.0  # serving 2 + warming 2
+    q = jq.JobQueue()
+    q.set_quotas([make_quota("fleet", 32)])
+    q.observe_service(svc)
+    q.observe(make_job("train", slices=1))
+    d = q.decide("fleet", "train")
+    assert (d.action, d.reason) == ("wait", jq.REASON_QUOTA)
+
+
+def test_inference_service_charge_survives_rebuild():
+    """Ledger rebuilds (restart, confirm()) recompute the serving charge
+    from watch state — incremental observe and full refresh agree."""
+    quotas = [make_quota("fleet", 32)]
+    svc = make_inference_service("llm", replicas=3)
+    job = make_job("train", slices=2)
+    q1 = jq.JobQueue()
+    q1.set_quotas(quotas)
+    q1.observe_service(svc)
+    q1.observe(job)
+    q2 = jq.JobQueue()
+    q2.refresh([job], quotas, [], [svc])
+    for q in (q1, q2):
+        d = q.decide("fleet", "train")
+        assert (d.action, d.reason) == ("wait", jq.REASON_QUOTA)
+    snap = q2.snapshot()
+    assert snap["inferenceServiceChips"] == {"fleet/llm": 24.0}
+    assert snap["namespaceCommittedChips"] == {"fleet": 24.0}
+
+
 def test_unknown_pool_is_unlimited_but_empty_pool_blocks():
     q = jq.JobQueue()
     q.observe(make_job("nofeed", slices=3))

@@ -50,7 +50,7 @@ def test_deployment_commands_are_real_services():
 
 def test_crds_match_api_layer():
     from kubeflow_tpu.platform.k8s.types import (
-        NOTEBOOK, PODDEFAULT, PROFILE, TENSORBOARD, TPUJOB,
+        INFERENCESERVICE, NOTEBOOK, PODDEFAULT, PROFILE, TENSORBOARD, TPUJOB,
     )
 
     by_plural = {}
@@ -61,11 +61,39 @@ def test_crds_match_api_layer():
                 spec["group"],
                 {v["name"] for v in spec["versions"] if v.get("served")},
             )
-    for gvk in (NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD, TPUJOB):
+    for gvk in (NOTEBOOK, PROFILE, PODDEFAULT, TENSORBOARD, TPUJOB,
+                INFERENCESERVICE):
         assert gvk.plural in by_plural, f"no CRD for {gvk.kind}"
         group, versions = by_plural[gvk.plural]
         assert group == gvk.group
         assert gvk.version in versions
+
+
+def test_every_crd_is_in_kustomization_and_rbac():
+    """A new CRD must be WIRED, not just present: listed in the
+    kustomization (or `kubectl apply -k` skips it) and granted to the
+    controller ClusterRole (or its reconciler gets 403s)."""
+    kustomization = yaml.safe_load(
+        (MANIFESTS / "kustomization.yaml").read_text())
+    listed = set(kustomization["resources"])
+    for path in sorted((MANIFESTS / "crds").glob("*.yaml")):
+        assert f"crds/{path.name}" in listed, (
+            f"{path.name} missing from manifests/kustomization.yaml")
+    role = next(doc for _n, doc in _docs()
+                if doc["kind"] == "ClusterRole"
+                and doc["metadata"]["name"] == "kubeflow-tpu-controller")
+    granted = set()
+    for rule in role["rules"]:
+        granted.update(rule.get("resources", []))
+    for _n, doc in _docs():
+        if doc["kind"] != "CustomResourceDefinition":
+            continue
+        plural = doc["spec"]["names"]["plural"]
+        assert plural in granted, f"ClusterRole lacks {plural}"
+        if any("status" in (v.get("subresources") or {})
+               for v in doc["spec"]["versions"]):
+            assert f"{plural}/status" in granted, (
+                f"ClusterRole lacks {plural}/status")
 
 
 def test_tpujob_crd_yaml_matches_api_manifest():
@@ -111,6 +139,62 @@ def test_tpujob_crd_yaml_matches_api_manifest():
             ("Phase", "string", ".status.phase"),
             ("Priority", "integer", ".spec.priority"),
             ("Slices", "integer", ".status.allocatedSlices"),
+            ("Reason", "string", ".status.reason"),
+            ("Age", "date", ".metadata.creationTimestamp"),
+        ]
+
+
+def test_inferenceservice_crd_yaml_matches_api_manifest():
+    """manifests/crds/inferenceservice.yaml and
+    apis/inferenceservice.crd_manifest() describe ONE schema: same
+    group/names/served versions, same required spec fields, same scale
+    subresource paths, same printer columns — the yaml cannot drift from
+    what the controller validates."""
+    from kubeflow_tpu.platform.apis import inferenceservice as svcapi
+
+    with open(MANIFESTS / "crds" / "inferenceservice.yaml") as f:
+        from_yaml = yaml.safe_load(f)
+    from_api = svcapi.crd_manifest()
+    assert from_yaml["spec"]["group"] == from_api["spec"]["group"]
+    assert (from_yaml["spec"]["names"]["kind"]
+            == from_api["spec"]["names"]["kind"] == "InferenceService")
+    for doc in (from_yaml, from_api):
+        (version,) = doc["spec"]["versions"]
+        assert version["name"] == svcapi.VERSION
+        assert version["storage"] is True
+        subresources = version["subresources"]
+        assert subresources["status"] == {}
+        # The scale subresource drives kubectl scale / HPA tooling over
+        # the SAME fields the telemetry autoscaler writes.
+        assert subresources["scale"] == {
+            "specReplicasPath": ".spec.replicas.initial",
+            "statusReplicasPath": ".status.replicas",
+            "labelSelectorPath": ".status.selector",
+        }
+        spec_schema = version["schema"]["openAPIV3Schema"][
+            "properties"]["spec"]
+        assert sorted(spec_schema["required"]) == ["model", "tpu"]
+        assert set(spec_schema["properties"]) == {
+            "model", "checkpointDir", "quantize", "mesh", "image",
+            "maxSeqLen", "port", "tpu", "replicas", "scale"}
+        assert spec_schema["properties"]["tpu"]["required"] == [
+            "accelerator"]
+        assert set(spec_schema["properties"]["tpu"]["properties"]) == {
+            "accelerator", "topology"}  # replicas scale, not DCN slices
+        assert spec_schema["properties"]["quantize"]["enum"] == ["int8"]
+        reps = spec_schema["properties"]["replicas"]["properties"]
+        assert reps["min"]["minimum"] == 0  # scale-to-zero is spec'able
+        assert set(spec_schema["properties"]["scale"]["properties"]) == {
+            "queueDepthTarget", "ttftP99TargetSeconds",
+            "slotOccupancyTarget", "idleSeconds", "cooldownSeconds"}
+        cols = [(c["name"], c["type"], c["jsonPath"])
+                for c in version["additionalPrinterColumns"]]
+        assert cols == [
+            ("Phase", "string", ".status.phase"),
+            ("Model", "string", ".spec.model"),
+            ("Replicas", "integer", ".status.replicas"),
+            ("Ready", "integer", ".status.readyReplicas"),
+            ("Revision", "integer", ".status.revision"),
             ("Reason", "string", ".status.reason"),
             ("Age", "date", ".metadata.creationTimestamp"),
         ]

@@ -189,6 +189,72 @@ def test_serve_metrics_endpoint(client):
     assert "generate_request_seconds_bucket" in text
 
 
+def test_readyz_runs_and_caches_warm_generate(service):
+    """/readyz (ISSUE 12): the first probe runs a REAL one-token warm
+    generate() and reports its wall time; later probes serve the cached
+    result — the controller's rolling update gets an honest signal
+    without paying a generate per probe."""
+    calls = []
+    orig = service.generate
+
+    def counting(*args, **kwargs):
+        calls.append((args, kwargs))
+        return orig(*args, **kwargs)
+
+    service.generate = counting
+    try:
+        c = Client(create_app(service, model_name="llama_debug"))
+        resp = c.get("/readyz")
+        assert resp.status_code == 200, resp.get_data(as_text=True)
+        body = resp.get_json()
+        assert body["ready"] is True
+        assert body["warm_generate_seconds"] >= 0
+        assert len(calls) == 1
+        assert calls[0][1]["max_new_tokens"] == 1
+        # Cached: a second probe generates nothing.
+        assert c.get("/readyz").status_code == 200
+        assert len(calls) == 1
+    finally:
+        service.generate = orig
+
+
+def test_readyz_reports_failure_and_retries(service):
+    """A failing warm generate is a 503 with the error (not a 500 stack
+    dump), and is retried — a transient fault must not wedge readiness
+    at permanently-unready."""
+    orig = service.generate
+    state = {"fail": True}
+
+    def flaky(*args, **kwargs):
+        if state["fail"]:
+            raise RuntimeError("device lost")
+        return orig(*args, **kwargs)
+
+    service.generate = flaky
+    try:
+        c = Client(create_app(service, model_name="llama_debug"))
+        resp = c.get("/readyz")
+        assert resp.status_code == 503
+        assert "device lost" in resp.get_data(as_text=True)
+        state["fail"] = False
+        assert c.get("/readyz").status_code == 200
+    finally:
+        service.generate = orig
+
+
+def test_serve_replica_revision_metric(service, monkeypatch):
+    """/metrics exposes serve_replica_revision (the rollout tests' probe):
+    explicit argument wins, else KFT_SERVE_REVISION, else 0."""
+    c = Client(create_app(service, model_name="llama_debug", revision=7))
+    assert "serve_replica_revision 7.0" in \
+        c.get("/metrics").get_data(as_text=True)
+    monkeypatch.setenv("KFT_SERVE_REVISION", "3")
+    c2 = Client(create_app(service, model_name="llama_debug"))
+    assert "serve_replica_revision 3.0" in \
+        c2.get("/metrics").get_data(as_text=True)
+    assert c2.get("/readyz").get_json()["revision"] == 3
+
+
 def test_serve_spmd_mesh_matches_single_device(devices8):
     """--mesh serving: params sharded tensor-parallel over the mesh produce
     the same tokens as the unsharded service."""
