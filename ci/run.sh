@@ -29,6 +29,11 @@ budget() { # budget <seconds> <label> <cmd...>
 
 budget 180 "native build" make -C native
 
+# kftlint (ISSUE 13, docs/analysis.md): zero unsuppressed, un-baselined
+# findings over the tree — the same gate the `lint` workflow lane runs.
+budget 60 "kftlint invariants" \
+  python -m kubeflow_tpu.analysis --baseline ci/kftlint_baseline.json
+
 # QUICK=1 skips the @pytest.mark.slow tier (the ~15 tests over 20s each);
 # every test runs under the conftest watchdog (KFT_TEST_TIMEOUT_S, default
 # 600 s/test) so a hung mesh test fails CI in bounded time instead of

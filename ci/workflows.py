@@ -341,6 +341,31 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # lint presubmit lane (ISSUE 13): kftlint over the whole tree — exit
+    # nonzero on any unsuppressed, un-baselined finding (the shipped
+    # baseline is EMPTY: every repo-native invariant in docs/analysis.md
+    # is enforced from day one) — then the locktrace tier-1 suite: the
+    # sharding/jobqueue/chaos harnesses under the lock-order tracer with
+    # the coordinator's shared state guarded, plus the planted-violation
+    # fixtures proving the detector bites.
+    name="lint",
+    include_dirs=[
+        "kubeflow_tpu/*", "ci/kftlint_baseline.json",
+        "tests/ctrlplane/lintcorpus/*", "releasing/*",
+    ],
+    steps=[
+        Step("kftlint", [
+            sys.executable, "-m", "kubeflow_tpu.analysis",
+            "--baseline", "ci/kftlint_baseline.json",
+        ]),
+        Step("lint-unit", _pytest("tests/ctrlplane/test_analysis.py"),
+             depends="kftlint"),
+        Step("locktrace", _pytest("tests/ctrlplane/test_locktrace.py")
+             + ["-m", "not slow"], depends="kftlint"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

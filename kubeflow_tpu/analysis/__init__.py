@@ -1,0 +1,32 @@
+"""kftlint — repo-native invariant linting for the control plane.
+
+The platform's hardest bugs (fence-overlap, stale-generation pod kill,
+status-merge wipe) were violations of *repo-specific* contracts — fenced
+writes, frozen-view reads, status-via-patch, jax-free controllers — that
+generic linters cannot know about.  This package checks them statically,
+the way the reference Kubeflow repo leans on golangci-lint for its
+controller tree:
+
+* ``engine``  — AST lint driver: rule registry, per-line / per-file
+  ``# kft: disable=RULE`` suppressions, a checked-in baseline so a new
+  rule can land green and ratchet down.
+* ``rules``   — the repo-native rule set (R001..R008); see
+  docs/analysis.md for the rule reference.
+
+Run it over the tree (repo root cwd)::
+
+    python -m kubeflow_tpu.analysis --baseline ci/kftlint_baseline.json
+
+Exit is nonzero on any unsuppressed, un-baselined finding — the ``lint``
+presubmit lane in ci/workflows.py gates on it.
+"""
+from kubeflow_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    Rule,
+    all_rules,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    write_baseline,
+)
+from kubeflow_tpu.analysis import rules as _rules  # noqa: F401  (registers)

@@ -54,9 +54,11 @@ DEFAULT_BLOCK_K = 512
 def force_enabled() -> bool:
     """Test/debug override: use the kernel (interpret mode off-TPU) even
     where the platform gate would fall back to XLA."""
-    import os
+    from kubeflow_tpu.platform import config
 
-    return os.environ.get("KUBEFLOW_TPU_FORCE_FLASH_DECODE", "") == "1"
+    return config.knob("KUBEFLOW_TPU_FORCE_FLASH_DECODE", "",
+                       doc="'1' forces the flash-decode kernel "
+                           "(interpret mode off-TPU)") == "1"
 
 
 def _pick_block(S: int) -> Optional[int]:

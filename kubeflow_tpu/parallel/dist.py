@@ -25,7 +25,9 @@ def worker_env() -> dict:
     # The variable names live in parallel/envspec.py — the SAME constants
     # the platform controllers inject from, so discovery and injection
     # cannot drift (round-tripped in tests/ctrlplane/test_tpujob_controller).
-    return envspec.worker_env_from(os.environ)
+    # Hands the WHOLE environ mapping to discovery — not a single knob
+    # read, so the registry has nothing to record here.
+    return envspec.worker_env_from(os.environ)  # kft: disable=R005 full-environ handoff
 
 
 def num_slices() -> int:

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from kubeflow_tpu.platform import config as platform_config
 from kubeflow_tpu.platform.tpu import ACCELERATORS
 from kubeflow_tpu.platform.web.framework import HttpError
 
@@ -28,7 +29,9 @@ _cache: Dict[str, tuple] = {}
 
 
 def load_spawner_config(path: Optional[str] = None) -> Dict[str, Any]:
-    resolved = path or os.environ.get("SPAWNER_CONFIG", CONFIG_PATH)
+    resolved = path or platform_config.knob(
+        "SPAWNER_CONFIG", CONFIG_PATH,
+        doc="spawner UI config yaml (mounted ConfigMap)")
     try:
         mtime = os.stat(resolved).st_mtime
     except OSError:

@@ -1,30 +1,113 @@
 """Env-driven configuration (the reference's GetEnvDefault pattern,
-culling_controller.go:385-391 / notebook_controller.go:203,427,489,503)."""
+culling_controller.go:385-391 / notebook_controller.go:203,427,489,503)
+— now a single-source **knob registry** (kftlint rule R005).
+
+Every environment knob resolves through ``knob(name, default, parser)``:
+the call both reads the environment and records the knob (name, default,
+parser, doc, secrecy) in the module-level ``KNOBS`` table, so the live
+surface is enumerable — ``/debug/knobs`` on the controller health port
+dumps effective values (docs/analysis.md "Knob registry").  The legacy
+``env/env_bool/env_int/env_float`` helpers are thin wrappers over
+``knob`` and keep their exact parsing semantics.
+
+A raw ``os.environ`` read anywhere else in the tree is a lint finding:
+an undocumented knob that /debug/knobs cannot see.
+"""
 from __future__ import annotations
 
 import os
+import threading
+from typing import Any, Callable, Dict, NamedTuple
+
+_SECRET_MARKERS = (
+    "TOKEN", "SECRET", "PASSWORD", "PASSWD", "CREDENTIAL", "API_KEY",
+    "APIKEY", "PRIVATE",
+)
 
 
-def env(name: str, default: str = "") -> str:
-    return os.environ.get(name, default)
+class Knob(NamedTuple):
+    name: str
+    default: Any
+    parser: Callable[[str], Any]
+    doc: str
+    secret: bool
 
 
-def env_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
+# name -> Knob, first registration wins (a knob read from two sites with
+# different defaults keeps the first-seen default in the table; each call
+# still returns with ITS default — the table is documentation, not state).
+KNOBS: Dict[str, Knob] = {}
+_lock = threading.Lock()
+
+
+def parse_bool(v: str) -> bool:
     return v.strip().lower() in ("1", "true", "yes", "on")
 
 
-def env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ[name])
-    except (KeyError, ValueError):
+def knob(name: str, default: Any = None, parser: Callable[[str], Any] = str,
+         *, doc: str = "", secret: bool = None) -> Any:
+    """Resolve env knob ``name`` through the registry: parse the env value
+    when set and parseable, else ``default``.  ``secret`` defaults to a
+    name sniff (TOKEN/SECRET/...) and controls /debug/knobs redaction."""
+    if secret is None:
+        secret = any(m in name.upper() for m in _SECRET_MARKERS)
+    with _lock:
+        if name not in KNOBS:
+            KNOBS[name] = Knob(name, default, parser, doc, secret)
+    raw = os.environ.get(name)  # kft: disable=R005 the registry itself
+    if raw is None:
         return default
+    try:
+        return parser(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+def effective(*, redact: bool = True) -> Dict[str, dict]:
+    """Snapshot of every registered knob with its resolved value — the
+    /debug/knobs payload.  Values re-resolve at call time (env changes
+    between reads show up); secrets render as '<redacted>' when set."""
+    out: Dict[str, dict] = {}
+    with _lock:
+        items = sorted(KNOBS.items())
+    for name, k in items:
+        raw = os.environ.get(name)  # kft: disable=R005 the registry itself
+        if raw is None:
+            value, source = k.default, "default"
+        else:
+            try:
+                value, source = k.parser(raw), "env"
+            except (TypeError, ValueError):
+                # The runtime silently falls back (knob()), but the debug
+                # page must not claim the environment supplied the
+                # default — the typo is exactly what the reader is
+                # hunting.
+                value, source = k.default, "env-unparseable"
+        if redact and k.secret and source == "env":
+            value = "<redacted>"
+        if not isinstance(value, (str, int, float, bool, type(None))):
+            value = str(value)
+        entry = {"value": value, "default": k.default
+                 if isinstance(k.default, (str, int, float, bool, type(None)))
+                 else str(k.default),
+                 "source": source}
+        if k.doc:
+            entry["doc"] = k.doc
+        out[name] = entry
+    return out
+
+
+def env(name: str, default: str = "") -> str:
+    return knob(name, default, str)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    return knob(name, default, parse_bool)
+
+
+def env_float(name: str, default: float) -> float:
+    return knob(name, default, float)
 
 
 def env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+    return knob(name, default, int)

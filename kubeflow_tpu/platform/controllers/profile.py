@@ -445,6 +445,7 @@ def labels_file_watcher(path: str, *, poll_seconds: float = 1.0):
     watch + reconcile-all of the reference (profile_controller.go:368-399).
     mtime polling also covers the ConfigMap symlink-swap dance the
     reference handles via Remove+re-Add."""
+    import logging
     import os
 
     def run(controller) -> None:
@@ -466,7 +467,10 @@ def labels_file_watcher(path: str, *, poll_seconds: float = 1.0):
                     for p in controller.reconciler.client.list(PROFILE):
                         controller.queue.add(Req("", name_of(p)))
                 except Exception:
-                    pass  # transient list failure; next change retries
+                    # Transient list failure; the next file change retries.
+                    logging.getLogger("kubeflow_tpu.controllers.profile").debug(
+                        "labels-file relist failed; next change retries",
+                        exc_info=True)
 
     return run
 

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Protocol, Tuple
 
+from kubeflow_tpu.platform import config
 from kubeflow_tpu.platform.k8s import errors
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
@@ -287,28 +288,37 @@ class RestKubeClient:
             base_url, token, ca_cert, client_cert = self._resolve_config()
         self.base_url = base_url.rstrip("/")
         self.timeout = (timeout if timeout is not None
-                        else float(os.environ.get("K8S_CLIENT_TIMEOUT", "30")))
+                        else config.knob("K8S_CLIENT_TIMEOUT", 30.0, float,
+                                         doc="per-request read timeout (s)"))
         self.connect_timeout = (
             connect_timeout if connect_timeout is not None
-            else float(os.environ.get("K8S_CLIENT_TIMEOUT_CONNECT", "5")))
+            else config.knob("K8S_CLIENT_TIMEOUT_CONNECT", 5.0, float,
+                             doc="per-request connect timeout (s)"))
         self.retries = (retries if retries is not None
-                        else int(os.environ.get("K8S_CLIENT_RETRIES", "3")))
+                        else config.knob("K8S_CLIENT_RETRIES", 3, int,
+                                         doc="retry budget, idempotent verbs"))
         self.retry_base = (
             retry_base if retry_base is not None
-            else float(os.environ.get("K8S_CLIENT_RETRY_BASE", "0.1")))
+            else config.knob("K8S_CLIENT_RETRY_BASE", 0.1, float,
+                             doc="full-jitter backoff base (s)"))
         self.retry_cap = (
             retry_cap if retry_cap is not None
-            else float(os.environ.get("K8S_CLIENT_RETRY_CAP", "5.0")))
+            else config.knob("K8S_CLIENT_RETRY_CAP", 5.0, float,
+                             doc="full-jitter backoff cap (s)"))
         self.breaker = CircuitBreaker(
             breaker_threshold if breaker_threshold is not None
-            else int(os.environ.get("K8S_CLIENT_CB_THRESHOLD", "5")),
+            else config.knob("K8S_CLIENT_CB_THRESHOLD", 5, int,
+                             doc="consecutive failures that open the circuit"),
             breaker_cooldown if breaker_cooldown is not None
-            else float(os.environ.get("K8S_CLIENT_CB_COOLDOWN", "10.0")),
+            else config.knob("K8S_CLIENT_CB_COOLDOWN", 10.0, float,
+                             doc="open-circuit cooldown before half-open (s)"),
         )
         if qps is None:
-            qps = float(os.environ.get("K8S_CLIENT_QPS", "50"))
+            qps = config.knob("K8S_CLIENT_QPS", 50.0, float,
+                              doc="client-side rate limit (0 disables)")
         if burst is None:
-            burst = int(os.environ.get("K8S_CLIENT_BURST", "100"))
+            burst = config.knob("K8S_CLIENT_BURST", 100, int,
+                                doc="token-bucket burst for the rate limit")
         self._limiter = TokenBucket(qps, burst) if qps > 0 else None
         self._session = requests.Session()
         # Explicit connection-pool sizing (K8S_CLIENT_POOL_SIZE): requests'
@@ -319,7 +329,9 @@ class RestKubeClient:
         # the dispatch layer stopped serializing it.  Sized to cover the
         # worker-count x flight-pool defaults with headroom for watches.
         if pool_size is None:
-            pool_size = int(os.environ.get("K8S_CLIENT_POOL_SIZE", "32"))
+            pool_size = config.knob(
+                "K8S_CLIENT_POOL_SIZE", 32, int,
+                doc="requests connection-pool size per host")
         self.pool_size = max(1, pool_size)
         adapter = requests.adapters.HTTPAdapter(
             pool_connections=self.pool_size, pool_maxsize=self.pool_size)
@@ -344,9 +356,11 @@ class RestKubeClient:
 
     @staticmethod
     def _resolve_config() -> Tuple[str, Optional[str], Optional[str], Optional[Tuple[str, str]]]:
-        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        host = config.knob("KUBERNETES_SERVICE_HOST", "",
+                           doc="in-cluster apiserver host (set by kubelet)")
         if host and os.path.exists(f"{SERVICE_ACCOUNT_DIR}/token"):
-            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            port = config.knob("KUBERNETES_SERVICE_PORT", "443",
+                               doc="in-cluster apiserver port")
             with open(f"{SERVICE_ACCOUNT_DIR}/token") as f:
                 token = f.read().strip()
             ca = f"{SERVICE_ACCOUNT_DIR}/ca.crt"
@@ -354,7 +368,9 @@ class RestKubeClient:
         # kubeconfig
         import yaml
 
-        path = os.environ.get("KUBECONFIG", os.path.expanduser("~/.kube/config"))
+        path = config.knob("KUBECONFIG",
+                           os.path.expanduser("~/.kube/config"),
+                           doc="kubeconfig path when not in-cluster")
         if not os.path.exists(path):
             raise RuntimeError(
                 "no API server config: not in-cluster and no kubeconfig at " + path

@@ -107,6 +107,15 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
                 start_response("200 OK",
                                [("Content-Type", "application/json")])
                 return [json.dumps(snap).encode()]
+        if path == "/debug/knobs":
+            # The effective env-knob surface (platform/config.py knob
+            # registry, kftlint R005): every knob any loaded module has
+            # resolved, with its live value, default and source — secrets
+            # redacted.  The first page to read when "which setting is
+            # this replica actually running with" is the question
+            # (docs/analysis.md "Knob registry").
+            start_response("200 OK", [("Content-Type", "application/json")])
+            return [json.dumps({"knobs": config.effective()}).encode()]
         if path == "/debug/traces" and debug_traces:
             from urllib.parse import parse_qs
 

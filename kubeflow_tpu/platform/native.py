@@ -54,7 +54,10 @@ def _load() -> Optional[ctypes.CDLL]:
         if _load_attempted:
             return _lib
         _load_attempted = True
-        if os.environ.get("KF_NATIVE", "1") == "0":
+        from kubeflow_tpu.platform import config
+
+        if config.knob("KF_NATIVE", "1",
+                       doc="'0' disables the native C++ engine") == "0":
             return None
         if not os.path.exists(_LIB_PATH) and not _try_build():
             return None
@@ -353,7 +356,7 @@ class NativeWorkQueue:
             if getattr(self, "_q", None):
                 self._lib.kfq_delete(self._q)
                 self._q = None
-        except Exception:
+        except Exception:  # kft: disable=R006 interpreter-shutdown __del__: modules may be torn down, logging unsafe
             pass
 
 

@@ -614,6 +614,7 @@ class Controller:
                         req.namespace or None)
             else:
                 obj.setdefault("status", {})["conditions"] = conditions
+                # kft: disable=R004 fallback for test doubles without patch_status
                 client.update_status(obj)
         except Exception:
             log.debug("%s: could not write ReconcileFailed condition for "
@@ -633,7 +634,9 @@ class Controller:
                     obj, "Warning", "ReconcileFailed",
                     f"reconcile gave up after max retries: {message}")
             except Exception:
-                pass
+                log.debug("%s: could not record ReconcileFailed event for "
+                          "%s/%s", self.name, req.namespace, req.name,
+                          exc_info=True)
 
     # -- stuck-reconcile watchdog --------------------------------------------
 

@@ -161,7 +161,8 @@ class LeaderElector:
             lease["spec"]["renewTime"] = None
             self.client.update(lease)
         except Exception:
-            pass
+            log.debug("%s: leader lease release failed; it will expire on "
+                      "its own", self.identity, exc_info=True)
 
     # -- loop ----------------------------------------------------------------
 

@@ -493,7 +493,8 @@ class ShardCoordinator:
             lease["spec"]["renewTime"] = None
             self.client.update(lease)
         except Exception:
-            pass
+            log.debug("%s: shard %d lease release failed; it will expire "
+                      "on its own", self.identity, shard, exc_info=True)
 
     def _renew_member(self, now: datetime.datetime) -> None:
         name = self._member_lease_name()
@@ -510,9 +511,11 @@ class ShardCoordinator:
                     "spec": self._spec(now, transitions=0),
                 })
             except Exception:
-                pass
+                log.debug("%s: membership lease create failed; next renew "
+                          "period retries", self.identity, exc_info=True)
         except Exception:
-            pass
+            log.debug("%s: membership lease renew failed; next renew "
+                      "period retries", self.identity, exc_info=True)
 
     def _live_members(self, now: datetime.datetime) -> int:
         """Count distinct live membership leases (self included).  The
@@ -740,7 +743,8 @@ class ShardCoordinator:
             self.client.delete(LEASE, self._member_lease_name(),
                                self.namespace)
         except Exception:
-            pass
+            log.debug("%s: membership lease delete on shutdown failed; "
+                      "incumbents age it out", self.identity, exc_info=True)
         metrics.deregister_shard_coordinator(self)
         if released:
             # The dispatcher has usually exited by now (stop is set), so
@@ -875,6 +879,7 @@ class FencedClient:
     def update_status(self, obj):
         gvk = gvk_of(obj)
         ctx = self._fence()
+        # kft: disable=R004 client-shim pass-through, not a status author
         out = self.inner.update_status(obj)
         self._log_write("update_status", gvk.kind, namespace_of(obj),
                         name_of(obj), ctx)

@@ -1,0 +1,590 @@
+"""locktrace — a test-time lock-order race detector (the `go test -race`
+analogue for this repo's threading, scoped to what Python can see).
+
+Two failure modes the chaos suites cannot reliably force but a graph can
+prove reachable:
+
+* **Lock-order cycles (potential deadlock).**  While a ``trace()`` is
+  installed, every ``threading.Lock/RLock/Condition`` *created* inside
+  the window is wrapped: each blocking acquire records edges from every
+  lock the thread already holds to the one it is acquiring.  Locks
+  aggregate into **classes by creation site** (lockdep's design: two
+  coordinators built from the same line are one class), so an ABBA pair
+  is caught even when the two runs that exhibit each ordering never
+  overlapped in time.  A cycle in the class graph = a thread interleaving
+  that deadlocks exists, even if this run got lucky.
+
+* **Unguarded writes to registered shared state.**  ``tracer.guard(obj,
+  lock, name)`` wraps a dict/list/set; every mutation asserts the
+  guarding lock is held by the writing thread, and violations are
+  collected (not raised mid-thread) for ``assert_clean()``.
+
+Usage (tests/ctrlplane/test_locktrace.py pins this, tier-1)::
+
+    with locktrace.trace() as t:
+        fleet = ShardedFleet(replicas=2, ...)   # locks created here are traced
+        ... drive it ...
+    t.assert_clean()   # no cycles, no unguarded writes
+
+Scope notes: only locks created inside the window are traced (pytest's
+own machinery stays raw); bookkeeping uses pre-patch primitives so the
+tracer never traces itself; non-blocking acquires (``acquire(False)``)
+record no edges — they cannot deadlock.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Set, Tuple
+
+# Pre-patch primitives: the tracer's own synchronization must never be
+# traced, and uninstall must restore exactly these.
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+class LockOrderViolation(AssertionError):
+    """A cycle in the lock-class order graph (potential deadlock)."""
+
+
+class GuardViolation(AssertionError):
+    """Registered shared state mutated without its guarding lock held."""
+
+
+def _creation_site() -> str:
+    """file:line of the frame that called the lock factory, skipping
+    locktrace and threading internals (Condition's default RLock, Event's
+    internal Condition... should attribute to the *caller*)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if base not in ("locktrace.py", "threading.py", "queue.py"):
+            try:
+                rel = os.path.relpath(fn, _REPO_ROOT)
+            except ValueError:
+                rel = fn
+            if not rel.startswith(".."):
+                fn = rel.replace(os.sep, "/")
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<unknown>"
+
+
+def _short_stack(limit: int = 8) -> str:
+    return "".join(traceback.format_stack(sys._getframe(3), limit=limit))
+
+
+class _TracedLock:
+    """Wraps a real lock; records lock-order edges and ownership.
+
+    Ownership is a per-thread holds map (ident -> recursion count,
+    guarded by the tracer's bookkeeping lock) rather than a single owner
+    field: a hand-off Lock — acquired in thread A, released in thread B —
+    must decrement *A's* hold, or A keeps a stale entry that fabricates
+    lock-order edges and lets A's unguarded writes pass the guard check.
+    The acquirer's TLS held-list entry is pruned lazily on its next
+    acquire (we cannot reach another thread's TLS)."""
+
+    def __init__(self, tracer: "LockTracer", inner, site: str):
+        self._tracer = tracer
+        self._inner = inner
+        self.site = site
+        self.name: Optional[str] = None  # tracer.name_lock merges classes
+        self._holds: Dict[int, int] = {}
+
+    @property
+    def key(self) -> str:
+        return self.name or self.site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._tracer._note_acquire(self, blocking)
+        if timeout == -1:
+            ok = self._inner.acquire(blocking)
+        else:
+            ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            ident = threading.get_ident()
+            with self._tracer._bk:
+                self._holds[ident] = self._holds.get(ident, 0) + 1
+            self._tracer._push_held(self)
+        return ok
+
+    def release(self):
+        ident = threading.get_ident()
+        with self._tracer._bk:
+            if self._holds.get(ident, 0) > 0:
+                self._holds[ident] -= 1
+                if not self._holds[ident]:
+                    del self._holds[ident]
+            elif self._holds:
+                # Hand-off: some other thread acquired it; shed one of
+                # its holds so its stale TLS entry prunes on next use.
+                other = next(iter(self._holds))
+                self._holds[other] -= 1
+                if not self._holds[other]:
+                    del self._holds[other]
+        self._inner.release()
+        self._tracer._pop_held(self)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def owned_by_current_thread(self) -> bool:
+        with self._tracer._bk:
+            return self._holds.get(threading.get_ident(), 0) > 0
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<traced {type(self._inner).__name__} {self.key}>"
+
+
+class _TracedRLock(_TracedLock):
+    """RLock variant: exposes the _release_save/_acquire_restore/_is_owned
+    trio so a real Condition over it releases ALL recursion levels in
+    wait() — defining these on the plain-Lock wrapper would advertise an
+    API the inner lock cannot honor (Condition probes with hasattr)."""
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        ident = threading.get_ident()
+        with self._tracer._bk:
+            n = self._holds.pop(ident, 0)
+        state = self._inner._release_save()
+        self._tracer._pop_held_all(self, n)
+        return (state, n)
+
+    def _acquire_restore(self, saved):
+        state, n = saved
+        self._tracer._note_acquire(self, True)
+        self._inner._acquire_restore(state)
+        ident = threading.get_ident()
+        with self._tracer._bk:
+            self._holds[ident] = self._holds.get(ident, 0) + max(1, n)
+        for _ in range(max(1, n)):
+            self._tracer._push_held(self)
+
+
+class _GuardedBase:
+    def __init__(self, tracer: "LockTracer", inner, lock, name: str):
+        self._tracer = tracer
+        self._inner = inner
+        self._lock = lock
+        self._name = name
+
+    def _check(self, op: str) -> None:
+        self._tracer._check_guard(self._lock, self._name, op)
+
+    def __len__(self):
+        return len(self._inner)
+
+    def __iter__(self):
+        return iter(self._inner)
+
+    def __contains__(self, item):
+        return item in self._inner
+
+    def __repr__(self):
+        return f"<guarded {self._name} {self._inner!r}>"
+
+
+class _GuardedDict(_GuardedBase):
+    def __getitem__(self, k):
+        return self._inner[k]
+
+    def __setitem__(self, k, v):
+        self._check(f"[{k!r}]=")
+        self._inner[k] = v
+
+    def __delitem__(self, k):
+        self._check(f"del [{k!r}]")
+        del self._inner[k]
+
+    def get(self, k, default=None):
+        return self._inner.get(k, default)
+
+    def keys(self):
+        return self._inner.keys()
+
+    def values(self):
+        return self._inner.values()
+
+    def items(self):
+        return self._inner.items()
+
+    def setdefault(self, k, default=None):
+        self._check(f"setdefault({k!r})")
+        return self._inner.setdefault(k, default)
+
+    def pop(self, k, *a):
+        self._check(f"pop({k!r})")
+        return self._inner.pop(k, *a)
+
+    def update(self, *a, **kw):
+        self._check("update")
+        return self._inner.update(*a, **kw)
+
+    def clear(self):
+        self._check("clear")
+        return self._inner.clear()
+
+
+class _GuardedList(_GuardedBase):
+    def __getitem__(self, i):
+        return self._inner[i]
+
+    def __setitem__(self, i, v):
+        self._check(f"[{i!r}]=")
+        self._inner[i] = v
+
+    def append(self, v):
+        self._check("append")
+        self._inner.append(v)
+
+    def extend(self, it):
+        self._check("extend")
+        self._inner.extend(it)
+
+    def insert(self, i, v):
+        self._check("insert")
+        self._inner.insert(i, v)
+
+    def pop(self, *a):
+        self._check("pop")
+        return self._inner.pop(*a)
+
+    def remove(self, v):
+        self._check("remove")
+        self._inner.remove(v)
+
+    def clear(self):
+        self._check("clear")
+        self._inner.clear()
+
+
+class _GuardedSet(_GuardedBase):
+    # Read-side set algebra passes through (sharding computes
+    # `self._owned - self._draining` and the like while holding the lock;
+    # reads are not the guard's business).
+    def __sub__(self, other):
+        return set(self._inner) - set(other)
+
+    def __rsub__(self, other):
+        return set(other) - set(self._inner)
+
+    def __and__(self, other):
+        return set(self._inner) & set(other)
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        return set(self._inner) | set(other)
+
+    __ror__ = __or__
+
+    # In-place forms MUST mutate through the guard: without these,
+    # `s -= {...}` would fall back to __sub__ and rebind the attribute to
+    # a plain unguarded set — the detector silently stops detecting.
+    def __isub__(self, other):
+        self._check("-=")
+        self._inner.difference_update(other)
+        return self
+
+    def __ior__(self, other):
+        self._check("|=")
+        self._inner.update(other)
+        return self
+
+    def __iand__(self, other):
+        self._check("&=")
+        self._inner.intersection_update(other)
+        return self
+
+    def copy(self):
+        return set(self._inner)
+
+    def add(self, v):
+        self._check("add")
+        self._inner.add(v)
+
+    def discard(self, v):
+        self._check("discard")
+        self._inner.discard(v)
+
+    def remove(self, v):
+        self._check("remove")
+        self._inner.remove(v)
+
+    def pop(self):
+        self._check("pop")
+        return self._inner.pop()
+
+    def clear(self):
+        self._check("clear")
+        self._inner.clear()
+
+
+class LockTracer:
+    def __init__(self):
+        self._bk = _REAL_LOCK()  # bookkeeping lock (never traced)
+        self._tls = threading.local()
+        # lock-class order graph: (from_key, to_key) -> first witness
+        self.edges: Dict[Tuple[str, str], dict] = {}
+        self.guard_violations: List[dict] = []
+        self._installed = False
+
+    # -- factory patching ----------------------------------------------------
+
+    def install(self) -> "LockTracer":
+        if self._installed:
+            raise RuntimeError("locktrace already installed")
+        self._installed = True
+        tracer = self
+
+        def make_lock():
+            return _TracedLock(tracer, _REAL_LOCK(), _creation_site())
+
+        def make_rlock():
+            return _TracedRLock(tracer, _REAL_RLOCK(), _creation_site())
+
+        def make_condition(lock=None):
+            if lock is None:
+                lock = make_rlock()
+            return _REAL_CONDITION(lock)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        threading.Condition = make_condition
+        return self
+
+    def uninstall(self) -> None:
+        threading.Lock = _REAL_LOCK
+        threading.RLock = _REAL_RLOCK
+        threading.Condition = _REAL_CONDITION
+        self._installed = False
+
+    # -- per-thread lockset + edges ------------------------------------------
+
+    def _held(self) -> List[_TracedLock]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, lock: _TracedLock, blocking: bool) -> None:
+        if not blocking:
+            return  # cannot deadlock
+        held = self._held()
+        # Prune entries whose hold this thread no longer has (a hand-off
+        # release from another thread shed it) — a stale entry here would
+        # fabricate edges from a lock we do not hold.
+        if held:
+            ident = threading.get_ident()
+            with self._bk:
+                held[:] = [h for h in held
+                           if h._holds.get(ident, 0) > 0]
+        if any(h is lock for h in held):
+            return  # reentrant re-acquire adds no ordering
+        if not held:
+            return
+        thread = threading.current_thread().name
+        new_edges = []
+        seen: Set[str] = set()
+        for h in held:
+            if h.key in seen:
+                continue
+            seen.add(h.key)
+            # h.key == lock.key with DIFFERENT instances is same-class
+            # nesting (two coordinators born on one source line, locked
+            # inside each other): a self-loop edge, reported as a cycle —
+            # lockdep's rule, since only an external order makes it safe.
+            new_edges.append((h.key, lock.key))
+        if not new_edges:
+            return
+        with self._bk:
+            for edge in new_edges:
+                if edge not in self.edges:
+                    self.edges[edge] = {
+                        "thread": thread,
+                        "holding": edge[0],
+                        "acquiring": edge[1],
+                        "stack": _short_stack(),
+                    }
+
+    def _push_held(self, lock: _TracedLock) -> None:
+        self._held().append(lock)
+
+    def _pop_held(self, lock: _TracedLock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+        # released by a thread that never acquired it (hand-off Lock
+        # usage) — nothing to unwind on this thread.
+
+    def _pop_held_all(self, lock: _TracedLock, n: int) -> None:
+        for _ in range(max(1, n)):
+            self._pop_held(lock)
+
+    # -- naming ---------------------------------------------------------------
+
+    def name_lock(self, lock: _TracedLock, name: str) -> _TracedLock:
+        """Merge a lock into a named class (instead of its creation site)."""
+        lock.name = name
+        return lock
+
+    # -- guards ---------------------------------------------------------------
+
+    def guard(self, obj, lock, name: str):
+        """Wrap shared state so every mutation asserts ``lock`` is held by
+        the writing thread.  Replace the attribute with the returned proxy:
+        ``coord._owned = tracer.guard(coord._owned, coord._lock, "owned")``."""
+        if isinstance(obj, dict):
+            return _GuardedDict(self, obj, lock, name)
+        if isinstance(obj, list):
+            return _GuardedList(self, obj, lock, name)
+        if isinstance(obj, set):
+            return _GuardedSet(self, obj, lock, name)
+        raise TypeError(f"cannot guard {type(obj).__name__}")
+
+    def _check_guard(self, lock, name: str, op: str) -> None:
+        if isinstance(lock, _TracedLock):
+            owned = lock.owned_by_current_thread()
+        elif hasattr(lock, "_is_owned"):
+            owned = lock._is_owned()
+        else:
+            owned = lock.locked()  # plain raw Lock: held by *someone*
+        if owned:
+            return
+        with self._bk:
+            self.guard_violations.append({
+                "state": name,
+                "op": op,
+                "thread": threading.current_thread().name,
+                "stack": _short_stack(),
+            })
+
+    # -- analysis -------------------------------------------------------------
+
+    def lock_order_cycles(self) -> List[List[str]]:
+        """Cycles in the lock-class graph (Tarjan SCCs of size > 1, plus
+        self-loops), each as the list of class keys involved."""
+        with self._bk:
+            edges = list(self.edges)
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v: str) -> None:
+            # Iterative Tarjan (the fleets build deep graphs).
+            work = [(v, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                succs = adj.get(node, [])
+                for i in range(pi, len(succs)):
+                    w = succs[i]
+                    if w not in index:
+                        work[-1] = (node, i + 1)
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(sorted(scc))
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in list(adj):
+            if v not in index:
+                strongconnect(v)
+        for a, b in edges:
+            if a == b:
+                sccs.append([a])
+        return sccs
+
+    def report(self) -> str:
+        lines = []
+        cycles = self.lock_order_cycles()
+        for cyc in cycles:
+            lines.append(f"lock-order cycle across classes: {cyc}")
+            with self._bk:
+                for (a, b), w in self.edges.items():
+                    if a in cyc and b in cyc:
+                        lines.append(
+                            f"  {a} -> {b} (thread {w['thread']}):\n"
+                            + "".join("    " + l for l in
+                                      w["stack"].splitlines(True)))
+        for v in self.guard_violations:
+            lines.append(
+                f"unguarded write to '{v['state']}' ({v['op']}) from "
+                f"thread {v['thread']}:\n"
+                + "".join("    " + l for l in v["stack"].splitlines(True)))
+        return "\n".join(lines)
+
+    def assert_clean(self) -> None:
+        cycles = self.lock_order_cycles()
+        if cycles:
+            raise LockOrderViolation(
+                f"{len(cycles)} lock-order cycle(s) — a deadlocking "
+                f"interleaving exists:\n{self.report()}")
+        if self.guard_violations:
+            raise GuardViolation(
+                f"{len(self.guard_violations)} unguarded write(s) to "
+                f"registered shared state:\n{self.report()}")
+
+
+@contextmanager
+def trace():
+    """Install the tracer for the block: locks *created* inside are
+    instrumented; pre-existing locks stay raw.  Analysis (assert_clean /
+    lock_order_cycles / report) stays valid after exit — traced locks
+    keep recording while their objects live, so stop your harness before
+    asserting if you want a closed world."""
+    tracer = LockTracer().install()
+    try:
+        yield tracer
+    finally:
+        tracer.uninstall()

@@ -10,7 +10,10 @@ granularity only affects patch size, not semantics).
 from __future__ import annotations
 
 import copy
+import logging
 from typing import Any, Dict, List
+
+log = logging.getLogger("kubeflow_tpu.webhook.jsonpatch")
 
 
 class PatchError(Exception):
@@ -126,7 +129,8 @@ def create_patch_fast(before: Any, after: Any) -> List[Dict[str, Any]]:
         try:
             return native.create_patch(before, after)
         except Exception:
-            pass
+            log.debug("native create_patch failed; falling back to the "
+                      "pure-Python diff", exc_info=True)
     return create_patch(before, after)
 
 

@@ -111,8 +111,11 @@ def train_loop(
     window_started_at = start_step
     step = start_step
     tokens_per_step = cfg.tokens_per_step
-    profile_dir = cfg.slow_step_profile_dir or os.environ.get(
-        "KFT_SLOW_STEP_PROFILE_DIR")
+    from kubeflow_tpu.platform import config
+
+    profile_dir = cfg.slow_step_profile_dir or config.knob(
+        "KFT_SLOW_STEP_PROFILE_DIR", None,
+        doc="directory for slow-step jax profiler dumps")
     profile_next = False
     profile_done = False
 

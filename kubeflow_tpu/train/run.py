@@ -22,6 +22,7 @@ from typing import Optional
 import jax
 
 from kubeflow_tpu.parallel import envspec
+from kubeflow_tpu.platform import config
 
 
 def install_preemption_handler(stop: threading.Event,
@@ -230,7 +231,9 @@ def main(argv: Optional[list] = None) -> int:
     # checkpoint dir without the image's command line knowing about it.
     ap.add_argument(
         "--checkpoint-dir",
-        default=os.environ.get(envspec.ENV_KFT_CHECKPOINT_DIR) or None)
+        default=config.knob(
+            envspec.ENV_KFT_CHECKPOINT_DIR, None,
+            doc="checkpoint dir injected by the TPUJob controller") or None)
     ap.add_argument("--checkpoint-every", type=int, default=100)
     ap.add_argument("--log-every", type=int, default=10)
     ap.add_argument("--distributed", action="store_true",

@@ -1,0 +1,13 @@
+"""R001 bad twin: reconcile-path writes that escape the fence."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        obj = {"metadata": {"name": req.name}}
+        # Fence bypass: writing through .inner skips check_fence.
+        self.client.inner.update(obj)
+        # Inline transport client: never wired through FencedClient.
+        FakeKube().create(obj)
+        # Client-shaped receiver that is not the injected self.client.
+        self.informer_client.delete(None, req.name)
+        return None

@@ -1,0 +1,16 @@
+"""R001 good twin: writes via the injected client / apply helpers; dict
+``.update`` is not a client verb."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        desired = {"metadata": {"name": req.name}}
+        self.client.create(desired)
+        limits = {}
+        limits.update({"google.com/tpu": 8})  # a dict, not a client
+        return None
+
+
+def helper(client, obj):
+    # Bare `client` is the injected (possibly fenced) client passed down.
+    client.update(obj)

@@ -1,0 +1,11 @@
+"""R002 bad twin: mutating frozen informer views without thaw()."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        nb = self.informer.get(req.name)
+        nb["status"] = {"phase": "Ready"}  # item store on a frozen view
+        items = self.informer.list(req.namespace)
+        for it in items:
+            it.setdefault("metadata", {})  # mutator on an iterated view
+        return None

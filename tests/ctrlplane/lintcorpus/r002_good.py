@@ -1,0 +1,13 @@
+"""R002 good twin: read frozen views freely; thaw() before any write;
+plural receivers are containers of informers, not caches."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        nb = self.informer.get(req.name)
+        phase = nb.get("status", {}).get("phase")  # reads are free
+        nb = thaw(nb)                              # intent-to-write copy
+        nb["status"] = {"phase": phase or "Ready"}
+        informer = self.informers.get(req.gvk)     # container .get
+        informer.resync_period = 30.0              # Informer config, not a view
+        return None

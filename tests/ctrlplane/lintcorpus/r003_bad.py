@@ -1,0 +1,10 @@
+"""R003 bad twin: module-level accelerator-stack imports in control-plane
+code."""
+import jax
+
+from kubeflow_tpu import models
+from kubeflow_tpu.models import llama
+
+
+def reconcile_with_model(req):
+    return jax.numpy.zeros(1), llama, models

@@ -1,0 +1,11 @@
+"""R003 good twin: the accelerator stack stays behind function-local
+imports; control-plane-safe imports are free."""
+import threading
+
+from kubeflow_tpu.platform.k8s import errors  # noqa: F401
+
+
+def maybe_touch_model():
+    import jax  # lazily, only on the path that needs it
+
+    return jax.numpy.zeros(1), threading.Lock()

@@ -1,0 +1,9 @@
+"""R004 bad twin: full-object status write."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        obj = {"metadata": {"name": req.name}, "status": {}}
+        obj["status"]["phase"] = "Ready"
+        self.client.update_status(obj)  # wipes sibling status owners
+        return None

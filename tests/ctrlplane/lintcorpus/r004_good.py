@@ -1,0 +1,9 @@
+"""R004 good twin: status goes through the diff'd merge patch."""
+from kubeflow_tpu.platform.runtime.apply import patch_status_diff
+
+
+class Reconciler:
+    def reconcile(self, req):
+        obj = {"metadata": {"name": req.name}, "status": {}}
+        patch_status_diff(self.client, self.gvk, obj, {"phase": "Ready"})
+        return None

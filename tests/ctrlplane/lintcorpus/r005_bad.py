@@ -1,0 +1,13 @@
+"""R005 bad twin: stray env reads the knob registry cannot see."""
+import os
+from os import environ
+
+TIMEOUT = float(os.environ.get("CORPUS_TIMEOUT", "30"))
+
+
+def flag():
+    return os.getenv("CORPUS_FLAG") == "1"
+
+
+def aliased():
+    return environ.get("CORPUS_ALIASED")

@@ -1,0 +1,9 @@
+"""R005 good twin: knobs resolve through the registry."""
+from kubeflow_tpu.platform import config
+
+TIMEOUT = config.knob("CORPUS_TIMEOUT", 30.0, float,
+                      doc="corpus example timeout")
+
+
+def flag():
+    return config.env_bool("CORPUS_FLAG", False)

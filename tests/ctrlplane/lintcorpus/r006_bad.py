@@ -1,0 +1,8 @@
+"""R006 bad twin: the error vanishes without a trace."""
+
+
+def release_lease(client, lease):
+    try:
+        client.update(lease)
+    except Exception:
+        pass

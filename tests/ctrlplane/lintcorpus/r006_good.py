@@ -1,0 +1,20 @@
+"""R006 good twin: broad handlers leave a trail; narrow handlers may
+pass."""
+import logging
+
+log = logging.getLogger("corpus")
+
+
+def release_lease(client, lease):
+    try:
+        client.update(lease)
+    except Exception:
+        log.debug("lease release failed; it will expire", exc_info=True)
+
+
+def optional_field(obj):
+    try:
+        return obj["status"]["phase"]
+    except KeyError:  # narrow and expected: not a silent swallow
+        pass
+    return None

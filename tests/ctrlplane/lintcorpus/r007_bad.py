@@ -1,0 +1,9 @@
+"""R007 bad twin: a metric declared in a controller module, with a label
+key outside the bounded allowlist."""
+from prometheus_client import Counter
+
+requests_total = Counter(
+    "corpus_requests_total",
+    "requests by user",
+    ["user_email"],  # unbounded-cardinality label key
+)

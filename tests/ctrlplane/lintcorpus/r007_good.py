@@ -1,0 +1,16 @@
+"""R007 good twin (routed as a metrics module): declared once, bounded
+label keys; collections.Counter is not a metric."""
+import collections
+
+from prometheus_client import CollectorRegistry, Counter
+
+registry = CollectorRegistry()
+
+reconciles_total = Counter(
+    "corpus_reconciles_total",
+    "reconciles by controller and result",
+    ["controller", "result"],
+    registry=registry,
+)
+
+word_counts = collections.Counter("not a metric declaration")

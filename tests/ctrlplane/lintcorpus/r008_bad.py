@@ -1,0 +1,10 @@
+"""R008 bad twin: unbounded blocking inside a reconcile body."""
+import time
+
+
+class Reconciler:
+    def reconcile(self, req):
+        time.sleep(5)             # the workqueue owns time
+        self.lock.acquire()       # no timeout: can pin the worker forever
+        self.ready.wait()         # ditto
+        return None

@@ -1,0 +1,15 @@
+"""R008 good twin: every block is bounded; delays ride the requeue."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        if not self.lock.acquire(timeout=1.0):
+            return self.requeue_after(0.5)
+        try:
+            if not self.ready.wait(0.25):
+                return self.requeue_after(0.5)
+        finally:
+            self.lock.release()
+        if self.backoff_needed():
+            return self.requeue_after(5.0)  # instead of time.sleep(5)
+        return None

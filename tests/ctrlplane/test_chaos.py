@@ -334,7 +334,11 @@ def test_tpujob_storm_converges_with_invariants(fleet_kube):
     try:
         for i in range(n):
             fleet_kube.create(make_tpujob(f"tj-{i:03d}"))
-        deadline = time.monotonic() + 90.0
+        # Slack over the ~35 s typical converge: under full-suite CPU
+        # contention the storm's retry backoffs stretch, and a dead-letter
+        # revival may need a resync tick — the pin is the invariant set
+        # below, not convergence latency.
+        deadline = time.monotonic() + 150.0
         while time.monotonic() < deadline:
             jobs = fleet_kube.list(TPUJOB, "fleet")
             if (len(jobs) == n

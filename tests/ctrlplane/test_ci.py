@@ -12,8 +12,10 @@ from ci.workflows import WORKFLOWS, select  # noqa: E402
 
 
 def test_dispatch_table_selects_by_changed_path():
+    # The lint lane triggers on ANY kubeflow_tpu change (ISSUE 13), so
+    # every component selection now carries it alongside its own lane.
     assert select(["kubeflow_tpu/platform/webhook/mutate.py"]) == [
-        "admission-webhook"
+        "lint", "admission-webhook"
     ]
     got = select(["kubeflow_tpu/platform/controllers/notebook.py"])
     assert "notebook-controller" in got
@@ -24,6 +26,38 @@ def test_dispatch_table_selects_by_changed_path():
     assert set(everything) == {
         n for n, wf in WORKFLOWS.items() if "presubmit" in wf.job_types
     }
+
+
+def test_presubmit_lane_list_is_pinned():
+    """The full presubmit lane list, pinned (ISSUE 13): a lane silently
+    dropped from the dispatch table is a coverage regression this test
+    turns into a loud diff."""
+    presubmit = sorted(n for n, wf in WORKFLOWS.items()
+                       if "presubmit" in wf.job_types)
+    assert presubmit == sorted([
+        "notebook-controller", "resilience", "ha-shard", "bench-smoke",
+        "tpujob", "inferenceservice", "lint", "admission-webhook",
+        "web-apps", "compute", "native", "notebook-images",
+    ])
+
+
+def test_lint_lane_registered_and_shaped():
+    """The lint lane (ISSUE 13): triggered by any kubeflow_tpu change,
+    kftlint gates on the shipped baseline, and the locktrace tier-1 suite
+    rides the same lane."""
+    assert "lint" in select(["kubeflow_tpu/platform/runtime/controller.py"])
+    assert "lint" in select(["kubeflow_tpu/models/llama.py"])
+    wf = WORKFLOWS["lint"]
+    assert [s.name for s in wf.steps] == ["kftlint", "lint-unit", "locktrace"]
+    kftlint = wf.steps[0].command
+    assert kftlint[1:4] == ["-m", "kubeflow_tpu.analysis", "--baseline"]
+    baseline_path = os.path.join(REPO, kftlint[4])
+    assert os.path.exists(baseline_path)
+    # Baseline hygiene: the highest-value contracts carry no debt.
+    data = json.load(open(baseline_path))
+    assert not {e["rule"] for e in data["findings"]} & {
+        "R001", "R003", "R004"}
+    assert "test_locktrace.py" in " ".join(wf.steps[2].command)
 
 
 def test_conformance_is_postsubmit_only():

@@ -1,0 +1,345 @@
+"""locktrace tier-1 suite (ISSUE 13): planted-violation detection plus
+the real control-plane harnesses running clean under the tracer.
+
+The three "suite" tests below are the acceptance pin: the sharding,
+jobqueue and chaos harnesses — the same machinery their own suites
+hammer — run under ``locktrace.trace()`` with the coordinator's shared
+state guarded, and must produce **zero lock-order cycles and zero
+unguarded writes**.  The planted fixtures prove the detector is not
+vacuous: an ABBA pair and an unguarded write must both fail loudly.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.testing import locktrace
+from kubeflow_tpu.platform.testing.locktrace import (
+    GuardViolation,
+    LockOrderViolation,
+)
+
+TTL = 0.5
+RENEW = 0.05
+
+
+# -- planted fixtures ---------------------------------------------------------
+
+
+def test_planted_abba_single_thread_detected():
+    """Lock-order is a class property: one thread taking A->B then B->A
+    proves a deadlocking two-thread interleaving exists."""
+    with locktrace.trace() as t:
+        a, b = threading.Lock(), threading.Lock()
+        t.name_lock(a, "A")
+        t.name_lock(b, "B")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    cycles = t.lock_order_cycles()
+    assert cycles == [["A", "B"]]
+    with pytest.raises(LockOrderViolation) as e:
+        t.assert_clean()
+    assert "A" in str(e.value) and "B" in str(e.value)
+
+
+def test_planted_abba_two_threads_detected():
+    """The classic: two threads, opposite orders, sequenced so both edges
+    are recorded; timeout acquires keep the test itself deadlock-free
+    (edges are recorded at acquire *attempt*, exactly so a timed-out
+    victim still leaves its evidence)."""
+    with locktrace.trace() as t:
+        a, b = threading.Lock(), threading.Lock()
+        t.name_lock(a, "A")
+        t.name_lock(b, "B")
+        holding_a, holding_b = threading.Event(), threading.Event()
+
+        def one():
+            with a:
+                holding_a.set()
+                holding_b.wait(2.0)
+                if b.acquire(timeout=0.2):  # attempt records A->B
+                    b.release()
+
+        def two():
+            holding_a.wait(2.0)
+            with b:
+                holding_b.set()
+                if a.acquire(timeout=0.2):  # attempt records B->A
+                    a.release()
+
+        t1 = threading.Thread(target=one)
+        t2 = threading.Thread(target=two)
+        t1.start(), t2.start()
+        t1.join(5), t2.join(5)
+    assert t.lock_order_cycles() == [["A", "B"]]
+
+
+def test_same_class_distinct_instance_nesting_is_a_cycle():
+    """Two locks born on ONE source line are one class; nesting one
+    inside the other (coordA._lock inside coordB._lock) is lockdep's
+    same-class rule: only an external order makes it safe, so it reports
+    as a self-loop cycle."""
+    with locktrace.trace() as t:
+        pair = [threading.Lock() for _ in range(2)]  # same creation site
+        with pair[0]:
+            with pair[1]:
+                pass
+    cycles = t.lock_order_cycles()
+    assert len(cycles) == 1 and len(cycles[0]) == 1, cycles
+    with pytest.raises(LockOrderViolation):
+        t.assert_clean()
+
+
+def test_consistent_order_is_clean():
+    with locktrace.trace() as t:
+        # Distinct creation sites: one line each (same-line locks share a
+        # class and same-class nesting is deliberately a violation).
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+        for _ in range(3):
+            with a, b, c:
+                pass
+    assert t.lock_order_cycles() == []
+    t.assert_clean()
+
+
+def test_planted_unguarded_write_detected():
+    with locktrace.trace() as t:
+        lock = threading.Lock()
+        shared = t.guard({}, lock, "shared-map")
+        with lock:
+            shared["guarded"] = 1          # fine
+        shared["unguarded"] = 2            # violation
+        shared.pop("guarded")              # violation
+    assert len(t.guard_violations) == 2
+    with pytest.raises(GuardViolation) as e:
+        t.assert_clean()
+    assert "shared-map" in str(e.value)
+
+
+def test_guarded_writes_from_worker_thread_clean():
+    with locktrace.trace() as t:
+        lock = threading.Lock()
+        shared = t.guard(set(), lock, "shared-set")
+
+        def worker(i):
+            with lock:
+                shared.add(i)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(shared) == 8
+    t.assert_clean()
+
+
+def test_handoff_lock_leaves_no_stale_state():
+    """A Lock acquired in thread A and released in thread B (hand-off
+    usage) must not leave A a phantom held entry (fabricated edges) nor a
+    stale ownership (unguarded writes passing the guard)."""
+    with locktrace.trace() as t:
+        handoff = threading.Lock()
+        t.name_lock(handoff, "H")
+        other = threading.Lock()
+        t.name_lock(other, "X")
+        shared = t.guard({}, handoff, "handoff-guarded")
+        released = threading.Event()
+
+        def releaser():
+            handoff.release()
+            released.set()
+
+        handoff.acquire()
+        threading.Thread(target=releaser).start()
+        assert released.wait(2.0)
+        # Ownership is gone: this write must be a violation.
+        shared["after-handoff"] = 1
+        # And no phantom H->X edge from the stale TLS entry.
+        with other:
+            pass
+    assert ("H", "X") not in t.edges, t.edges
+    assert len(t.guard_violations) == 1
+
+
+def test_guarded_set_inplace_ops_stay_guarded():
+    """`s -= {...}` / `s |= {...}` must mutate through the proxy (and be
+    checked), not rebind to a plain unguarded set."""
+    with locktrace.trace() as t:
+        lock = threading.Lock()
+        s = t.guard({1, 2, 3}, lock, "inplace-set")
+        with lock:
+            s |= {4}
+            s -= {1}
+        assert set(s) == {2, 3, 4}
+        assert isinstance(s, type(t.guard(set(), lock, "probe")))
+        s |= {5}  # outside the lock: violation, still guarded
+    assert len(t.guard_violations) == 1
+
+
+def test_condition_event_and_reentrant_rlock_machinery():
+    """Condition.wait over a traced RLock must release every recursion
+    level (the _release_save trio) and re-acquire cleanly; Event/Queue
+    built inside the window ride the patched factories."""
+    with locktrace.trace() as t:
+        cond = threading.Condition()
+        hits = []
+
+        def waiter():
+            with cond:
+                with cond:  # reentrant: wait() must shed BOTH levels
+                    if cond.wait(timeout=2.0):
+                        hits.append(1)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        th.join(5)
+        assert hits == [1]
+        ev = threading.Event()
+        ev.set()
+        assert ev.wait(0.1)
+    t.assert_clean()
+
+
+# -- the real harnesses, traced ----------------------------------------------
+
+
+def _guard_coordinator(t, coord):
+    """Register the shard coordinator's lock-guarded state — the sets the
+    fencing story rests on — so any mutation outside its _lock fails the
+    run."""
+    coord._owned = t.guard(coord._owned, coord._lock, "coordinator._owned")
+    coord._renewed_at = t.guard(coord._renewed_at, coord._lock,
+                                "coordinator._renewed_at")
+    coord._tokens = t.guard(coord._tokens, coord._lock,
+                            "coordinator._tokens")
+
+
+def _fleet(t, **kw):
+    from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+
+    fleet = ShardedFleet(lease_seconds=TTL, renew_seconds=RENEW, **kw)
+    for r in fleet.replicas:
+        _guard_coordinator(t, r.coordinator)
+    return fleet
+
+
+@pytest.fixture(autouse=True)
+def _quiet():
+    logging.disable(logging.ERROR)
+    yield
+    logging.disable(logging.NOTSET)
+
+
+def test_sharding_suite_clean_under_locktrace():
+    """The ShardedFleet replica-kill scenario (the sharding suite's
+    core): zero lock-order cycles, zero unguarded coordinator writes."""
+    with locktrace.trace() as t:
+        fleet = _fleet(t, replicas=2, num_shards=4)
+        try:
+            fleet.wait_stable_shard_map()
+            fleet.create_wave(30)
+            fleet.kill(1)
+            fleet.wait_converged(timeout=90)
+            fleet.wait_stable_shard_map()
+        finally:
+            fleet.close()
+    assert len(t.edges) > 0, "tracer saw no lock nesting — vacuous run"
+    t.assert_clean()
+
+
+def test_chaos_suite_clean_under_locktrace():
+    """The seeded-storm scenario (the chaos suite's smoke shape) over a
+    sharded fleet: fault-path locking (retries, circuit breaker, watch
+    re-establishment) must stay cycle-free too."""
+    from kubeflow_tpu.platform.testing.chaos import storm
+
+    with locktrace.trace() as t:
+        # Sized like the tier-1 chaos smoke (12 objects, bounded
+        # injections so the tail is calm); the tracer adds per-acquire
+        # bookkeeping and the full suite adds CPU contention, so the
+        # convergence deadline carries slack — the pin here is the lock
+        # graph, not convergence latency.
+        fleet = _fleet(t, replicas=2, num_shards=4,
+                       chaos_faults=storm(rate=0.05, max_injections=20),
+                       chaos_seed=20260804)
+        try:
+            fleet.wait_stable_shard_map()
+            fleet.create_wave(12)
+            fleet.wait_converged(timeout=180)
+        finally:
+            fleet.close()
+    assert len(t.edges) > 0
+    t.assert_clean()
+
+
+def test_jobqueue_suite_clean_under_locktrace():
+    """The TPUJob gang/queue path (the jobqueue suite's machinery) over a
+    sharded fleet with a replica kill mid-lifecycle: ledger + gang
+    teardown/recreate locking stays cycle-free, coordinator state stays
+    guarded."""
+    from kubeflow_tpu.platform.apis import tpujob as jobapi
+    from kubeflow_tpu.platform.controllers import tpujob as jobctrl
+    from kubeflow_tpu.platform.k8s.types import TPUJOB
+
+    n = 6
+    with locktrace.trace() as t:
+        fleet = _fleet(t, replicas=2, num_shards=4, workers=2,
+                       controller_factory=jobctrl.make_controller,
+                       tpu_nodes=2 * n)
+
+        def all_at(phase, restarts):
+            js = fleet.kube.list(TPUJOB, fleet.namespace)
+            return len(js) == n and all(
+                jobapi.phase_of(j) == phase
+                and jobapi.restarts_of(j) == restarts for j in js)
+
+        def wait(pred, what, timeout=90.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                time.sleep(0.05)
+            raise TimeoutError(what)
+
+        try:
+            fleet.wait_stable_shard_map()
+            for i in range(n):
+                fleet.kube.create({
+                    "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+                    "metadata": {"name": f"tj-{i:03d}",
+                                 "namespace": fleet.namespace},
+                    "spec": {
+                        "tpu": {"accelerator": "v5e", "topology": "2x4",
+                                "slices": 2},
+                        "template": {"spec": {"containers": [
+                            {"name": "worker", "image": "trainer"}]}},
+                    },
+                })
+            wait(lambda: all_at("Running", 0), "initial gang converge")
+            fleet.kill(0)
+            # Preempt one worker of every gang so the survivor runs the
+            # heavy teardown/recreate burst — the lock-richest controller
+            # path — under the tracer.
+            for i in range(n):
+                fleet.kube.set_pod_phase(fleet.namespace,
+                                         f"tj-{i:03d}-s1-0", "Failed")
+            wait(lambda: all_at("Running", 1),
+                 "every gang restarted by the survivor", timeout=120.0)
+        finally:
+            fleet.close()
+    assert len(t.edges) > 0
+    t.assert_clean()

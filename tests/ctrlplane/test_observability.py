@@ -201,3 +201,56 @@ def test_slow_reconcile_dumps_structured_trace(monkeypatch, caplog):
     # Same trace in the ring buffer (the /debug/traces source).
     ring = [t for t in trace.recent() if t["controller"] == name]
     assert ring and ring[-1]["trace_id"] == payload["trace_id"]
+
+
+def test_debug_knobs_endpoint_dumps_registry_with_redaction():
+    """/debug/knobs (ISSUE 13, kftlint R005): every knob resolved through
+    platform/config.py shows up with value/default/source; names sniffed
+    as secrets are redacted when set from the environment."""
+    import os
+
+    from kubeflow_tpu.platform import config
+
+    config.knob("KFT_TEST_KNOB_PLAIN", 7, int, doc="observability test knob")
+    config.knob("KFT_TEST_KNOB_TOKEN", "")
+    os.environ["KFT_TEST_KNOB_PLAIN"] = "11"
+    os.environ["KFT_TEST_KNOB_TOKEN"] = "hunter2"
+
+    class _Mgr:
+        def healthy(self):
+            return True
+
+    server = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1")
+    try:
+        body = json.loads(
+            _get(f"http://127.0.0.1:{server.server_port}/debug/knobs"))
+        knobs = body["knobs"]
+        plain = knobs["KFT_TEST_KNOB_PLAIN"]
+        assert plain["value"] == 11 and plain["default"] == 7
+        assert plain["source"] == "env"
+        assert plain["doc"] == "observability test knob"
+        token = knobs["KFT_TEST_KNOB_TOKEN"]
+        assert token["value"] == "<redacted>"
+        assert "hunter2" not in json.dumps(body)
+    finally:
+        server.shutdown()
+        del os.environ["KFT_TEST_KNOB_PLAIN"]
+        del os.environ["KFT_TEST_KNOB_TOKEN"]
+
+
+def test_debug_knobs_reports_unparseable_env_source():
+    """An env var that is SET but fails its parser must not masquerade as
+    a clean env-sourced value — the typo is what the reader is hunting."""
+    import os
+
+    from kubeflow_tpu.platform import config
+
+    config.knob("KFT_TEST_KNOB_BADINT", 3, int)
+    os.environ["KFT_TEST_KNOB_BADINT"] = "five"
+    try:
+        assert config.knob("KFT_TEST_KNOB_BADINT", 3, int) == 3  # runtime falls back
+        entry = config.effective()["KFT_TEST_KNOB_BADINT"]
+        assert entry["value"] == 3
+        assert entry["source"] == "env-unparseable"
+    finally:
+        del os.environ["KFT_TEST_KNOB_BADINT"]
