@@ -29,6 +29,10 @@ import os
 import subprocess
 import sys
 
+# Run as `python ci/bench_smoke.py` from the repo root: put the root on
+# the path so the segment-name source of truth imports.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 REQUIRED_METRICS = {
     "ctrlplane_fleet_converge_ms_per_notebook",
     "ctrlplane_fleet_scale_ratio",
@@ -193,6 +197,32 @@ def main() -> int:
     for key in ("workers_1_converge_s", "workers_4_converge_s"):
         if not isinstance(sweep.get(key), (int, float)):
             print(f"sweep line missing {key}", file=sys.stderr)
+            return 1
+    # Causal-tracing segment breakdowns (ISSUE 14): the wave-converge and
+    # inferenceservice lines must carry a non-empty *_segments dict of
+    # named-segment seconds — an empty dict means the journey wiring
+    # silently unhooked (a raw create severed the trace, the store was
+    # disabled, the analyzer broke) long before anyone reads a journey.
+    from kubeflow_tpu.telemetry.critical_path import SEGMENTS
+
+    known = set(SEGMENTS) | {"unattributed"}
+    for line_name in ("ctrlplane_fleet_converge_ms_per_notebook",
+                      "inferenceservice_scale_converge_s"):
+        segs = seen[line_name].get("converge_segments")
+        if not isinstance(segs, dict) or not segs:
+            print(f"{line_name}: converge_segments missing/empty: {segs}",
+                  file=sys.stderr)
+            return 1
+        bad = {k: v for k, v in segs.items()
+               if k not in known or not isinstance(v, (int, float))
+               or v < 0}
+        if bad:
+            print(f"{line_name}: malformed segment entries: {bad}",
+                  file=sys.stderr)
+            return 1
+        if sum(segs.values()) <= 0:
+            print(f"{line_name}: zero-length segment breakdown: {segs}",
+                  file=sys.stderr)
             return 1
     # Sharded-HA lines (ISSUE 9): the per-replica load vectors and the
     # fencing-invariant write count must keep riding — a zero count means

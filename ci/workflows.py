@@ -366,6 +366,31 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # journey presubmit lane (ISSUE 14): the causal-propagation unit
+    # matrix (mint/stamp/extract/link round trips, client+wire header
+    # carry, FlightPool context carry, the critical-path analyzer) plus
+    # the merged-journey acceptance assertion — the TPUJob conformance
+    # scenario's submit→Running critical-path decomposition — as a
+    # presubmit smoke.  A severed journey (a raw create dropping the
+    # traceparent, a broken extract at watch delivery) fails HERE, not
+    # the first time an operator opens /debug/journey.
+    name="journey",
+    include_dirs=[
+        "kubeflow_tpu/telemetry/*", "kubeflow_tpu/platform/runtime/*",
+        "kubeflow_tpu/platform/k8s/*", "kubeflow_tpu/platform/testing/*",
+        "kubeflow_tpu/platform/controllers/*", "conformance/*",
+        "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest("tests/ctrlplane/test_causal.py")),
+        Step("journey-smoke", [
+            sys.executable, "conformance/run.py",
+            "--only", "tpujob-train-converge",
+        ], depends="unit"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

@@ -486,6 +486,7 @@ def tpujob_train_converge():
 
     try:
         # 4x4 on v5e = 16 chips / 2 hosts per slice; 2 slices over DCN.
+        t_submit = _time.time()
         kube.create({
             "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
             "metadata": {"name": "llama-train", "namespace": "train"},
@@ -502,7 +503,14 @@ def tpujob_train_converge():
                 "checkpointDir": ckpt,
             },
         })
-        wait(lambda: jobapi.phase_of(job()) == "Running", "gang Running")
+        # Tight poll: t_running feeds the journey-vs-wall assertion
+        # below, and the default 50 ms cadence would eat the tolerance.
+        deadline = _time.monotonic() + 120.0
+        while jobapi.phase_of(job()) != "Running":
+            if _time.monotonic() >= deadline:
+                raise TimeoutError("tpujob conformance: gang Running")
+            _time.sleep(0.01)
+        t_running = _time.time()
         wait(mid_run.is_set, "first generation mid-run")
         # Preempt slice 1's worker 0: the gang must tear down WHOLE.
         kube.set_pod_phase("train", "llama-train-s1-0", "Failed")
@@ -519,6 +527,45 @@ def tpujob_train_converge():
     assert jobapi.restarts_of(final) == 1, final.get("status")
     for s in deep_get(final, "status", "slices", default=[]):
         assert s["total"] == 2, final.get("status")
+
+    # -- the merged causal journey (ISSUE 14 acceptance) ----------------
+    # One trace_id links submit → admission → gang create → pod start →
+    # Running, and survives the gang restart: the generation-1 StatefulSet
+    # creates land on the SAME journey as generation 0's.
+    from kubeflow_tpu.telemetry import causal, critical_path
+
+    jctx = causal.from_object(final)
+    assert jctx is not None, "TPUJob lost its traceparent annotation"
+    spans = causal.merge_journeys(causal.journey(jctx.trace_id))
+    assert spans, "journey is empty"
+    # Trace continuity across the gang restart: 2 slices x 2 generations
+    # of StatefulSet creates on one trace_id.
+    sts_creates = [s for s in spans
+                   if s.get("segment") == "write_rtt"
+                   and s.get("kind") == "StatefulSet"
+                   and s["name"] == "k8s.create"]
+    assert len(sts_creates) >= 4, (
+        f"gang restart severed the journey: only {len(sts_creates)} "
+        f"StatefulSet creates on trace {jctx.trace_id}")
+    # Submit→Running critical path: clip the journey to the Running
+    # observation, decompose, and check (a) exactly one admission_queue
+    # segment and (b) the named segments sum to the measured wall time
+    # within 10% (floor 0.12 s — the Running poll granularity plus
+    # 2-CPU-container scheduling noise must not flake the band).
+    clipped = [s for s in spans if s["end_ts"] <= t_running + 0.02]
+    d = critical_path.decompose(clipped)
+    admission = [e for e in d["path"]
+                 if e.get("segment") == "admission_queue"]
+    assert len(admission) == 1, (
+        f"submit→Running critical path carries {len(admission)} "
+        f"admission_queue segments: {[e['name'] for e in d['path']]}")
+    wall = t_running - t_submit
+    total = sum(d["segments"].values())
+    assert abs(total - wall) <= max(0.10 * wall, 0.12), (
+        f"critical-path segments sum to {total:.3f}s vs measured "
+        f"submit→Running wall {wall:.3f}s "
+        f"(segments: {d['segments']})")
+
     assert len(histories) == 2, [len(h) for h in histories]
     first_gen, resumed = histories
     # Resume really happened: the second generation's first logged step is

@@ -10,7 +10,7 @@ controller tree:
 * ``engine``  — AST lint driver: rule registry, per-line / per-file
   ``# kft: disable=RULE`` suppressions, a checked-in baseline so a new
   rule can land green and ratchet down.
-* ``rules``   — the repo-native rule set (R001..R008); see
+* ``rules``   — the repo-native rule set (R001..R009); see
   docs/analysis.md for the rule reference.
 
 Run it over the tree (repo root cwd)::

@@ -1,4 +1,4 @@
-"""The repo-native rule set (R001..R008).
+"""The repo-native rule set (R001..R009).
 
 Each rule encodes a contract a past PR bled for — the rationale, an
 example finding, and the sanctioned fix live in docs/analysis.md.  Rules
@@ -542,3 +542,37 @@ class NoUnboundedBlocking(Rule):
                    f".{attr}() without a timeout inside a reconcile body "
                    "can block a worker forever; pass timeout= and handle "
                    "the miss")
+
+
+@register
+class StampedChildCreates(Rule):
+    """R009: child-object creates in controllers go through the
+    context-stamping ``runtime.apply`` helpers (``apply.create`` /
+    ``create_or_update``) — a raw ``client.create`` drops the
+    ``kubeflow.org/traceparent`` annotation and severs the object
+    journey SILENTLY: the child converges fine, but its watch events,
+    reconciles and write RTTs vanish from `/debug/journey` and the
+    critical-path decomposition under-reports forever.  Scope: the
+    INJECTED client only (``self.client`` / bare ``client``) — creates
+    on any other client-shaped receiver are already R001 fence-bypass
+    findings, and the two rules never double-report one site."""
+
+    id = "R009"
+    summary = ("controller child creates go through the context-stamping "
+               "apply.create / create_or_update, never raw client.create")
+    scope = (CONTROLLERS,)
+
+    def check(self, tree, text, path):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute) and fn.attr == "create"):
+                continue
+            chain = _attr_chain(fn.value)
+            if chain in (["self", "client"], ["client"]):
+                yield (node.lineno,
+                       f"raw {'.'.join(chain)}.create() drops the "
+                       "traceparent annotation and severs the child's "
+                       "journey; use apply.create(self.client, obj) or "
+                       "apply.create_or_update")

@@ -496,7 +496,14 @@ def create_app(service: GenerationService, *, model_name: str = "model",
             n = int(request.args.get("n", ""))
         except ValueError:
             n = None
-        return json_response({"traces": tel.tracer.recent(n)})
+        # ONE implementation of the query contract, shared with the
+        # controllers' endpoint (telemetry.trace.filter_traces;
+        # docs/observability.md "The /debug/traces contract").
+        from kubeflow_tpu.telemetry.trace import filter_traces
+
+        return json_response({"traces": filter_traces(
+            tel.tracer.recent(None), n=n,
+            trace_id=request.args.get("trace_id"))})
 
     @app.route("/metrics")
     def metrics(request):
@@ -517,6 +524,12 @@ def create_app(service: GenerationService, *, model_name: str = "model",
 
     @app.route("/v1/generate", methods=["POST"])
     def generate(request):
+        # Header passthrough (telemetry/causal.py): the shared web
+        # framework already installed any caller-sent traceparent as the
+        # request's current context (web/framework.App.__call__), so the
+        # serve trace links into the caller's journey via
+        # ServeTelemetry.begin_request reading causal.current() —
+        # nothing to re-parse here.
         body = request.get_json(force=True, silent=True) or {}
         t0 = time.perf_counter()
         try:  # noqa: SIM105 — latency must cover every outcome

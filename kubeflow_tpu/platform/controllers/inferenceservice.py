@@ -468,11 +468,24 @@ class InferenceServiceReconciler(Reconciler):
     def _probe_ready(self, svc: Resource, pod: Resource) -> bool:
         """The controller's OWN readiness generate() check before a
         traffic flip — the kubelet's probe gates the pod Ready condition,
-        this gates the Service selector."""
+        this gates the Service selector.  The probe round trip lands on
+        the service's causal journey as a ``readiness_warm`` segment
+        (the warm generate is where rollout-flip latency hides)."""
+        from kubeflow_tpu.telemetry import causal
+
         url = self._endpoint_of(pod, api.port_of(svc))
         if url is None:
             return False
-        return self.scraper(url + "/readyz") is not None
+        t0 = time.time()
+        ok = self.scraper(url + "/readyz") is not None
+        ctx = causal.current()
+        if ctx is not None:
+            causal.record(
+                "readiness_warm", trace_id=ctx.trace_id,
+                parent_span_id=ctx.span_id, segment="readiness_warm",
+                start_ts=t0, end_ts=time.time(),
+                object=name_of(pod), ok=ok)
+        return ok
 
     # -- generation -----------------------------------------------------------
 

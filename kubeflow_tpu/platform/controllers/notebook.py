@@ -50,6 +50,7 @@ from kubeflow_tpu.platform.k8s.types import (
 )
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
 from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.runtime import apply
 from kubeflow_tpu.platform.runtime.apply import merge_patch_for, patch_status_diff
 from kubeflow_tpu.platform.runtime.flight import shared_pool
 from kubeflow_tpu.platform.tpu import SliceSpec
@@ -435,7 +436,7 @@ class NotebookReconciler(Reconciler):
         current = self._cached_get(STATEFULSET, name, ns)
         if current is None:
             try:
-                created = self.client.create(desired)
+                created = apply.create(self.client, desired)
             except errors.AlreadyExists:
                 # Cache lag: a just-created STS hasn't landed in the
                 # informer yet.  Re-read fresh and fall through to the
@@ -558,7 +559,7 @@ class NotebookReconciler(Reconciler):
         current = self._cached_get(SERVICE, name, ns)
         if current is None:
             try:
-                return self.client.create(desired)
+                return apply.create(self.client, desired)
             except errors.AlreadyExists:
                 # Cache lag — re-read fresh and reconcile against it.
                 current = self.client.get(SERVICE, name, ns)
@@ -620,7 +621,7 @@ class NotebookReconciler(Reconciler):
             return
         if current is None:
             try:
-                self.client.create(desired)
+                apply.create(self.client, desired)
             except errors.AlreadyExists:
                 current = self.client.get(PODDISRUPTIONBUDGET, pdb_name, ns)
             else:
@@ -676,7 +677,7 @@ class NotebookReconciler(Reconciler):
         current = self._cached_get(VIRTUALSERVICE, name, ns)
         if current is None:
             try:
-                return self.client.create(desired)
+                return apply.create(self.client, desired)
             except errors.AlreadyExists:
                 current = self.client.get(VIRTUALSERVICE, name, ns)
         spec_diff = merge_patch_for(current.get("spec"), desired.get("spec"))
@@ -818,7 +819,7 @@ class NotebookReconciler(Reconciler):
                 "count": ev.get("count", 1),
             }
             try:
-                self.client.create(mirror)
+                apply.create(self.client, mirror)
             except errors.AlreadyExists:
                 pass
             except errors.ApiError:
@@ -864,7 +865,7 @@ class NotebookReconciler(Reconciler):
             "count": 1,
         }
         try:
-            self.client.create(marker)
+            apply.create(self.client, marker)
             return
         except errors.AlreadyExists:
             pass

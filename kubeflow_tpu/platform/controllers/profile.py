@@ -36,6 +36,7 @@ from kubeflow_tpu.platform.k8s.types import (
     set_owner,
 )
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
+from kubeflow_tpu.platform.runtime import apply
 from kubeflow_tpu.platform.runtime import metrics
 
 OWNER_ANNOTATION = "owner"
@@ -240,7 +241,7 @@ class ProfileReconciler(Reconciler):
                 },
             }
             set_owner(ns, profile)
-            self.client.create(ns)
+            apply.create(self.client, ns)
             return True
         existing_owner = deep_get(ns, "metadata", "annotations", OWNER_ANNOTATION)
         if existing_owner is None:
@@ -279,7 +280,7 @@ class ProfileReconciler(Reconciler):
             }
             set_owner(sa, profile)
             try:
-                self.client.create(sa)
+                apply.create(self.client, sa)
             except errors.Conflict:
                 pass
 
@@ -416,7 +417,7 @@ class ProfileReconciler(Reconciler):
         try:
             current = self.client.get(gvk, name, ns)
         except errors.NotFound:
-            self.client.create(desired)
+            apply.create(self.client, desired)
             return
         interesting = ("spec", "roleRef", "subjects")
         if any(current.get(k) != desired.get(k) for k in interesting if k in desired):

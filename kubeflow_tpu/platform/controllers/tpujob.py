@@ -72,6 +72,7 @@ from kubeflow_tpu.platform.k8s.types import (
 from kubeflow_tpu.platform.runtime import EventRecorder, Reconciler, Request, Result
 from kubeflow_tpu.platform.runtime import jobqueue as jq
 from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.runtime import apply
 from kubeflow_tpu.platform.runtime.apply import patch_status_diff
 from kubeflow_tpu.platform.runtime.flight import shared_pool
 from kubeflow_tpu.platform.tpu import SliceSpec
@@ -300,6 +301,27 @@ class TPUJobReconciler(Reconciler):
                     if queued_since is not None:
                         metrics.tpujob_queue_wait_seconds.observe(
                             max(0.0, time.time() - queued_since))
+                    # Causal journey: ONE admission_queue span per
+                    # admission — queuedAt → granted for a parked job,
+                    # zero-length inside this reconcile for a job that
+                    # fit immediately (the critical-path analyzer
+                    # carves it out of the reconcile either way, so
+                    # submit→Running decomposes with exactly one
+                    # admission segment; conformance pins it).
+                    from kubeflow_tpu.telemetry import causal
+
+                    jctx = causal.from_object(job)
+                    if jctx is not None:
+                        admit_ts = time.time()
+                        causal.record(
+                            "admission_queue", trace_id=jctx.trace_id,
+                            parent_span_id=jctx.span_id,
+                            segment="admission_queue",
+                            start_ts=(queued_since
+                                      if queued_since is not None
+                                      else admit_ts),
+                            end_ts=admit_ts, object=name,
+                            slices=decision.slices)
                     # Re-admissions (a preemption wrote status.generation
                     # before) start a NEW gang generation; a first-ever
                     # admission keeps generation == restarts so a legacy
@@ -763,7 +785,7 @@ class TPUJobReconciler(Reconciler):
             except errors.NotFound:
                 pass
         try:
-            self.client.create(desired)
+            apply.create(self.client, desired)
             return True
         except errors.AlreadyExists:
             # Cache lag on a just-created STS — or an injected/raced 409
@@ -808,7 +830,7 @@ class TPUJobReconciler(Reconciler):
         if self._cached_get(SERVICE, name, ns) is not None:
             return  # spec is generation-invariant; nothing to update
         try:
-            self.client.create(desired)
+            apply.create(self.client, desired)
         except errors.AlreadyExists:
             pass
 

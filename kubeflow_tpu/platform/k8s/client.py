@@ -442,6 +442,15 @@ class RestKubeClient:
 
         verb = verb or method.lower()
         headers = {}
+        # Causal propagation (telemetry/causal.py): every verb carries
+        # the current trace context as a W3C traceparent header, so a
+        # context-aware server (HttpKube in tests, a proxy in front of a
+        # real apiserver) can link the request to its journey.
+        from kubeflow_tpu.telemetry import causal
+
+        tp = causal.current_traceparent()
+        if tp:
+            headers[causal.TRACEPARENT_HEADER] = tp
         if method == "PATCH":
             # Computed ONCE, outside the retry loop: pop() is destructive
             # and a second attempt must not silently fall back to "merge".
@@ -587,6 +596,14 @@ class RestKubeClient:
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
         gvk = gvk_of(obj)
+        # First-admission minting (telemetry/causal.py): a context-free
+        # platform CR gets its journey root stamped before it crosses
+        # the wire — on a COPY, never the caller's dict (FakeKube stamps
+        # after its own copy; the real client must not diverge in
+        # caller-visible side effects).
+        from kubeflow_tpu.telemetry import causal
+
+        obj = causal.stamped_copy_on_admission(obj)
         params = {"dryRun": "All"} if dry_run else None
         return self._request(
             "POST", gvk.path(namespace_of(obj)), params=params, body=obj,

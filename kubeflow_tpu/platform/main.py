@@ -116,6 +116,22 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
             # (docs/analysis.md "Knob registry").
             start_response("200 OK", [("Content-Type", "application/json")])
             return [json.dumps({"knobs": config.effective()}).encode()]
+        if path.startswith("/debug/journey/") and debug_traces:
+            # One object journey (telemetry/causal.py): every causal
+            # span this replica recorded for the trace_id — watch_lag,
+            # queue_wait, reconcile, write_rtt, admission_queue ... —
+            # as JSON.  Fleet tooling GETs this from every replica and
+            # joins with causal.merge_journeys; the critical-path
+            # analyzer (telemetry/critical_path.py) decomposes the
+            # result (docs/observability.md "Object journeys").
+            from kubeflow_tpu.telemetry import causal
+
+            trace_id = path[len("/debug/journey/"):]
+            start_response("200 OK", [("Content-Type", "application/json")])
+            return [json.dumps({
+                "trace_id": trace_id,
+                "spans": causal.journey(trace_id),
+            }).encode()]
         if path == "/debug/traces" and debug_traces:
             from urllib.parse import parse_qs
 
@@ -126,8 +142,18 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
                 n = int(qs["n"][0]) if "n" in qs else None
             except (ValueError, IndexError):
                 n = None
+            # ONE implementation of the query contract (filters before
+            # the ?n= cap; ?trace_id= matches own id OR the causal
+            # journey link) shared with the serve apps' endpoint —
+            # docs/observability.md "The /debug/traces contract".
+            from kubeflow_tpu.telemetry.trace import filter_traces
+
+            traces = filter_traces(
+                trace.recent(None), n=n,
+                trace_id=(qs.get("trace_id") or [None])[0],
+                controller=(qs.get("controller") or [None])[0])
             start_response("200 OK", [("Content-Type", "application/json")])
-            return [json.dumps({"traces": trace.recent(n)}).encode()]
+            return [json.dumps({"traces": traces}).encode()]
         start_response("404 Not Found", [("Content-Type", "text/plain")])
         return [b"not found"]
 

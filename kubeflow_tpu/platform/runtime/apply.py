@@ -26,15 +26,46 @@ from __future__ import annotations
 import copy
 import hashlib
 import json
+import time
 from typing import Any, Iterable, Optional
 
 from kubeflow_tpu.platform.k8s import errors
-from kubeflow_tpu.platform.k8s.types import GVK, Resource, deep_get, meta, name_of, namespace_of
+from kubeflow_tpu.platform.k8s.types import GVK, Resource, deep_get, gvk_of, meta, name_of, namespace_of
+from kubeflow_tpu.telemetry import causal
 
 HASH_ANNOTATION = "kubeflow.org/generated-hash"
 
 # Sentinel distinguishing "no change" from "the change is null/removal".
 _UNCHANGED = object()
+
+
+def _timed_write(verb: str, kind: str, name: str, fn):
+    """Run one client write, recording its round trip as a ``write_rtt``
+    span on the current causal journey (telemetry/causal.py) — failed
+    writes record too (ok=False): a journey showing where a reconcile
+    burned its retries is the point."""
+    t0 = time.time()
+    try:
+        out = fn()
+    except Exception:
+        causal.record_write(verb, kind, name, t0, ok=False)
+        raise
+    causal.record_write(verb, kind, name, t0)
+    return out
+
+
+def create(client, desired: Resource) -> Resource:
+    """Context-stamping create: the sanctioned way for a reconciler to
+    create a child object (kftlint R009).  Stamps the child with the
+    reconcile's causal context — a Notebook's StatefulSets, a TPUJob's
+    gang, an InferenceService's revisions all inherit the parent's
+    trace — and records the write RTT on the journey.  Exceptions
+    (AlreadyExists and friends) propagate exactly like ``client.create``,
+    so existing fallback logic keeps its shape."""
+    causal.stamp_child(desired)
+    gvk = gvk_of(desired)
+    return _timed_write("create", gvk.kind, name_of(desired),
+                        lambda: client.create(desired))
 
 
 def content_hash(obj) -> str:
@@ -93,11 +124,15 @@ def patch_status_diff(client, gvk: GVK, obj: Resource,
         return False
     patcher = getattr(client, "patch_status", None)
     if patcher is not None:
-        patcher(gvk, name_of(obj), {"status": diff}, namespace_of(obj))
+        _timed_write(
+            "patch_status", gvk.kind, name_of(obj),
+            lambda: patcher(gvk, name_of(obj), {"status": diff},
+                            namespace_of(obj)))
         return True
     full = copy.deepcopy(obj)
     full["status"] = desired_status
-    client.update_status(full)
+    _timed_write("update_status", gvk.kind, name_of(obj),
+                 lambda: client.update_status(full))
     return True
 
 
@@ -116,26 +151,38 @@ def create_or_update(
     owned = {k: desired[k] for k in owned_fields if k in desired}
     desired_hash = content_hash(owned)
     meta(desired).setdefault("annotations", {})[hash_annotation] = desired_hash
+    # Causal journey (telemetry/causal.py): the child inherits the
+    # reconcile's trace context.  Stamped OUTSIDE the hash (annotations
+    # are not owned fields), and restamped on every content change so
+    # each generation of a child links to the reconcile that caused it.
+    causal.stamp_child(desired)
     ns = meta(desired).get("namespace")
     name = name_of(desired)
     try:
         current = client.get(gvk, name, ns)
     except errors.NotFound:
-        return client.create(desired)
+        return _timed_write("create", gvk.kind, name,
+                            lambda: client.create(desired))
     if deep_get(current, "metadata", "annotations", hash_annotation) == desired_hash:
         return current
     patcher = getattr(client, "patch", None)
     if patcher is not None:
         patch: dict = {
-            "metadata": {"annotations": {hash_annotation: desired_hash}}}
+            "metadata": {"annotations": {
+                hash_annotation: desired_hash,
+                **causal.annotations_of(desired),
+            }}}
         for k, v in owned.items():
             sub = merge_patch_for(current.get(k), v)
             if sub is not None:
                 patch[k] = sub
-        return patcher(gvk, name, patch, ns)
+        return _timed_write("patch", gvk.kind, name,
+                            lambda: patcher(gvk, name, patch, ns))
     # Legacy full-update path for clients without patch (test doubles).
     current = copy.deepcopy(current)
     for k, v in owned.items():
         current[k] = v
     meta(current).setdefault("annotations", {})[hash_annotation] = desired_hash
-    return client.update(current)
+    meta(current)["annotations"].update(causal.annotations_of(desired))
+    return _timed_write("update", gvk.kind, name,
+                        lambda: client.update(current))

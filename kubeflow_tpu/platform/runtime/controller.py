@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.platform import config
 from kubeflow_tpu.platform.k8s.types import GVK, Resource, controller_of, meta, name_of, namespace_of
+from kubeflow_tpu.telemetry import causal
 
 log = logging.getLogger("kubeflow_tpu.runtime")
 
@@ -314,6 +315,14 @@ class Controller:
                                else DEFAULT_STUCK_SECONDS)
         self._inflight: Dict[Request, list] = {}
         self._inflight_lock = threading.Lock()
+        # Causal journey plumbing (telemetry/causal.py): the trace
+        # context extracted from a watch-delivered object rides here from
+        # enqueue to dequeue — the workqueue itself carries only keys.
+        # Request -> (TraceContext, delivery wall time); popped at
+        # dequeue, bounded below against keys that never dequeue (shard
+        # moves).
+        self._pending_ctx: Dict[Request, Tuple] = {}
+        self._pending_ctx_lock = threading.Lock()
         self._client = None  # set by start(); dead-letter writes need it
         self._recorder = None  # lazy EventRecorder (shared correlator)
         # Sharded HA (runtime/sharding.py): a ShardCoordinator partitions
@@ -344,6 +353,81 @@ class Controller:
     def _primary_mapper(self, obj: Resource) -> List[Request]:
         return [Request(namespace_of(obj) or "", name_of(obj))]
 
+    def _note_event(self, obj: Resource, reqs: List[Request]) -> None:
+        """Re-extract the causal context at watch delivery: record the
+        measured watch-lag span (stamp wall time → delivery) and park
+        the context per request so the dequeue can open its queue-wait
+        span.  Objects without a context (un-stamped secondaries like
+        kubelet-created pods) pass silently — the reconcile falls back
+        to the primary's own annotation."""
+        if not reqs:
+            return
+        ctx = causal.from_object(obj)
+        if ctx is None:
+            return
+        now = time.time()
+        if ctx.stamped_ts is not None:
+            lag = now - ctx.stamped_ts
+            # First delivery of this stamp only — PROCESS-wide (an
+            # object is stamped once per causing write but delivered
+            # many times, to every status bump, every in-process
+            # replica, and again on a shard handover — only the first
+            # delivery measures the write→watch lag).  And bounded:
+            # replays (add_handler ADDED backfills, relists) re-deliver
+            # objects stamped long ago — the bound keeps phantom
+            # minutes-long watch_lag segments off the journey.
+            if (0.0 <= lag <= causal.WATCH_LAG_MAX_S
+                    and causal.first_lag_observation(
+                        ctx.trace_id, ctx.span_id)):
+                extra = ({"replica": self.shards.identity}
+                         if self.shards is not None else {})
+                causal.record(
+                    "watch_lag", trace_id=ctx.trace_id,
+                    parent_span_id=ctx.span_id, segment="watch_lag",
+                    start_ts=ctx.stamped_ts, end_ts=now,
+                    kind=obj.get("kind", ""), controller=self.name,
+                    **extra)
+        with self._pending_ctx_lock:
+            if len(self._pending_ctx) > 8192:
+                # Keys that never dequeue here (ownership moved, queue
+                # dedup) would otherwise grow this map unboundedly; the
+                # journey cost of a rare flush is a missing queue_wait
+                # span, recovered on the next event.
+                self._pending_ctx.clear()
+            for req in reqs:
+                self._pending_ctx[req] = (ctx, now)
+
+    def _event_context(self, req: Request):
+        """The context for a dequeued key: the parked watch-delivery
+        entry (eager — its queue_wait span is recorded either way), else
+        None; resync/requeue paths and events on un-stamped secondaries
+        fall back to a LAZY derivation from the primary's own annotation
+        (_install_lazy_context) so a no-op sweep allocates nothing."""
+        with self._pending_ctx_lock:
+            entry = self._pending_ctx.pop(req, None)
+        if entry is not None:
+            return entry
+        return None, None
+
+    def _install_lazy_context(self, req: Request, box: dict) -> None:
+        """Arm the thread-local causal context with a factory reading the
+        primary's annotation from the informer cache — resolved only if
+        the reconcile actually writes (apply.* asks for current()).  The
+        parent context lands in ``box`` for the post-reconcile span."""
+        informer = self.informers.get(self.primary)
+        if informer is None or not informer.has_synced:
+            return
+
+        def factory():
+            obj = informer.get(req.name, req.namespace or None)
+            pctx = causal.from_object(obj) if obj is not None else None
+            if pctx is None:
+                return None
+            box["parent"] = pctx
+            return causal.child(pctx)
+
+        causal.set_lazy(factory)
+
     def _owner_mapper(self, obj: Resource) -> List[Request]:
         ref = controller_of(obj)
         if ref and ref.get("kind") == self.primary.kind:
@@ -370,9 +454,10 @@ class Controller:
                         rv = None
                         self._stop.wait(1.0)
                         break
-                    for req in mapper(obj):
-                        if self._owns(req):
-                            self.queue.add(req)
+                    reqs = [r for r in mapper(obj) if self._owns(r)]
+                    self._note_event(obj, reqs)
+                    for req in reqs:
+                        self.queue.add(req)
                     new_rv = meta(obj).get("resourceVersion")
                     if new_rv is not None:
                         rv = new_rv
@@ -465,6 +550,8 @@ class Controller:
             # backoff if the shard ever comes back.
             self.queue.forget(req)
             self._key_failures.pop(req, None)
+            with self._pending_ctx_lock:
+                self._pending_ctx.pop(req, None)
             return
         if self.shards is not None:
             from kubeflow_tpu.platform.runtime import sharding
@@ -482,6 +569,30 @@ class Controller:
         if tr is not None and shim is not None:
             tr.add_span("dequeue", duration_s=shim.wait_of(req),
                         queue="workqueue")
+        # Causal journey: the context extracted at watch delivery (or
+        # from the primary's own annotation) becomes the thread-local
+        # CURRENT context for this reconcile — apply.* stamps children
+        # from it, the FlightPool carries it, and the reconcile's span
+        # links API write → watch → queue → this body on one trace_id.
+        cctx, delivered_ts = self._event_context(req)
+        rctx = None
+        lazy_box: Dict = {}
+        wall0 = time.time()
+        causal.consume_mark()  # clear any stale mark on this worker
+        if cctx is not None:
+            if delivered_ts is not None:
+                causal.record(
+                    "queue_wait", trace_id=cctx.trace_id,
+                    parent_span_id=cctx.span_id, segment="queue_wait",
+                    start_ts=delivered_ts, end_ts=wall0,
+                    controller=self.name)
+            rctx = causal.child(cctx)
+            causal.set_current(rctx)
+            if tr is not None:
+                tr.links["causal_trace_id"] = cctx.trace_id
+                tr.links["causal_span_id"] = rctx.span_id
+        else:
+            self._install_lazy_context(req, lazy_box)
         outcome = "success"
         t0 = time.perf_counter()
         with self._inflight_lock:
@@ -536,6 +647,32 @@ class Controller:
                 from kubeflow_tpu.platform.runtime import sharding
 
                 sharding.set_current_request(None)
+            # Event-driven reconciles always land on the journey; lazy-
+            # context ones (resync sweeps, secondary events on un-stamped
+            # objects) only when they actually DID something — the
+            # factory resolved because a write/admission/probe asked for
+            # the context.  A steady-state no-op sweep therefore records
+            # nothing and allocates (almost) nothing.
+            lazy_ctx = causal.current_resolved() if rctx is None else None
+            if rctx is None and lazy_ctx is not None:
+                rctx, cctx = lazy_ctx, lazy_box.get("parent")
+                if tr is not None and cctx is not None:
+                    tr.links["causal_trace_id"] = cctx.trace_id
+                    tr.links["causal_span_id"] = rctx.span_id
+            if rctx is not None and (delivered_ts is not None
+                                     or causal.consume_mark()):
+                extra = ({"replica": self.shards.identity}
+                         if self.shards is not None else {})
+                causal.record(
+                    "reconcile", trace_id=rctx.trace_id,
+                    span_id=rctx.span_id,
+                    parent_span_id=(cctx.span_id if cctx is not None
+                                    else None),
+                    segment="reconcile", start_ts=wall0,
+                    end_ts=time.time(), controller=self.name,
+                    request=f"{req.namespace}/{req.name}",
+                    result=outcome, **extra)
+            causal.set_current(None)
             with self._inflight_lock:
                 self._inflight.pop(req, None)
             metrics.controller_runtime_reconcile_time_seconds.labels(
@@ -789,9 +926,10 @@ class Controller:
             informer = self.informers.get(gvk)
             if informer is not None:
                 def on_delta(_etype, obj, _mapper=mapper):
-                    for req in _mapper(obj):
-                        if self._owns(req):
-                            self.queue.add(req)
+                    reqs = [r for r in _mapper(obj) if self._owns(r)]
+                    self._note_event(obj, reqs)
+                    for req in reqs:
+                        self.queue.add(req)
 
                 informer.add_handler(on_delta)
                 continue

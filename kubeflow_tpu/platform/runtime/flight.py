@@ -107,21 +107,42 @@ class FlightPool:
             return []
         if n == 1 or self.size <= 1 or getattr(_local, "in_flight", False):
             return self._run_inline(calls, return_exceptions)
-        from kubeflow_tpu.platform.runtime import metrics, sharding
+        from kubeflow_tpu.platform.runtime import metrics, sharding, trace
+        from kubeflow_tpu.telemetry import causal
 
-        # Carry the submitting reconcile's fence context onto the pool
-        # threads: a fanned-out secondary write must fence on the SAME
-        # key as its reconcile's inline writes (runtime/sharding.py), and
-        # thread-locals don't cross thread boundaries by themselves.
+        # Carry the submitting reconcile's thread-locals onto the pool
+        # threads — thread-locals don't cross thread boundaries by
+        # themselves, and all three ride the SAME carry:
+        #   * the fence context: a fanned-out secondary write must fence
+        #     on the same key as its reconcile's inline writes;
+        #   * the causal context: a child created from a flight slot
+        #     must inherit the reconcile's trace (apply.stamp_child);
+        #   * the active reconcile trace: a span opened inside a slot
+        #     lands in the submitting reconcile's span tree, not the
+        #     worker thread's.
         fence_req = sharding.current_request()
-        if fence_req is not None:
-            def _carry(fn, _req=fence_req):
+        cctx = causal.current()
+        submit_trace = trace.current()
+        # Marks recorded inside a slot land on the POOL thread's local;
+        # collect them so the submitting reconcile still reads as acting
+        # (a lazy-context repair whose only writes were fanned out must
+        # still record its reconcile span).
+        slot_marked = [False]
+        if fence_req is not None or cctx is not None \
+                or submit_trace is not None:
+            def _carry(fn, _req=fence_req, _ctx=cctx, _tr=submit_trace):
                 def wrapped():
                     sharding.set_current_request(_req)
+                    causal.set_current(_ctx)
+                    trace.adopt(_tr)
                     try:
                         return fn()
                     finally:
+                        if causal.consume_mark():
+                            slot_marked[0] = True
                         sharding.set_current_request(None)
+                        causal.set_current(None)
+                        trace.adopt(None)
                 return wrapped
 
             calls = [_carry(fn) for fn in calls]
@@ -137,6 +158,8 @@ class FlightPool:
         with cond:
             while remaining[0]:
                 cond.wait()
+        if slot_marked[0]:
+            causal.mark_thread()
         return self._settle(results, errors, return_exceptions)
 
     @staticmethod

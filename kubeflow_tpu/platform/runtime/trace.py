@@ -22,6 +22,12 @@ env knobs, same ``kubeflow_tpu.runtime.trace`` logger, same
 controller/request wire keys.  Knobs stay MODULE attributes read at call
 time, so tests (and operators poking a live process) keep patching
 ``trace.SLOW_RECONCILE_SECONDS`` / ``trace.ENABLED`` as before.
+
+Trace ids are the 128-bit causal mints (telemetry/causal.py): the old
+process-local prefix+counter scheme could emit colliding ids from two
+sharded replicas into one merged journey; the causal scheme keeps the
+no-urandom-per-reconcile property (counter in a per-process random
+block) while making cross-replica collisions impossible.
 """
 from __future__ import annotations
 
@@ -70,6 +76,12 @@ def begin(controller: str, request: str) -> Optional[_Trace]:
 
 def current() -> Optional[_Trace]:
     return _tracer.current()
+
+
+def adopt(tr: Optional[_Trace]) -> None:
+    """Install an existing trace as this thread's active one (the
+    FlightPool carry — see Tracer.adopt)."""
+    _tracer.adopt(tr)
 
 
 def active() -> bool:

@@ -177,8 +177,15 @@ class FakeKube:
             return self.list(gvk, namespace), self._latest_rv
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource:
+        from kubeflow_tpu.telemetry import causal
+
         with self._lock:
             obj = _copy_obj(obj)
+            # First-admission minting, same rule as RestKubeClient: a
+            # context-free platform CR gets its journey root here (the
+            # caller's current context — e.g. an HttpKube-extracted
+            # traceparent header — is inherited when set).
+            causal.mint_on_admission(obj)
             gvk = gvk_of(obj)
             name = name_of(obj)
             ns = namespace_of(obj)

@@ -101,8 +101,18 @@ class HttpKube:
     # -- WSGI ---------------------------------------------------------------
 
     def __call__(self, environ, start_response):
+        from kubeflow_tpu.telemetry import causal
+
         try:
-            return self._dispatch(environ, start_response)
+            # Server-side context extraction: a traceparent header from
+            # RestKubeClient becomes the current context for the handler,
+            # so FakeKube's first-admission minting inherits the caller's
+            # trace across the wire (cleared before watch streams run —
+            # they outlive the request thread's handling).
+            ctx = causal.parse_traceparent(
+                environ.get("HTTP_TRACEPARENT"))
+            with causal.use(ctx):
+                return self._dispatch(environ, start_response)
         except errors.ApiError as e:
             body = json.dumps(e.to_status()).encode()
             headers = [("Content-Type", "application/json"),

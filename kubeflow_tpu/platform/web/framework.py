@@ -105,8 +105,16 @@ class App:
     # -- wsgi ----------------------------------------------------------------
 
     def __call__(self, environ, start_response):
+        from kubeflow_tpu.telemetry import causal
+
         request = Request(environ)
-        response = self._dispatch(request)
+        # Causal propagation (telemetry/causal.py): an upstream
+        # traceparent header becomes the current context for the whole
+        # request, so a CRUD-backend create mints the new CR's journey
+        # root as a CHILD of the caller's trace instead of a fresh one.
+        ctx = causal.parse_traceparent(environ.get("HTTP_TRACEPARENT"))
+        with causal.use(ctx):
+            response = self._dispatch(request)
         return response(environ, start_response)
 
     def _dispatch(self, request: Request) -> Response:

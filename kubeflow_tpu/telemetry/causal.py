@@ -1,0 +1,452 @@
+"""Causal trace context for the control plane: W3C-style traceparent
+propagation through OBJECT WRITES and WATCH EVENTS.
+
+The per-reconcile Tracer (telemetry/trace.py) answers "where did THIS
+reconcile go?"; it dies at every process and thread boundary, so nobody
+can answer "where did the 2.2 ms/notebook actually go — watch lag, queue
+wait, reconcile CPU, or write RTT?".  In an RPC system the answer is
+Dapper/OpenTelemetry context propagation down the call stack; in a
+reconcile-driven system causality flows through the API server — a write
+causes a watch delivery causes an enqueue causes a reconcile causes more
+writes — so the context must ride the OBJECTS themselves:
+
+* **mint** — a 128-bit ``trace_id`` + 64-bit ``span_id`` is minted at
+  first admission (CRD create through any client, a web backend POST, a
+  serve request's incoming header) and stamped into the
+  ``kubeflow.org/traceparent`` annotation (W3C traceparent syntax) with
+  the stamp wall time in ``kubeflow.org/tracestate`` (``kft=ts:<epoch>``
+  — what watch-lag is measured against);
+* **stamp** — ``runtime/apply.py`` stamps every child object a
+  reconciler generates with a child context of the reconcile's own
+  (same trace_id, fresh span_id): a notebook's StatefulSets, a TPUJob's
+  gang, an InferenceService's revision Deployments all join the parent's
+  journey;
+* **extract** — controllers re-extract the context at watch delivery and
+  carry it through the workqueue to the reconcile, where it becomes the
+  thread-local *current* context (and rides FlightPool fan-outs exactly
+  like the write-fence context);
+* **link** — the reconcile's Tracer trace carries
+  ``causal_trace_id``/``causal_span_id``, so ``/debug/traces?trace_id=``
+  finds every reconcile of a journey.
+
+Spans land in a bounded per-process store (``record``/``journey``,
+served at ``/debug/journey/<trace_id>``); per-replica stores from a
+sharded fleet join with ``merge_journeys``.  The segment names the
+critical-path analyzer (telemetry/critical_path.py) decomposes a journey
+into are the ``segment=`` values recorded here: ``watch_lag``,
+``queue_wait``, ``reconcile``, ``write_rtt``, ``pod_start``,
+``admission_queue``, ``readiness_warm``.
+
+Id minting keeps the PR-2 "no urandom per reconcile" property via a
+counter-in-random-block scheme: ONE ``secrets`` read per process seeds a
+random 128-bit block, and each id is the block plus an incrementing
+counter — unique within a process by the counter, unique across replicas
+by the per-process entropy (the PR-1 16-hex prefix+counter ids could
+collide across sharded replicas in a merged journey; these cannot,
+pinned in test_sharding.py).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import re
+import secrets
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from kubeflow_tpu.platform import config
+
+TRACEPARENT_ANNOTATION = "kubeflow.org/traceparent"
+TRACESTATE_ANNOTATION = "kubeflow.org/tracestate"
+TRACEPARENT_HEADER = "traceparent"
+
+# Objects minted at first admission when they arrive context-free: the
+# platform's own API group (a Notebook, TPUJob, InferenceService ... CR
+# is a journey ROOT; core-kind children are stamped explicitly by
+# apply.* from their parent's context instead).
+MINT_API_GROUP = "kubeflow.org"
+
+# Bounded per-process span store (the /debug/journey body).
+JOURNEY_BUFFER_SIZE = config.knob(
+    "JOURNEY_BUFFER_SIZE", 8192, int,
+    doc="causal span store size (spans, process-wide ring)")
+# Watch-lag spans older than this are informer replays of objects stamped
+# long before this journey window (add_handler ADDED replays, relists) —
+# recording them would graft minutes-long phantom segments onto the
+# journey.
+WATCH_LAG_MAX_S = config.knob(
+    "JOURNEY_WATCH_LAG_MAX_SECONDS", 60.0, float,
+    doc="watch_lag spans longer than this are dropped as replays")
+ENABLED = not config.env_bool("JOURNEY_DISABLE", False)
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$")
+_TS_RE = re.compile(r"kft=ts:([0-9.]+)")
+
+# -- id minting (counter-in-random-block; one secrets read per process) -------
+
+_rand = secrets.token_bytes(24)
+_trace_base = int.from_bytes(_rand[:16], "big")
+_span_base = int.from_bytes(_rand[16:], "big")
+_counter = itertools.count()
+
+
+def new_trace_id() -> str:
+    """128-bit trace id: per-process random block + counter.  The high
+    64 bits stay pure per-process entropy, so ids from different replicas
+    never collide in a merged journey; the counter makes in-process ids
+    unique without a syscall per trace."""
+    return f"{(_trace_base + next(_counter)) & ((1 << 128) - 1):032x}"
+
+
+def new_span_id() -> str:
+    return f"{(_span_base + next(_counter)) & ((1 << 64) - 1):016x}"
+
+
+# -- context ------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    trace_id: str
+    span_id: str
+    # Wall time the context was stamped onto its object (from the
+    # tracestate annotation) — what watch_lag measures from.  None for
+    # contexts that never rode an object (serve headers).
+    stamped_ts: Optional[float] = None
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+
+def mint() -> TraceContext:
+    return TraceContext(new_trace_id(), new_span_id())
+
+
+def child(ctx: TraceContext) -> TraceContext:
+    """Same trace, fresh span id — the link from a cause (the stamped
+    parent / the delivering event) to its effect (a reconcile, a child
+    write)."""
+    return TraceContext(ctx.trace_id, new_span_id())
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[TraceContext]:
+    if not value:
+        return None
+    m = _TRACEPARENT_RE.match(value.strip().lower())
+    if m is None:
+        return None
+    return TraceContext(m.group(1), m.group(2))
+
+
+# -- thread-local current context --------------------------------------------
+
+_local = threading.local()
+
+
+def current() -> Optional[TraceContext]:
+    """The thread's current context.  A lazy factory (set_lazy) resolves
+    on FIRST use here: a steady-state no-op reconcile that never writes
+    never pays for deriving its context (the resync allocation band)."""
+    ctx = getattr(_local, "ctx", None)
+    if ctx is not None:
+        return ctx
+    factory = getattr(_local, "ctx_factory", None)
+    if factory is not None:
+        _local.ctx_factory = None  # one shot, even when it answers None
+        ctx = factory()
+        _local.ctx = ctx
+    return ctx
+
+
+def current_resolved() -> Optional[TraceContext]:
+    """The current context ONLY if already resolved — never triggers a
+    lazy factory (the controller's post-reconcile check: did anything
+    actually use the context?)."""
+    return getattr(_local, "ctx", None)
+
+
+def set_current(ctx: Optional[TraceContext]) -> None:
+    _local.ctx = ctx
+    _local.ctx_factory = None
+
+
+def set_lazy(factory) -> None:
+    """Install a zero-argument context factory resolved on first
+    ``current()`` call (a write, a child stamp) — the allocation-free
+    path for reconciles that may turn out to be no-ops."""
+    _local.ctx = None
+    _local.ctx_factory = factory
+
+
+def current_traceparent() -> Optional[str]:
+    ctx = current()
+    return ctx.to_traceparent() if ctx is not None else None
+
+
+@contextmanager
+def use(ctx: Optional[TraceContext]):
+    """Install ``ctx`` as the current context for the block (no-op on
+    None, so callers can wrap unconditionally)."""
+    if ctx is None:
+        yield None
+        return
+    prev = current()
+    set_current(ctx)
+    try:
+        yield ctx
+    finally:
+        set_current(prev)
+
+
+# -- the object annotation contract ------------------------------------------
+
+
+def _annotations(obj) -> Dict:
+    md = obj.get("metadata") if hasattr(obj, "get") else None
+    if md is None:
+        return {}
+    return md.get("annotations") or {}
+
+
+def from_object(obj) -> Optional[TraceContext]:
+    """Extract the context an object carries (watch delivery / cache
+    read); accepts frozen informer views — reads only."""
+    ann = _annotations(obj)
+    ctx = parse_traceparent(ann.get(TRACEPARENT_ANNOTATION))
+    if ctx is None:
+        return None
+    m = _TS_RE.search(ann.get(TRACESTATE_ANNOTATION) or "")
+    if m is not None:
+        try:
+            return dataclasses.replace(ctx, stamped_ts=float(m.group(1)))
+        except ValueError:
+            pass
+    return ctx
+
+
+def stamp(obj, ctx: Optional[TraceContext] = None) -> Optional[TraceContext]:
+    """Write ``ctx`` (default: a fresh mint) into the object's
+    annotations with the stamp wall time.  Returns the stamped context;
+    None when the object is immutable (a frozen view — the caller is
+    serializing a cache read, not authoring a write)."""
+    if ctx is None:
+        ctx = mint()
+    ctx = dataclasses.replace(ctx, stamped_ts=round(time.time(), 6))
+    try:
+        ann = obj.setdefault("metadata", {}).setdefault("annotations", {})
+        ann[TRACEPARENT_ANNOTATION] = ctx.to_traceparent()
+        ann[TRACESTATE_ANNOTATION] = f"kft=ts:{ctx.stamped_ts}"
+    except (TypeError, AttributeError):
+        return None
+    return ctx
+
+
+def stamp_child(obj) -> Optional[TraceContext]:
+    """Stamp a reconciler-generated child object: a child context of the
+    current (reconcile) context when one is installed, else the
+    first-admission mint rule.  The apply.* helpers call this on every
+    create/update they author — a raw ``client.create`` that skips it
+    severs the journey silently (kftlint R009)."""
+    cur = current()
+    if cur is not None:
+        return stamp(obj, child(cur))
+    return mint_on_admission(obj)
+
+
+def mint_on_admission(obj) -> Optional[TraceContext]:
+    """First-admission minting, shared by every client CREATE path
+    (RestKubeClient, FakeKube, and therefore HttpKube): an object already
+    carrying a context keeps it; a context-free object of the platform's
+    API group is stamped from the caller's current context (a CRUD
+    backend request, an upstream traceparent header) or a fresh mint.
+    Other groups pass through untouched — their stamps come from apply.*
+    with a real parent."""
+    existing = from_object(obj)
+    if existing is not None:
+        return existing
+    api = obj.get("apiVersion", "") if hasattr(obj, "get") else ""
+    if not str(api).startswith(MINT_API_GROUP + "/"):
+        return None
+    cur = current()
+    return stamp(obj, child(cur) if cur is not None else mint())
+
+
+def stamped_copy_on_admission(obj):
+    """``mint_on_admission`` for callers that must not mutate their
+    input (RestKubeClient serializing a caller-owned dict or a frozen
+    view): returns the object unchanged when no mint applies, else a
+    SHALLOW copy with copied metadata/annotations carrying the stamp —
+    the caller's object is never touched, matching FakeKube's
+    stamp-after-copy behavior."""
+    if from_object(obj) is not None:
+        return obj
+    api = obj.get("apiVersion", "") if hasattr(obj, "get") else ""
+    if not str(api).startswith(MINT_API_GROUP + "/"):
+        return obj
+    out = dict(obj)
+    md = dict(out.get("metadata") or {})
+    md["annotations"] = dict(md.get("annotations") or {})
+    out["metadata"] = md
+    cur = current()
+    stamp(out, child(cur) if cur is not None else mint())
+    return out
+
+
+def annotations_of(obj) -> Dict[str, str]:
+    """The two causal annotations an object carries (for patches that
+    must restamp alongside the generated-hash annotation)."""
+    ann = _annotations(obj)
+    return {k: ann[k] for k in (TRACEPARENT_ANNOTATION,
+                                TRACESTATE_ANNOTATION) if k in ann}
+
+
+# -- the span store -----------------------------------------------------------
+
+
+class SpanStore:
+    """Bounded per-process store of causal spans, keyed by nothing —
+    journeys are reconstructed by trace_id scan over the ring (the ring
+    is small; a scan is cheaper than maintaining an index that must
+    evict in lockstep)."""
+
+    def __init__(self, maxlen: int = JOURNEY_BUFFER_SIZE):
+        self._lock = threading.Lock()
+        self._spans: collections.deque = collections.deque(
+            maxlen=max(int(maxlen), 16))
+
+    def record(self, name: str, *, trace_id: str,
+               span_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None,
+               segment: Optional[str] = None,
+               start_ts: float, end_ts: float, **attrs) -> dict:
+        span = {
+            "name": name,
+            "trace_id": trace_id,
+            "span_id": span_id or new_span_id(),
+            "start_ts": round(start_ts, 6),
+            "end_ts": round(end_ts, 6),
+            "duration_ms": round(max(end_ts - start_ts, 0.0) * 1e3, 3),
+        }
+        if parent_span_id:
+            span["parent_span_id"] = parent_span_id
+        if segment:
+            span["segment"] = segment
+        if attrs:
+            span.update(attrs)
+        with self._lock:
+            self._spans.append(span)
+        return span
+
+    def journey(self, trace_id: str) -> List[dict]:
+        with self._lock:
+            spans = [dict(s) for s in self._spans
+                     if s["trace_id"] == trace_id]
+        spans.sort(key=lambda s: (s["start_ts"], s["end_ts"]))
+        return spans
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+STORE = SpanStore()
+
+# Stamps whose watch_lag span was already recorded IN THIS PROCESS —
+# shared across controllers and in-process replicas (a ShardedFleet
+# handover must not re-record a stamp the dead replica already
+# measured; merge_journeys dedupes span ids, not semantics).  Bounded:
+# when full, an arbitrary half is evicted instead of a wholesale clear,
+# so recent stamps are never re-admitted en masse.  Cross-PROCESS
+# handovers can still record a second watch_lag for one stamp — bounded
+# by WATCH_LAG_MAX_S and documented in docs/observability.md.
+_lag_seen: set = set()
+_lag_lock = threading.Lock()
+
+
+def first_lag_observation(trace_id: str, span_id: str) -> bool:
+    """True exactly once per stamp per process — the watch_lag
+    recording gate (Controller._note_event)."""
+    key = (trace_id, span_id)
+    with _lag_lock:
+        if key in _lag_seen:
+            return False
+        if len(_lag_seen) > 8192:
+            for _ in range(4096):
+                _lag_seen.pop()
+        _lag_seen.add(key)
+        return True
+
+
+def record(name: str, *, trace_id: str, **kwargs) -> Optional[dict]:
+    """Record one causal span into the process store (no-op when
+    JOURNEY_DISABLE is set).  Marks the recording thread (see
+    consume_mark) so the controller can tell an acting reconcile from a
+    steady-state no-op sweep."""
+    if not ENABLED:
+        return None
+    _local.mark = True
+    return STORE.record(name, trace_id=trace_id, **kwargs)
+
+
+def mark_thread() -> None:
+    """Set the acting mark on the CURRENT thread — the FlightPool uses
+    this to propagate marks recorded inside fanned-out slots (pool
+    threads have their own thread-locals) back to the submitting
+    reconcile worker."""
+    _local.mark = True
+
+
+def consume_mark() -> bool:
+    """True when this thread recorded any span since the last call —
+    the controller's acting-reconcile test: a resync sweep reconciles
+    every key as a no-op, and retaining a span per no-op would grow the
+    journey store (and the resync allocation band) with segments that
+    say nothing."""
+    marked = getattr(_local, "mark", False)
+    _local.mark = False
+    return marked
+
+
+def journey(trace_id: str) -> List[dict]:
+    return STORE.journey(trace_id)
+
+
+def record_write(verb: str, kind: str, name: str, start_ts: float, *,
+                 ok: bool = True, **attrs) -> None:
+    """A child-write RTT span against the current context (the apply.*
+    helpers' hook) — segment ``write_rtt``, parented on the reconcile's
+    span so the journey shows which reconcile paid which write."""
+    ctx = current()
+    if ctx is None:
+        return
+    record(f"k8s.{verb}", trace_id=ctx.trace_id,
+           parent_span_id=ctx.span_id, segment="write_rtt",
+           start_ts=start_ts, end_ts=time.time(), kind=kind, object=name,
+           ok=ok, **attrs)
+
+
+def merge_journeys(*span_lists: List[dict]) -> List[dict]:
+    """Join per-replica journey exports (the /debug/journey bodies of a
+    ShardedFleet, or conformance's per-store reads) into one timeline:
+    dedupe by span_id (a span is recorded by exactly one replica; the
+    same export read twice must not double segments), sort by time."""
+    seen = set()
+    merged: List[dict] = []
+    for spans in span_lists:
+        for s in spans or []:
+            key = s.get("span_id")
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append(s)
+    merged.sort(key=lambda s: (s.get("start_ts", 0.0),
+                               s.get("end_ts", 0.0)))
+    return merged

@@ -133,8 +133,18 @@ class ServeTelemetry:
     # -- request lifecycle ----------------------------------------------------
 
     def begin_request(self):
-        return self.tracer.begin(
+        tr = self.tracer.begin(
             self.component, f"req-{next(_request_ids)}")
+        if tr is not None:
+            # A traceparent header installed by the app (models/serve.py)
+            # links this request trace into the caller's causal journey.
+            from kubeflow_tpu.telemetry import causal
+
+            ctx = causal.current()
+            if ctx is not None:
+                tr.links["causal_trace_id"] = ctx.trace_id
+                tr.links["causal_span_id"] = ctx.span_id
+        return tr
 
     def span(self, name: str, **attrs):
         return self.tracer.span(name, **attrs)

@@ -26,14 +26,36 @@ env knobs, same logger name); ``telemetry/compute.py`` and
 from __future__ import annotations
 
 import collections
-import itertools
 import json
 import logging
-import secrets
 import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
+
+from kubeflow_tpu.telemetry import causal
+
+
+def filter_traces(traces: List[dict], *, n: Optional[int] = None,
+                  trace_id: Optional[str] = None,
+                  **fields: Optional[str]) -> List[dict]:
+    """THE /debug/traces query contract, shared by the controllers'
+    endpoint (platform/main.py) and the serve apps' (models/serve.py) so
+    it cannot drift (docs/observability.md "The /debug/traces
+    contract"): ``trace_id`` matches a trace's own id OR its
+    ``causal_trace_id`` journey link; extra ``fields`` (e.g.
+    ``controller=``) match exactly; filters apply BEFORE the ``n`` cap,
+    which keeps the newest n matches (n <= 0 returns nothing)."""
+    if trace_id:
+        traces = [t for t in traces
+                  if t.get("trace_id") == trace_id
+                  or t.get("causal_trace_id") == trace_id]
+    for key, want in fields.items():
+        if want:
+            traces = [t for t in traces if t.get(key) == want]
+    if n is not None:
+        traces = traces[-n:] if n > 0 else []
+    return traces
 
 
 class Span:
@@ -56,12 +78,6 @@ class Span:
         return d
 
 
-# One urandom read per PROCESS; ids are prefix + counter (shared across
-# tracers — a trace id only needs to be unique, not per-plane).
-_id_prefix = secrets.token_hex(4)
-_id_counter = itertools.count()
-
-
 class Trace:
     """One traced unit of work (a reconcile, a train step, a serve
     request).  ``keys`` names the two identity fields in the exported
@@ -71,7 +87,11 @@ class Trace:
 
     def __init__(self, component: str, name: str,
                  keys: Tuple[str, str] = ("component", "request")):
-        self.trace_id = f"{_id_prefix}{next(_id_counter) & 0xFFFFFFFF:08x}"
+        # 128-bit ids from the causal counter-in-random-block mint (one
+        # secrets read per PROCESS, never a syscall per trace): the PR-1
+        # 16-hex prefix+counter ids could collide across sharded
+        # replicas in a merged journey (pinned in test_sharding.py).
+        self.trace_id = causal.new_trace_id()
         self.component = component
         self.name = name
         self.keys = keys
@@ -79,6 +99,10 @@ class Trace:
         self._t0 = time.perf_counter()
         self.spans: List[Span] = []
         self.result = ""
+        # Cross-trace links merged flat into to_dict() — the reconcile
+        # path sets causal_trace_id/causal_span_id here so
+        # /debug/traces?trace_id= finds every reconcile of a journey.
+        self.links: Dict[str, str] = {}
 
     def add_span(self, name: str, *, duration_s: float, offset_s: float = 0.0,
                  **attrs) -> Span:
@@ -90,7 +114,7 @@ class Trace:
         return sp
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "trace_id": self.trace_id,
             self.keys[0]: self.component,
             self.keys[1]: self.name,
@@ -100,6 +124,9 @@ class Trace:
             "result": self.result,
             "spans": [s.to_dict() for s in self.spans],
         }
+        if self.links:
+            d.update(self.links)
+        return d
 
 
 class Tracer:
@@ -135,6 +162,14 @@ class Tracer:
 
     def current(self) -> Optional[Trace]:
         return getattr(self._local, "trace", None)
+
+    def adopt(self, tr: Optional[Trace]) -> None:
+        """Install an EXISTING trace as this thread's active one — the
+        FlightPool carry: a span opened inside a fanned-out flight slot
+        must land in the submitting reconcile's trace, not the worker
+        thread's (list.append on the shared span list is atomic under
+        the GIL)."""
+        self._local.trace = tr
 
     def active(self) -> bool:
         return getattr(self._local, "trace", None) is not None

@@ -5,7 +5,7 @@
 class Reconciler:
     def reconcile(self, req):
         desired = {"metadata": {"name": req.name}}
-        self.client.create(desired)
+        apply.create(self.client, desired)  # the stamping helper (R009)
         limits = {}
         limits.update({"google.com/tpu": 8})  # a dict, not a client
         return None

@@ -1,0 +1,16 @@
+"""R009 bad twin: a raw create on the INJECTED client in a reconcile
+path drops the traceparent annotation and severs the object journey
+silently (creates on any other client are R001 findings already)."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        desired = {"metadata": {"name": req.name}}
+        # Child created without the context stamp: the STS never joins
+        # its notebook's journey.
+        self.client.create(desired)
+        return None
+
+
+def helper(client, desired):
+    client.create(desired)

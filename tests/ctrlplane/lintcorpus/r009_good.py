@@ -1,0 +1,11 @@
+"""R009 good twin: child creates ride the context-stamping apply
+helpers; non-client ``.create`` receivers are not client writes."""
+
+
+class Reconciler:
+    def reconcile(self, req):
+        desired = {"metadata": {"name": req.name}}
+        apply.create(self.client, desired)
+        create_or_update(self.client, GVK, desired)
+        factory.create(desired)  # not a client-shaped receiver
+        return None

@@ -34,6 +34,7 @@ CORPUS = {
     "R007": ("r007", "kubeflow_tpu/platform/controllers/corpus.py",
              "kubeflow_tpu/platform/runtime/metrics.py"),
     "R008": ("r008", "kubeflow_tpu/platform/controllers/corpus.py", None),
+    "R009": ("r009", "kubeflow_tpu/platform/controllers/corpus.py", None),
 }
 
 
@@ -42,9 +43,9 @@ def _corpus(stem: str, kind: str) -> str:
         return fh.read()
 
 
-def test_registry_has_the_eight_rules():
+def test_registry_has_the_nine_rules():
     ids = sorted(r.id for r in engine.all_rules())
-    assert ids == [f"R00{i}" for i in range(1, 9)]
+    assert ids == [f"R00{i}" for i in range(1, 10)]
     assert set(CORPUS) == set(ids)
 
 
@@ -181,7 +182,7 @@ def test_cli_list_rules_and_exit_codes(tmp_repo, tmp_path):
         [sys.executable, "-m", "kubeflow_tpu.analysis", "--list-rules"],
         capture_output=True, text=True, env=env, cwd=REPO)
     assert out.returncode == 0
-    assert all(f"R00{i}" in out.stdout for i in range(1, 9))
+    assert all(f"R00{i}" in out.stdout for i in range(1, 10))
 
     dirty = subprocess.run(
         [sys.executable, "-m", "kubeflow_tpu.analysis",

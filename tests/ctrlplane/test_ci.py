@@ -36,8 +36,9 @@ def test_presubmit_lane_list_is_pinned():
                        if "presubmit" in wf.job_types)
     assert presubmit == sorted([
         "notebook-controller", "resilience", "ha-shard", "bench-smoke",
-        "tpujob", "inferenceservice", "lint", "admission-webhook",
-        "web-apps", "compute", "native", "notebook-images",
+        "tpujob", "inferenceservice", "lint", "journey",
+        "admission-webhook", "web-apps", "compute", "native",
+        "notebook-images",
     ])
 
 
@@ -58,6 +59,21 @@ def test_lint_lane_registered_and_shaped():
     assert not {e["rule"] for e in data["findings"]} & {
         "R001", "R003", "R004"}
     assert "test_locktrace.py" in " ".join(wf.steps[2].command)
+
+
+def test_journey_lane_registered_and_shaped():
+    """The journey lane (ISSUE 14): causal-propagation units gate the
+    TPUJob merged-journey conformance smoke, triggered by telemetry and
+    control-plane changes."""
+    assert "journey" in select(["kubeflow_tpu/telemetry/causal.py"])
+    assert "journey" in select(
+        ["kubeflow_tpu/platform/runtime/controller.py"])
+    wf = WORKFLOWS["journey"]
+    assert [s.name for s in wf.steps] == ["unit", "journey-smoke"]
+    assert "test_causal.py" in " ".join(wf.steps[0].command)
+    smoke = wf.steps[1].command
+    assert smoke[-2:] == ["--only", "tpujob-train-converge"]
+    assert wf.steps[1].depends == "unit"
 
 
 def test_conformance_is_postsubmit_only():

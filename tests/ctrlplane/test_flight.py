@@ -125,6 +125,46 @@ def test_inline_path_settles_all_slots_before_raising():
     assert ran == ["late"]
 
 
+def test_flight_carries_trace_and_causal_context():
+    """The PR-8 fence carry extended (ISSUE 14): the submitting
+    reconcile's CAUSAL context and its ACTIVE trace ride the same carry —
+    a span opened inside a flight slot lands in the submitting
+    reconcile's trace (not the worker thread's), and causal.current()
+    inside a slot is the reconcile's context."""
+    from kubeflow_tpu.platform.runtime import trace
+    from kubeflow_tpu.telemetry import causal
+
+    pool = FlightPool(4)
+    ctx = causal.mint()
+    tr = trace.begin("flight-probe", "ns/nb")
+    assert tr is not None
+    causal.set_current(ctx)
+    try:
+        seen = {}
+
+        def slot(i):
+            def fn():
+                seen[i] = (causal.current(), trace.current(),
+                           threading.get_ident())
+                with trace.span(f"slot-{i}"):
+                    time.sleep(0.005)
+                return i
+            return fn
+
+        assert pool.run([slot(i) for i in range(3)]) == [0, 1, 2]
+        # Ran on pool threads, not inline.
+        assert any(t[2] != threading.get_ident() for t in seen.values())
+        for got_ctx, got_tr, _tid in seen.values():
+            assert got_ctx is ctx
+            assert got_tr is tr
+    finally:
+        causal.set_current(None)
+        d = trace.finish()
+    names = [s["name"] for s in d["spans"]]
+    assert sorted(n for n in names if n.startswith("slot-")) == [
+        "slot-0", "slot-1", "slot-2"]
+
+
 def test_shared_pool_is_a_singleton():
     assert shared_pool() is shared_pool()
     assert shared_pool().size >= 1

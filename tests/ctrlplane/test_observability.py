@@ -99,6 +99,40 @@ def test_manager_metrics_expose_runtime_series_end_to_end():
         # ?n= bounds the response.
         body_1 = json.loads(_get(base + "/debug/traces?n=1"))
         assert len(body_1["traces"]) == 1
+        # ?controller= / ?trace_id= filter BEFORE the ?n= cap (the
+        # contract documented in docs/observability.md "Reconcile traces
+        # and journeys").
+        filtered = json.loads(_get(
+            base + "/debug/traces?controller=notebook-controller"))
+        assert filtered["traces"] and all(
+            t["controller"] == "notebook-controller"
+            for t in filtered["traces"])
+        assert json.loads(_get(
+            base + "/debug/traces?controller=no-such"))["traces"] == []
+        one = traces[-1]
+        by_id = json.loads(_get(
+            base + f"/debug/traces?trace_id={one['trace_id']}"))
+        assert [t["trace_id"] for t in by_id["traces"]] == [
+            one["trace_id"]]
+        # The reconcile trace links its causal journey, ?trace_id=
+        # matches the JOURNEY id too, and /debug/journey/<trace_id>
+        # serves the causal spans themselves.
+        from kubeflow_tpu.telemetry import causal
+
+        nb_live = kube.get(NOTEBOOK, "nb", "user1")
+        jctx = causal.from_object(nb_live)
+        assert jctx is not None
+        by_journey = json.loads(_get(
+            base + f"/debug/traces?trace_id={jctx.trace_id}"))
+        assert by_journey["traces"] and all(
+            t.get("causal_trace_id") == jctx.trace_id
+            for t in by_journey["traces"])
+        journey = json.loads(_get(
+            base + f"/debug/journey/{jctx.trace_id}"))
+        assert journey["trace_id"] == jctx.trace_id
+        segs = {s.get("segment") for s in journey["spans"]}
+        assert {"watch_lag", "queue_wait", "reconcile",
+                "write_rtt"} <= segs, segs
     finally:
         if health is not None:
             health.shutdown()

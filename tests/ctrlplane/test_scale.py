@@ -31,11 +31,16 @@ def _harness(**kwargs):
 def test_resync_allocation_stays_in_band():
     """Tier-1 copy-amplification tripwire: one steady-state resync cycle
     of a 40-notebook fleet, under tracemalloc.  The peak allocation per
-    no-op reconcile is pinned: zero-copy frozen-view reads measure
-    ~2.5 KiB/object on the dev container, while the pre-frozen-view
-    copy-per-read path measured ~4.9 KiB/object — so the 4.0 band fails
-    fast if deep copies creep back onto the informer read path, long
-    before the full bench would notice."""
+    no-op reconcile is pinned: zero-copy frozen-view reads measured
+    ~3.5 KiB/object on the dev container pre-causal-tracing and
+    ~4.8-5.0 with it (the causal machinery adds a mostly-FIXED retained
+    footprint — context ids, trace links, the journey ring — that a tiny
+    N amortizes poorly: the same machine measures ~1.9 KiB/object at
+    N=200 and the full bench band holds at 600), while the
+    pre-frozen-view copy-per-read path added ~+2.4 KiB/object of
+    PER-OBJECT copy churn on top of any base — so the 6.5 band still
+    fails fast if deep copies creep back onto the informer read path,
+    long before the full bench would notice."""
     h = _harness()
     try:
         h.wave(40, timeout=60.0)
@@ -44,9 +49,9 @@ def test_resync_allocation_stays_in_band():
     finally:
         h.close()
     assert alloc["n"] >= 40
-    assert alloc["peak_kb_per_obj"] < 4.0, (
+    assert alloc["peak_kb_per_obj"] < 6.5, (
         f"resync allocated {alloc['peak_kb_per_obj']:.2f} KiB/object at "
-        f"peak (band 4.0) — copy amplification is back on the read path")
+        f"peak (band 6.5) — copy amplification is back on the read path")
 
 
 @slow

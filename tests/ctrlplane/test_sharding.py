@@ -391,6 +391,77 @@ def test_fleet_kill_replica_mid_wave_fast():
         fleet.close()
 
 
+def test_trace_ids_unique_across_replica_fleet():
+    """ISSUE 14 satellite: reconcile trace ids are the causal 128-bit
+    counter-in-random-block mints — the PR-1 16-hex prefix+counter
+    scheme was seeded once per process, so two replicas could emit
+    COLLIDING ids into a merged journey.  A 4-replica fleet wave must
+    produce all-unique 32-hex ids across every replica's reconciles."""
+    from kubeflow_tpu.platform.runtime import trace as rtrace
+
+    rtrace.clear()
+    fleet = ShardedFleet(replicas=4, num_shards=8,
+                         lease_seconds=TTL, renew_seconds=RENEW)
+    try:
+        fleet.wait_stable_shard_map()
+        fleet.wave(40, timeout=120)
+    finally:
+        fleet.close()
+    ids = [t["trace_id"] for t in rtrace.recent()]
+    assert ids, "no reconcile traces recorded"
+    assert all(len(i) == 32 and int(i, 16) >= 0 for i in ids), ids[:3]
+    assert len(ids) == len(set(ids)), "colliding reconcile trace ids"
+
+
+def test_journey_survives_replica_kill():
+    """ISSUE 14 acceptance: one notebook's trace_id stays continuous
+    across a replica kill — the surviving replica's reconcile spans join
+    the SAME journey the dead replica started, and the per-replica span
+    attribution proves both wrote to it."""
+    from kubeflow_tpu.telemetry import causal
+
+    fleet = ShardedFleet(replicas=2, num_shards=4,
+                         lease_seconds=TTL, renew_seconds=RENEW)
+    try:
+        fleet.wait_stable_shard_map()
+        fleet.wave(12, timeout=120)
+        # Pick a notebook owned by replica 0 (the one we'll kill).
+        owned0 = fleet.replicas[0].coordinator.owned()
+        victim = next(
+            f"nb-{i:05d}" for i in range(12)
+            if shard_of(fleet.namespace, f"nb-{i:05d}", 4) in owned0)
+        nb = fleet.kube.get(NOTEBOOK, victim, fleet.namespace)
+        ctx = causal.from_object(nb)
+        assert ctx is not None
+        before = [s for s in causal.journey(ctx.trace_id)
+                  if s.get("segment") == "reconcile"]
+        assert before and all(s.get("replica") == "r0" for s in before)
+        fleet.kill(0)
+        fleet.wait_stable_shard_map()
+        # Touch the spec so the survivor reconciles (and re-writes) it —
+        # the update keeps the create-time stamp, so the journey is the
+        # same trace.
+        nb = fleet.kube.get(NOTEBOOK, victim, fleet.namespace)
+        nb["spec"]["tpu"]["topology"] = "2x2"
+        fleet.kube.update(nb)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            after = [s for s in causal.journey(ctx.trace_id)
+                     if s.get("segment") == "reconcile"
+                     and s.get("replica") == "r1"]
+            if after:
+                break
+            time.sleep(0.05)
+        assert after, "survivor's reconciles never joined the journey"
+        merged = causal.merge_journeys(causal.journey(ctx.trace_id))
+        replicas = {s.get("replica") for s in merged
+                    if s.get("segment") == "reconcile"}
+        assert replicas == {"r0", "r1"}, replicas
+        assert {s["trace_id"] for s in merged} == {ctx.trace_id}
+    finally:
+        fleet.close()
+
+
 def test_tpujob_gang_writes_fenced_across_replica_kill():
     """The fifth controller under sharded HA (ISSUE 10): a TPUJob fleet
     over 2 replicas survives a replica kill mid-lifecycle.  After the
