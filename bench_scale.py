@@ -177,6 +177,20 @@ JOBQUEUE_DECISIONS_BASELINE = 20_000.0
 # serial or O(fleet) per reconcile, not scheduler noise.
 INFERENCE_SERVICES = 50
 INFERENCE_SCALE_BASELINE_S = 0.7
+# Fleet metrics pipeline band (ISSUE 15): ``--fleetscrape-targets``
+# synthetic replica /metrics pages (serve gauges + 8-bucket TTFT
+# histogram + counters, ~19 samples each) through the REAL pipeline —
+# FleetScraper fan-out on the FlightPool, prometheus-text parse, TSDB
+# store, then a burn-rate rule evaluation per pass — and the banded
+# value is stored samples per second across the whole loop.  Pinned
+# 2026-08-05 on the 2-CPU dev container: 200 targets x 4 passes sustain
+# ~46-54k samples/s best-of-3 (parse-dominated; the TSDB's per-name
+# series index keeps rule evaluation off the store-scan path).  Banded
+# at the usual loose 3x: the tripwire is a parse-per-rule or
+# store-rescan regression going O(series) per sample, not scheduler
+# noise.
+FLEETSCRAPE_TARGETS = 200
+FLEETSCRAPE_SAMPLES_BASELINE = 45_000.0
 
 
 def _rss_mb() -> float:
@@ -772,6 +786,87 @@ def run_jobqueue(n_jobs: int = JOBQUEUE_JOBS,
     }
 
 
+def run_fleetscrape(n_targets: int = FLEETSCRAPE_TARGETS,
+                    passes: int = 4) -> dict:
+    """The fleet-metrics-pipeline microbench (ISSUE 15): ``n_targets``
+    synthetic replica pages through scrape → parse → TSDB store → rule
+    evaluation per pass — the whole decision substrate, measured as
+    stored samples per second.  Best-of-3 loops (throughput: max is the
+    one-sided-noise statistic, like the jobqueue band)."""
+    from kubeflow_tpu.telemetry import slo
+    from kubeflow_tpu.telemetry import fleetscrape as fs
+    from kubeflow_tpu.telemetry.tsdb import TSDB
+
+    les = ("0.01", "0.05", "0.2", "1.0", "5.0", "20.0", "60.0", "+Inf")
+
+    def page(target: int, tick: int) -> str:
+        base = (target * 131 + tick * 977) % 4096
+        lines = [
+            f"serve_queue_depth {base % 17}",
+            "serve_decode_slots 8",
+            f"serve_decode_slots_active {base % 9}",
+            f'generate_requests_total{{outcome="ok"}} {base * 3}',
+            f"serve_per_token_seconds_sum {base / 100.0}",
+            f"serve_per_token_seconds_count {base}",
+            f"process_cpu_seconds_total {tick * 2.5}",
+            f"serve_input_tokens_total {base * 40}",
+            f"serve_output_tokens_total {base * 11}",
+            f"serve_batch_rows_sum {base}",
+            f"serve_batch_rows_count {max(base // 4, 1)}",
+        ]
+        for i, le in enumerate(les):
+            lines.append(
+                "serve_time_to_first_token_seconds_bucket"
+                f'{{le="{le}"}} {base * (i + 1) // len(les)}')
+        return "\n".join(lines) + "\n"
+
+    tick_box = [0]
+    samples = []
+    for _attempt in range(3):
+        tsdb = TSDB(capacity=max(passes + 2, 8),
+                    max_series=max(n_targets * 32, 8192))
+        scraper = fs.FleetScraper(
+            tsdb, scraper=lambda url: page(int(url.rsplit("/", 2)[-2]),
+                                           tick_box[0]))
+        targets = [fs.Target(url=f"http://replica/{i}/metrics",
+                             labels={"service": f"bench/svc-{i % 20}",
+                                     "replica": f"r{i}"})
+                   for i in range(n_targets)]
+        engine = slo.RuleEngine(tsdb, [slo.BurnRateRule(
+            name="bench-ttft", threshold=1.0, objective=0.99,
+            metric="serve_time_to_first_token_seconds_bucket",
+            fast_window_s=60.0, slow_window_s=600.0)])
+        stored = 0
+        evals = 0
+        t0 = time.perf_counter()
+        for p in range(passes):
+            tick_box[0] += 1
+            stats = scraper.scrape(targets, ts=1000.0 + p)
+            assert stats.ok == n_targets, stats
+            stored += stats.samples
+            engine.evaluate(at=1000.0 + p)
+            evals += 1
+        elapsed = time.perf_counter() - t0
+        samples.append({
+            "samples": stored, "evals": evals,
+            "elapsed_s": elapsed,
+            "samples_per_s": stored / max(elapsed, 1e-9),
+            "series": len(tsdb),
+        })
+    best = max(samples, key=lambda s: s["samples_per_s"])
+    return {
+        "targets": n_targets,
+        "passes": passes,
+        "samples": best["samples"],
+        "series": best["series"],
+        "rule_evals": best["evals"],
+        "elapsed_s": round(best["elapsed_s"], 4),
+        "samples_per_s": round(best["samples_per_s"], 1),
+        "samples_per_s_all": [round(s["samples_per_s"], 1)
+                              for s in samples],
+    }
+
+
 def run_inference_scale(n_services: int = INFERENCE_SERVICES,
                         *, timeout: float = 120.0) -> dict:
     """The InferenceService autoscale-converge bench (ISSUE 12):
@@ -990,6 +1085,11 @@ def main(argv=None) -> int:
                    help="InferenceService count for the autoscale-"
                         "converge band (ISSUE 12: one traffic wave, "
                         "every service must reach its target width)")
+    p.add_argument("--fleetscrape-targets", type=int,
+                   default=FLEETSCRAPE_TARGETS,
+                   help="synthetic scrape-target count for the fleet "
+                        "metrics pipeline band (ISSUE 15: scrape -> "
+                        "TSDB store -> burn-rate rule eval per pass)")
     p.add_argument("--sharded-only", action="store_true",
                    help="run ONLY the sharded-HA phase (the ha-chaos "
                         "lane's 4-replica smoke)")
@@ -1183,6 +1283,24 @@ def main(argv=None) -> int:
             jobq["decisions_per_s"] / JOBQUEUE_DECISIONS_BASELINE, 4),
         "band": _band_min(jobq["decisions_per_s"],
                           JOBQUEUE_DECISIONS_BASELINE),
+        "band_floor": round(1.0 / BAND_FACTOR, 3),
+    }), flush=True)
+    scrape = run_fleetscrape(args.fleetscrape_targets)
+    print(json.dumps({
+        "metric": "fleetscrape_samples_per_s",
+        "value": scrape["samples_per_s"],
+        "unit": f"samples/sec ({scrape['targets']} targets x "
+                f"{scrape['passes']} passes through scrape -> TSDB "
+                "store -> burn-rate rule eval, best of 3)",
+        "samples": scrape["samples"],
+        "series": scrape["series"],
+        "rule_evals": scrape["rule_evals"],
+        "elapsed_s": scrape["elapsed_s"],
+        "samples_per_s_all": scrape["samples_per_s_all"],
+        "vs_baseline": round(
+            scrape["samples_per_s"] / FLEETSCRAPE_SAMPLES_BASELINE, 4),
+        "band": _band_min(scrape["samples_per_s"],
+                          FLEETSCRAPE_SAMPLES_BASELINE),
         "band_floor": round(1.0 / BAND_FACTOR, 3),
     }), flush=True)
     inference = run_inference_scale(args.inference_services)

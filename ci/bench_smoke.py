@@ -47,6 +47,7 @@ REQUIRED_METRICS = {
     "ctrlplane_fleet_churn",
     "tpujob_queue_decisions_per_s",
     "inferenceservice_scale_converge_s",
+    "fleetscrape_samples_per_s",
 }
 # Metrics whose full-run lines are banded; at smoke N they must still
 # carry the self-report fields so trending tooling never hits a gap.
@@ -60,6 +61,7 @@ BANDED_METRICS = {
     "ctrlplane_sharded_replica_load",
     "tpujob_queue_decisions_per_s",
     "inferenceservice_scale_converge_s",
+    "fleetscrape_samples_per_s",
 }
 
 
@@ -172,6 +174,7 @@ def main() -> int:
         "--small", "6", "--large", "10", "--chaos-fleet", "6",
         "--sweep-fleet", "8", "--churn-seconds", "0.5",
         "--sharded-fleet", "24", "--inference-services", "6",
+        "--fleetscrape-targets", "24",
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=560)
     seen = _parse_json_lines(proc.stdout, "bench_scale")
@@ -245,6 +248,17 @@ def main() -> int:
     if not (isinstance(jobq.get("decisions"), int)
             and jobq["decisions"] > 0 and jobq.get("value", 0) > 0):
         print(f"jobqueue line missing/zero decisions: {jobq}",
+              file=sys.stderr)
+        return 1
+    # Fleet metrics pipeline band (ISSUE 15): the scrape->store->rule
+    # loop must really have stored samples and evaluated rules — zeros
+    # mean the pipeline silently unhooked.
+    scrape = seen["fleetscrape_samples_per_s"]
+    if not (isinstance(scrape.get("samples"), int) and scrape["samples"] > 0
+            and scrape.get("value", 0) > 0
+            and isinstance(scrape.get("rule_evals"), int)
+            and scrape["rule_evals"] > 0):
+        print(f"fleetscrape line missing/zero samples: {scrape}",
               file=sys.stderr)
         return 1
     # InferenceService autoscale band (ISSUE 12): both wave legs must

@@ -391,6 +391,33 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # slo presubmit lane (ISSUE 15): the fleet metrics pipeline's unit
+    # matrices — TSDB edge semantics (counter resets, ring/series
+    # eviction, sparse buckets), fleetscrape (fan-out, reason-classified
+    # failures, the autoscaler's stored-series sample), burn-rate rule
+    # evaluation incl. the 2-replica exactly-one-Event pin, and goodput
+    # tiling — then the autoscaler A/B migration pin.  The slow
+    # ShardedFleet/storm acceptance variants ride the -m slow exclusion
+    # into the conformance/postsubmit cadence.
+    name="slo",
+    include_dirs=[
+        "kubeflow_tpu/telemetry/*", "kubeflow_tpu/platform/runtime/*",
+        "kubeflow_tpu/platform/controllers/*",
+        "kubeflow_tpu/platform/testing/*", "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest(
+            "tests/ctrlplane/test_tsdb.py",
+            "tests/ctrlplane/test_fleetscrape.py",
+            "tests/ctrlplane/test_slo.py",
+            "tests/ctrlplane/test_goodput.py",
+        ) + ["-m", "not slow"]),
+        Step("autoscale-ab", _pytest("tests/ctrlplane/test_autoscale.py"),
+             depends="unit"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

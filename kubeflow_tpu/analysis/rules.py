@@ -407,6 +407,10 @@ _LABEL_ALLOWLIST = {
     "profile", "shard", "component", "queue", "name", "engine", "code",
     "method", "phase", "model", "app", "severity", "device", "le",
     "outcome", "pool", "action", "impl",
+    # ISSUE 15 (the fleet metrics pipeline; docs/observability.md "The
+    # metrics pipeline"): "alert" is bounded by the declared SLO rule
+    # set, "state" by the fixed alert/goodput state vocabularies.
+    "alert", "state",
 }
 
 

@@ -152,7 +152,7 @@ class InferenceServiceReconciler(Reconciler):
                  informers: Optional[dict] = None,
                  queue: Optional[jq.JobQueue] = None,
                  scraper=None, sync_period: Optional[float] = None,
-                 now=time.time):
+                 tsdb=None, now=time.time):
         self.client = client
         self.informers: dict = informers or {}
         self.recorder = EventRecorder(client, "inferenceservice-controller")
@@ -175,11 +175,29 @@ class InferenceServiceReconciler(Reconciler):
             else config.env_float("INFERENCESERVICE_SYNC_SECONDS",
                                   DEFAULT_SYNC_S))
         self.now = now
-        # Last-scrape TTFT buckets per service key: p99 is computed over
-        # the DELTA between passes so a long-gone traffic spike can't
-        # pin the fleet wide (in-memory only — after a restart the first
-        # pass re-baselines and reports no TTFT signal).
-        self._ttft_prev: Dict[str, Dict[float, float]] = {}
+        # The fleet metrics substrate (telemetry/{tsdb,fleetscrape}.py):
+        # replica scrapes land in an in-process TSDB and the decision
+        # sample is computed from stored series.  The old private
+        # ``_ttft_prev`` bucket-delta memory is subsumed by the
+        # pass-join in ``fleetscrape.serve_sample`` (A/B-pinned
+        # identical in test_autoscale.py).  Bare construction gets a
+        # PRIVATE store — scrape memory is per-reconciler, exactly like
+        # the dict it replaced, so test instances never couple through
+        # process state; ``make_controller`` passes the process-shared
+        # ``default_tsdb()`` so the manager's SLO rule engine evaluates
+        # the SAME series (one scrape path).  In-process either way:
+        # after a restart the first pass re-baselines and reports no
+        # TTFT signal.
+        from kubeflow_tpu.telemetry import fleetscrape
+        from kubeflow_tpu.telemetry.tsdb import TSDB
+
+        self.tsdb = tsdb if tsdb is not None else TSDB()
+        self.fleet = fleetscrape.FleetScraper(
+            self.tsdb, scraper=scraper,
+            on_error=lambda reason:
+                metrics.inferenceservice_scrape_errors_total.labels(
+                    reason=reason).inc(),
+            now=now)
 
     # -- cache-backed reads ---------------------------------------------------
 
@@ -205,7 +223,7 @@ class InferenceServiceReconciler(Reconciler):
             # ownerReference GC tears the Deployments/Service down with
             # the CR; drop the ledger charge and the scrape memory now.
             self.queue.forget_service(req.namespace, req.name)
-            self._ttft_prev.pop(f"{req.namespace}/{req.name}", None)
+            self.tsdb.drop(matcher={"service": f"{req.namespace}/{req.name}"})
             return None
 
         try:
@@ -427,43 +445,22 @@ class InferenceServiceReconciler(Reconciler):
 
     def _scrape(self, svc: Resource,
                 ready_pods: List[Resource]) -> ServeSample:
-        """The real scrape path: GET /metrics on every ready serving
-        replica, merge to one sample.  TTFT p99 is computed over the
-        bucket DELTA since the previous pass."""
+        """The real scrape path, on the fleet substrate: GET /metrics on
+        every ready serving replica through the FleetScraper (one fetch
+        hook, FlightPool fan-out, reason-classified failures), store the
+        samples in the shared TSDB with service/replica labels, and
+        compute the decision sample from stored series — TTFT p99 over
+        the merged-bucket delta between this pass and the previous one,
+        exactly the retired private-scrape semantics (the A/B pin in
+        test_autoscale.py)."""
+        from kubeflow_tpu.telemetry import fleetscrape
+
         ns, name = meta(svc)["namespace"], name_of(svc)
-        port = api.port_of(svc)
-        texts: List[str] = []
-        for pod in ready_pods:
-            url = self._endpoint_of(pod, port)
-            if url is None:
-                continue
-            text = self.scraper(url + "/metrics")
-            if text is None:
-                metrics.inferenceservice_scrape_errors_total.inc()
-            else:
-                texts.append(text)
-        sample, buckets = parse_serve_pages(texts)
         key = f"{ns}/{name}"
-        if sample.replicas_scraped:
-            sample = self._ttft_delta(key, sample, buckets)
-        else:
-            self._ttft_prev.pop(key, None)
-        return sample
-
-    def _ttft_delta(self, key: str, sample: ServeSample,
-                    buckets: Dict[float, float]) -> ServeSample:
-        import dataclasses
-
-        prev = self._ttft_prev.get(key)
-        self._ttft_prev[key] = buckets
-        if prev is None:
-            # First pass (or post-restart re-baseline): no TTFT signal —
-            # cumulative history must not read as current pressure.
-            return dataclasses.replace(sample, ttft_p99_s=None)
-        delta = {le: max(0.0, c - prev.get(le, 0.0))
-                 for le, c in buckets.items()}
-        return dataclasses.replace(
-            sample, ttft_p99_s=quantile_from_buckets(delta, 0.99))
+        targets = fleetscrape.inferenceservice_targets(
+            ready_pods, port=api.port_of(svc), service_key=key)
+        self.fleet.scrape_service(key, targets)
+        return fleetscrape.serve_sample(self.tsdb, key)
 
     def _probe_ready(self, svc: Resource, pod: Resource) -> bool:
         """The controller's OWN readiness generate() check before a
@@ -709,6 +706,13 @@ def make_controller(client, **kwargs):
     queue_informers[NODE].add_handler(
         lambda _e, _o: queue.set_nodes(queue_informers[NODE].list()))
 
+    # Production wiring scrapes into the process-shared store so the
+    # manager's SLO rule engine reads the same serve series (ONE scrape
+    # path — docs/observability.md "The metrics pipeline"); explicit
+    # tsdb= overrides for hermetic harnesses.
+    from kubeflow_tpu.telemetry import fleetscrape
+
+    kwargs.setdefault("tsdb", fleetscrape.default_tsdb())
     reconciler = InferenceServiceReconciler(client, informers=informers,
                                             queue=queue, **kwargs)
 

@@ -919,13 +919,10 @@ class NotebookReconciler(Reconciler):
 
 
 def _seconds_since(timestamp: Optional[str]) -> Optional[float]:
-    if not timestamp:
-        return None
-    import calendar
+    from kubeflow_tpu.platform.k8s.types import parse_timestamp
 
-    try:
-        then = calendar.timegm(time.strptime(timestamp, "%Y-%m-%dT%H:%M:%SZ"))
-    except ValueError:
+    then = parse_timestamp(timestamp)
+    if then is None:
         return None
     return max(0.0, time.time() - then)
 

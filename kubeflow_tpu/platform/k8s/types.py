@@ -227,6 +227,29 @@ def pod_ready(pod: Resource) -> bool:
     return False
 
 
+def parse_timestamp(value) -> "float | None":
+    """ISO-8601 Kubernetes timestamp → epoch seconds (UTC); None on
+    junk.  Accepts the apiserver's ``Z`` form with or without fractional
+    seconds plus numeric offsets — the ONE implementation for every
+    epoch-seconds consumer (jobqueue queue-wait ages, the notebook
+    spawn-latency histogram; culling keeps its datetime-returning
+    variant for tz-aware comparisons)."""
+    if not value:
+        return None
+    import datetime
+
+    for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S.%fZ",
+                "%Y-%m-%dT%H:%M:%S%z", "%Y-%m-%dT%H:%M:%S.%f%z"):
+        try:
+            dt = datetime.datetime.strptime(value, fmt)
+        except (ValueError, TypeError):
+            continue
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        return dt.timestamp()
+    return None
+
+
 def copy_resource(x: Any) -> Any:
     """Deep copy for JSON-shaped resources (dict/list/scalars — the only
     shapes k8s objects hold; they all cross the wire as JSON).  ~5x faster

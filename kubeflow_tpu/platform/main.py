@@ -64,6 +64,26 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
     if debug_traces is None:
         debug_traces = config.env_bool("DEBUG_TRACES", True)
 
+    # The /debug/ index (docs/observability.md): one line per live debug
+    # surface, so an operator landing on the health port can discover
+    # the whole family without the docs open.  Pinned by
+    # test_observability.py::test_debug_index_lists_live_surfaces.
+    debug_index = {
+        "/debug/knobs": "effective env-knob registry (value/default/"
+                        "source, secrets redacted)",
+        "/debug/queue": "TPUJob gang admission ledger (waiting order, "
+                        "allocations, pool/quota tallies, preemption "
+                        "targets)",
+        "/debug/shards": "shard-lease ownership map (sharded HA)",
+        "/debug/traces": "recent reconcile span trees (?n=, ?trace_id=, "
+                         "?controller=)",
+        "/debug/journey/<trace_id>": "causal spans of one object journey "
+                                     "(fleet-joinable)",
+        "/debug/alerts": "burn-rate SLO alert states + live burn rates",
+        "/debug/goodput": "per-profile chip-second goodput decomposition "
+                          "(goodput/queued/restarting/idle)",
+    }
+
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "")
         if path == "/healthz":
@@ -103,6 +123,35 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
             from kubeflow_tpu.platform.runtime import jobqueue
 
             snap = jobqueue.debug_snapshot()
+            if snap is not None:
+                start_response("200 OK",
+                               [("Content-Type", "application/json")])
+                return [json.dumps(snap).encode()]
+        if path in ("/debug", "/debug/"):
+            start_response("200 OK", [("Content-Type", "application/json")])
+            return [json.dumps({"debug": debug_index}).encode()]
+        if path == "/debug/alerts":
+            # Burn-rate SLO alert states (telemetry/slo.py): per-rule
+            # firing/inactive with live fast/slow burn rates, windows,
+            # thresholds — the first page to read when "is the SLO
+            # burning" is the question (docs/observability.md "The
+            # metrics pipeline").  404 until a rule engine registers.
+            from kubeflow_tpu.telemetry import slo
+
+            snap = slo.debug_snapshot()
+            if snap is not None:
+                start_response("200 OK",
+                               [("Content-Type", "application/json")])
+                return [json.dumps(snap).encode()]
+        if path == "/debug/goodput":
+            # Per-profile TPU goodput accounting (telemetry/goodput.py):
+            # cumulative allocated chip-seconds tiled into goodput /
+            # queued / restarting / idle, with the ratio — "what
+            # fraction of the chips each profile held did work".  404
+            # until an accountant registers.
+            from kubeflow_tpu.telemetry import goodput
+
+            snap = goodput.debug_snapshot()
             if snap is not None:
                 start_response("200 OK",
                                [("Content-Type", "application/json")])
@@ -239,6 +288,29 @@ def run_controllers(args) -> int:
             notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
     mgr.start()
     _serve_health(mgr, args.health_port, client=client, shards=shards)
+    # The fleet metrics pipeline (docs/observability.md "The metrics
+    # pipeline"): scrape -> in-process TSDB -> burn-rate SLO rules +
+    # goodput accounting, on one knobbed cadence.  Targets: the
+    # self-scrape of this replica's registry (reconcile/watch-lag/
+    # queue-wait series) and any KFT_SCRAPE_PEERS; the InferenceService
+    # reconciler writes its replica scrapes into the SAME shared TSDB,
+    # so the serve-TTFT rule reads the one scrape path.  Lease/Event
+    # traffic is never fenced — the pipeline writes (alert Events)
+    # go through the raw client.
+    from kubeflow_tpu.platform.runtime import metrics as runtime_metrics
+    from kubeflow_tpu.telemetry import fleetscrape as fleetscrape_mod
+    from kubeflow_tpu.telemetry import goodput as goodput_mod
+    from kubeflow_tpu.telemetry import slo as slo_mod
+
+    pipeline = fleetscrape_mod.MetricsPipeline(
+        client=client)
+    pipeline.scraper.add_source(lambda: [fleetscrape_mod.self_target(
+        runtime_metrics.render,
+        labels={"replica": config.env("POD_NAME", "") or "self"})])
+    pipeline.scraper.add_source(fleetscrape_mod.peer_targets)
+    slo_mod.register_debug_alerts(pipeline.engine)
+    goodput_mod.register_debug_goodput(pipeline.goodput)
+    pipeline.start()
     from kubeflow_tpu.platform.runtime.flight import shared_pool
 
     logging.info(
@@ -252,6 +324,9 @@ def run_controllers(args) -> int:
         else "off",
     )
     _wait_for_term()
+    pipeline.stop()
+    slo_mod.register_debug_alerts(None)
+    goodput_mod.register_debug_goodput(None)
     mgr.stop()
     return 0
 

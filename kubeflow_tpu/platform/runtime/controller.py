@@ -376,17 +376,35 @@ class Controller:
             # replays (add_handler ADDED backfills, relists) re-deliver
             # objects stamped long ago — the bound keeps phantom
             # minutes-long watch_lag segments off the journey.
-            if (0.0 <= lag <= causal.WATCH_LAG_MAX_S
-                    and causal.first_lag_observation(
-                        ctx.trace_id, ctx.span_id)):
-                extra = ({"replica": self.shards.identity}
-                         if self.shards is not None else {})
-                causal.record(
-                    "watch_lag", trace_id=ctx.trace_id,
-                    parent_span_id=ctx.span_id, segment="watch_lag",
-                    start_ts=ctx.stamped_ts, end_ts=now,
-                    kind=obj.get("kind", ""), controller=self.name,
-                    **extra)
+            if (lag >= 0.0 and causal.first_lag_observation(
+                    ctx.trace_id, ctx.span_id)):
+                from kubeflow_tpu.platform.runtime import metrics
+
+                if lag <= causal.WATCH_LAG_MAX_S:
+                    extra = ({"replica": self.shards.identity}
+                             if self.shards is not None else {})
+                    causal.record(
+                        "watch_lag", trace_id=ctx.trace_id,
+                        parent_span_id=ctx.span_id, segment="watch_lag",
+                        start_ts=ctx.stamped_ts, end_ts=now,
+                        kind=obj.get("kind", ""), controller=self.name,
+                        **extra)
+                    # The histogram twin of the span — what the
+                    # watch-lag SLO burn-rate rule reads from the
+                    # self-scrape (telemetry/slo.py).  Same dedup/replay
+                    # guard: one observation per stamp, first delivery
+                    # only.
+                    metrics.informer_watch_lag_seconds.labels(
+                        kind=obj.get("kind", "")).observe(lag)
+                else:
+                    # Past the replay bound, span and histogram record
+                    # nothing BY DESIGN (a relist replay of an old stamp
+                    # is not a lag) — but a watch path degraded beyond
+                    # the bound would otherwise be invisible to the very
+                    # SLO built for it, so the overflow is counted where
+                    # an operator (or a rule) can see it.
+                    metrics.informer_watch_lag_overflow_total.labels(
+                        kind=obj.get("kind", "")).inc()
         with self._pending_ctx_lock:
             if len(self._pending_ctx) > 8192:
                 # Keys that never dequeue here (ownership moved, queue

@@ -106,11 +106,18 @@ class Decision:
 
 
 class _Entry:
-    __slots__ = ("demand", "alloc")
+    __slots__ = ("demand", "alloc", "queued_at")
 
-    def __init__(self, demand: GangDemand, alloc: Optional[int]):
+    def __init__(self, demand: GangDemand, alloc: Optional[int],
+                 queued_at: Optional[float] = None):
         self.demand = demand
         self.alloc = alloc            # None = waiting; int = admitted slices
+        # Waiting entries only: status.queuedAt (falls back to the
+        # creationTimestamp for jobs whose park hasn't committed yet) —
+        # the scrape-time oldest-wait starvation gauge reads this.
+        self.queued_at = queued_at
+
+
 
 
 def demand_of(job: Resource) -> Optional[GangDemand]:
@@ -331,11 +338,19 @@ class JobQueue:
         alloc = jobapi.allocated_slices(job)
         if alloc is not None and phase not in jobapi.HOLDING_PHASES:
             alloc = None
+        queued_at = None
+        if alloc is None:
+            queued_at = jobapi.queued_at(job)
+            if queued_at is None:
+                from kubeflow_tpu.platform.k8s.types import parse_timestamp
+
+                queued_at = parse_timestamp(demand.created)
         cur = self._entries.get(key)
-        if cur is not None and cur.demand == demand and cur.alloc == alloc:
+        if (cur is not None and cur.demand == demand
+                and cur.alloc == alloc and cur.queued_at == queued_at):
             return False
         self._drop_locked(key)
-        entry = _Entry(demand, alloc)
+        entry = _Entry(demand, alloc, queued_at)
         self._entries[key] = entry
         if alloc is None:
             bisect.insort(self._waiting, (demand.rank, key))
@@ -678,6 +693,27 @@ class JobQueue:
         with self._lock:
             return dict(self._waiting_by_ns)
 
+    def oldest_wait_by_namespace(self, now: Optional[float] = None
+                                 ) -> Dict[str, float]:
+        """Age of the oldest currently-queued job per profile namespace
+        — the starvation signal ``tpujob_queue_wait_seconds`` (observed
+        only at admission) structurally cannot carry.  O(waiting), read
+        at scrape time only."""
+        if now is None:
+            now = self._now()
+        out: Dict[str, float] = {}
+        with self._lock:
+            for _rank, key in self._waiting:
+                entry = self._entries[key]
+                since = entry.queued_at
+                if since is None:
+                    continue
+                age = max(0.0, now - since)
+                ns = entry.demand.namespace
+                if age > out.get(ns, -1.0):
+                    out[ns] = age
+        return out
+
     def allocated_total(self) -> int:
         with self._lock:
             return self._alloc_total
@@ -765,3 +801,11 @@ def register_debug_queue(queue: Optional[JobQueue]) -> None:
 def debug_snapshot() -> Optional[dict]:
     q = _debug_queue
     return q.snapshot() if q is not None else None
+
+
+def oldest_queue_waits() -> Optional[Dict[str, float]]:
+    """The scrape-time oldest-wait gauge's read
+    (runtime/metrics.py::_TpuJobQueueWaitCollector); None until a tpujob
+    controller registers its queue."""
+    q = _debug_queue
+    return q.oldest_wait_by_namespace() if q is not None else None

@@ -262,9 +262,11 @@ inferenceservice_rollouts_total = Counter(
 )
 inferenceservice_scrape_errors_total = Counter(
     "inferenceservice_scrape_errors_total",
-    "replica /metrics scrapes that failed (the replica is absent from "
-    "that autoscaling pass; an all-fail pass holds width)",
-    registry=registry,
+    "replica /metrics scrapes that failed, by reason: 'timeout' / "
+    "'connect' (a down or unreachable replica — absent from that "
+    "autoscaling pass; an all-fail pass holds width) vs 'parse' (the "
+    "replica answered garbage — a regression, not an outage)",
+    ["reason"], registry=registry,
 )
 
 
@@ -606,6 +608,81 @@ informer_watch_restarts_total = Counter(
     "Informer watch stream failures/expiries that forced a re-establish",
     ["kind"], registry=registry,
 )
+informer_watch_lag_seconds = Histogram(
+    "informer_watch_lag_seconds",
+    "API write committed -> watch event delivered, measured once per "
+    "causal stamp at its first delivery (the journey's watch_lag span, "
+    "as a histogram the watch-lag SLO burn-rate rule can read)",
+    ["kind"], buckets=_QUEUE_BUCKETS, registry=registry,
+)
+
+# -- fleet metrics pipeline (telemetry/{tsdb,fleetscrape,slo,goodput}.py;
+#    docs/observability.md "The metrics pipeline") ----------------------------
+
+fleetscrape_scrape_errors_total = Counter(
+    "fleetscrape_scrape_errors_total",
+    "fleet-pipeline target scrapes that failed, by bounded reason: "
+    "'timeout' (socket stall), 'connect' (unreachable/refused/hook "
+    "failure), 'parse' (page fetched but unparseable)",
+    ["reason"], registry=registry,
+)
+fleetscrape_samples_total = Counter(
+    "fleetscrape_samples_total",
+    "samples written into the in-process TSDB by the fleet scrape "
+    "pipeline (the bench band's numerator)",
+    registry=registry,
+)
+fleetscrape_targets = Gauge(
+    "fleetscrape_targets",
+    "scrape targets discovered on the most recent pipeline pass",
+    registry=registry,
+)
+kft_tsdb_series_evicted_total = Counter(
+    "kft_tsdb_series_evicted_total",
+    "series evicted from the fleet TSDB at its max_series bound "
+    "(oldest-last-sample first).  A climbing rate means the store is "
+    "undersized for the fleet (KFT_TSDB_MAX_SERIES) and burn-rate "
+    "windows are silently losing history — size up or filter targets",
+    registry=registry,
+)
+informer_watch_lag_overflow_total = Counter(
+    "informer_watch_lag_overflow_total",
+    "watch deliveries whose measured lag exceeded the "
+    "JOURNEY_WATCH_LAG_MAX_SECONDS replay bound (one count per stamp): "
+    "either relist replays of old stamps, or — climbing steadily — a "
+    "watch path degraded PAST the bound, which the lag histogram (and "
+    "the watch-lag SLO) cannot see by construction",
+    ["kind"], registry=registry,
+)
+kft_alerts_firing = Gauge(
+    "kft_alerts_firing",
+    "burn-rate alert state per SLO rule: 1 = firing (both windows over "
+    "their burn thresholds), 0 = inactive (telemetry/slo.py; the live "
+    "detail is /debug/alerts)",
+    ["alert"], registry=registry,
+)
+kft_alert_transitions_total = Counter(
+    "kft_alert_transitions_total",
+    "burn-rate alert state transitions ('firing' / 'resolved'), also "
+    "recorded as one fleet-wide Kubernetes Event each",
+    ["alert", "state"], registry=registry,
+)
+tpu_goodput_ratio = Gauge(
+    "tpu_goodput_ratio",
+    "cumulative productive chip-seconds over allocated chip-seconds per "
+    "profile namespace (telemetry/goodput.py; the decomposition tiles "
+    "exactly — see /debug/goodput)",
+    ["profile"], registry=registry,
+)
+tpu_chip_seconds_total = Counter(
+    "tpu_chip_seconds_total",
+    "allocated chip-seconds per profile, decomposed by state: 'goodput' "
+    "(training steps on ready workers, busy decode slots), 'queued' "
+    "(granted but not yet working), 'restarting' (gang restart / "
+    "preemption drain), 'idle' (ready but unoccupied); the four states "
+    "sum to the allocation exactly",
+    ["profile", "state"], registry=registry,
+)
 informer_relist_duration_seconds = Histogram(
     "informer_relist_duration_seconds",
     "Full LIST + store rebuild duration per informer relist",
@@ -773,7 +850,35 @@ class _RuntimeStateCollector:
         yield shard_owned
 
 
+class _TpuJobQueueWaitCollector:
+    """Scrape-time ``tpujob_queue_oldest_wait_seconds{profile}``: the
+    age of the OLDEST currently-queued TPUJob per profile, read from the
+    registered jobqueue ledger.  ``tpujob_queue_wait_seconds`` observes
+    only at admission, so a starving job is invisible there until it
+    admits — this gauge is the starvation tripwire next to the depth
+    gauge (docs/observability.md).  Ages grow with wall time without a
+    state change, so this must be scrape-time, never eager."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        g = GaugeMetricFamily(
+            "tpujob_queue_oldest_wait_seconds",
+            "age of the oldest TPUJob currently parked Queued, per "
+            "profile namespace (0 series when nothing waits)",
+            labels=["profile"],
+        )
+        from kubeflow_tpu.platform.runtime import jobqueue
+
+        waits = jobqueue.oldest_queue_waits()
+        if waits:
+            for ns, age in sorted(waits.items()):
+                g.add_metric([ns], age)
+        yield g
+
+
 registry.register(_RuntimeStateCollector())
+registry.register(_TpuJobQueueWaitCollector())
 
 
 # -- histogram quantile helpers (bench_scale.py's p50/p99 reporting) ----------

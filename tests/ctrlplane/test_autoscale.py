@@ -279,3 +279,144 @@ def test_targets_from_spec_defaults_and_overrides():
     b = decide_scale(2, s, t, ScaleState(), now=50.0)
     assert a == b and s == _sample(queue_depth=8.0)
     assert dataclasses.asdict(a.state)  # state is a plain value
+
+
+# -- the TSDB-migration A/B pin (ISSUE 15) ------------------------------------
+#
+# The autoscaler moved off its private scrape (parse pages per reconcile,
+# diff TTFT buckets in ``_ttft_prev``) onto the fleet pipeline (scrape ->
+# TSDB -> ``fleetscrape.serve_sample``).  The matrix below REPLAYS the
+# retired private-path semantics as a reference implementation and pins
+# the stored-series path sample-identical on the same scraped traffic —
+# and ``decide_scale`` is pure, so identical samples mean identical
+# decisions by construction (asserted anyway).
+
+
+def _legacy_samples(passes):
+    """The pre-migration private-scrape path, frozen as a reference:
+    parse_serve_pages + the _ttft_prev merged-bucket delta."""
+    from kubeflow_tpu.platform.controllers.inferenceservice import (
+        parse_serve_pages,
+    )
+    from kubeflow_tpu.telemetry.metrics import quantile_from_buckets
+
+    prev = None
+    out = []
+    for texts in passes:
+        sample, buckets = parse_serve_pages([t for t in texts
+                                             if t is not None])
+        if sample.replicas_scraped:
+            last, prev = prev, buckets
+            if last is None:
+                sample = dataclasses.replace(sample, ttft_p99_s=None)
+            else:
+                delta = {le: max(0.0, c - last.get(le, 0.0))
+                         for le, c in buckets.items()}
+                sample = dataclasses.replace(
+                    sample,
+                    ttft_p99_s=quantile_from_buckets(delta, 0.99))
+        else:
+            prev = None
+        out.append(sample)
+    return out
+
+
+def _tsdb_samples(passes):
+    """The same traffic through the fleet substrate."""
+    from kubeflow_tpu.telemetry import fleetscrape as fs
+    from kubeflow_tpu.telemetry.tsdb import TSDB
+
+    db = TSDB()
+    box = {}
+    sc = fs.FleetScraper(db, scraper=lambda url: box.get(url))
+    out = []
+    for i, texts in enumerate(passes):
+        box = {f"u{j}": t for j, t in enumerate(texts)}
+        targets = [fs.Target(url=f"u{j}",
+                             labels={"service": "ns/svc",
+                                     "replica": f"r{j}"})
+                   for j in range(len(texts))]
+        sc.scrape_service("ns/svc", targets, ts=100.0 + 10.0 * i)
+        out.append(fs.serve_sample(db, "ns/svc"))
+    return out
+
+
+def _page(*, queue=0.0, requests=0.0, slots=None, active=None, ttft=None):
+    lines = [f"serve_queue_depth {queue}",
+             f'generate_requests_total{{outcome="ok"}} {requests}']
+    if slots is not None:
+        lines += [f"serve_decode_slots {slots}",
+                  f"serve_decode_slots_active {active or 0}"]
+    for le, v in (ttft or {}).items():
+        lines.append(
+            f'serve_time_to_first_token_seconds_bucket{{le="{le}"}} {v}')
+    return "\n".join(lines) + "\n"
+
+
+AB_TRAFFIC = {
+    "steady": [
+        [_page(queue=2.0, requests=10.0, ttft={"0.2": 5, "1.0": 9,
+                                               "+Inf": 10})],
+        [_page(queue=4.0, requests=30.0, ttft={"0.2": 6, "1.0": 12,
+                                               "+Inf": 30})],
+        [_page(queue=1.0, requests=35.0, ttft={"0.2": 10, "1.0": 16,
+                                               "+Inf": 35})],
+    ],
+    "two-replicas-merge": [
+        [_page(queue=8.0, requests=10.0, slots=8, active=4,
+               ttft={"1.0": 4, "+Inf": 5}),
+         _page(queue=2.0, requests=6.0, slots=8, active=8,
+               ttft={"1.0": 1, "+Inf": 6})],
+        [_page(queue=6.0, requests=20.0, slots=8, active=2,
+               ttft={"1.0": 8, "+Inf": 9}),
+         _page(queue=0.0, requests=9.0, slots=8, active=1,
+               ttft={"1.0": 2, "+Inf": 11})],
+    ],
+    "replica-restart-resets-buckets": [
+        [_page(requests=50.0, ttft={"1.0": 40, "+Inf": 50})],
+        [_page(requests=2.0, ttft={"1.0": 1, "+Inf": 2})],   # reset
+        [_page(requests=8.0, ttft={"1.0": 5, "+Inf": 8})],
+    ],
+    "outage-pass-rebaselines": [
+        [_page(requests=10.0, ttft={"1.0": 10, "+Inf": 10})],
+        [None],                                              # all fail
+        [_page(requests=90.0, ttft={"1.0": 20, "+Inf": 90})],
+        [_page(requests=95.0, ttft={"1.0": 21, "+Inf": 95})],
+    ],
+    "replica-appears-mid-window": [
+        [_page(requests=10.0, ttft={"1.0": 9, "+Inf": 10})],
+        [_page(requests=12.0, ttft={"1.0": 10, "+Inf": 12}),
+         _page(requests=100.0, ttft={"1.0": 60, "+Inf": 100})],
+    ],
+    "replica-drops-mid-window": [
+        [_page(requests=10.0, ttft={"1.0": 9, "+Inf": 10}),
+         _page(requests=20.0, ttft={"1.0": 15, "+Inf": 20})],
+        [_page(requests=14.0, ttft={"1.0": 11, "+Inf": 14})],
+    ],
+}
+
+
+def test_tsdb_path_matches_private_scrape_path_sample_for_sample():
+    for name, passes in AB_TRAFFIC.items():
+        legacy = _legacy_samples(passes)
+        stored = _tsdb_samples(passes)
+        assert stored == legacy, (name, stored, legacy)
+
+
+def test_tsdb_path_yields_identical_decision_sequences():
+    """Belt AND suspenders over purity: chain decide_scale over both
+    sample sequences (state threaded through) and pin the decisions."""
+    targets = _targets(min_replicas=0, max_replicas=8,
+                       ttft_p99_s=1.0, idle_seconds=120.0,
+                       cooldown_seconds=0.0)
+    for name, passes in AB_TRAFFIC.items():
+        seqs = []
+        for samples in (_legacy_samples(passes), _tsdb_samples(passes)):
+            state, width, decisions = ScaleState(), 2, []
+            for i, sample in enumerate(samples):
+                d = decide_scale(width, sample, targets, state,
+                                 now=1000.0 + 10.0 * i)
+                decisions.append((d.replicas, d.reason))
+                state, width = d.state, d.replicas
+            seqs.append(decisions)
+        assert seqs[0] == seqs[1], (name, seqs)

@@ -36,7 +36,7 @@ def test_presubmit_lane_list_is_pinned():
                        if "presubmit" in wf.job_types)
     assert presubmit == sorted([
         "notebook-controller", "resilience", "ha-shard", "bench-smoke",
-        "tpujob", "inferenceservice", "lint", "journey",
+        "tpujob", "inferenceservice", "lint", "journey", "slo",
         "admission-webhook", "web-apps", "compute", "native",
         "notebook-images",
     ])
@@ -73,6 +73,23 @@ def test_journey_lane_registered_and_shaped():
     assert "test_causal.py" in " ".join(wf.steps[0].command)
     smoke = wf.steps[1].command
     assert smoke[-2:] == ["--only", "tpujob-train-converge"]
+    assert wf.steps[1].depends == "unit"
+
+
+def test_slo_lane_registered_and_shaped():
+    """The slo lane (ISSUE 15): pipeline unit matrices gate the
+    autoscaler A/B migration pin, triggered by telemetry and
+    control-plane changes."""
+    assert "slo" in select(["kubeflow_tpu/telemetry/tsdb.py"])
+    assert "slo" in select(
+        ["kubeflow_tpu/platform/controllers/inferenceservice.py"])
+    wf = WORKFLOWS["slo"]
+    assert [s.name for s in wf.steps] == ["unit", "autoscale-ab"]
+    unit = " ".join(wf.steps[0].command)
+    for piece in ("test_tsdb.py", "test_fleetscrape.py", "test_slo.py",
+                  "test_goodput.py"):
+        assert piece in unit
+    assert "test_autoscale.py" in " ".join(wf.steps[1].command)
     assert wf.steps[1].depends == "unit"
 
 

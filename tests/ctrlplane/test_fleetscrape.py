@@ -1,0 +1,289 @@
+"""The fleet scrape manager (ISSUE 15): target fan-out into the TSDB,
+reason-classified scrape failures, the autoscaler's stored-series
+ServeSample, self-scrape targets, and the pipeline cadence loop."""
+from __future__ import annotations
+
+import pytest
+
+from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.telemetry import fleetscrape as fs
+from kubeflow_tpu.telemetry.tsdb import TSDB
+
+
+def page(*, queue=0.0, requests=0.0, slots=None, active=None,
+         ttft=None):
+    lines = [f"serve_queue_depth {queue}",
+             f'generate_requests_total{{outcome="ok"}} {requests}']
+    if slots is not None:
+        lines += [f"serve_decode_slots {slots}",
+                  f"serve_decode_slots_active {active or 0}"]
+    for le, v in (ttft or {}).items():
+        lines.append(
+            f'serve_time_to_first_token_seconds_bucket{{le="{le}"}} {v}')
+    return "\n".join(lines) + "\n"
+
+
+def _errors(reason):
+    return metrics.registry.get_sample_value(
+        "fleetscrape_scrape_errors_total", {"reason": reason}) or 0.0
+
+
+def test_scrape_stores_samples_with_target_labels():
+    db = TSDB()
+    sc = fs.FleetScraper(db, scraper=lambda url: page(queue=4.0),
+                         now=lambda: 50.0)
+    stats = sc.scrape([
+        fs.Target(url="http://a/metrics", labels={"service": "n/s",
+                                                  "replica": "a"}),
+        fs.Target(url="http://b/metrics", labels={"service": "n/s",
+                                                  "replica": "b"}),
+    ])
+    assert stats.ok == 2 and stats.samples == 4
+    rows = db.values_at("serve_queue_depth", {"service": "n/s"}, 50.0)
+    assert sorted(r["replica"] for r, _v in rows) == ["a", "b"]
+
+
+def test_scrape_error_reasons_are_bounded_and_counted():
+    db = TSDB()
+    before = {r: _errors(r) for r in ("timeout", "connect", "parse")}
+
+    def scraper(url):
+        if "dead" in url:
+            return None                 # down replica
+        if "slow" in url:
+            raise TimeoutError()        # stalled socket
+        return "{ not metrics"          # parse regression
+
+    own = []
+    sc = fs.FleetScraper(db, scraper=scraper, on_error=own.append)
+    stats = sc.scrape([fs.Target(url="http://dead/metrics"),
+                       fs.Target(url="http://slow/metrics"),
+                       fs.Target(url="http://garbled/metrics")])
+    assert stats.ok == 0
+    assert _errors("connect") == before["connect"] + 1
+    assert _errors("timeout") == before["timeout"] + 1
+    assert _errors("parse") == before["parse"] + 1
+    # The owner hook (the serving controller's labeled counter) sees the
+    # same bounded reasons.
+    assert sorted(own) == ["connect", "parse", "timeout"]
+
+
+def test_self_scrape_target_reads_local_registry():
+    db = TSDB()
+    sc = fs.FleetScraper(db)
+    target = fs.self_target(metrics.render, labels={"replica": "self"})
+    stats = sc.scrape([target], ts=9.0)
+    assert stats.ok == 1 and stats.samples > 10
+    # A control-plane series landed with the self label.
+    assert db.instant("notebook_create_total", {"replica": "self"})
+
+
+def test_serve_sample_first_pass_has_no_ttft_signal():
+    db = TSDB()
+    sc = fs.FleetScraper(
+        db, scraper=lambda url: page(queue=6.0, requests=10.0,
+                                     ttft={"1.0": 5, "+Inf": 5}))
+    sc.scrape_service("n/s", [fs.Target(url="u", labels={
+        "service": "n/s", "replica": "r0"})], ts=10.0)
+    s = fs.serve_sample(db, "n/s")
+    assert s.replicas_scraped == 1 and s.queue_depth == 6.0
+    assert s.requests_total == 10.0
+    assert s.ttft_p99_s is None  # cumulative history is not pressure
+
+
+def test_serve_sample_delta_resets_and_outage_rebaseline():
+    db = TSDB()
+    pages = {}
+    sc = fs.FleetScraper(db, scraper=lambda url: pages.get(url))
+    t = [fs.Target(url="u", labels={"service": "n/s", "replica": "r0"})]
+
+    pages["u"] = page(ttft={"1.0": 10, "+Inf": 10})
+    sc.scrape_service("n/s", t, ts=10.0)
+    pages["u"] = page(ttft={"1.0": 10, "+Inf": 14})
+    sc.scrape_service("n/s", t, ts=20.0)
+    s = fs.serve_sample(db, "n/s")
+    # Delta: 4 new events, all over the 1.0 bucket -> p99 clamps to the
+    # top finite bound.
+    assert s.ttft_p99_s == 1.0
+    # Replica restart: counters reset BELOW the previous pass — the
+    # per-le clamp keeps the delta at zero instead of negative.
+    pages["u"] = page(ttft={"1.0": 1, "+Inf": 1})
+    sc.scrape_service("n/s", t, ts=30.0)
+    assert fs.serve_sample(db, "n/s").ttft_p99_s is None
+    # Outage pass (no replica answered) re-baselines: the next
+    # successful pass has no TTFT signal.
+    pages["u"] = None
+    sc.scrape_service("n/s", t, ts=40.0)
+    assert fs.serve_sample(db, "n/s").replicas_scraped == 0
+    pages["u"] = page(ttft={"1.0": 50, "+Inf": 50})
+    sc.scrape_service("n/s", t, ts=50.0)
+    assert fs.serve_sample(db, "n/s").ttft_p99_s is None
+
+
+def test_serve_sample_frozen_clock_passes_stay_distinct():
+    """Reconcilers run with test-frozen clocks; pass timestamps must
+    stay strictly monotonic per service or the pass join collapses."""
+    db = TSDB()
+    pages = {"u": page(queue=2.0)}
+    sc = fs.FleetScraper(db, scraper=lambda url: pages["u"],
+                         now=lambda: 1000.0)
+    t = [fs.Target(url="u", labels={"service": "n/s", "replica": "r0"})]
+    sc.scrape_service("n/s", t)
+    pages["u"] = page(queue=8.0)
+    sc.scrape_service("n/s", t)
+    s = fs.serve_sample(db, "n/s")
+    assert s.replicas_scraped == 1 and s.queue_depth == 8.0
+
+
+def test_discovery_sources_feed_targets_and_survive_failure():
+    db = TSDB()
+    sc = fs.FleetScraper(db, scraper=lambda url: page())
+    sc.add_source(lambda: [fs.Target(url="http://x/metrics")])
+
+    def broken():
+        raise RuntimeError("boom")
+
+    sc.add_source(broken)
+    assert len(sc.targets()) == 1
+    stats = sc.scrape()
+    assert stats.targets == 1 and stats.ok == 1
+
+
+def test_inferenceservice_targets_follow_endpoint_contract():
+    pods = [
+        {"metadata": {"name": "p0", "annotations": {
+            "inferenceservices.kubeflow.org/endpoint": "http://ep0/"}},
+         "status": {}},
+        {"metadata": {"name": "p1"}, "status": {"podIP": "10.0.0.9"}},
+        {"metadata": {"name": "p2"}, "status": {}},  # no route: skipped
+    ]
+    targets = fs.inferenceservice_targets(pods, port=9000,
+                                          service_key="n/s")
+    assert [(t.url, t.labels["replica"]) for t in targets] == [
+        ("http://ep0/metrics", "p0"),
+        ("http://10.0.0.9:9000/metrics", "p1"),
+    ]
+    assert all(t.labels["service"] == "n/s" for t in targets)
+
+
+def test_pipeline_step_scrapes_evaluates_and_ticks_goodput():
+    from kubeflow_tpu.telemetry import goodput as gp
+    from kubeflow_tpu.telemetry import slo
+
+    db = TSDB()
+    clock = [100.0]
+    engine = slo.RuleEngine(db, [], now=lambda: clock[0])
+    acct = gp.GoodputAccountant(now=lambda: clock[0])
+    pipe = fs.MetricsPipeline(tsdb=db, engine=engine, goodput=acct,
+                              now=lambda: clock[0], interval=999.0)
+    pipe.scraper.add_source(lambda: [fs.self_target(metrics.render)])
+    stats = pipe.step()
+    assert stats.ok == 1 and engine.last_eval_at == 100.0
+    clock[0] = 110.0
+    pipe.step()
+    assert engine.last_eval_at == 110.0
+    # start/stop is clean (daemon thread, no firing at this interval).
+    pipe.start()
+    pipe.stop()
+
+
+@pytest.mark.parametrize("reason,exc", [
+    ("timeout", TimeoutError), ("connect", RuntimeError)])
+def test_fetch_hook_exception_classification(reason, exc):
+    db = TSDB()
+
+    def scraper(url):
+        raise exc()
+
+    sc = fs.FleetScraper(db, scraper=scraper)
+    before = _errors(reason)
+    sc.scrape([fs.Target(url="u")])
+    assert _errors(reason) == before + 1
+
+
+def test_reconciler_scrape_memory_is_private_but_wiring_is_shared():
+    """The migration keeps the replaced ``_ttft_prev`` dict's isolation:
+    a bare reconciler owns a PRIVATE store (a second instance's first
+    pass re-baselines instead of computing a delta against the first's
+    passes), while make_controller wires the process-shared store so the
+    manager's rule engine reads the same serve series."""
+    from kubeflow_tpu.platform.controllers import inferenceservice as svc
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    kube = FakeKube()
+    kube.add_namespace("serve")
+    r1 = svc.InferenceServiceReconciler(kube, scraper=lambda url: None)
+    r2 = svc.InferenceServiceReconciler(kube, scraper=lambda url: None)
+    assert r1.tsdb is not r2.tsdb
+    ctrl = svc.make_controller(kube, scraper=lambda url: None)
+    try:
+        assert ctrl.reconciler.tsdb is fs.default_tsdb()
+    finally:
+        del ctrl
+
+
+def test_target_names_filter_and_eviction_visibility():
+    """Replica targets filter to the decision series (the fleet-scale
+    guard against series-bound churn), and eviction at the bound is
+    surfaced as a counter — never a buried attribute."""
+    db = TSDB(max_series=3)
+    full = page(queue=1.0, requests=2.0, slots=8, active=4,
+                ttft={"1.0": 1, "+Inf": 2})
+    sc = fs.FleetScraper(db, scraper=lambda url: full)
+    before = metrics.registry.get_sample_value(
+        "kft_tsdb_series_evicted_total") or 0.0
+    t = fs.Target(url="u", labels={"service": "n/s", "replica": "r0"},
+                  names=frozenset({"serve_queue_depth"}))
+    stats = sc.scrape([t], ts=1.0)
+    assert stats.samples == 1
+    assert db.names() == ["serve_queue_depth"]
+    # Unfiltered scrape overflows the tiny bound: evictions become a
+    # scrapeable counter delta.
+    sc.scrape([fs.Target(url="u", labels={"replica": "r1"})], ts=2.0)
+    assert db.evictions > 0
+    after = metrics.registry.get_sample_value(
+        "kft_tsdb_series_evicted_total") or 0.0
+    assert after - before == db.evictions
+    # The serving controller's default target set carries the filter.
+    pods = [{"metadata": {"name": "p0", "annotations": {
+        "inferenceservices.kubeflow.org/endpoint": "http://e"}},
+        "status": {}}]
+    (target,) = fs.inferenceservice_targets(pods, port=1, service_key="x")
+    assert target.names == fs.SERVE_SAMPLE_NAMES
+
+
+def test_watch_lag_overflow_counted_not_observed():
+    """A lag past the replay bound records neither span nor histogram
+    sample (by design) but MUST count — a watch path degraded beyond
+    the bound would otherwise be invisible to the watch-lag SLO."""
+    import time as _time
+
+    from kubeflow_tpu.platform.k8s.types import NOTEBOOK
+    from kubeflow_tpu.platform.runtime import Reconciler, Request
+    from kubeflow_tpu.platform.runtime.controller import Controller
+    from kubeflow_tpu.telemetry import causal
+
+    class _R(Reconciler):
+        def reconcile(self, req):
+            return None
+
+    ctrl = Controller("lag-probe", _R(), primary=NOTEBOOK)
+    ctx = causal.mint()
+    obj = {"apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+           "metadata": {"name": "nb", "namespace": "x", "annotations": {
+               causal.TRACEPARENT_ANNOTATION: ctx.to_traceparent(),
+               causal.TRACESTATE_ANNOTATION:
+                   f"kft=ts:{_time.time() - 10_000.0}",
+           }}}
+
+    def overflow():
+        return metrics.registry.get_sample_value(
+            "informer_watch_lag_overflow_total",
+            {"kind": "Notebook"}) or 0.0
+
+    before = overflow()
+    ctrl._note_event(obj, [Request("x", "nb")])
+    assert overflow() == before + 1
+    # Dedup: the same stamp never counts twice.
+    ctrl._note_event(obj, [Request("x", "nb")])
+    assert overflow() == before + 1

@@ -1,0 +1,269 @@
+"""Per-tenant goodput accounting (ISSUE 15): the tiling invariant
+(goodput + queued + restarting + idle == allocated, by construction),
+the workload decompositions, staleness (a dead replica's series stops
+counting), and the seeded-storm acceptance — a TPUJob + InferenceService
+fleet under chaos produces a nonzero, monotone-consistent
+tpu_goodput_ratio at /debug/goodput."""
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.telemetry import goodput as gp
+from kubeflow_tpu.telemetry.tsdb import TSDB
+
+
+def job(*, ns="team-a", phase="Running", alloc=2, ready=None, total=None,
+        slices=2):
+    status = {"phase": phase, "allocatedSlices": alloc, "generation": 0,
+              "restarts": 0}
+    if ready is not None:
+        status["slices"] = [{"slice": 0, "ready": ready, "total": total}]
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "j", "namespace": ns},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4",
+                         "slices": slices},
+                 "template": {"spec": {"containers": [{"name": "w"}]}}},
+        "status": status,
+    }
+
+
+def service(*, ns="team-a", replicas=2, ready=2, name="svc"):
+    return {
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "InferenceService",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"model": "llama_125m",
+                 "tpu": {"accelerator": "v5e", "topology": "2x4"},
+                 "replicas": {"min": 1, "max": 4}},
+        "status": {"phase": "Ready", "replicas": replicas,
+                   "readyReplicas": ready, "revision": 1,
+                   "targetRevision": 1},
+    }
+
+
+def test_job_use_decomposition_by_phase():
+    # 2x4 v5e slice = 8 chips; 2 slices allocated = 16 chips.
+    u = gp.job_use(job(phase="Running", ready=2, total=2))
+    assert (u.chips, u.productive, u.idle) == (16.0, 16.0, 0.0)
+    u = gp.job_use(job(phase="Running", ready=1, total=2))
+    assert u.productive == 8.0 and u.idle == 8.0
+    u = gp.job_use(job(phase="Pending"))
+    assert u.queued == u.chips == 16.0
+    u = gp.job_use(job(phase="Restarting"))
+    assert u.restarting == 16.0
+    u = gp.job_use(job(phase="Preempting"))
+    assert u.restarting == 16.0
+    assert gp.job_use(job(phase="Succeeded")) is None
+    # Queued jobs hold nothing.
+    q = job(phase="Queued")
+    q["status"].pop("allocatedSlices")
+    assert gp.job_use(q) is None
+
+
+def test_service_use_occupancy_and_cold_replicas():
+    db = TSDB()
+    # svc: 2 replicas x 8 chips, 1 ready.  Ready replica half-occupied.
+    db.append("serve_decode_slots", {"service": "team-a/svc",
+                                     "replica": "p0"}, 8.0, ts=100.0)
+    db.append("serve_decode_slots_active", {"service": "team-a/svc",
+                                            "replica": "p0"}, 4.0, ts=100.0)
+    u = gp.service_use(service(replicas=2, ready=1), tsdb=db, at=100.0,
+                       staleness=60.0)
+    assert u.chips == 16.0
+    assert u.queued == 8.0            # the cold replica
+    assert u.productive == 4.0        # 8 ready chips * 0.5 occupancy
+    assert u.idle == 4.0
+    # No scraped series -> ready chips read idle, never productive.
+    u2 = gp.service_use(service(replicas=1, ready=1), tsdb=TSDB(),
+                        at=100.0, staleness=60.0)
+    assert u2.productive == 0.0 and u2.idle == 8.0
+
+
+def test_dead_replica_series_stops_counting():
+    """A killed serving pod leaves its frozen slot gauges in the ring;
+    past the staleness bound they must not count — otherwise its
+    replacement double-counts the same chips."""
+    db = TSDB()
+    for replica, ts in (("dead", 10.0), ("live", 100.0)):
+        db.append("serve_decode_slots", {"service": "t/s",
+                                         "replica": replica}, 8.0, ts=ts)
+        db.append("serve_decode_slots_active", {"service": "t/s",
+                                                "replica": replica},
+                  8.0, ts=ts)
+    u = gp.service_use(service(ns="t", name="s", replicas=1, ready=1),
+                       tsdb=db, at=100.0, staleness=30.0)
+    # Only the live replica's occupancy (8/8) over one replica's chips.
+    assert u.productive == 8.0 and u.idle == 0.0
+
+
+def test_tiling_invariant_holds_under_adversarial_inputs():
+    """The accountant clamps each bucket into the remaining allocation,
+    so the four states tile allocated chip-seconds EXACTLY whatever the
+    inputs claim (overlapping states, over-claims, negatives)."""
+    acct = gp.GoodputAccountant(now=lambda: 0.0)
+    acct.tick([], at=0.0)
+    uses = [
+        gp.WorkloadUse("p", 16.0, productive=20.0, queued=10.0,
+                       restarting=10.0),               # over-claimed
+        gp.WorkloadUse("p", 8.0, productive=-3.0),     # negative claim
+        gp.WorkloadUse("q", 4.0, productive=1.0, queued=1.0),
+    ]
+    acct.tick(uses, at=10.0)
+    snap = acct.snapshot()
+    for profile, row in snap["profiles"].items():
+        assert row["tiles"], row
+        total = sum(row[f"{s}ChipSeconds"] for s in gp.STATES)
+        assert total == pytest.approx(row["allocatedChipSeconds"])
+    assert snap["profiles"]["p"]["allocatedChipSeconds"] == \
+        pytest.approx(240.0)
+    assert snap["profiles"]["q"]["goodputRatio"] == pytest.approx(0.25)
+
+
+def test_observe_integrates_jobs_and_services_per_profile():
+    db = TSDB()
+    acct = gp.GoodputAccountant(now=lambda: 0.0, staleness=60.0)
+    jobs = [job(ns="team-a", phase="Running", ready=2, total=2)]
+    services = [service(ns="team-b", replicas=1, ready=0)]
+    acct.observe(jobs, services, tsdb=db, at=0.0)     # baseline tick
+    acct.observe(jobs, services, tsdb=db, at=5.0)
+    snap = acct.snapshot()["profiles"]
+    assert snap["team-a"]["goodputChipSeconds"] == pytest.approx(80.0)
+    assert snap["team-a"]["goodputRatio"] == 1.0
+    assert snap["team-b"]["queuedChipSeconds"] == pytest.approx(40.0)
+    assert snap["team-b"]["goodputRatio"] == 0.0
+
+
+def test_monotone_counters_and_ratio_consistency():
+    acct = gp.GoodputAccountant(now=lambda: 0.0)
+    acct.tick([], at=0.0)
+    last = {}
+    for t in range(1, 6):
+        frac = 0.5 + 0.1 * t
+        acct.tick([gp.WorkloadUse("p", 10.0, productive=10.0 * frac)],
+                  at=float(t))
+        snap = acct.snapshot()["profiles"]["p"]
+        for s in gp.STATES:
+            key = f"{s}ChipSeconds"
+            assert snap[key] >= last.get(key, 0.0)  # never decreases
+            last[key] = snap[key]
+        assert 0.0 < snap["goodputRatio"] <= 1.0
+
+
+@pytest.mark.slow
+def test_storm_fleet_produces_nonzero_goodput_at_debug_endpoint():
+    """THE acceptance pin: TPUJob gangs + InferenceService replicas on
+    one seeded ChaosKube storm, both REAL controllers reconciling, the
+    accountant ticking from watch state + the shared TSDB — the
+    /debug/goodput page serves a nonzero goodput ratio whose cumulative
+    counters are monotone and tile exactly."""
+    from kubeflow_tpu.platform import main as main_mod
+    from kubeflow_tpu.platform.controllers import (
+        inferenceservice as svcctrl,
+    )
+    from kubeflow_tpu.platform.controllers import tpujob as jobctrl
+    from kubeflow_tpu.platform.k8s.types import INFERENCESERVICE, TPUJOB
+    from kubeflow_tpu.platform.testing import FakeKube
+    from kubeflow_tpu.platform.testing.chaos import ChaosKube, storm
+    from kubeflow_tpu.platform.testing.jobsim import TpuJobGangSim
+    from kubeflow_tpu.platform.testing.servesim import InferenceFleetSim
+
+    ns = "goodput"
+    inner = FakeKube()
+    inner.add_namespace(ns)
+    for i in range(8):
+        inner.add_tpu_node(f"tpu-{i}", topology="2x4")
+    kube = ChaosKube(inner, storm(rate=0.05), seed=7)
+    db = TSDB()
+
+    def pages(url):
+        if url.endswith("/readyz"):
+            return '{"ready": true}'
+        return ("serve_decode_slots 8\nserve_decode_slots_active 6\n"
+                "serve_queue_depth 1.0\n"
+                'generate_requests_total{outcome="ok"} 50\n')
+
+    jc = jobctrl.make_controller(kube)
+    sc = svcctrl.make_controller(kube, scraper=pages, sync_period=0.05,
+                                 tsdb=db)
+    jobsim = TpuJobGangSim(inner, ns)
+    servesim = InferenceFleetSim(
+        inner, ns, endpoint_for=lambda s, r, i: f"sim://{s}/{r}/{i}")
+    acct = gp.GoodputAccountant(staleness=60.0)
+    from kubeflow_tpu.telemetry import goodput as gpmod
+
+    gpmod.register_debug_goodput(acct)
+
+    class _Mgr:
+        def healthy(self):
+            return True
+
+    server = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1")
+    try:
+        jc.start(kube)
+        sc.start(kube)
+        for i in range(2):
+            inner.create({
+                "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+                "metadata": {"name": f"gang-{i}", "namespace": ns},
+                "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4",
+                                 "slices": 1},
+                         "template": {"spec": {"containers": [
+                             {"name": "w", "image": "x"}]}}},
+            })
+            inner.create({
+                "apiVersion": "kubeflow.org/v1alpha1",
+                "kind": "InferenceService",
+                "metadata": {"name": f"svc-{i}", "namespace": ns},
+                "spec": {"model": "llama_125m",
+                         "tpu": {"accelerator": "v5e", "topology": "2x4"},
+                         "replicas": {"min": 1, "max": 2, "initial": 1}},
+            })
+
+        url = f"http://127.0.0.1:{server.server_port}/debug/goodput"
+        deadline = time.monotonic() + 30.0
+        prev_alloc = 0.0
+        good_seen = False
+        while time.monotonic() < deadline:
+            acct.observe(inner.list(TPUJOB, None),
+                         inner.list(INFERENCESERVICE, None),
+                         tsdb=db)
+            body = json.load(urllib.request.urlopen(url))
+            row = body["profiles"].get(ns)
+            if row:
+                assert row["tiles"], row
+                assert row["allocatedChipSeconds"] >= prev_alloc
+                prev_alloc = row["allocatedChipSeconds"]
+                ratio = row["goodputRatio"]
+                if ratio and ratio > 0:
+                    good_seen = True
+                    assert 0.0 < ratio <= 1.0
+                    if row["allocatedChipSeconds"] > 20.0:
+                        break
+            time.sleep(0.1)
+        assert good_seen, "no nonzero goodput ratio under the storm"
+    finally:
+        server.shutdown()
+        gpmod.register_debug_goodput(None)
+        sc.stop()
+        jc.stop()
+        servesim.close()
+        jobsim.close()
+
+
+def test_backwards_clock_never_reintegrates_an_interval():
+    """An out-of-order tick (NTP step, duplicate timestamp) must not
+    move the integration anchor backward — rewinding it would count an
+    already-integrated interval twice."""
+    acct = gp.GoodputAccountant(now=lambda: 0.0)
+    use = [gp.WorkloadUse("p", 8.0, productive=8.0)]
+    acct.tick(use, at=0.0)
+    acct.tick(use, at=100.0)   # integrates [0, 100] = 800 chip-seconds
+    acct.tick(use, at=95.0)    # clock stepped back: ignored entirely
+    acct.tick(use, at=100.0)   # duplicate: ignored
+    snap = acct.snapshot()["profiles"]["p"]
+    assert snap["allocatedChipSeconds"] == pytest.approx(800.0)
+    assert snap["goodputChipSeconds"] == pytest.approx(800.0)

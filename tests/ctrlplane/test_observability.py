@@ -288,3 +288,107 @@ def test_debug_knobs_reports_unparseable_env_source():
         assert entry["source"] == "env-unparseable"
     finally:
         del os.environ["KFT_TEST_KNOB_BADINT"]
+
+
+def test_debug_index_lists_live_surfaces():
+    """/debug/ (ISSUE 15 satellite): the health port indexes every live
+    debug surface with a one-line description, so the family is
+    discoverable without the docs open."""
+
+    class _Mgr:
+        def healthy(self):
+            return True
+
+    server = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{server.server_port}"
+        body = json.loads(_get(base + "/debug/"))
+        index = body["debug"]
+        assert {"/debug/knobs", "/debug/queue", "/debug/shards",
+                "/debug/traces", "/debug/journey/<trace_id>",
+                "/debug/alerts", "/debug/goodput"} <= set(index)
+        assert all(isinstance(v, str) and v for v in index.values())
+        # The bare path serves it too.
+        assert json.loads(_get(base + "/debug"))["debug"] == index
+    finally:
+        server.shutdown()
+
+
+def test_debug_alerts_and_goodput_endpoints_serve_registered_state():
+    """/debug/alerts + /debug/goodput (ISSUE 15): 404 until the pipeline
+    registers its engine/accountant (the single-slot registry pattern,
+    like /debug/queue), then live JSON."""
+    import urllib.error
+
+    from kubeflow_tpu.telemetry import fleetscrape as fs
+    from kubeflow_tpu.telemetry import goodput as goodput_mod
+    from kubeflow_tpu.telemetry import slo as slo_mod
+    from kubeflow_tpu.telemetry.tsdb import TSDB
+
+    class _Mgr:
+        def healthy(self):
+            return True
+
+    server = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        for path in ("/debug/alerts", "/debug/goodput"):
+            try:
+                _get(base + path)
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            else:  # pragma: no cover
+                raise AssertionError(f"{path} served before registration")
+
+        db = TSDB()
+        pipe = fs.MetricsPipeline(tsdb=db, now=lambda: 100.0,
+                                  interval=999.0)
+        slo_mod.register_debug_alerts(pipe.engine)
+        goodput_mod.register_debug_goodput(pipe.goodput)
+        try:
+            pipe.step(at=100.0)
+            alerts = json.loads(_get(base + "/debug/alerts"))
+            assert {a["alert"] for a in alerts["alerts"]} == {
+                "serve-ttft-p99", "reconcile-p99", "watch-lag",
+                "queue-wait"}
+            assert all(a["state"] == "inactive" for a in alerts["alerts"])
+            goodput = json.loads(_get(base + "/debug/goodput"))
+            assert goodput == {"profiles": {}, "lastTickAt": 100.0}
+        finally:
+            slo_mod.register_debug_alerts(None)
+            goodput_mod.register_debug_goodput(None)
+    finally:
+        server.shutdown()
+
+
+def test_queue_oldest_wait_gauge_rides_scrape(monkeypatch):
+    """tpujob_queue_oldest_wait_seconds (ISSUE 15 satellite): the
+    starvation gauge reads the registered ledger at scrape time — ages
+    grow with wall time without a queue state change."""
+    from kubeflow_tpu.platform.runtime import jobqueue as jq
+    from kubeflow_tpu.platform.runtime import metrics
+
+    clock = [1000.0]
+    q = jq.JobQueue(now=lambda: clock[0])
+    q.observe({
+        "apiVersion": "kubeflow.org/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "starving", "namespace": "team-a",
+                     "creationTimestamp": "2026-01-01T00:00:00Z"},
+        "spec": {"tpu": {"accelerator": "v5e", "topology": "2x4",
+                         "slices": 1},
+                 "template": {"spec": {"containers": [{"name": "w"}]}}},
+        "status": {"phase": "Queued", "queuedAt": 900.0},
+    })
+    jq.register_debug_queue(q)
+    try:
+        def sample():
+            return metrics.registry.get_sample_value(
+                "tpujob_queue_oldest_wait_seconds",
+                {"profile": "team-a"})
+
+        assert sample() == 100.0
+        clock[0] = 1250.0  # no state change: the age still grows
+        assert sample() == 350.0
+    finally:
+        jq.register_debug_queue(None)
+    assert sample() is None

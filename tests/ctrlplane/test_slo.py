@@ -1,0 +1,273 @@
+"""Burn-rate SLO alerting over the fleet TSDB (ISSUE 15): the
+multi-window math, the alert state machine, fleet-wide exactly-one
+Event emission, and the non-vacuity acceptance — a planted TTFT
+degradation through the REAL serving scrape path fires the fast-window
+alert within one evaluation cadence across a 2-replica ShardedFleet,
+emits one Event, and clears on recovery."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from kubeflow_tpu.platform.k8s.types import EVENT, deep_get
+from kubeflow_tpu.platform.runtime import metrics
+from kubeflow_tpu.platform.testing import FakeKube
+from kubeflow_tpu.telemetry import slo
+from kubeflow_tpu.telemetry.tsdb import TSDB
+
+TTFT_BUCKET = "serve_time_to_first_token_seconds_bucket"
+
+
+def rule(**kw):
+    base = dict(name="ttft", metric=TTFT_BUCKET, threshold=1.0,
+                objective=0.9, fast_window_s=300.0, slow_window_s=3600.0,
+                fast_burn=2.0, slow_burn=1.0)
+    base.update(kw)
+    return slo.BurnRateRule(**base)
+
+
+def feed(db, *, at, good, total, labels=None):
+    """One cumulative bucket observation set (good = under-threshold)."""
+    db.append(TTFT_BUCKET, {**(labels or {}), "le": "1.0"}, good, ts=at)
+    db.append(TTFT_BUCKET, {**(labels or {}), "le": "+Inf"}, total, ts=at)
+
+
+def test_first_scrape_of_a_long_lived_counter_is_not_an_increase():
+    """A fresh TSDB's first scrape of a replica that has served for a
+    day stores huge cumulative buckets; strict increase semantics (a
+    series' first sample is history, not events) keep that from firing
+    a spurious page on a healthy fleet right after a controller
+    restart."""
+    db = TSDB()
+    feed(db, at=100.0, good=80_000, total=100_000)  # first-ever sample
+    eng = slo.RuleEngine(db, [rule()], now=lambda: 100.0)
+    assert eng.evaluate() == []
+    assert eng.states["ttft"].state == "inactive"
+
+
+def test_burn_rate_math_and_two_window_gate():
+    db = TSDB()
+    r = rule()
+    # 50% of events over the threshold against a 10% budget: burn = 5.
+    feed(db, at=90.0, good=0, total=0)
+    feed(db, at=100.0, good=5, total=10)
+    fast, slow, events = r.burn_rates(db, at=100.0)
+    assert fast == pytest.approx(5.0) and slow == pytest.approx(5.0)
+    assert events == 10
+    eng = slo.RuleEngine(db, [r], now=lambda: 100.0)
+    out = eng.evaluate()
+    assert [t["state"] for t in out] == ["firing"]
+    assert eng.states["ttft"].state == "firing"
+    assert metrics.registry.get_sample_value(
+        "kft_alerts_firing", {"alert": "ttft"}) == 1.0
+
+
+def test_fast_window_alone_does_not_fire():
+    """A cliff inside the fast window with a CLEAN slow window history
+    pages; a clean fast window never does — and an hour of old errors
+    with a clean fast window doesn't page either (the slow window
+    confirms, the fast window gates recency)."""
+    db = TSDB()
+    r = rule(fast_window_s=10.0, slow_window_s=1000.0,
+             fast_burn=2.0, slow_burn=2.0)
+    # Old errors far outside the fast window.
+    feed(db, at=100.0, good=0, total=100)
+    # Fast window: all good (delta 100 good / 100 total).
+    feed(db, at=995.0, good=100, total=200)
+    feed(db, at=1000.0, good=200, total=300)
+    eng = slo.RuleEngine(db, [r], now=lambda: 1000.0)
+    assert eng.evaluate() == []
+    assert eng.states["ttft"].state == "inactive"
+
+
+def test_no_events_is_no_signal_not_recovery():
+    db = TSDB()
+    r = rule()
+    feed(db, at=99.0, good=0, total=0)
+    feed(db, at=100.0, good=0, total=10)
+    eng = slo.RuleEngine(db, [r], now=lambda: 100.0)
+    assert [t["state"] for t in eng.evaluate()] == ["firing"]
+    # Every series ages out of both windows: silence must hold the page
+    # (a scrape outage mid-incident is not recovery).
+    out = eng.evaluate(at=100.0 + 3600.0 * 3)
+    assert out == [] and eng.states["ttft"].state == "firing"
+    # Fresh clean traffic inside both windows resolves it.
+    feed(db, at=100.0 + 3600.0 * 3, good=110, total=120)
+    out = eng.evaluate(at=100.0 + 3600.0 * 3 + 1)
+    assert [t["state"] for t in out] == ["resolved"]
+    assert eng.states["ttft"].state == "inactive"
+
+
+def test_recording_rule_materializes_quantile_series():
+    db = TSDB()
+    feed(db, at=10.0, good=0, total=0)
+    feed(db, at=50.0, good=9, total=10)
+    rec = slo.RecordingRule(record="ttft:p99", metric=TTFT_BUCKET,
+                            q=0.5, window_s=100.0)
+    eng = slo.RuleEngine(db, [], recording=[rec], now=lambda: 50.0)
+    eng.evaluate()
+    rows = db.instant("ttft:p99")
+    assert rows and 0.0 < rows[0][2] <= 1.0
+
+
+def test_default_rules_cover_the_four_slos():
+    names = {r.name for r in slo.default_rules()}
+    assert names == {"serve-ttft-p99", "reconcile-p99", "watch-lag",
+                     "queue-wait"}
+    for r in slo.default_rules():
+        assert r.fast_window_s < r.slow_window_s
+        assert r.fast_burn > r.slow_burn
+
+
+def test_transition_emits_exactly_one_event_across_replicas():
+    """Two replicas evaluating the same rules over the same scraped data
+    both announce the transition; the stamping apply helper's
+    content-hash (deterministic Event name + owned content) makes the
+    second apply a no-op — exactly one Event object fleet-wide, flipped
+    in place on resolve."""
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    db = TSDB()
+    feed(db, at=5.0, good=0, total=0)
+    feed(db, at=10.0, good=0, total=50)
+    engines = [slo.RuleEngine(db, [rule()], client=kube,
+                              now=lambda: 10.0) for _ in range(2)]
+    for eng in engines:
+        eng.evaluate()
+    events = kube.list(EVENT, "kubeflow")
+    firing = [e for e in events if e.get("reason") == "AlertFiring"]
+    assert len(firing) == 1, events
+    assert firing[0]["metadata"]["name"] == "kft-alert-ttft"
+    # Stamped through the apply helpers: the content hash rides it.
+    assert deep_get(firing[0], "metadata", "annotations",
+                    "kubeflow.org/generated-hash")
+    # Recovery flips the same object (still one Event, reason flipped).
+    feed(db, at=11.0, good=550, total=600)
+    for eng in engines:
+        eng.evaluate(at=11.0)
+    events = kube.list(EVENT, "kubeflow")
+    assert len([e for e in events
+                if e["metadata"]["name"] == "kft-alert-ttft"]) == 1
+    assert kube.get(EVENT, "kft-alert-ttft",
+                    "kubeflow")["reason"] == "AlertResolved"
+
+
+@pytest.mark.slow
+def test_planted_ttft_degradation_fires_once_across_sharded_fleet():
+    """THE acceptance pin (ISSUE 15): a 2-replica ShardedFleet runs the
+    REAL InferenceService controllers (servesim playing the kubelet,
+    replica /metrics pages planted); both replicas scrape the same
+    service into the shared process TSDB; per-replica rule engines
+    evaluate the serve-TTFT burn rule.  A planted TTFT degradation
+    fires the fast-window alert within ONE evaluation cadence, emits
+    exactly one Event fleet-wide, survives a replica kill, and clears
+    on recovery."""
+    from kubeflow_tpu.platform.controllers import inferenceservice as svcctrl
+    from kubeflow_tpu.platform.testing.servesim import InferenceFleetSim
+    from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+
+    state = {"degraded": False, "requests": 0.0}
+
+    def pages(url):
+        if url.endswith("/readyz"):
+            return '{"ready": true}'
+        state["requests"] += 1.0
+        # Cumulative TTFT buckets: healthy traffic lands under 0.2s;
+        # degraded traffic all lands past 5s (the rule threshold).
+        good = 0.0 if state["degraded"] else state["requests"]
+        return (
+            "serve_queue_depth 0.0\n"
+            f'generate_requests_total{{outcome="ok"}} {state["requests"]}\n'
+            f'serve_time_to_first_token_seconds_bucket{{le="0.2"}} {good}\n'
+            f'serve_time_to_first_token_seconds_bucket{{le="5.0"}} {good}\n'
+            "serve_time_to_first_token_seconds_bucket{le=\"+Inf\"} "
+            f"{state['requests']}\n")
+
+    db = TSDB()
+    fleet = ShardedFleet(
+        replicas=2, num_shards=4, workers=2,
+        controller_factory=lambda client, **kw: svcctrl.make_controller(
+            client, scraper=pages, sync_period=0.05, tsdb=db, **kw))
+    sim = InferenceFleetSim(
+        fleet.kube, fleet.namespace,
+        endpoint_for=lambda svc, rev, i: f"sim://{svc}/{rev}/{i}")
+    ttft_rule = rule(name="serve-ttft-p99", threshold=0.2,
+                     objective=0.9, fast_window_s=5.0,
+                     slow_window_s=60.0, fast_burn=2.0, slow_burn=1.0)
+    engines = [slo.RuleEngine(db, [ttft_rule], client=r.chaos,
+                              namespace="kubeflow")
+               for r in fleet.replicas]
+    try:
+        fleet.kube.create({
+            "apiVersion": "kubeflow.org/v1alpha1",
+            "kind": "InferenceService",
+            "metadata": {"name": "llm", "namespace": fleet.namespace},
+            "spec": {"model": "llama_125m",
+                     "tpu": {"accelerator": "v5e", "topology": "2x4"},
+                     "replicas": {"min": 1, "max": 2, "initial": 1}},
+        })
+
+        def wait(cond, timeout=20.0, what=""):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if cond():
+                    return
+                time.sleep(0.05)
+            raise TimeoutError(what or "condition")
+
+        # Healthy traffic flows through the real scrape path.
+        wait(lambda: fleet.kube.list(EVENT, fleet.namespace) is not None
+             and db.latest_n("fleetscrape_pass",
+                             {"service": f"{fleet.namespace}/llm"}, 1),
+             what="first scrape pass")
+        wait(lambda: db.increase(TTFT_BUCKET,
+                                 {"service": f"{fleet.namespace}/llm",
+                                  "le": "+Inf"}, window=60.0,
+                                 at=time.time()) > 0,
+             what="ttft series flowing")
+        for eng in engines:
+            eng.evaluate()
+        assert all(e.states["serve-ttft-p99"].state == "inactive"
+                   for e in engines)
+
+        # Plant the degradation; the controllers' own scrape cadence
+        # carries it into the store; ONE evaluation pass fires.
+        state["degraded"] = True
+        base = state["requests"]
+        wait(lambda: state["requests"] > base + 4, what="degraded scrapes")
+        fired = []
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not fired:
+            for eng in engines:
+                fired.extend(eng.evaluate())
+            time.sleep(0.05)
+        assert any(t["state"] == "firing" for t in fired), fired
+        events = [e for e in fleet.kube.list(EVENT, "kubeflow")
+                  if e["metadata"]["name"] == "kft-alert-serve-ttft-p99"]
+        assert len(events) == 1 and events[0]["reason"] == "AlertFiring"
+
+        # Replica 0 dies mid-incident: the survivor keeps evaluating and
+        # the Event set stays at exactly one.
+        fleet.kill(0)
+        engines[1].evaluate()
+        events = [e for e in fleet.kube.list(EVENT, "kubeflow")
+                  if e["metadata"]["name"] == "kft-alert-serve-ttft-p99"]
+        assert len(events) == 1
+
+        # Recovery: healthy traffic again; the survivor's engine clears
+        # the alert and flips the same Event to AlertResolved.
+        state["degraded"] = False
+        base = state["requests"]
+        wait(lambda: state["requests"] > base + 8, what="recovery scrapes")
+        deadline = time.monotonic() + 10.0
+        while (time.monotonic() < deadline
+               and engines[1].states["serve-ttft-p99"].state == "firing"):
+            engines[1].evaluate()
+            time.sleep(0.05)
+        assert engines[1].states["serve-ttft-p99"].state == "inactive"
+        ev = fleet.kube.get(EVENT, "kft-alert-serve-ttft-p99", "kubeflow")
+        assert ev["reason"] == "AlertResolved"
+    finally:
+        sim.close()
+        fleet.close()

@@ -1,0 +1,171 @@
+"""The in-process TSDB's edge semantics (ISSUE 15 satellite matrix):
+counter resets mid-window (replica restart), stale-series eviction at
+the series bound, ring capacity, histogram_quantile over sparse
+buckets, exact-timestamp pass joins."""
+from __future__ import annotations
+
+import math
+
+from kubeflow_tpu.telemetry.tsdb import TSDB
+
+
+def test_append_instant_and_label_matching():
+    db = TSDB()
+    db.append("m", {"a": "1"}, 10.0, ts=1.0)
+    db.append("m", {"a": "1"}, 11.0, ts=2.0)
+    db.append("m", {"a": "2"}, 99.0, ts=2.0)
+    rows = db.instant("m", {"a": "1"})
+    assert rows == [({"a": "1"}, 2.0, 11.0)]
+    # at= picks the latest sample at or before the instant.
+    assert db.instant("m", {"a": "1"}, at=1.5) == [({"a": "1"}, 1.0, 10.0)]
+    # No matcher = every series of the name.
+    assert len(db.instant("m")) == 2
+    assert db.instant("missing") == []
+    assert sorted(db.names()) == ["m"]
+
+
+def test_instant_staleness_drops_dead_series():
+    """A target that stopped reporting keeps its frozen last value in
+    the ring; a staleness-bounded read must not count it (the goodput
+    no-double-count contract)."""
+    db = TSDB()
+    db.append("g", {"replica": "dead"}, 8.0, ts=10.0)
+    db.append("g", {"replica": "live"}, 8.0, ts=100.0)
+    rows = db.instant("g", at=100.0, staleness=30.0)
+    assert [r[0]["replica"] for r in rows] == ["live"]
+    # Unbounded read still sees both.
+    assert len(db.instant("g", at=100.0)) == 2
+
+
+def test_ring_capacity_drops_oldest_samples():
+    db = TSDB(capacity=4)
+    for i in range(10):
+        db.append("m", None, float(i), ts=float(i))
+    (_labels, samples), = db.window("m")
+    assert [v for _ts, v in samples] == [6.0, 7.0, 8.0, 9.0]
+
+
+def test_stale_series_evicted_at_capacity():
+    """At max_series, the series with the OLDEST last sample is evicted
+    — a dead target's leftovers, never the hot series still appending."""
+    db = TSDB(max_series=3)
+    db.append("m", {"i": "stale"}, 1.0, ts=1.0)
+    db.append("m", {"i": "warm"}, 1.0, ts=50.0)
+    db.append("m", {"i": "hot"}, 1.0, ts=100.0)
+    db.append("m", {"i": "new"}, 1.0, ts=101.0)  # evicts "stale"
+    assert db.evictions == 1
+    have = {ls["i"] for ls in db.labelsets("m")}
+    assert have == {"warm", "hot", "new"}
+    # Appending to an existing series never evicts.
+    db.append("m", {"i": "warm"}, 2.0, ts=102.0)
+    assert db.evictions == 1 and len(db) == 3
+
+
+def test_increase_is_counter_reset_aware():
+    """A replica restart drops its counter to ~0 mid-window: the
+    post-reset value is the increase since the reset — never a negative
+    rate, never a lost pre-reset head."""
+    db = TSDB()
+    for ts, v in [(1, 100.0), (2, 110.0), (3, 5.0), (4, 8.0)]:
+        db.append("c", {"r": "0"}, v, ts=float(ts))
+    # Window [1.5, 4]: the sample just before it anchors the first
+    # delta — +10, reset->5, +3 = 18.
+    assert db.increase("c", {"r": "0"}, window=2.5, at=4.0) == 18.0
+    # A window containing the series' FIRST sample counts deltas only —
+    # Prometheus semantics: one cumulative observation is history, not
+    # an increase (a first scrape after a restart must not read a
+    # long-lived remote counter's lifetime as in-window events).
+    assert db.increase("c", {"r": "0"}, window=100.0, at=4.0) == 18.0
+    assert db.rate("c", {"r": "0"}, window=2.5, at=4.0) == 18.0 / 2.5
+    # A single-sample series contributes nothing yet.
+    db.append("c", {"r": "1"}, 7.0, ts=3.9)
+    assert db.increase("c", None, window=2.5, at=4.0) == 18.0
+    db.append("c", {"r": "1"}, 9.0, ts=3.95)
+    assert db.increase("c", None, window=2.5, at=4.0) == 20.0
+    # Empty window: zero, never an error.
+    assert db.increase("c", None, window=1.0, at=50.0) == 0.0
+
+
+def test_histogram_quantile_over_sparse_buckets():
+    """Series may carry different bucket subsets (old replicas predate a
+    bucket change; a page was truncated): the merge treats a missing le
+    as absent, not zero-crash, and interpolates over what exists."""
+    db = TSDB()
+    # replica 0: full bucket set (zero baseline + one observation set,
+    # so the windowed form has a real increase to interpolate over).
+    for le, v in [("0.1", 2.0), ("1.0", 8.0), ("+Inf", 10.0)]:
+        db.append("h_bucket", {"le": le, "r": "0"}, 0.0, ts=1.0)
+        db.append("h_bucket", {"le": le, "r": "0"}, v, ts=5.0)
+    # replica 1: sparse — no 0.1 bucket.
+    for le, v in [("1.0", 4.0), ("+Inf", 4.0)]:
+        db.append("h_bucket", {"le": le, "r": "1"}, 0.0, ts=1.0)
+        db.append("h_bucket", {"le": le, "r": "1"}, v, ts=5.0)
+    q50 = db.histogram_quantile(0.5, "h_bucket", at=5.0)
+    assert q50 is not None and 0.1 <= q50 <= 1.0
+    # Windowed form over increases (same values: zero baseline).
+    q50w = db.histogram_quantile(0.5, "h_bucket", window=10.0, at=5.0)
+    assert q50w == q50
+    # Empty matcher -> None, never a crash.
+    assert db.histogram_quantile(0.99, "h_bucket", {"r": "9"}) is None
+    assert db.histogram_quantile(0.99, "nope") is None
+
+
+def test_values_at_is_an_exact_pass_join():
+    db = TSDB()
+    db.append("g", {"r": "0"}, 1.0, ts=10.0)
+    db.append("g", {"r": "1"}, 2.0, ts=10.0)
+    db.append("g", {"r": "0"}, 5.0, ts=20.0)  # r1 missed the pass
+    assert sorted(v for _l, v in db.values_at("g", ts=10.0)) == [1.0, 2.0]
+    assert [v for _l, v in db.values_at("g", ts=20.0)] == [5.0]
+    assert db.values_at("g", ts=15.0) == []
+
+
+def test_merged_at_exact_and_latest():
+    db = TSDB()
+    db.append("h_bucket", {"le": "1.0", "r": "0"}, 3.0, ts=10.0)
+    db.append("h_bucket", {"le": "+Inf", "r": "0"}, 4.0, ts=10.0)
+    db.append("h_bucket", {"le": "1.0", "r": "1"}, 1.0, ts=9.0)
+    exact = db.merged_at("h_bucket", ts=10.0)
+    assert exact == {1.0: 3.0, math.inf: 4.0}
+    latest = db.merged_at("h_bucket", ts=10.0, exact=False)
+    assert latest == {1.0: 4.0, math.inf: 4.0}
+
+
+def test_drop_and_len():
+    db = TSDB()
+    db.append("a", {"service": "ns/x"}, 1.0, ts=1.0)
+    db.append("b", {"service": "ns/x"}, 1.0, ts=1.0)
+    db.append("a", {"service": "ns/y"}, 1.0, ts=1.0)
+    assert len(db) == 3
+    assert db.drop(matcher={"service": "ns/x"}) == 2
+    assert len(db) == 1 and db.labelsets("a") == [{"service": "ns/y"}]
+
+
+def test_ingest_page_parses_buckets_and_rejects_garbage():
+    db = TSDB()
+    page = (
+        "# HELP h stuff\n# TYPE h histogram\n"
+        'h_bucket{le="0.5"} 1\nh_bucket{le="+Inf"} 2\n'
+        "h_count 2\nh_sum 0.9\n"
+        'requests_total{outcome="ok"} 7\n'
+    )
+    n = db.ingest_page(page, labels={"replica": "r0"}, ts=3.0)
+    assert n == 5
+    assert db.merged_at("h_bucket", {"replica": "r0"}, ts=3.0) == {
+        0.5: 1.0, math.inf: 2.0}
+    assert db.values_at("requests_total", {"outcome": "ok"}, 3.0) == [
+        ({"outcome": "ok", "replica": "r0"}, 7.0)]
+    try:
+        db.ingest_page("this is { not metrics", ts=4.0)
+    except ValueError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("garbage page must raise ValueError")
+
+
+def test_latest_n_newest_first():
+    db = TSDB()
+    for i in range(5):
+        db.append("p", {"service": "s"}, float(i), ts=float(i))
+    assert db.latest_n("p", {"service": "s"}, n=2) == [(4.0, 4.0),
+                                                       (3.0, 3.0)]
