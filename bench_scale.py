@@ -192,6 +192,15 @@ INFERENCE_SCALE_BASELINE_S = 0.7
 FLEETSCRAPE_TARGETS = 200
 FLEETSCRAPE_SAMPLES_BASELINE = 45_000.0
 
+# Always-on profiler overhead band (ISSUE 16): sampler-on vs sampler-off
+# fleet-converge waves, min-of-N per arm.  The budget is 5% — the design
+# point that justifies running the sampler ALWAYS (GWP lineage): at
+# 67 Hz a sampling pass walks sys._current_frames() over a few dozen
+# threads and folds ~24 frames each, comfortably under the budget; the
+# band trips if stack folding or attribution ever lands on a hot path.
+PROFILE_OVERHEAD_BAND_PCT = 5.0
+PROFILE_FLEET = 80
+
 
 def _rss_mb() -> float:
     with open("/proc/self/status") as f:
@@ -637,6 +646,79 @@ def run_chaos(n: int, *, seed: int = CHAOS_SEED, rate: float = CHAOS_RATE,
         "faults_injected": injected,
         "dead_letters": dead_letters,
         "reconcile_errors": errors,
+    }
+
+
+def run_profile_overhead(n: int, *, rounds: int = 2,
+                         waves: int = 8) -> dict:
+    """The always-on-profiler guard (ISSUE 16): A/B fleet-converge arms
+    with the sampler off vs on (default KFT_PROFILE_HZ, registered like
+    production so every attribution seam is live).  The BANDED number is
+    CPU-accounted, not wall-clock: the sampler meters its own thread CPU
+    (``Profiler.sampler_cpu_seconds``, ``time.thread_time`` deltas
+    around each pass), and ``overhead_pct`` = sampler CPU burnt during
+    the timed waves / converge CPU everything else burnt.  Both sides
+    come off CPU clocks, so a 2-CPU shared container's scheduler jitter
+    — which swings single-wave wall time ±30% on identical code, far
+    more than a 67 Hz sampler ever could — cancels out of the band.
+    The wall-clock A/B legs (min over rounds of a ``waves``-wave
+    amortised arm) ride along as evidence that "on" does not regress
+    converge beyond that same noise.  The band also requires
+    samples > 0 — a sampler that silently never ran would otherwise
+    band at a perfect 0%."""
+    from kubeflow_tpu.telemetry import profiler as profiler_mod
+
+    off_s, on_s = [], []
+    sampler_cpu = work_cpu = 0.0
+    samples = 0
+    roles = set()
+    for i in range(max(1, rounds)):
+        for arm in ("off", "on"):
+            prof = None
+            if arm == "on":
+                prof = profiler_mod.Profiler()
+                prof.start()
+                profiler_mod.register_debug_profiler(prof)
+            h = FleetHarness()
+            try:
+                wall = cpu = scpu = 0.0
+                for w in range(max(1, waves)):
+                    c0 = prof.sampler_cpu_seconds if prof else 0.0
+                    out = h.wave(n, prefix=f"prof-{arm}{i}-{w}")
+                    wall += out["converge_s"]
+                    cpu += out["cpu_s"]
+                    if prof is not None:
+                        scpu += prof.sampler_cpu_seconds - c0
+            finally:
+                h.close()
+                if prof is not None:
+                    prof.stop()
+                    profiler_mod.register_debug_profiler(None)
+            if prof is not None:
+                for w in prof.windows():
+                    samples += w["samples"]
+                    for line in (prof.folded(w["window"]) or "").splitlines():
+                        roles.add(line.split(";", 1)[0])
+                on_s.append(wall)
+                sampler_cpu += scpu
+                # wave() meters process CPU, which includes the sampler
+                # thread — subtract it so the ratio is sampler vs work.
+                work_cpu += max(cpu - scpu, 1e-9)
+            else:
+                off_s.append(wall)
+    best_off, best_on = min(off_s), min(on_s)
+    return {
+        "fleet": n,
+        "waves": waves,
+        "overhead_pct": round(sampler_cpu / max(work_cpu, 1e-9) * 100.0, 2),
+        "sampler_cpu_s": round(sampler_cpu, 4),
+        "converge_cpu_s": round(work_cpu, 4),
+        "converge_off_s": round(best_off, 3),
+        "converge_on_s": round(best_on, 3),
+        "off_samples_s": [round(s, 3) for s in off_s],
+        "on_samples_s": [round(s, 3) for s in on_s],
+        "profile_samples": samples,
+        "roles": sorted(roles),
     }
 
 
@@ -1090,6 +1172,10 @@ def main(argv=None) -> int:
                    help="synthetic scrape-target count for the fleet "
                         "metrics pipeline band (ISSUE 15: scrape -> "
                         "TSDB store -> burn-rate rule eval per pass)")
+    p.add_argument("--profile-fleet", type=int, default=PROFILE_FLEET,
+                   help="wave size for the profiler-overhead A/B band "
+                        "(ISSUE 16: sampler on vs off, band "
+                        f"<= {PROFILE_OVERHEAD_BAND_PCT:g}%%)")
     p.add_argument("--sharded-only", action="store_true",
                    help="run ONLY the sharded-HA phase (the ha-chaos "
                         "lane's 4-replica smoke)")
@@ -1302,6 +1388,27 @@ def main(argv=None) -> int:
         "band": _band_min(scrape["samples_per_s"],
                           FLEETSCRAPE_SAMPLES_BASELINE),
         "band_floor": round(1.0 / BAND_FACTOR, 3),
+    }), flush=True)
+    profile = run_profile_overhead(args.profile_fleet)
+    print(json.dumps({
+        "metric": "ctrlplane_profile_overhead_pct",
+        "value": profile["overhead_pct"],
+        "unit": f"% sampler CPU vs converge CPU "
+                f"({args.profile_fleet}-notebook x {profile['waves']}-wave "
+                "arms, default KFT_PROFILE_HZ; wall A/B legs ride as "
+                "evidence)",
+        "sampler_cpu_s": profile["sampler_cpu_s"],
+        "converge_cpu_s": profile["converge_cpu_s"],
+        "converge_off_s": profile["converge_off_s"],
+        "converge_on_s": profile["converge_on_s"],
+        "off_samples_s": profile["off_samples_s"],
+        "on_samples_s": profile["on_samples_s"],
+        "profile_samples": profile["profile_samples"],
+        "roles": profile["roles"],
+        "band": "pass" if (
+            profile["overhead_pct"] <= PROFILE_OVERHEAD_BAND_PCT
+            and profile["profile_samples"] > 0) else "REGRESSION",
+        "band_floor": PROFILE_OVERHEAD_BAND_PCT,
     }), flush=True)
     inference = run_inference_scale(args.inference_services)
     inference_ok = (inference["dead_letters"] == 0

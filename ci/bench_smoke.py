@@ -48,6 +48,7 @@ REQUIRED_METRICS = {
     "tpujob_queue_decisions_per_s",
     "inferenceservice_scale_converge_s",
     "fleetscrape_samples_per_s",
+    "ctrlplane_profile_overhead_pct",
 }
 # Metrics whose full-run lines are banded; at smoke N they must still
 # carry the self-report fields so trending tooling never hits a gap.
@@ -62,6 +63,7 @@ BANDED_METRICS = {
     "tpujob_queue_decisions_per_s",
     "inferenceservice_scale_converge_s",
     "fleetscrape_samples_per_s",
+    "ctrlplane_profile_overhead_pct",
 }
 
 
@@ -174,7 +176,7 @@ def main() -> int:
         "--small", "6", "--large", "10", "--chaos-fleet", "6",
         "--sweep-fleet", "8", "--churn-seconds", "0.5",
         "--sharded-fleet", "24", "--inference-services", "6",
-        "--fleetscrape-targets", "24",
+        "--fleetscrape-targets", "24", "--profile-fleet", "6",
     ]
     proc = subprocess.run(cmd, capture_output=True, text=True, timeout=560)
     seen = _parse_json_lines(proc.stdout, "bench_scale")
@@ -259,6 +261,29 @@ def main() -> int:
             and isinstance(scrape.get("rule_evals"), int)
             and scrape["rule_evals"] > 0):
         print(f"fleetscrape line missing/zero samples: {scrape}",
+              file=sys.stderr)
+        return 1
+    # Profiler overhead band (ISSUE 16): the line must carry both wall
+    # A/B legs and the CPU denominator, and the sampler must really have
+    # sampled — zero profile_samples means the sampler thread never ran
+    # (or attribution broke), which would make the overhead claim
+    # vacuous.  sampler_cpu_s must be present (it IS the band's
+    # numerator) but may legitimately round toward zero on a fast box.
+    prof = seen["ctrlplane_profile_overhead_pct"]
+    for key in ("converge_off_s", "converge_on_s", "converge_cpu_s"):
+        if not (isinstance(prof.get(key), (int, float))
+                and prof[key] > 0):
+            print(f"profile overhead line missing/zero {key}: {prof}",
+                  file=sys.stderr)
+            return 1
+    if not (isinstance(prof.get("sampler_cpu_s"), (int, float))
+            and prof["sampler_cpu_s"] >= 0):
+        print(f"profile overhead line missing sampler_cpu_s: {prof}",
+              file=sys.stderr)
+        return 1
+    if not (isinstance(prof.get("profile_samples"), int)
+            and prof["profile_samples"] > 0):
+        print(f"profile overhead line missing/zero profile_samples: {prof}",
               file=sys.stderr)
         return 1
     # InferenceService autoscale band (ISSUE 12): both wave legs must

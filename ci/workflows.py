@@ -418,6 +418,31 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # profile presubmit lane (ISSUE 16): the sampling profiler's unit
+    # matrix — window rotation/folded format under a fake clock, the
+    # Tracer-seam attribution pins (a FlightPool slot sample lands under
+    # the submitting controller's role, the fleetscrape pool carries a
+    # stable name), the /debug/profile surface — plus the incident
+    # flight recorder's determinism matrix (2-replica exactly-one-Event,
+    # debounce, ring bound, bundle manifest shape), then the debug-index
+    # coverage pin so neither surface can ship unlisted.
+    name="profile",
+    include_dirs=[
+        "kubeflow_tpu/telemetry/*", "kubeflow_tpu/platform/runtime/*",
+        "kubeflow_tpu/platform/testing/*", "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest(
+            "tests/ctrlplane/test_profiler.py",
+            "tests/ctrlplane/test_incidents.py",
+        ) + ["-m", "not slow"]),
+        Step("observability", _pytest(
+            "tests/ctrlplane/test_observability.py",
+        ) + ["-m", "not slow"], depends="unit"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="admission-webhook",
     include_dirs=["kubeflow_tpu/platform/webhook/*", "releasing/*"],
     steps=[Step("unit", _pytest("tests/ctrlplane/test_webhook.py"))],

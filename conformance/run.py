@@ -1178,26 +1178,52 @@ def main(argv=None) -> int:
         return 2
     selected = [(n, f) for n, f in CHECKS if not only or n in only]
 
+    # The always-on profiler runs over the whole suite, exactly as it
+    # would in production (ISSUE 16): the report carries per-role sample
+    # counts, so a conformance run doubles as a living demonstration
+    # that the sampler attributes real control-plane work — and a slow
+    # scenario leaves a flamegraph behind instead of a shrug.
+    from kubeflow_tpu.telemetry import profiler as profiler_mod
+
+    prof = profiler_mod.Profiler()
+    prof.start()
+    profiler_mod.register_debug_profiler(prof)
+
     results = []
-    for name, fn in selected:
-        t0 = time.perf_counter()
-        try:
-            fn()
-            results.append({"check": name, "passed": True,
-                            "seconds": round(time.perf_counter() - t0, 3)})
-            print(f"PASS {name}")
-        except Exception:
-            results.append({
-                "check": name, "passed": False,
-                "seconds": round(time.perf_counter() - t0, 3),
-                "error": traceback.format_exc(limit=5),
-            })
-            print(f"FAIL {name}")
-            traceback.print_exc(limit=5)
+    try:
+        for name, fn in selected:
+            t0 = time.perf_counter()
+            try:
+                fn()
+                results.append({"check": name, "passed": True,
+                                "seconds": round(time.perf_counter() - t0, 3)})
+                print(f"PASS {name}")
+            except Exception:
+                results.append({
+                    "check": name, "passed": False,
+                    "seconds": round(time.perf_counter() - t0, 3),
+                    "error": traceback.format_exc(limit=5),
+                })
+                print(f"FAIL {name}")
+                traceback.print_exc(limit=5)
+    finally:
+        profiler_mod.register_debug_profiler(None)
+        prof.stop()
+    profile_roles = {}
+    for win in [prof.folded(w["window"]) or "" for w in prof.windows()]:
+        for line in win.splitlines():
+            role = line.split(";", 1)[0]
+            count = int(line.rsplit(" ", 1)[1])
+            profile_roles[role] = profile_roles.get(role, 0) + count
     report = {
         "suite": "kubeflow-tpu-conformance",
         "passed": all(r["passed"] for r in results),
         "checks": results,
+        "profile": {
+            "samples": sum(profile_roles.values()),
+            "roles": dict(sorted(profile_roles.items())),
+            "errors": prof.errors,
+        },
     }
     with open(args.report, "w") as f:
         json.dump(report, f, indent=2)

@@ -411,6 +411,12 @@ _LABEL_ALLOWLIST = {
     # metrics pipeline"): "alert" is bounded by the declared SLO rule
     # set, "state" by the fixed alert/goodput state vocabularies.
     "alert", "state",
+    # ISSUE 16 (continuous profiling; docs/observability.md "Profiling
+    # and incidents"): "role" is bounded by the attribution seams —
+    # controller/component names, registered pool names, and stripped
+    # long-lived thread names; default Thread-N names all fold into the
+    # single "unattributed" value.
+    "role",
 }
 
 

@@ -505,6 +505,39 @@ def create_app(service: GenerationService, *, model_name: str = "model",
             tel.tracer.recent(None), n=n,
             trace_id=request.args.get("trace_id"))})
 
+    @app.route("/debug/profile")
+    def debug_profile(request):
+        # The serve half of /debug/profile (platform/main.py documents
+        # the full query surface): folded stacks from the process-wide
+        # registered profiler — request threads attribute to the model
+        # component through the same Tracer seam as reconciles.  Same
+        # DEBUG_TRACES gate as traces; 404 while no profiler runs.
+        if not debug_traces_enabled:
+            raise HttpError(404, "debug traces disabled")
+        from werkzeug.wrappers import Response
+
+        from kubeflow_tpu.telemetry import profiler as _profiler
+
+        prof = _profiler.debug_profiler()
+        if prof is None:
+            raise HttpError(404, "no profiler registered")
+        body = None
+        if request.args.get("seconds"):
+            try:
+                body = prof.capture(float(request.args["seconds"]))
+            except ValueError:
+                body = None
+        elif request.args.get("window"):
+            try:
+                body = prof.folded(int(request.args["window"]))
+            except ValueError:
+                body = None
+        else:
+            body = prof.folded()
+        if body is None:
+            raise HttpError(404, "no such profile window")
+        return Response(body, mimetype="text/plain")
+
     @app.route("/metrics")
     def metrics(request):
         from werkzeug.wrappers import Response

@@ -82,6 +82,12 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
         "/debug/alerts": "burn-rate SLO alert states + live burn rates",
         "/debug/goodput": "per-profile chip-second goodput decomposition "
                           "(goodput/queued/restarting/idle)",
+        "/debug/profile": "always-on sampling profiler: folded stacks "
+                          "per role (?window=, ?list=1, ?diff=w1,w2, "
+                          "?seconds=N on-demand capture)",
+        "/debug/incidents": "incident flight-recorder bundles captured "
+                            "on alert firing (manifest list; fetch one "
+                            "at /debug/incidents/<id>)",
     }
 
     def app(environ, start_response):
@@ -181,6 +187,80 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
                 "trace_id": trace_id,
                 "spans": causal.journey(trace_id),
             }).encode()]
+        if path == "/debug/profile" and debug_traces:
+            # The always-on sampling profiler (telemetry/profiler.py):
+            # folded stacks per thread role for the covering window —
+            # the "why" behind a burn.  Same gate as /debug/traces
+            # (stacks reveal more than /metrics); 404 until the
+            # entrypoint registers a profiler.  ?list=1 = window index,
+            # ?window=N = one closed window, ?diff=w1,w2 = signed stack
+            # deltas, ?seconds=N = synchronous on-demand capture.
+            from urllib.parse import parse_qs
+
+            from kubeflow_tpu.telemetry import profiler as profiler_mod
+
+            prof = profiler_mod.debug_profiler()
+            if prof is not None:
+                qs = parse_qs(environ.get("QUERY_STRING", ""))
+                body = None
+                if "list" in qs:
+                    start_response("200 OK",
+                                   [("Content-Type", "application/json")])
+                    return [json.dumps({
+                        "windows": prof.windows(),
+                        "hz": prof.hz,
+                        "windowSeconds": prof.window_seconds,
+                        "errors": prof.errors,
+                        "samplerCpuSeconds": round(
+                            prof.sampler_cpu_seconds, 4),
+                    }).encode()]
+                if "diff" in qs:
+                    try:
+                        w1, w2 = (int(w) for w in
+                                  qs["diff"][0].split(",", 1))
+                        body = prof.diff(w1, w2)
+                    except ValueError:
+                        body = None
+                elif "seconds" in qs:
+                    try:
+                        body = prof.capture(float(qs["seconds"][0]))
+                    except ValueError:
+                        body = None
+                elif "window" in qs:
+                    try:
+                        body = prof.folded(int(qs["window"][0]))
+                    except ValueError:
+                        body = None
+                else:
+                    body = prof.folded()
+                if body is not None:
+                    start_response("200 OK",
+                                   [("Content-Type", "text/plain")])
+                    return [body.encode()]
+        if path == "/debug/incidents":
+            # The incident flight recorder (telemetry/incidents.py):
+            # manifests of every captured bundle, newest first — what
+            # evidence exists for recent pages.  404 until a recorder
+            # registers.
+            from kubeflow_tpu.telemetry import incidents as incidents_mod
+
+            snap = incidents_mod.debug_snapshot()
+            if snap is not None:
+                start_response("200 OK",
+                               [("Content-Type", "application/json")])
+                return [json.dumps(snap).encode()]
+        if path.startswith("/debug/incidents/"):
+            # One full incident bundle by id: the TSDB burn window,
+            # worst journeys, profile window, debug snapshots and knob
+            # state frozen at capture time.
+            from kubeflow_tpu.telemetry import incidents as incidents_mod
+
+            bundle = incidents_mod.debug_get(
+                path[len("/debug/incidents/"):])
+            if bundle is not None:
+                start_response("200 OK",
+                               [("Content-Type", "application/json")])
+                return [json.dumps(bundle).encode()]
         if path == "/debug/traces" and debug_traces:
             from urllib.parse import parse_qs
 
@@ -300,8 +380,20 @@ def run_controllers(args) -> int:
     from kubeflow_tpu.platform.runtime import metrics as runtime_metrics
     from kubeflow_tpu.telemetry import fleetscrape as fleetscrape_mod
     from kubeflow_tpu.telemetry import goodput as goodput_mod
+    from kubeflow_tpu.telemetry import incidents as incidents_mod
+    from kubeflow_tpu.telemetry import profiler as profiler_mod
     from kubeflow_tpu.telemetry import slo as slo_mod
 
+    # The always-on sampling profiler (telemetry/profiler.py): one
+    # sampler thread, rotating folded-stack windows attributed by thread
+    # role — /debug/profile, the self-time gauges, slow-dump window
+    # references and incident bundles all read the registered instance.
+    profiler = None
+    if config.knob("KFT_PROFILE_ENABLED", True, config.parse_bool,
+                   doc="run the always-on sampling profiler"):
+        profiler = profiler_mod.Profiler()
+        profiler.start()
+        profiler_mod.register_debug_profiler(profiler)
     pipeline = fleetscrape_mod.MetricsPipeline(
         client=client)
     pipeline.scraper.add_source(lambda: [fleetscrape_mod.self_target(
@@ -310,6 +402,18 @@ def run_controllers(args) -> int:
     pipeline.scraper.add_source(fleetscrape_mod.peer_targets)
     slo_mod.register_debug_alerts(pipeline.engine)
     goodput_mod.register_debug_goodput(pipeline.goodput)
+    # The incident flight recorder rides the pipeline's rule engine;
+    # wire the shard map in as an extra bundle section (same evidence
+    # /debug/shards serves) and register it for /debug/incidents.
+    if pipeline.incidents is not None:
+        if shards is not None:
+            pipeline.incidents.add_section(
+                "shards", lambda: {
+                    "identity": shards.identity,
+                    "num_shards": shards.num_shards,
+                    "owned": sorted(shards.owned()),
+                })
+        incidents_mod.register_debug_incidents(pipeline.incidents)
     pipeline.start()
     from kubeflow_tpu.platform.runtime.flight import shared_pool
 
@@ -327,6 +431,10 @@ def run_controllers(args) -> int:
     pipeline.stop()
     slo_mod.register_debug_alerts(None)
     goodput_mod.register_debug_goodput(None)
+    incidents_mod.register_debug_incidents(None)
+    if profiler is not None:
+        profiler.stop()
+        profiler_mod.register_debug_profiler(None)
     mgr.stop()
     return 0
 

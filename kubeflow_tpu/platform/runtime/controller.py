@@ -546,6 +546,12 @@ class Controller:
                 log.warning("%s: resync list failed", self.name, exc_info=True)
 
     def _worker(self) -> None:
+        # Static profile role: even between reconciles (or with tracing
+        # disabled) this thread's samples group under the controller,
+        # not a worker-N bucket; an active reconcile trace refines the
+        # attribution through the Tracer seam.
+        from kubeflow_tpu.telemetry import profiler
+        profiler.register_thread_role(self.name)
         while not self._stop.is_set():
             req = self.queue.get()
             if req is None:

@@ -77,6 +77,12 @@ class FlightPool:
 
     def _worker(self) -> None:
         _local.in_flight = True
+        # Claim a stable profile role at birth: between carries this
+        # thread samples under the pool's name instead of defeating
+        # profile grouping as Thread-N; a slot carrying a submitted
+        # trace overrides it via the Tracer adopt seam.
+        from kubeflow_tpu.telemetry import profiler
+        profiler.register_thread_role(self.name)
         while True:
             with self._lock:
                 self._idle += 1

@@ -667,6 +667,21 @@ kft_alert_transitions_total = Counter(
     "recorded as one fleet-wide Kubernetes Event each",
     ["alert", "state"], registry=registry,
 )
+kft_profile_samples_total = Counter(
+    "kft_profile_samples_total",
+    "stack samples folded into the rotating profile windows by the "
+    "always-on sampler, per attributed thread role — active reconcile/"
+    "request component, registered pool name, or stripped thread name "
+    "(telemetry/profiler.py; the windows themselves are /debug/profile)",
+    ["role"], registry=registry,
+)
+kft_incidents_captured_total = Counter(
+    "kft_incidents_captured_total",
+    "incident evidence bundles captured by the flight recorder on "
+    "burn-rate firing transitions, per alert (telemetry/incidents.py; "
+    "bundles are listed at /debug/incidents, debounced per alert)",
+    ["alert"], registry=registry,
+)
 tpu_goodput_ratio = Gauge(
     "tpu_goodput_ratio",
     "cumulative productive chip-seconds over allocated chip-seconds per "
@@ -877,8 +892,37 @@ class _TpuJobQueueWaitCollector:
         yield g
 
 
+class _ProfileSelfTimeCollector:
+    """Scrape-time ``kft_profile_self_seconds{role}``: per-role self
+    time over the profiler's OPEN window (samples / hz), read from the
+    single-slot registered profiler — the profile-derived signal the
+    TSDB/SLO layer can store and alert on ("which controller's CPU grew
+    when the burn started") without fetching flamegraphs.  Scrape-time
+    because the window fills continuously; 0 series until a profiler
+    registers."""
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        g = GaugeMetricFamily(
+            "kft_profile_self_seconds",
+            "per-role sampled self time over the open profile window "
+            "(samples / KFT_PROFILE_HZ; roles are controllers, pools, "
+            "serve/train components — see /debug/profile)",
+            labels=["role"],
+        )
+        from kubeflow_tpu.telemetry import profiler
+
+        p = profiler.debug_profiler()
+        if p is not None:
+            for role, seconds in sorted(p.self_seconds().items()):
+                g.add_metric([role], seconds)
+        yield g
+
+
 registry.register(_RuntimeStateCollector())
 registry.register(_TpuJobQueueWaitCollector())
+registry.register(_ProfileSelfTimeCollector())
 
 
 # -- histogram quantile helpers (bench_scale.py's p50/p99 reporting) ----------

@@ -348,6 +348,18 @@ class SpanStore:
         spans.sort(key=lambda s: (s["start_ts"], s["end_ts"]))
         return spans
 
+    def recent(self, *, start: float = float("-inf"),
+               end: float = float("inf")) -> List[dict]:
+        """Copies of every stored span whose end lands in
+        ``[start, end]``, time-ordered — the incident flight recorder's
+        worst-journey scan (telemetry/incidents.py) and any other reader
+        that needs the ring without knowing trace ids up front."""
+        with self._lock:
+            spans = [dict(s) for s in self._spans
+                     if start <= s["end_ts"] <= end]
+        spans.sort(key=lambda s: (s["start_ts"], s["end_ts"]))
+        return spans
+
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()

@@ -7,8 +7,11 @@ InferenceService autoscaler fetched its replicas' /metrics and diffed
 TTFT buckets inside the reconciler, bench bands were one-shot, and no
 component could ask a HISTORY question ("is the TTFT SLO burning?").
 ``FleetScraper`` owns the fetch: targets (a URL through the scraper
-hook, or an in-process page callable for self-scrapes) fan out on the
-shared FlightPool, pages parse ONCE, and every sample lands in the
+hook, or an in-process page callable for self-scrapes) fan out on a
+dedicated named FlightPool (``scrape_pool``: a slow target must not
+starve the controllers' shared pool, and its workers carry a stable
+``fleetscrape`` profile role), pages parse ONCE, and every sample lands
+in the
 :class:`~kubeflow_tpu.telemetry.tsdb.TSDB` carrying the target's labels
 plus the one per-pass timestamp that makes pass-joins exact.
 
@@ -101,6 +104,28 @@ class ScrapeStats:
     ok: int = 0
     samples: int = 0
     errors: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+
+_scrape_pool = None
+_scrape_pool_lock = threading.Lock()
+
+
+def scrape_pool():
+    """The fleetscrape fan-out pool: dedicated (never the controllers'
+    shared pool — a slow scrape target must not starve reconcile
+    fan-outs) and NAMED, so its workers carry a stable ``fleetscrape``
+    profile role instead of sampling as Thread-N.  Re-resolved when the
+    size knob changes (the shared_pool() pattern)."""
+    from kubeflow_tpu.platform.runtime.flight import FlightPool
+
+    global _scrape_pool
+    size = config.knob(
+        "KFT_FLEETSCRAPE_POOL_SIZE", 8, int,
+        doc="worker threads fanning out fleet scrape targets")
+    with _scrape_pool_lock:
+        if _scrape_pool is None or _scrape_pool.size != size:
+            _scrape_pool = FlightPool(size, name="fleetscrape")
+        return _scrape_pool
 
 
 def fetch_url(url: str, timeout: float = SCRAPE_TIMEOUT_S):
@@ -231,9 +256,7 @@ class FleetScraper:
             return stats
         pool = self._pool
         if pool is None:
-            from kubeflow_tpu.platform.runtime.flight import shared_pool
-
-            pool = self._pool = shared_pool()
+            pool = self._pool = scrape_pool()
         results = pool.run(
             [lambda t=t: self._scrape_one(t, ts) for t in targets],
             return_exceptions=True)
@@ -429,8 +452,10 @@ class MetricsPipeline:
                  scraper: Optional[Callable] = None,
                  engine=None, goodput=None, client=None,
                  informers: Optional[dict] = None,
-                 interval: Optional[float] = None, now=time.time):
+                 interval: Optional[float] = None,
+                 incidents=None, now=time.time):
         from kubeflow_tpu.telemetry import goodput as goodput_mod
+        from kubeflow_tpu.telemetry import incidents as incidents_mod
         from kubeflow_tpu.telemetry import slo
 
         self.tsdb = tsdb if tsdb is not None else default_tsdb()
@@ -439,6 +464,15 @@ class MetricsPipeline:
         self.engine = (engine if engine is not None
                        else slo.RuleEngine(self.tsdb, slo.default_rules(),
                                            client=client, now=now))
+        # The incident flight recorder rides the engine's firing
+        # transitions by default (pass ``incidents=False`` to run
+        # without one; a caller-built engine keeps its own recorder).
+        if incidents is None and self.engine.incidents is None:
+            incidents = incidents_mod.IncidentRecorder(
+                self.tsdb, client=client, now=now)
+        self.incidents = incidents or self.engine.incidents
+        if incidents:
+            self.engine.incidents = incidents
         self.goodput = (goodput if goodput is not None
                         else goodput_mod.GoodputAccountant(now=now))
         self.client = client

@@ -174,13 +174,18 @@ class RuleEngine:
     def __init__(self, tsdb: TSDB, rules: Optional[List[BurnRateRule]] = None,
                  *, recording: Optional[List[RecordingRule]] = None,
                  client=None, namespace: str = "kubeflow",
-                 component: str = "slo-engine", now=time.time):
+                 component: str = "slo-engine", incidents=None, now=time.time):
         self.tsdb = tsdb
         self.rules = list(default_rules() if rules is None else rules)
         self.recording = list(recording or [])
         self.client = client
         self.namespace = namespace
         self.component = component
+        # Optional flight recorder (telemetry/incidents.py): every
+        # transition TO firing captures one evidence bundle.  Kept as a
+        # plain attribute so tests and MetricsPipeline can attach one
+        # after construction.
+        self.incidents = incidents
         self.now = now
         self.states: Dict[str, AlertState] = {
             r.name: AlertState() for r in self.rules}
@@ -233,6 +238,16 @@ class RuleEngine:
                             "fast_burn": st.fast_burn,
                             "slow_burn": st.slow_burn})
         self._emit_event(rule, firing=(to_state == STATE_FIRING))
+        if to_state == STATE_FIRING and self.incidents is not None:
+            # Page-time evidence: the flight recorder snapshots the burn
+            # window, worst journeys, profile window and debug surfaces
+            # into one bundle (debounced per alert inside capture()).
+            # A capture failure must never break the alert state machine.
+            try:
+                self.incidents.capture(rule, st, at, engine=self)
+            except Exception:
+                log.debug("incident capture for %s failed", rule.name,
+                          exc_info=True)
 
     def _emit_event(self, rule: BurnRateRule, *, firing: bool) -> None:
         """One fleet-wide Event per transition, through the stamping

@@ -33,7 +33,7 @@ import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Tuple
 
-from kubeflow_tpu.telemetry import causal
+from kubeflow_tpu.telemetry import causal, profiler
 
 
 def filter_traces(traces: List[dict], *, n: Optional[int] = None,
@@ -155,9 +155,14 @@ class Tracer:
         traces never leak across units of work."""
         if not enabled:
             self._local.trace = None
+            profiler.clear_active_role()
             return None
         tr = Trace(component, name, self.keys)
         self._local.trace = tr
+        # The profiler's attribution seam: while this trace is active,
+        # samples of this thread fold under the traced component (the
+        # reconciling controller, the serving model, the train step).
+        profiler.set_active_role(component)
         return tr
 
     def current(self) -> Optional[Trace]:
@@ -170,6 +175,10 @@ class Tracer:
         thread's (list.append on the shared span list is atomic under
         the GIL)."""
         self._local.trace = tr
+        # Carry profile attribution with the trace: a slot sampled
+        # mid-flight folds under the SUBMITTING component's role, not
+        # the pool's; adopt(None) at slot exit restores the pool role.
+        profiler.set_active_role(tr.component if tr is not None else None)
 
     def active(self) -> bool:
         return getattr(self._local, "trace", None) is not None
@@ -201,11 +210,21 @@ class Tracer:
         if tr is None:
             return None
         self._local.trace = None
+        profiler.clear_active_role()
         tr.result = result
         d = tr.to_dict()
+        slow = (slow_seconds is not None
+                and d["duration_ms"] >= slow_seconds * 1e3)
+        if slow:
+            # Point the dump at the covering profile window: the "why"
+            # for this slow trace is the flamegraph that was already
+            # being collected while it ran (/debug/profile?window=N).
+            wid = profiler.covering_window_id()
+            if wid is not None:
+                d["profile_window"] = wid
         with self._lock:
             self._recent.append(d)
-        if slow_seconds is not None and d["duration_ms"] >= slow_seconds * 1e3:
+        if slow:
             self.log.warning(
                 "%s: %s", self.slow_message, json.dumps(d, sort_keys=True))
         return d

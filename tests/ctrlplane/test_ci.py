@@ -37,7 +37,7 @@ def test_presubmit_lane_list_is_pinned():
     assert presubmit == sorted([
         "notebook-controller", "resilience", "ha-shard", "bench-smoke",
         "tpujob", "inferenceservice", "lint", "journey", "slo",
-        "admission-webhook", "web-apps", "compute", "native",
+        "profile", "admission-webhook", "web-apps", "compute", "native",
         "notebook-images",
     ])
 
@@ -90,6 +90,22 @@ def test_slo_lane_registered_and_shaped():
                   "test_goodput.py"):
         assert piece in unit
     assert "test_autoscale.py" in " ".join(wf.steps[1].command)
+    assert wf.steps[1].depends == "unit"
+
+
+def test_profile_lane_registered_and_shaped():
+    """The profile lane (ISSUE 16): profiler + incident unit matrices
+    gate the debug-index coverage pin, triggered by telemetry and
+    control-plane runtime changes."""
+    assert "profile" in select(["kubeflow_tpu/telemetry/profiler.py"])
+    assert "profile" in select(
+        ["kubeflow_tpu/platform/runtime/flight.py"])
+    wf = WORKFLOWS["profile"]
+    assert [s.name for s in wf.steps] == ["unit", "observability"]
+    unit = " ".join(wf.steps[0].command)
+    for piece in ("test_profiler.py", "test_incidents.py"):
+        assert piece in unit
+    assert "test_observability.py" in " ".join(wf.steps[1].command)
     assert wf.steps[1].depends == "unit"
 
 

@@ -1,0 +1,272 @@
+"""The incident flight recorder (ISSUE 16): a firing burn-rate
+transition captures ONE bounded deterministic evidence bundle — the TSDB
+burn window, worst-object journeys, the covering profile window, live
+debug snapshots and knob state — debounced per alert, ring-bounded,
+announced fleet-wide by exactly one Event (the PR-15 dedup discipline),
+and served at /debug/incidents."""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from kubeflow_tpu.platform.k8s.types import EVENT, deep_get
+from kubeflow_tpu.platform import main as main_mod
+from kubeflow_tpu.platform.testing import FakeKube
+from kubeflow_tpu.telemetry import causal, incidents, profiler, slo
+from kubeflow_tpu.telemetry.tsdb import TSDB
+
+from .test_slo import TTFT_BUCKET, feed, rule
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()
+
+
+def _recorder(db, **kw):
+    kw.setdefault("client", None)
+    kw.setdefault("now", lambda: 10.0)
+    return incidents.IncidentRecorder(db, **kw)
+
+
+def _plant_journey(trace_id: str, *, at: float, duration_s: float):
+    causal.STORE.record(
+        "reconcile", trace_id=trace_id, segment="reconcile",
+        start_ts=at - duration_s, end_ts=at)
+
+
+def test_firing_transition_captures_one_bundle_with_evidence():
+    """The tentpole wiring: RuleEngine._transition(firing) →
+    recorder.capture() → one bundle whose sections carry the burn-window
+    TSDB export, the ranked worst journeys, the covering profile window,
+    the recorded series, live snapshots and knobs — plus the counter
+    bump and the announce Event."""
+    from kubeflow_tpu.platform.runtime import metrics
+
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    db = TSDB()
+    feed(db, at=5.0, good=0, total=0)
+    feed(db, at=10.0, good=0, total=50)
+    causal.STORE.clear()  # earlier tests' fake-clock spans must not rank
+    # Two journeys inside the burn window, one outside: the bundle keeps
+    # the in-window ones ranked worst-first.
+    _plant_journey("a" * 32, at=9.0, duration_s=2.0)
+    _plant_journey("b" * 32, at=9.5, duration_s=5.0)
+    _plant_journey("c" * 32, at=-4000.0, duration_s=9.0)  # aged out
+    prof = profiler.Profiler(now=lambda: 10.0)
+    prof.sample_once()
+    profiler.register_debug_profiler(prof)
+    rec = _recorder(db, client=kube, max_journeys=2)
+    eng = slo.RuleEngine(
+        db, [rule()],
+        recording=[slo.RecordingRule(record="ttft:p99", metric=TTFT_BUCKET,
+                                     q=0.5, window_s=100.0)],
+        client=kube, incidents=rec, now=lambda: 10.0)
+    try:
+        before = metrics.registry.get_sample_value(
+            "kft_incidents_captured_total", {"alert": "ttft"}) or 0.0
+        assert [t["state"] for t in eng.evaluate()] == ["firing"]
+        snap = rec.snapshot()
+        assert len(snap["incidents"]) == 1
+        manifest = snap["incidents"][0]
+        assert manifest["id"] == "ttft-10"
+        assert manifest["alert"] == "ttft"
+        assert manifest["state"] == "firing"
+        assert manifest["capturedAt"] == 10
+        assert manifest["profileWindow"] is not None
+        assert manifest["series"] >= 1 and manifest["journeys"] == 2
+
+        bundle = rec.get("ttft-10")
+        alert = bundle["alert"]
+        assert alert["metric"] == TTFT_BUCKET
+        assert alert["state"] == "firing" and alert["fastBurn"] is not None
+        # The TSDB export replays the rule's slow window: every sample
+        # timestamp lands inside [at - slow_window, at].
+        tsdb_sec = bundle["tsdb"]
+        assert tsdb_sec["metric"] == TTFT_BUCKET
+        assert tsdb_sec["start"] == 10.0 - 3600.0 and tsdb_sec["end"] == 10.0
+        assert tsdb_sec["series"]
+        for series in tsdb_sec["series"]:
+            assert series["samples"]
+            for ts, _v in series["samples"]:
+                assert tsdb_sec["start"] <= ts <= tsdb_sec["end"]
+        # Worst journeys, ranked by longest span; the aged-out trace is
+        # excluded even though its span was the longest ever recorded.
+        journeys = bundle["journeys"]
+        assert [j["trace_id"] for j in journeys] == ["b" * 32, "a" * 32]
+        assert journeys[0]["worst_span_ms"] == 5000.0
+        assert all(j["spans"] for j in journeys)
+        # Profile, recorded series, knobs, engine alert snapshot.
+        assert bundle["profile"]["window"] == prof.current_window_id()
+        assert isinstance(bundle["profile"]["folded"], str)
+        assert bundle["recorded"][0]["metric"] == "ttft:p99"
+        assert bundle["recorded"][0]["series"]
+        assert "KFT_INCIDENT_RING" in bundle["knobs"]
+        assert bundle["alerts"]["alerts"][0]["alert"] == "ttft"
+        assert sorted(manifest["sections"]) == manifest["sections"]
+        assert {"alert", "alerts", "journeys", "knobs", "profile",
+                "recorded", "tsdb"} <= set(manifest["sections"])
+
+        assert metrics.registry.get_sample_value(
+            "kft_incidents_captured_total", {"alert": "ttft"}) == before + 1
+        ev = kube.get(EVENT, "kft-incident-ttft", "kubeflow")
+        assert ev["reason"] == "IncidentCaptured"
+        assert ev["type"] == "Warning"
+        assert deep_get(ev, "involvedObject", "kind") == "FleetSLO"
+    finally:
+        profiler.register_debug_profiler(None)
+        causal.STORE.clear()
+
+
+def test_two_replicas_capture_one_event_and_equivalent_manifests():
+    """Determinism across the fleet (the PR-15 Event discipline): two
+    engines over the same scraped data, each with its OWN recorder, both
+    capture — but the stamped announce Event dedupes to ONE object, and
+    the two bundles' manifests are EQUAL (every field a deterministic
+    function of rule + transition time + shared state)."""
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    db = TSDB()
+    feed(db, at=5.0, good=0, total=0)
+    feed(db, at=10.0, good=0, total=50)
+    causal.STORE.clear()
+    _plant_journey("d" * 32, at=9.0, duration_s=1.0)
+    recorders = [_recorder(db, client=kube) for _ in range(2)]
+    engines = [slo.RuleEngine(db, [rule()], client=kube, incidents=r,
+                              now=lambda: 10.0)
+               for r in recorders]
+    try:
+        for eng in engines:
+            eng.evaluate()
+        events = [e for e in kube.list(EVENT, "kubeflow")
+                  if e.get("reason") == "IncidentCaptured"]
+        assert len(events) == 1, events
+        assert events[0]["metadata"]["name"] == "kft-incident-ttft"
+        manifests = [r.snapshot()["incidents"] for r in recorders]
+        assert len(manifests[0]) == len(manifests[1]) == 1
+        assert manifests[0][0] == manifests[1][0]
+    finally:
+        causal.STORE.clear()
+
+
+def test_debounce_and_ring_bound():
+    """A flapping alert must not churn the ring: captures of the same
+    alert inside the debounce window return None; the ring keeps only
+    the newest KFT_INCIDENT_RING bundles."""
+    db = TSDB()
+    feed(db, at=5.0, good=0, total=0)
+    feed(db, at=10.0, good=0, total=50)
+    rec = _recorder(db, debounce_s=100.0, ring=2)
+    r = rule()
+    st = slo.AlertState(state="firing", fast_burn=5.0, slow_burn=5.0)
+    assert rec.capture(r, st, at=10.0) is not None
+    assert rec.capture(r, st, at=50.0) is None  # debounced
+    # A DIFFERENT alert is not debounced by the first one's capture.
+    other = rule(name="ttft-other")
+    assert rec.capture(other, st, at=50.0) is not None
+    assert rec.capture(r, st, at=200.0) is not None
+    assert rec.capture(r, st, at=400.0) is not None
+    snap = rec.snapshot()
+    assert snap["ring"] == 2 and snap["debounceSeconds"] == 100.0
+    # Newest first; the ring evicted everything before the last two.
+    assert [m["id"] for m in snap["incidents"]] == ["ttft-400", "ttft-200"]
+    assert rec.get("ttft-10") is None
+    assert rec.get("ttft-400")["id"] == "ttft-400"
+
+
+def test_capture_failure_never_breaks_the_transition():
+    """The flight recorder is evidence, not control flow: a recorder
+    that raises must not stop the alert transition (or the Event)."""
+
+    class Boom:
+        def capture(self, *a, **kw):
+            raise RuntimeError("recorder exploded")
+
+    kube = FakeKube()
+    kube.add_namespace("kubeflow")
+    db = TSDB()
+    feed(db, at=5.0, good=0, total=0)
+    feed(db, at=10.0, good=0, total=50)
+    eng = slo.RuleEngine(db, [rule()], client=kube, incidents=Boom(),
+                         now=lambda: 10.0)
+    assert [t["state"] for t in eng.evaluate()] == ["firing"]
+    assert eng.states["ttft"].state == "firing"
+    assert kube.get(EVENT, "kft-alert-ttft", "kubeflow") is not None
+
+
+def test_extra_sections_ride_the_bundle():
+    """Entrypoint-wired sections (main.py adds "shards") land in the
+    bundle and its manifest; a section that raises degrades to None
+    instead of killing the capture."""
+    db = TSDB()
+    feed(db, at=5.0, good=0, total=0)
+    feed(db, at=10.0, good=0, total=50)
+    rec = _recorder(db)
+    rec.add_section("shards", lambda: {"identity": "r0", "owned": [0, 1]})
+    rec.add_section("broken", lambda: 1 / 0)
+    st = slo.AlertState(state="firing")
+    bundle = rec.capture(rule(), st, at=10.0)
+    assert bundle["shards"] == {"identity": "r0", "owned": [0, 1]}
+    assert bundle["broken"] is None
+    assert "shards" in bundle["manifest"]["sections"]
+    assert "broken" not in bundle["manifest"]["sections"]
+
+
+def test_debug_incidents_endpoints():
+    """/debug/incidents + /debug/incidents/<id>: 404 until a recorder
+    registers, then the manifest list and full bundles."""
+
+    class _Mgr:
+        def healthy(self):
+            return True
+
+    server = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.server_port}"
+    try:
+        for path in ("/debug/incidents", "/debug/incidents/ttft-10"):
+            try:
+                _get(base + path)
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            else:  # pragma: no cover
+                raise AssertionError(f"{path} served before registration")
+
+        db = TSDB()
+        feed(db, at=5.0, good=0, total=0)
+        feed(db, at=10.0, good=0, total=50)
+        rec = _recorder(db)
+        rec.capture(rule(), slo.AlertState(state="firing"), at=10.0)
+        incidents.register_debug_incidents(rec)
+        try:
+            listing = json.loads(_get(base + "/debug/incidents"))
+            assert [m["id"] for m in listing["incidents"]] == ["ttft-10"]
+            bundle = json.loads(_get(base + "/debug/incidents/ttft-10"))
+            assert bundle["id"] == "ttft-10"
+            assert bundle["tsdb"]["series"]
+            try:
+                _get(base + "/debug/incidents/no-such-id")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            else:  # pragma: no cover
+                raise AssertionError("unknown incident id served")
+        finally:
+            incidents.register_debug_incidents(None)
+    finally:
+        server.shutdown()
+
+
+def test_pipeline_wires_a_recorder_by_default():
+    """MetricsPipeline attaches an IncidentRecorder to its engine unless
+    the caller opts out with incidents=False — the entrypoint gets
+    flight recording without extra plumbing."""
+    from kubeflow_tpu.telemetry import fleetscrape as fs
+
+    pipe = fs.MetricsPipeline(tsdb=TSDB(), now=lambda: 100.0,
+                              interval=999.0)
+    assert isinstance(pipe.incidents, incidents.IncidentRecorder)
+    assert pipe.engine.incidents is pipe.incidents
+    off = fs.MetricsPipeline(tsdb=TSDB(), now=lambda: 100.0,
+                             interval=999.0, incidents=False)
+    assert off.incidents is None and off.engine.incidents is None

@@ -291,9 +291,12 @@ def test_debug_knobs_reports_unparseable_env_source():
 
 
 def test_debug_index_lists_live_surfaces():
-    """/debug/ (ISSUE 15 satellite): the health port indexes every live
-    debug surface with a one-line description, so the family is
-    discoverable without the docs open."""
+    """/debug/ (ISSUE 15 satellite, hardened by ISSUE 16): the health
+    port indexes every live debug surface with a one-line description,
+    so the family is discoverable without the docs open — and every
+    route actually wired in _serve_health must appear in the index, so
+    a future surface can't ship unlisted."""
+    import inspect
 
     class _Mgr:
         def healthy(self):
@@ -304,12 +307,30 @@ def test_debug_index_lists_live_surfaces():
         base = f"http://127.0.0.1:{server.server_port}"
         body = json.loads(_get(base + "/debug/"))
         index = body["debug"]
-        assert {"/debug/knobs", "/debug/queue", "/debug/shards",
-                "/debug/traces", "/debug/journey/<trace_id>",
-                "/debug/alerts", "/debug/goodput"} <= set(index)
+        assert set(index) == {
+            "/debug/knobs", "/debug/queue", "/debug/shards",
+            "/debug/traces", "/debug/journey/<trace_id>",
+            "/debug/alerts", "/debug/goodput", "/debug/profile",
+            "/debug/incidents"}
         assert all(isinstance(v, str) and v for v in index.values())
         # The bare path serves it too.
         assert json.loads(_get(base + "/debug"))["debug"] == index
+
+        # Source-derived coverage pin: every routed /debug path in
+        # _serve_health — exact matches and startswith prefixes — must
+        # be represented in the index.  Adding a route without an index
+        # entry fails HERE, not the first time an operator goes looking.
+        src = inspect.getsource(main_mod._serve_health)
+        exact = set(re.findall(r'path == "(/debug/[^"]+)"', src))
+        prefixes = set(re.findall(
+            r'path\.startswith\("(/debug/[^"]+/)"\)', src))
+        assert exact and prefixes  # the regexes still match the source
+        for path in exact:
+            assert path in index, f"routed {path} missing from /debug/ index"
+        for prefix in prefixes:
+            assert any(k == prefix.rstrip("/") or k.startswith(prefix)
+                       for k in index), (
+                f"routed prefix {prefix} missing from /debug/ index")
     finally:
         server.shutdown()
 

@@ -1,0 +1,411 @@
+"""The always-on sampling profiler (ISSUE 16): window rotation and the
+folded-stack export under a fake clock, the attribution resolution order
+(active Tracer role → static pool role → stripped thread name →
+unattributed), the FlightPool/fleetscrape attribution pins (satellite 3
+— a slot sample lands under the SUBMITTING controller's role, idle pool
+workers under the pool name), the /debug/profile surface, the scrape
+metrics, and the slow-dump profile-window reference."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from kubeflow_tpu.platform import main as main_mod
+from kubeflow_tpu.telemetry import trace as trace_mod
+from kubeflow_tpu.telemetry.profiler import (
+    ProfileWindow,
+    Profiler,
+    UNATTRIBUTED,
+    debug_profiler,
+    register_debug_profiler,
+    register_thread_role,
+    resolve_role,
+    set_active_role,
+)
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()
+
+
+def _spin(stop: threading.Event):
+    while not stop.wait(0.001):
+        sum(range(50))
+
+
+def _spawn_spinner(name=None):
+    stop = threading.Event()
+    t = threading.Thread(target=_spin, args=(stop,), name=name, daemon=True)
+    t.start()
+    return t, stop
+
+
+def _wait_for(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(0.01)
+    raise TimeoutError(what)
+
+
+def test_attribution_resolution_order():
+    """active (Tracer) → static (registered) → stripped thread name →
+    unattributed; interpreter default names mean nobody claimed the
+    thread."""
+    active = {7: "notebook-controller"}
+    static = {7: "controller", 8: "fleetscrape"}
+    assert resolve_role(7, "Thread-3", active, static) == "notebook-controller"
+    assert resolve_role(8, "Thread-4", active, static) == "fleetscrape"
+    # Name fallback strips trailing -N/_N counters so pool siblings fold.
+    assert resolve_role(9, "notebook-worker-3", active, static) == \
+        "notebook-worker"
+    assert resolve_role(9, "scrape_pool-0-1", active, static) == "scrape_pool"
+    assert resolve_role(9, "fleet-metrics-pipeline", active, static) == \
+        "fleet-metrics-pipeline"
+    # Interpreter defaults and empty names fold to unattributed.
+    assert resolve_role(9, "Thread-12", active, static) == UNATTRIBUTED
+    assert resolve_role(9, "Dummy-5", active, static) == UNATTRIBUTED
+    assert resolve_role(9, "", active, static) == UNATTRIBUTED
+
+
+def test_dead_threads_role_never_claims_a_recycled_ident():
+    """The OS recycles thread idents: a role registered by a thread that
+    has since died (a closed pool's worker, a Tracer user killed
+    mid-trace) must stop resolving the moment the thread does, or an
+    unrelated new thread inheriting the ident would silently sample
+    under the dead thread's role (real order-dependent failure: a prior
+    test's controller workers re-attributed this file's spinners)."""
+    done = threading.Event()
+
+    def _register_and_exit():
+        register_thread_role("doomed-pool")
+        set_active_role("doomed-controller")
+        done.set()
+
+    t = threading.Thread(target=_register_and_exit, daemon=True)
+    t.start()
+    assert done.wait(10.0)
+    t.join(timeout=10.0)
+    ident = t.ident
+    # Both seams registered by the now-dead thread fall through to the
+    # NAME seam for whoever owns that ident next.
+    assert resolve_role(ident, "fresh-worker-2") == "fresh-worker"
+    assert resolve_role(ident, "Thread-99") == UNATTRIBUTED
+
+
+def test_window_rotation_and_folded_format():
+    """Samples fold into (role, stack) counts; windows rotate on the
+    clock; the export is standard folded-stack text (root-first frames,
+    trailing count) fed straight to flamegraph tooling."""
+    clock = [0.0]
+    p = Profiler(hz=50.0, window_seconds=10.0, windows=3,
+                 now=lambda: clock[0])
+    t, stop = _spawn_spinner(name="probe-spinner")
+    try:
+        assert p.sample_once() >= 1
+        w1 = p.current_window_id()
+        folded = p.folded()
+        lines = [ln for ln in folded.splitlines()
+                 if ln.startswith("probe-spinner;")]
+        assert lines, folded
+        # role;root;...;leaf count — every line ends with an int count.
+        role, rest = lines[0].split(";", 1)
+        assert role == "probe-spinner"
+        assert int(rest.rsplit(" ", 1)[1]) >= 1
+        # Same window until the clock crosses the rotation period.
+        clock[0] = 9.0
+        p.sample_once()
+        assert p.current_window_id() == w1
+        clock[0] = 10.0
+        p.sample_once()
+        w2 = p.current_window_id()
+        assert w2 == w1 + 1
+        index = p.windows()
+        assert [w["window"] for w in index] == [w1, w2]
+        assert index[0]["end"] == 10.0 and index[1]["end"] is None
+        assert index[0]["samples"] >= 2
+        # Closed windows stay addressable until the ring evicts them.
+        assert "probe-spinner;" in p.folded(w1)
+        assert p.folded(w1 + 99) is None
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_stack_depth_truncation():
+    """Deep stacks keep the leaf-most frames and mark the cut at the
+    root, so one pathological recursion can't bloat every aggregate."""
+    p = Profiler(hz=50.0, window_seconds=60.0, stack_depth=1,
+                 now=lambda: 0.0)
+    t, stop = _spawn_spinner(name="deep-spinner")
+    try:
+        p.sample_once()
+        lines = [ln for ln in p.folded().splitlines()
+                 if ln.startswith("deep-spinner;")]
+        assert lines and all(
+            ln.split(";", 1)[1].startswith("<truncated>;") for ln in lines)
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_diff_is_signed_deltas_largest_regressions_first():
+    p = Profiler(now=lambda: 0.0)
+    w1 = ProfileWindow(1, 0.0)
+    w1.end = 10.0
+    w1.stacks = {("a", "x"): 5, ("a", "y"): 2, ("a", "same"): 3}
+    w2 = ProfileWindow(2, 10.0)
+    w2.end = 20.0
+    w2.stacks = {("a", "x"): 9, ("a", "z"): 3, ("a", "same"): 3}
+    p._ring.append(w1)
+    p._ring.append(w2)
+    # Signed w2-w1, sorted by largest growth; zero deltas dropped.
+    assert p.diff(1, 2).splitlines() == ["a;x +4", "a;z +3", "a;y -2"]
+    assert p.diff(2, 1).splitlines() == ["a;y +2", "a;z -3", "a;x -4"]
+    assert p.diff(1, 99) is None
+
+
+def test_tracer_seam_sets_and_clears_active_role():
+    """The shared Tracer is THE attribution seam: begin() points the
+    thread's samples at the traced component, finish() restores the
+    fallback — a default-named thread goes back to unattributed."""
+    p = Profiler(now=lambda: 0.0)
+    tracer = trace_mod.Tracer("probe-domain")
+    in_trace = threading.Event()
+    release = threading.Event()
+    cleared = threading.Event()
+    done = threading.Event()
+
+    def run():
+        tracer.begin("probe-component", "req")
+        in_trace.set()
+        release.wait(10)
+        tracer.finish()
+        cleared.set()
+        done.wait(10)
+
+    t = threading.Thread(target=run, daemon=True)  # default Thread-N name
+    t.start()
+    try:
+        assert in_trace.wait(10)
+        p.sample_once()
+        assert any(ln.startswith("probe-component;")
+                   for ln in p.folded().splitlines()), p.folded()
+        release.set()
+        assert cleared.wait(10)
+        p.rotate()
+        p.sample_once()
+        fresh = p.folded()
+        assert not any(ln.startswith("probe-component;")
+                       for ln in fresh.splitlines()), fresh
+    finally:
+        release.set()
+        done.set()
+        t.join()
+
+
+def test_flight_slot_sample_lands_under_submitting_controller():
+    """Satellite 3: a FlightPool worker claims the pool name at birth
+    (never Thread-N), and a slot carrying a submitted reconcile's trace
+    attributes to the SUBMITTING controller's role for the duration of
+    the carry."""
+    from kubeflow_tpu.platform.runtime import trace as rt_trace
+    from kubeflow_tpu.platform.runtime.flight import FlightPool
+
+    p = Profiler(now=lambda: 0.0)
+    pool = FlightPool(2, name="probe-pool")
+    inside = threading.Event()
+    release = threading.Event()
+
+    def blocking_slot():
+        inside.set()
+        release.wait(10)
+
+    def submit():
+        rt_trace.begin("probe-flight-ctrl", "user1/nb")
+        try:
+            # Two calls: a single call short-circuits to inline execution.
+            pool.run([blocking_slot, lambda: None])
+        finally:
+            rt_trace.finish()
+
+    submitter = threading.Thread(target=submit, daemon=True)
+    submitter.start()
+    try:
+        assert inside.wait(10)
+        p.sample_once()
+        slot_lines = [ln for ln in p.folded().splitlines()
+                      if ln.startswith("probe-flight-ctrl;")]
+        assert slot_lines, p.folded()
+        assert any("blocking_slot" in ln for ln in slot_lines), slot_lines
+    finally:
+        release.set()
+        submitter.join()
+    # After the carry ends the idle worker samples under its static pool
+    # role — the attribution hole this PR closes (Thread-N no more).
+    p.rotate()
+    p.sample_once()
+    folded = p.folded()
+    assert any(ln.startswith("probe-pool;") for ln in folded.splitlines()), \
+        folded
+    assert not any(ln.startswith("probe-flight-ctrl;")
+                   for ln in folded.splitlines()), folded
+
+
+def test_fleetscrape_pool_has_stable_role():
+    """Satellite 3, second half: the fleetscrape fan-out runs on its own
+    named pool, so scrape I/O shows up in profiles as ``fleetscrape``."""
+    from kubeflow_tpu.telemetry import fleetscrape
+
+    pool = fleetscrape.scrape_pool()
+    assert pool.name == "fleetscrape"
+    assert fleetscrape.scrape_pool() is pool  # stable singleton
+    p = Profiler(now=lambda: 0.0)
+    pool.run([lambda: None, lambda: None])  # spawn + idle the workers
+    p.sample_once()
+    folded = p.folded()
+    assert any(ln.startswith("fleetscrape;")
+               for ln in folded.splitlines()), folded
+
+
+def test_sampler_thread_runs_and_stops():
+    """The real sampler thread fills the open window at hz without any
+    caller driving it, and stop() joins it."""
+    p = Profiler(hz=200.0, window_seconds=60.0)
+    t, stop = _spawn_spinner(name="live-spinner")
+    p.start()
+    try:
+        _wait_for(lambda: p.windows() and p.windows()[-1]["samples"] > 0,
+                  what="sampler samples")
+        assert p.errors == 0
+    finally:
+        stop.set()
+        t.join()
+        p.stop()
+    assert p._thread is None
+
+
+def test_profile_counters_and_self_time_gauge_ride_scrape():
+    """kft_profile_samples_total{role} counts every folded sample;
+    kft_profile_self_seconds reads the REGISTERED profiler's open window
+    at scrape time and disappears on deregistration."""
+    from kubeflow_tpu.platform.runtime import metrics
+
+    p = Profiler(hz=50.0, now=lambda: 0.0)
+    t, stop = _spawn_spinner(name="gauge-spinner")
+    try:
+        before = metrics.registry.get_sample_value(
+            "kft_profile_samples_total", {"role": "gauge-spinner"}) or 0.0
+        p.sample_once()
+        after = metrics.registry.get_sample_value(
+            "kft_profile_samples_total", {"role": "gauge-spinner"})
+        assert after == before + 1.0
+        register_debug_profiler(p)
+        try:
+            val = metrics.registry.get_sample_value(
+                "kft_profile_self_seconds", {"role": "gauge-spinner"})
+            # samples / hz over the open window.
+            assert val == 1.0 / 50.0
+        finally:
+            register_debug_profiler(None)
+        assert metrics.registry.get_sample_value(
+            "kft_profile_self_seconds", {"role": "gauge-spinner"}) is None
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_slow_trace_dump_references_covering_profile_window():
+    """A slow trace's JSON dump carries the covering profile window id —
+    the flamegraph for "why was this slow" was already being collected
+    while it ran."""
+    p = Profiler(now=lambda: 0.0)
+    tracer = trace_mod.Tracer("probe-slow")
+    register_debug_profiler(p)
+    try:
+        tracer.begin("probe-slow-comp", "req")
+        d = tracer.finish(result="ok", slow_seconds=0.0)
+        assert d["profile_window"] == p.current_window_id()
+    finally:
+        register_debug_profiler(None)
+    tracer.begin("probe-slow-comp", "req")
+    d = tracer.finish(result="ok", slow_seconds=0.0)
+    assert "profile_window" not in d  # no profiler, no dangling reference
+
+
+def test_debug_profile_endpoint():
+    """/debug/profile: 404 until a profiler registers, folded text by
+    default, ?list=1 window index, ?window= one window, ?diff= signed
+    deltas, ?seconds= synchronous capture; DEBUG_TRACES=false turns the
+    whole surface off (stacks reveal more than /metrics)."""
+
+    class _Mgr:
+        def healthy(self):
+            return True
+
+    server = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.server_port}"
+    t, stop = _spawn_spinner(name="http-spinner")
+    try:
+        try:
+            _get(base + "/debug/profile")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:  # pragma: no cover
+            raise AssertionError("served before registration")
+
+        p = Profiler(hz=50.0, now=time.time)
+        p.sample_once()
+        wid = p.current_window_id()
+        register_debug_profiler(p)
+        try:
+            assert debug_profiler() is p
+            text = _get(base + "/debug/profile").decode()
+            assert any(ln.startswith("http-spinner;")
+                       for ln in text.splitlines()), text
+            index = json.loads(_get(base + "/debug/profile?list=1"))
+            assert index["hz"] == 50.0 and index["errors"] == 0
+            assert [w["window"] for w in index["windows"]] == [wid]
+            assert _get(base + f"/debug/profile?window={wid}").decode() \
+                == text
+            # Same window against itself: every delta is zero → empty.
+            assert _get(base + f"/debug/profile?diff={wid},{wid}") == b""
+            # On-demand capture samples immediately at seconds=0.
+            cap = _get(base + "/debug/profile?seconds=0").decode()
+            assert any(ln.startswith("http-spinner;")
+                       for ln in cap.splitlines()), cap
+            for bad in ("?window=999", "?diff=1,999", "?window=nope"):
+                try:
+                    _get(base + "/debug/profile" + bad)
+                except urllib.error.HTTPError as e:
+                    assert e.code == 404, bad
+                else:  # pragma: no cover
+                    raise AssertionError(f"{bad} served a missing window")
+        finally:
+            register_debug_profiler(None)
+    finally:
+        stop.set()
+        t.join()
+        server.shutdown()
+
+    # The gate: stacks are off with the traces endpoint.
+    gated = main_mod._serve_health(_Mgr(), 0, host="127.0.0.1",
+                                   debug_traces=False)
+    p = Profiler(now=lambda: 0.0)
+    register_debug_profiler(p)
+    try:
+        try:
+            _get(f"http://127.0.0.1:{gated.server_port}/debug/profile")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:  # pragma: no cover
+            raise AssertionError("gate off but profile served")
+    finally:
+        register_debug_profiler(None)
+        gated.shutdown()

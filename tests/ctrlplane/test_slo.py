@@ -162,10 +162,19 @@ def test_planted_ttft_degradation_fires_once_across_sharded_fleet():
     evaluate the serve-TTFT burn rule.  A planted TTFT degradation
     fires the fast-window alert within ONE evaluation cadence, emits
     exactly one Event fleet-wide, survives a replica kill, and clears
-    on recovery."""
+    on recovery.
+
+    Extended by ISSUE 16: the page also auto-captures evidence — each
+    replica's flight recorder snapshots exactly ONE incident bundle at
+    its firing transition (debounce holds it at one), the bundle's
+    manifest carries a live profile window, the TTFT burn-window TSDB
+    export, and at least one merged journey, and the capture announce
+    dedupes to ONE fleet-wide Event."""
     from kubeflow_tpu.platform.controllers import inferenceservice as svcctrl
     from kubeflow_tpu.platform.testing.servesim import InferenceFleetSim
     from kubeflow_tpu.platform.testing.shardfleet import ShardedFleet
+    from kubeflow_tpu.telemetry import incidents as incidents_mod
+    from kubeflow_tpu.telemetry import profiler as profiler_mod
 
     state = {"degraded": False, "requests": 0.0}
 
@@ -198,6 +207,16 @@ def test_planted_ttft_degradation_fires_once_across_sharded_fleet():
     engines = [slo.RuleEngine(db, [ttft_rule], client=r.chaos,
                               namespace="kubeflow")
                for r in fleet.replicas]
+    # The always-on profiler samples the REAL storm; each replica's
+    # engine carries its own flight recorder, as in production.
+    prof = profiler_mod.Profiler()
+    prof.start()
+    profiler_mod.register_debug_profiler(prof)
+    recorders = []
+    for eng in engines:
+        eng.incidents = incidents_mod.IncidentRecorder(
+            db, client=eng.client, namespace="kubeflow")
+        recorders.append(eng.incidents)
     try:
         fleet.kube.create({
             "apiVersion": "kubeflow.org/v1alpha1",
@@ -247,6 +266,39 @@ def test_planted_ttft_degradation_fires_once_across_sharded_fleet():
                   if e["metadata"]["name"] == "kft-alert-serve-ttft-p99"]
         assert len(events) == 1 and events[0]["reason"] == "AlertFiring"
 
+        # ISSUE 16 acceptance: the page carried its evidence.  Drive
+        # both engines to their firing transition (each captures on its
+        # OWN transition), then: exactly one bundle per recorder, each
+        # manifest holding a profile window + the TTFT burn-window TSDB
+        # export + at least one journey — and ONE announce Event
+        # fleet-wide despite two captures.
+        deadline = time.monotonic() + 10.0
+        while (time.monotonic() < deadline
+               and not all(e.states["serve-ttft-p99"].state == "firing"
+                           for e in engines)):
+            for eng in engines:
+                eng.evaluate()
+            time.sleep(0.05)
+        assert all(e.states["serve-ttft-p99"].state == "firing"
+                   for e in engines)
+        incident_events = [e for e in fleet.kube.list(EVENT, "kubeflow")
+                           if e.get("reason") == "IncidentCaptured"]
+        assert len(incident_events) == 1, incident_events
+        assert incident_events[0]["metadata"]["name"] == \
+            "kft-incident-serve-ttft-p99"
+        for rec in recorders:
+            bundles = rec.snapshot()["incidents"]
+            assert len(bundles) == 1, bundles
+            manifest = bundles[0]
+            assert manifest["alert"] == "serve-ttft-p99"
+            assert manifest["profileWindow"] is not None
+            assert manifest["series"] >= 1
+            assert manifest["journeys"] >= 1
+            bundle = rec.get(manifest["id"])
+            assert bundle["tsdb"]["metric"] == TTFT_BUCKET
+            assert bundle["profile"]["folded"]
+            assert bundle["journeys"][0]["spans"]
+
         # Replica 0 dies mid-incident: the survivor keeps evaluating and
         # the Event set stays at exactly one.
         fleet.kill(0)
@@ -269,5 +321,7 @@ def test_planted_ttft_degradation_fires_once_across_sharded_fleet():
         ev = fleet.kube.get(EVENT, "kft-alert-serve-ttft-p99", "kubeflow")
         assert ev["reason"] == "AlertResolved"
     finally:
+        profiler_mod.register_debug_profiler(None)
+        prof.stop()
         sim.close()
         fleet.close()
