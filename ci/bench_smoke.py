@@ -6,13 +6,16 @@ load-bearing keys are present:
 
 * ``bench_scale.py`` (control plane, tiny N): the parallel-dispatch keys
   (``ctrlplane_wave_converge_workers`` / ``ctrlplane_wire_converge_s``);
-* ``bench.py --sections llama8k,serve`` (compute plane,
+* ``bench.py --sections llama8k,serve,serve_paged`` (compute plane,
   KFT_BENCH_SMOKE=1): the telemetry-derived keys (``step_p50_s``/
   ``step_p99_s`` from the shared step histogram, the ``hbm_peak_bytes``
   key — null on CPU — and the ``attention_mask_bytes_estimate`` line
   the XLA arm's pre-flight estimator publishes), plus the
   continuous-batching ``serve`` A/B line (scheduler vs lock-serialized
-  tokens/s, speedup band, p99 TTFT/latency keys).
+  tokens/s, speedup band, p99 TTFT/latency keys) and the paged-KV
+  ``serve_paged`` A/B line (paged vs fixed-slot pool at equal KV
+  memory, speedup band, prefix-hit ratio — which must be positive, and
+  the paged arm must beat the fixed arm outright).
 
 A refactor that renames a metric, breaks a band field, or silently
 unhooks the telemetry wiring fails CI here instead of being discovered
@@ -89,7 +92,8 @@ def check_compute_bench() -> int:
     and the continuous-batching A/B line."""
     env = dict(os.environ, KFT_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
     proc = subprocess.run(
-        [sys.executable, "bench.py", "--sections", "llama8k,serve"],
+        [sys.executable, "bench.py", "--sections",
+         "llama8k,serve,serve_paged"],
         capture_output=True, text=True, timeout=560, env=env,
     )
     seen = _parse_json_lines(proc.stdout, "bench")
@@ -165,6 +169,43 @@ def check_compute_bench() -> int:
             print(f"serve line missing key {key}: {serve}",
                   file=sys.stderr)
             return 1
+    # Paged-KV serve section (ISSUE 17): the A/B line must parse with
+    # both arms' throughput, the speedup band self-report (floor 1.5,
+    # asserted by the banded full run), and the prefix-cache proof.
+    # Two VALUE assertions ride even at smoke size because they test
+    # mechanism, not hardware: the prefix cache must actually hit on a
+    # shared-system-prompt workload, and the paged arm must BEAT the
+    # fixed-slot arm outright — a paged pool that loses to the pool it
+    # replaced is a routing/implementation regression at any N.
+    paged = seen.get("serve_paged_tokens_per_sec")
+    if paged is None:
+        print(f"bench smoke missing the serve_paged line: {sorted(seen)}",
+              file=sys.stderr)
+        return 1
+    for key in ("value", "fixed_tokens_per_sec", "speedup_vs_fixed",
+                "band_floor", "prefix_hit_ratio", "latency_p99_s",
+                "fixed_latency_p99_s"):
+        if not isinstance(paged.get(key), (int, float)):
+            print(f"serve_paged line missing key {key}: {paged}",
+                  file=sys.stderr)
+            return 1
+    if paged.get("band") not in ("pass", "REGRESSION"):
+        print(f"serve_paged line band invalid: {paged.get('band')!r}",
+              file=sys.stderr)
+        return 1
+    for key in ("ttft_p99_s", "fixed_ttft_p99_s"):
+        if key not in paged:  # null only on an empty histogram
+            print(f"serve_paged line missing key {key}: {paged}",
+                  file=sys.stderr)
+            return 1
+    if not paged["prefix_hit_ratio"] > 0:
+        print(f"prefix cache never hit on a shared-prefix workload: "
+              f"{paged}", file=sys.stderr)
+        return 1
+    if not paged["speedup_vs_fixed"] > 1.0:
+        print(f"paged arm lost to the fixed-slot arm: {paged}",
+              file=sys.stderr)
+        return 1
     print(f"bench-smoke compute OK: {len(seen)} metrics "
           f"({', '.join(sorted(seen))})")
     return 0

@@ -235,13 +235,39 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
-    # Serve-soak lane (ISSUE 8, postsubmit): concurrent clients hammer
-    # the werkzeug generation app over a real socket for a bounded
-    # wall-clock, asserting the continuous-batching invariants — no
-    # dropped requests, no cross-request row mixing (greedy
-    # determinism), telemetry counters balance (admitted == evicted +
-    # in-flight) — plus the fast scheduler token-equality matrix as the
-    # gate in front of it.
+    # Serve presubmit lane (ISSUE 17): the paged-KV/spec-decode fast
+    # matrix on every serve-path change — paged == contiguous ==
+    # sequential token equality (greedy + seeded sampling), shared-prefix
+    # copy-on-write divergence, chunked-prefill interleave with
+    # mid-flight eviction, the speculative accept/reject boundaries, the
+    # KFT_SERVE_PAGED=0 fallback pin, and the strict knob validation —
+    # plus the serve-registry page/prefix/spec counter balance pins.
+    name="serve",
+    include_dirs=[
+        "kubeflow_tpu/models/*", "kubeflow_tpu/telemetry/*",
+        "kubeflow_tpu/ops/*", "kubeflow_tpu/platform/config.py",
+        "releasing/*",
+    ],
+    steps=[
+        Step("engine-matrix", _pytest("tests/test_scheduler.py")
+             + ["-m", "not slow"]),
+        Step("serve-metrics", _pytest("tests/test_telemetry.py")
+             + ["-k", "serve_kv or serve_spec"], depends="engine-matrix"),
+    ],
+))
+
+_register(ComponentWorkflow(
+    # Serve-soak lane (ISSUE 8, postsubmit; extended by ISSUE 17):
+    # concurrent clients hammer the werkzeug generation app over a real
+    # socket for a bounded wall-clock, asserting the continuous-batching
+    # invariants — no dropped requests, no cross-request row mixing
+    # (greedy determinism), telemetry counters balance (admitted ==
+    # evicted + in-flight) — plus the paged-pool soak: a shared-prefix
+    # hammer against the paged engine pinning zero cross-request page
+    # aliasing outside the declared shared prefix, prefix hits accruing,
+    # and the page ledger draining balanced (free + shared ==
+    # pages_total - 1, active == 0).  The fast token-equality matrix
+    # gates both soaks.
     name="serve-soak",
     include_dirs=[
         "kubeflow_tpu/models/*", "kubeflow_tpu/telemetry/*",

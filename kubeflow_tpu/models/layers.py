@@ -5,10 +5,34 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import flax.struct
 import jax
 import jax.numpy as jnp
 
 from kubeflow_tpu import ops
+
+
+@flax.struct.dataclass
+class PagedSlots:
+    """Per-row paged-KV addressing for ``Attention._update_cache``.
+
+    The paged pool (models/paged.py) stores every row's K/V in one flat
+    pooled tensor of ``pool_positions`` slots (``num_pages × page_len``);
+    a row's logical cache positions map to physical slots through its
+    page table.  The caller resolves that mapping to FLAT indices:
+
+      write  [b, s] int32 — physical slot for each incoming token
+      read   [b, L] int32 — physical slot for each of the row's L
+                            logical positions (unallocated logical pages
+                            point at the reserved null page, which the
+                            caller's mask_bias hides)
+
+    ``pool_positions`` is static metadata (the pooled tensors' leading
+    dim), so one compiled graph serves one pool geometry."""
+
+    write: jax.Array
+    read: jax.Array
+    pool_positions: int = flax.struct.field(pytree_node=False, default=0)
 
 
 class Embed(nn.Module):
@@ -94,7 +118,13 @@ class Attention(nn.Module):
         cache index — the continuous-batching slot pool, where rows sit
         at different depths of their generations.  In that mode the
         built-in causal bias is skipped entirely: ``mask_bias`` must
-        carry the full per-row visibility mask."""
+        carry the full per-row visibility mask.
+
+        ``cache_slots`` may also be a ``PagedSlots``: the block-paged
+        pool (models/paged.py), where K/V live in ONE flat pooled tensor
+        and per-row page tables resolve logical positions to physical
+        slots.  Multi-token calls are allowed there (chunked prefill /
+        speculative verify); the mask_bias contract is the same."""
         b, s, dim = x.shape
         kv_heads = self.num_kv_heads or self.num_heads
         head_dim = self.head_dim or dim // self.num_heads
@@ -234,6 +264,34 @@ class Attention(nn.Module):
         b, s, kv_heads, head_dim = k.shape
         if max_decode_len is None:
             raise ValueError("decode=True requires max_decode_len")
+        if isinstance(slots, PagedSlots):
+            # Block-paged pool: K/V for EVERY row live in one flat
+            # [pool_positions, kv_h, d] tensor — a row's footprint is the
+            # pages its table maps, not a longest-bucket slot.  The
+            # classic per-batch cache variables are deliberately NOT
+            # created on this path (they would allocate the full
+            # fixed-slot pool the paged design exists to avoid).
+            # Scatter collisions only happen on the reserved null page
+            # (masked trash), so last-writer-wins is harmless.
+            pool = slots.pool_positions
+            paged_k = self.variable(
+                "cache", "paged_key",
+                lambda: jnp.zeros((pool, kv_heads, head_dim), k.dtype),
+            )
+            paged_v = self.variable(
+                "cache", "paged_value",
+                lambda: jnp.zeros((pool, kv_heads, head_dim), v.dtype),
+            )
+            k_pool = paged_k.value.at[slots.write].set(k)
+            v_pool = paged_v.value.at[slots.write].set(v)
+            paged_k.value = k_pool
+            paged_v.value = v_pool
+            # Gather preserves logical order, so a row's [L] view is
+            # byte-for-byte the contiguous layout the sequential decode
+            # would have used; unallocated logical pages read the null
+            # page, which the caller's mask_bias turns into exact-zero
+            # attention contributions.
+            return k_pool[slots.read], v_pool[slots.read], None
         cached_k = self.variable(
             "cache", "cached_key",
             lambda: jnp.zeros((b, max_decode_len, kv_heads, head_dim), k.dtype),

@@ -1,0 +1,1122 @@
+"""Block-paged KV serving: paged pool + prefix reuse + chunked prefill +
+speculative decoding — the PagedAttention/vLLM design grafted onto the
+continuous-batching scheduler (models/scheduler.py).
+
+The fixed-slot pool buckets every row to ``slot_len`` cache positions, so
+mixed prompt lengths pay the longest-bucket tax in HBM.  Here K/V live in
+ONE flat pooled tensor of ``num_pages × page_len`` physical positions per
+layer; a row holds exactly ``ceil(len/page_len)`` pages, resolved through
+its page table into the flat write/read indices
+``layers.PagedSlots`` carries into ``Attention._update_cache``.
+
+    physical pool   [num_pages * page_len, kv_h, d]   (page 0 = null/trash)
+    page table      row -> [p3, p7, p1, ...]          (logical page j -> physical)
+    read indices    row -> flat positions for all L logical slots
+                    (unallocated logical pages point at the null page,
+                    which the per-row visibility bias masks to exact zeros)
+
+Three exploits ride on the pages:
+
+* **Prefix sharing** — page-sized chunks of the raw prompt hash into a
+  trie (``PrefixCache``); identical prefixes map to the SAME read-only
+  physical pages, prefilled once.  Copy-on-write is by construction:
+  shared pages are never written after insertion (decode writes start at
+  the padded prompt length, past every fully-real prompt page), so
+  divergence lands in the row's own fresh pages.
+* **Chunked prefill** — the un-shared prompt suffix prefills in
+  ``KFT_SERVE_PREFILL_CHUNK``-token chunks interleaved with decode
+  quanta, so a long admission never stalls the pool.
+* **Speculative decoding** — a small draft model (same vocab) proposes
+  ``KFT_SERVE_SPEC_TOKENS`` greedy tokens per step from its own paged
+  pool (same page-table geometry, lockstep pointers); ONE target pass
+  over [current, d_1..d_k] verifies them.  Greedy acceptance emits the
+  longest prefix where d_i == argmax(target logits) plus the bonus
+  token, which is provably the exact target-greedy stream — a rejected
+  draft still yields one correct token.  Spec steps run only while every
+  live row is greedy (temperature 0); sampled rows fall back to the
+  normal quantum, which is always token-correct.
+
+Token equality vs the sequential path is byte-for-byte (greedy and
+seeded sampling): gathers preserve logical order, masked positions
+contribute exact zeros (the -1e30 bias underflows exp to 0.0), and the
+first-token sampling replays ``generate._prefill_parts``' rng recipe op
+for op.  Pinned by tests/test_scheduler.py's paged matrix.
+
+``KFT_SERVE_PAGED=0`` (or a mesh) falls back to the fixed-slot
+DecodeScheduler unchanged; this module is single-host (the paged pool is
+not mesh-sharded yet — see docs/serving.md "Paged KV and prefix reuse").
+"""
+from __future__ import annotations
+
+import functools
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.models.layers import PagedSlots
+from kubeflow_tpu.models.scheduler import (
+    DecodeScheduler,
+    PendingRequest,
+    _NEG_INF,
+    _Slot,
+)
+from kubeflow_tpu.platform import config
+
+
+def _read_indices(page_rows: jax.Array, *, page_len: int) -> jax.Array:
+    """[W, M] physical page ids -> [W, M*page_len] flat pool positions."""
+    W = page_rows.shape[0]
+    return (page_rows[:, :, None] * page_len
+            + jnp.arange(page_len)[None, None, :]).reshape(W, -1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "lanes", "slot_len", "pool_positions"),
+)
+def _init_paged_pool(model, params, *, lanes, slot_len, pool_positions):
+    """Build the flat paged cache pytree by running one (discarded)
+    paged decode step — the flax ``paged_key``/``paged_value`` variables
+    initialize to zeros at [pool_positions, kv_h, d] per layer.  All the
+    step's writes land on the null page (trash by definition)."""
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    p = dequantize_params(params)
+    ps = PagedSlots(
+        write=jnp.zeros((lanes, 1), jnp.int32),
+        read=jnp.zeros((lanes, slot_len), jnp.int32),
+        pool_positions=pool_positions,
+    )
+    _, state = model.apply(
+        {"params": p}, jnp.zeros((lanes, 1), jnp.int32),
+        positions=jnp.zeros((lanes, 1), jnp.int32),
+        decode=True, cache_len=slot_len,
+        mask_bias=jnp.zeros((lanes, 1, 1, slot_len), jnp.float32),
+        cache_slots=ps, mutable=["cache"],
+    )
+    return state["cache"]
+
+
+@functools.partial(jax.jit, static_argnames=("model",), donate_argnums=(1,))
+def _prefill_chunk(model, cache, params, tokens, positions, paged_slots,
+                   chunk_start, pad_rows, lengths, last_logits):
+    """One chunked-prefill pass: ``tokens`` [b, c] land at logical slots
+    [chunk_start, chunk_start + c) of each row's paged region.  Returns
+    ``(cache, last_logits)`` where row i's last-valid-token logits are
+    captured when slot ``lengths[i] - 1`` falls inside this chunk.
+
+    The bias is causal-by-logical-slot + the row's prompt-padding holes
+    — the same effective mask ``generate._prefill_parts`` applies (its
+    built-in causal bias + pad_bias), so the chunk-at-a-time logits
+    equal the one-pass prefill's bit for bit."""
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    b, c = tokens.shape
+    L = pad_rows.shape[-1]
+    k_pos = jnp.arange(L)
+    q_slots = chunk_start + jnp.arange(c)                   # [c]
+    allowed = k_pos[None, :] <= q_slots[:, None]            # [c, L]
+    bias = (jnp.where(allowed, 0.0, _NEG_INF)[None, :, :]
+            + pad_rows[:, None, :])[:, None]                # [b, 1, c, L]
+    logits, state = model.apply(
+        {"params": params, "cache": cache}, tokens,
+        positions=positions, decode=True, mask_bias=bias,
+        cache_len=L, cache_slots=paged_slots, mutable=["cache"],
+    )
+    last_idx = (lengths - 1) - chunk_start                  # [b]
+    in_chunk = (last_idx >= 0) & (last_idx < c)
+    idx = jnp.clip(last_idx, 0, c - 1)
+    picked = jnp.take_along_axis(
+        logits, jnp.broadcast_to(idx[:, None, None],
+                                 (b, 1, logits.shape[-1])), axis=1)[:, 0]
+    last_logits = jnp.where(in_chunk[:, None], picked, last_logits)
+    return state["cache"], last_logits
+
+
+@functools.partial(jax.jit, static_argnames=("sampled",))
+def _sample_first(last_logits, rng, temps, top_ks, eos_ids, has_eos, *,
+                  sampled):
+    """First-token sampling from accumulated last-valid logits — op for
+    op the tail of ``generate._prefill_parts`` (split(rng, b) → per-row
+    split → sample_logits_rows), so the paged first token is
+    byte-identical to the sequential path's."""
+    from kubeflow_tpu.models.generate import sample_logits_rows
+
+    b = last_logits.shape[0]
+    row_rngs = jax.random.split(rng, b)
+    split2 = jax.vmap(jax.random.split)(row_rngs)
+    row_rngs, subs = split2[:, 0], split2[:, 1]
+    first = sample_logits_rows(last_logits, subs, temps=temps,
+                               top_ks=top_ks, sampled=sampled)
+    done0 = has_eos & (first == eos_ids)
+    return first, row_rngs, done0
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "quantum", "sampled", "page_len",
+                     "pool_positions"),
+    donate_argnums=(1,),
+)
+def _paged_pool_steps(model, cache, params, token, pos, write, rngs, done,
+                      pad_rows, page_rows, temps, top_ks, eos_ids, has_eos,
+                      *, quantum, sampled, page_len, pool_positions):
+    """``quantum`` decode steps over the paged pool — the exact
+    ``scheduler._pool_steps`` body with the per-row write index resolved
+    through the page table into flat pool positions.  Vacated lanes keep
+    stepping as zombies; the host zeroes their page-table rows at
+    eviction, so zombie writes land on the null page and can never
+    corrupt a reallocated page."""
+    from kubeflow_tpu.models.generate import decode_step
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    W, L = pad_rows.shape
+    k_pos = jnp.arange(L)
+    rows = jnp.arange(W)
+    read = _read_indices(page_rows, page_len=page_len)
+
+    def step(carry, _):
+        cache, token, pos, write, rngs, done = carry
+        slots = jnp.minimum(write, L - 1)
+        flat_w = (page_rows[rows, slots // page_len] * page_len
+                  + slots % page_len)
+        allowed = k_pos[None, :] <= slots[:, None]
+        bias = (jnp.where(allowed, 0.0, _NEG_INF)[:, None, None, :]
+                + pad_rows[:, None, None, :])
+        ps = PagedSlots(write=flat_w[:, None], read=read,
+                        pool_positions=pool_positions)
+        cache, nxt, pos, rngs, done = decode_step(
+            model, params, cache, token, pos, rngs, done, bias,
+            cache_len=L, temps=temps, top_ks=top_ks, eos_ids=eos_ids,
+            has_eos=has_eos, sampled=sampled, cache_slots=ps,
+        )
+        return (cache, nxt, pos, write + 1, rngs, done), (nxt, done)
+
+    carry = (cache, token, pos, write, rngs, done)
+    (cache, token, pos, write, rngs, done), (toks, dones) = jax.lax.scan(
+        step, carry, None, length=quantum)
+    return cache, token, pos, write, rngs, done, toks, dones
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "k", "page_len", "pool_positions"),
+    donate_argnums=(1,),
+)
+def _draft_propose(model, cache, params, token, pos, write, pad_rows,
+                   page_rows, *, k, page_len, pool_positions):
+    """k+1 greedy draft steps from the draft's paged pool: steps 1..k
+    propose d_1..d_k; the extra (k+1)-th step's proposal is discarded —
+    it exists so the draft cache covers slot write+k and stays hole-free
+    when the target accepts all k (the next spec step would otherwise
+    attend a never-written slot).  Rejected-tail writes go stale but are
+    overwritten by the very step that next reaches their slot, before
+    any query can see them."""
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    W, L = pad_rows.shape
+    k_pos = jnp.arange(L)
+    rows = jnp.arange(W)
+    read = _read_indices(page_rows, page_len=page_len)
+
+    def step(carry, _):
+        cache, tok, pos, write = carry
+        slots = jnp.minimum(write, L - 1)
+        flat_w = (page_rows[rows, slots // page_len] * page_len
+                  + slots % page_len)
+        allowed = k_pos[None, :] <= slots[:, None]
+        bias = (jnp.where(allowed, 0.0, _NEG_INF)[:, None, None, :]
+                + pad_rows[:, None, None, :])
+        ps = PagedSlots(write=flat_w[:, None], read=read,
+                        pool_positions=pool_positions)
+        logits, state = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            positions=pos[:, None], decode=True, mask_bias=bias,
+            cache_len=L, cache_slots=ps, mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (state["cache"], nxt, pos + 1, write + 1), nxt
+
+    (cache, _, _, _), outs = jax.lax.scan(
+        step, (cache, token, pos, write), None, length=k + 1)
+    return cache, outs[:k].T                                # [W, k]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("model", "page_len", "pool_positions"),
+    donate_argnums=(1,),
+)
+def _spec_verify(model, cache, params, token, drafts, pos, write, pad_rows,
+                 page_rows, *, page_len, pool_positions):
+    """ONE target pass over [current, d_1..d_k] per row (k+1 query
+    positions, per-position causal visibility): returns the greedy
+    next-token at every position and the longest accepted prefix length.
+    Row i emits greedy[i, :accepted+1] — the accepted drafts ARE
+    greedy[:accepted] by the match definition, plus the free bonus
+    token, so the emitted stream is exactly target-greedy."""
+    from kubeflow_tpu.models.quantize import dequantize_params
+
+    params = dequantize_params(params)
+    W, L = pad_rows.shape
+    k = drafts.shape[1]
+    k_pos = jnp.arange(L)
+    seq = jnp.concatenate([token[:, None], drafts], axis=1)   # [W, k+1]
+    positions = pos[:, None] + jnp.arange(k + 1)[None, :]
+    slots = jnp.minimum(write[:, None] + jnp.arange(k + 1)[None, :], L - 1)
+    flat_w = (page_rows[jnp.arange(W)[:, None], slots // page_len]
+              * page_len + slots % page_len)                  # [W, k+1]
+    read = _read_indices(page_rows, page_len=page_len)
+    allowed = k_pos[None, None, :] <= slots[:, :, None]       # [W, k+1, L]
+    bias = (jnp.where(allowed, 0.0, _NEG_INF)
+            + pad_rows[:, None, :])[:, None]                  # [W,1,k+1,L]
+    ps = PagedSlots(write=flat_w, read=read,
+                    pool_positions=pool_positions)
+    logits, state = model.apply(
+        {"params": params, "cache": cache}, seq, positions=positions,
+        decode=True, mask_bias=bias, cache_len=L, cache_slots=ps,
+        mutable=["cache"],
+    )
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [W, k+1]
+    match = (drafts == greedy[:, :k]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)    # [W]
+    return state["cache"], greedy, accepted
+
+
+class PageAllocator:
+    """Host-side free list of physical pages with refcounts.  Page 0 is
+    reserved as the null/trash page: unallocated logical pages and
+    zombie-lane writes resolve to it, always behind the visibility
+    mask."""
+
+    def __init__(self, total_pages: int):
+        if total_pages < 2:
+            raise ValueError(
+                f"paged pool needs >= 2 pages (1 null + 1 usable), got "
+                f"{total_pages}")
+        self.total = total_pages
+        self._free = list(range(total_pages - 1, 0, -1))
+        self._refs: Dict[int, int] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_count(self) -> int:
+        return len(self._refs)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh pages at refcount 1, or None when the pool is short
+        (caller retries after evictions free pages)."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def retain(self, pages):
+        for p in pages:
+            self._refs[p] += 1
+
+    def release(self, pages):
+        for p in pages:
+            n = self._refs[p] - 1
+            if n < 0:
+                raise AssertionError(f"page {p} over-released")
+            if n == 0:
+                del self._refs[p]
+                self._free.append(p)
+            else:
+                self._refs[p] = n
+
+
+class _PrefixNode:
+    __slots__ = ("page", "chunk", "parent", "children", "row_refs",
+                 "last_use")
+
+    def __init__(self, page, chunk, parent):
+        self.page = page
+        self.chunk = chunk
+        self.parent = parent
+        self.children: dict = {}
+        self.row_refs = 0
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Hash-keyed trie over page-sized chunks of RAW prompt tokens:
+    node at depth j maps chunk j to the physical page holding its K/V.
+    Exact token tuples are the dict keys, so a hash collision can never
+    serve the wrong prefix.  Each node holds one allocator reference on
+    its page; live rows additionally pin nodes via ``row_refs``.  Under
+    page pressure, unpinned LEAF nodes evict in LRU order (leaf-first
+    keeps every cached chain walkable from the root)."""
+
+    def __init__(self, allocator: PageAllocator, page_len: int):
+        self.alloc = allocator
+        self.page_len = page_len
+        self._root: dict = {}
+        self._nodes: List[_PrefixNode] = []
+        self._clock = 0
+        self.hits = 0       # pages served from the cache
+        self.misses = 0     # lookup-eligible pages that had to prefill
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._nodes)
+
+    def page_ids(self) -> set:
+        return {n.page for n in self._nodes}
+
+    def lookup(self, tokens, cap: int) -> Tuple[List[_PrefixNode], List[int]]:
+        """Walk the trie over ``tokens``' page-sized chunks, at most
+        ``cap`` deep.  Returns (nodes, physical pages) of the longest
+        cached prefix."""
+        self._clock += 1
+        nodes: List[_PrefixNode] = []
+        pages: List[int] = []
+        level = self._root
+        i = 0
+        p = self.page_len
+        while len(nodes) < cap and i + p <= len(tokens):
+            node = level.get(tuple(tokens[i:i + p]))
+            if node is None:
+                break
+            node.last_use = self._clock
+            nodes.append(node)
+            pages.append(node.page)
+            level = node.children
+            i += p
+        return nodes, pages
+
+    def acquire(self, nodes):
+        for n in nodes:
+            n.row_refs += 1
+
+    def release(self, nodes):
+        for n in nodes:
+            n.row_refs -= 1
+
+    def insert(self, tokens, pages, parent_nodes, upto: int):
+        """Insert chunks [len(parent_nodes), upto) of ``tokens``, whose
+        physical pages are ``pages`` (the row's FULL page table, shared
+        prefix included).  Only fully-real prompt pages are eligible
+        (``upto = len // page_len``); the cache takes one allocator ref
+        per newly inserted page."""
+        level = self._root if not parent_nodes else parent_nodes[-1].children
+        parent = parent_nodes[-1] if parent_nodes else None
+        p = self.page_len
+        self._clock += 1
+        for j in range(len(parent_nodes), upto):
+            chunk = tuple(tokens[j * p:(j + 1) * p])
+            node = level.get(chunk)
+            if node is None:
+                node = _PrefixNode(pages[j], chunk, parent)
+                node.last_use = self._clock
+                self.alloc.retain([pages[j]])
+                level[chunk] = node
+                self._nodes.append(node)
+            else:
+                node.last_use = self._clock
+            level = node.children
+            parent = node
+
+    def evict_for(self, needed: int):
+        """Evict unpinned LRU leaves until the allocator can serve
+        ``needed`` pages (best effort — pinned chains stay)."""
+        while self.alloc.free_count < needed:
+            victims = [n for n in self._nodes
+                       if not n.children and n.row_refs == 0]
+            if not victims:
+                return
+            v = min(victims, key=lambda n: n.last_use)
+            parent_map = v.parent.children if v.parent else self._root
+            del parent_map[v.chunk]
+            self._nodes.remove(v)
+            self.alloc.release([v.page])
+
+
+class _PagedSlot(_Slot):
+    """One pool lane under the paged engine: the base bookkeeping plus
+    the row's page table, its own (releasable) pages, and the prefix
+    nodes it pins."""
+
+    __slots__ = ("pages", "own_pages", "nodes")
+
+
+class _PrefillState:
+    """The in-progress chunked admission: one request's rows prefill
+    chunk by chunk, interleaved with decode quanta.  The request stays
+    at the head of the scheduler queue until this completes, so a loop
+    crash can always reach it through ``_fail_outstanding``."""
+
+    __slots__ = ("req", "tokens_np", "positions_np", "lengths", "padded",
+                 "n", "pages", "own_pages", "nodes", "shared", "cursor",
+                 "last_logits", "pad_np", "read_np", "sampling")
+
+    def __init__(self, req):
+        self.req = req
+
+
+class PagedDecodeScheduler(DecodeScheduler):
+    """DecodeScheduler with the block-paged pool engine.  Same public
+    surface (submit / stats / stop, the crash-fallback contract, the
+    admitted == evicted + active balance), different cache economics:
+
+      page_len      KFT_SERVE_PAGE_LEN      tokens per page (default 64;
+                                            slot_len must divide evenly)
+      num_pages     KFT_SERVE_PAGES         physical pages incl. the null
+                                            page (default: the fixed
+                                            pool's capacity, slots x
+                                            slot_len / page_len, + 1)
+      prefill_chunk KFT_SERVE_PREFILL_CHUNK tokens per admission prefill
+                                            pass (0 = whole suffix at
+                                            once; default 512)
+      spec_tokens   KFT_SERVE_SPEC_TOKENS   draft tokens per speculative
+                                            step (default 4; active only
+                                            with a draft model)
+      prefix_cache  KFT_SERVE_PREFIX_CACHE  prefix-page sharing on/off
+
+    ``slots`` remains the static batch width of the compiled pool step
+    (lanes); pages are the memory currency — a short row in a lane holds
+    2 pages while a long one holds 30, where the fixed pool charged both
+    the full slot_len.
+    """
+
+    def __init__(self, model, params, *, slots=None, slot_len=None,
+                 quantum=None, mesh=None, telemetry=None,
+                 page_len=None, num_pages=None, prefill_chunk=None,
+                 spec_tokens=None, draft_model=None, draft_params=None,
+                 prefix_cache=None):
+        if mesh is not None:
+            # The flat pool has no batch axis to shard; SPMD serving
+            # stays on the fixed-slot scheduler (serve.py routes there).
+            raise ValueError(
+                "PagedDecodeScheduler does not support a mesh; use "
+                "DecodeScheduler for SPMD serving")
+        super().__init__(model, params, slots=slots, slot_len=slot_len,
+                         quantum=quantum, mesh=None, telemetry=telemetry)
+        self.page_len = page_len or config.knob(
+            "KFT_SERVE_PAGE_LEN", 64, int,
+            doc="Paged-KV page size in tokens (models/paged.py); the "
+                "serve slot length must be a multiple of it",
+            validate=lambda v: None if 1 <= v <= 4096
+            else "must be in [1, 4096]")
+        if self.page_len < 1 or self.slot_len % self.page_len:
+            raise ValueError(
+                f"KFT_SERVE_PAGE_LEN {self.page_len} must be a positive "
+                f"divisor of slot_len {self.slot_len} — a bad page size "
+                f"must fail loudly, not quietly serve the fallback path")
+        self.max_pages_row = self.slot_len // self.page_len
+        default_pages = self.slots * self.max_pages_row + 1
+        self.num_pages = num_pages or config.knob(
+            "KFT_SERVE_PAGES", 0, int,
+            doc="Physical KV pages in the paged pool, null page "
+                "included (0 = the fixed pool's capacity + 1)",
+            validate=lambda v: None if v >= 0 else "must be >= 0",
+        ) or default_pages
+        if self.num_pages < self.max_pages_row + 1:
+            raise ValueError(
+                f"KFT_SERVE_PAGES {self.num_pages} cannot hold one "
+                f"full-length row ({self.max_pages_row} pages) plus the "
+                f"null page")
+        self.pool_positions = self.num_pages * self.page_len
+        self.prefill_chunk = prefill_chunk if prefill_chunk is not None \
+            else config.knob(
+                "KFT_SERVE_PREFILL_CHUNK", 512, int,
+                doc="Chunked-prefill pass size in tokens (0 = whole "
+                    "prompt suffix in one pass)",
+                validate=lambda v: None if v >= 0 else "must be >= 0")
+        self.spec_tokens = spec_tokens if spec_tokens is not None \
+            else config.knob(
+                "KFT_SERVE_SPEC_TOKENS", 4, int,
+                doc="Draft tokens proposed per speculative-decoding "
+                    "step (needs --draft-model; 0 disables)",
+                validate=lambda v: None if 0 <= v <= 64
+                else "must be in [0, 64]")
+        if not (0 <= self.spec_tokens <= 64):
+            raise ValueError(
+                f"KFT_SERVE_SPEC_TOKENS {self.spec_tokens} outside "
+                f"[0, 64]")
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        if draft_model is not None:
+            if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_model.cfg.vocab_size} != target "
+                    f"vocab {model.cfg.vocab_size}: speculative proposals "
+                    f"would index a different token space")
+            if draft_model.cfg.max_seq_len < self.slot_len:
+                raise ValueError(
+                    f"draft max_seq_len {draft_model.cfg.max_seq_len} < "
+                    f"slot_len {self.slot_len}")
+        use_prefix = prefix_cache if prefix_cache is not None else \
+            config.env_bool("KFT_SERVE_PREFIX_CACHE", True)
+        self.allocator = PageAllocator(self.num_pages)
+        self.prefix = (PrefixCache(self.allocator, self.page_len)
+                       if use_prefix else None)
+        self._lane_pages: List[List[int]] = [[] for _ in range(self.slots)]
+        self._prefilling: Optional[_PrefillState] = None
+        self._draft_cache = None
+        self._spec_proposed_total = 0
+        self._spec_accepted_total = 0
+
+    # -- sizing -----------------------------------------------------------
+
+    def _spec_slack(self) -> int:
+        """Extra write slots a speculative verify can touch past the last
+        budgeted token — reserved so verify reads within budget never
+        resolve to the (clobbered) null page."""
+        if self.draft_model is None or self.spec_tokens < 1:
+            return 0
+        return self.spec_tokens + 1
+
+    def _pages_per_row(self, padded: int, n: int) -> int:
+        need = padded + n - 1 + self._spec_slack()
+        return min(math.ceil(need / self.page_len), self.max_pages_row)
+
+    def submit(self, rows, *, max_new_tokens, temperature=0.0, top_k=None,
+               eos_token=None, seed=0, tokens=None, prompt_mask=None):
+        longest = max(len(r) for r in rows)
+        if longest + max_new_tokens <= self.slot_len:
+            # Worst-case page demand (no prefix reuse) must fit the pool,
+            # or admission would stall forever; the slot_len bound above
+            # keeps the base class's error for oversized rows.
+            need = self._pages_per_row(longest, max_new_tokens) * len(rows)
+            if need > self.num_pages - 1:
+                raise ValueError(
+                    f"request needs up to {need} KV pages "
+                    f"({len(rows)} rows x "
+                    f"{self._pages_per_row(longest, max_new_tokens)}), "
+                    f"pool has {self.num_pages - 1} usable "
+                    f"(KFT_SERVE_PAGES)")
+        return super().submit(
+            rows, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, eos_token=eos_token, seed=seed, tokens=tokens,
+            prompt_mask=prompt_mask)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update({
+            "page_len": self.page_len,
+            "pages_total": self.num_pages,
+            "pages_free": self.allocator.free_count,
+            "pages_shared": self.prefix.cached_pages if self.prefix else 0,
+            "pages_active": self.allocator.allocated_count
+            - (self.prefix.cached_pages if self.prefix else 0),
+            "prefix_hits": self.prefix.hits if self.prefix else 0,
+            "prefix_misses": self.prefix.misses if self.prefix else 0,
+            "spec_proposed": self._spec_proposed_total,
+            "spec_accepted": self._spec_accepted_total,
+        })
+        return out
+
+    def debug_pages(self) -> dict:
+        """Live page-table snapshot for the soak aliasing check: any two
+        lanes' page sets may only overlap inside the declared shared
+        (prefix-cache) pages."""
+        shared = self.prefix.page_ids() if self.prefix else set()
+        lanes = {i: list(pages)
+                 for i, pages in enumerate(self._lane_pages)
+                 if self._slot_state[i] is not None}
+        return {"shared": shared, "lanes": lanes}
+
+    # -- pool -------------------------------------------------------------
+
+    def _ensure_pool(self):
+        if self._cache is not None:
+            return
+        self._cache = _init_paged_pool(
+            self.model, self.params, lanes=self.slots,
+            slot_len=self.slot_len, pool_positions=self.pool_positions)
+        if self.draft_model is not None and self.spec_tokens >= 1:
+            self._draft_cache = _init_paged_pool(
+                self.draft_model, self.draft_params, lanes=self.slots,
+                slot_len=self.slot_len,
+                pool_positions=self.pool_positions)
+        self._rngs = jax.random.split(jax.random.key(0), self.slots)
+        self._pad_rows = jnp.full(
+            (self.slots, self.slot_len), _NEG_INF, jnp.float32)
+        self._update_page_metrics()
+
+    def _page_rows_np(self) -> np.ndarray:
+        pr = np.zeros((self.slots, self.max_pages_row), np.int32)
+        for i, pages in enumerate(self._lane_pages):
+            if pages:
+                pr[i, :len(pages)] = pages
+        return pr
+
+    def _update_page_metrics(self):
+        tel = self._telemetry()
+        if tel is None or not hasattr(tel, "kv_pages"):
+            return
+        shared = self.prefix.cached_pages if self.prefix else 0
+        active = self.allocator.allocated_count - shared
+        tel.kv_pages.labels(state="free").set(self.allocator.free_count)
+        tel.kv_pages.labels(state="active").set(active)
+        tel.kv_pages.labels(state="shared").set(shared)
+        # Fragmentation: capacity reserved by live rows but not yet
+        # holding written tokens.  Written positions per lane = the write
+        # pointer (clamped to its reservation); the in-flight prefill
+        # counts its cursor.
+        reserved = 0
+        written = 0
+        for i, slot in enumerate(self._slot_state):
+            if slot is None:
+                continue
+            cap = len(self._lane_pages[i]) * self.page_len
+            reserved += cap
+            written += min(slot.write, cap)
+        for slot in self._pending_rows:
+            cap = len(slot.pages) * self.page_len
+            reserved += cap
+            written += min(slot.write, cap)
+        st = self._prefilling
+        if st is not None:
+            cap = len(st.pages[0]) * self.page_len * len(st.req.rows)
+            reserved += cap
+            written += st.cursor * len(st.req.rows)
+        frag = 1.0 - written / reserved if reserved else 0.0
+        tel.kv_page_fragmentation.set(frag)
+
+    # -- admission --------------------------------------------------------
+
+    def _admit(self):
+        """Paged admission: place prefilled rows, then advance the ONE
+        in-progress chunked prefill by a single chunk, then (if idle)
+        start the next queued request.  Returning with ``_prefilling``
+        set yields the device back to ``_run_quantum`` — that is the
+        chunked-prefill/decode interleave."""
+        while True:
+            free = self._free_slots()
+            while free and self._pending_rows:
+                self._place(self._pending_rows[0], free.pop(0))
+                self._pending_rows.pop(0)
+            st = self._prefilling
+            if st is not None:
+                try:
+                    self._advance_prefill(st)
+                except BaseException as exc:  # noqa: BLE001 — per-request
+                    self._abort_prefill(st, exc)
+                    continue
+                if self._prefilling is not None:
+                    return          # mid-prefill: give decode a quantum
+                continue            # finished: loop to place its rows
+            if self._pending_rows:
+                return              # rows wait on lanes, keep decoding
+            with self._cond:
+                if not self._queue:
+                    return
+                req = self._queue[0]
+            try:
+                started = self._begin_prefill(req)
+            except BaseException as exc:  # noqa: BLE001 — per-request
+                self._drop_queued(req)
+                req._fail(exc)
+                tel = self._telemetry()
+                if tel is not None:
+                    tel.queue_depth.dec(len(req.rows))
+                continue
+            if not started:
+                return              # pages short: decode frees them
+
+    def _drop_queued(self, req: PendingRequest):
+        with self._cond:
+            if req in self._queue:
+                self._queue.remove(req)
+
+    def _abort_prefill(self, st: _PrefillState, exc: BaseException):
+        self._prefilling = None
+        for own in st.own_pages:
+            self.allocator.release(own)
+        if self.prefix is not None:
+            for nodes in st.nodes:
+                self.prefix.release(nodes)
+        self._drop_queued(st.req)
+        st.req._fail(exc)
+        tel = self._telemetry()
+        if tel is not None:
+            tel.queue_depth.dec(len(st.req.rows))
+        self._update_page_metrics()
+
+    def _begin_prefill(self, req: PendingRequest) -> bool:
+        """Host-side admission start: prefix lookup, page allocation, the
+        chunk cursor.  Returns False (request stays queued) when the
+        allocator is short even after LRU prefix eviction — decode
+        quanta keep running and free pages."""
+        rows = req.rows
+        b = len(rows)
+        n = req.max_new_tokens
+        padded = max(len(r) for r in rows)
+        p = self.page_len
+        if self.prefix is not None:
+            caps = [(len(r) - 1) // p for r in rows]
+            looked = [self.prefix.lookup(r, cap)
+                      for r, cap in zip(rows, caps)]
+            # One uniform shared depth across the request's rows keeps
+            # the batched chunk pass rectangular; capped so at least one
+            # suffix token remains to produce the first-token logits.
+            m = min(len(nodes) for nodes, _ in looked)
+        else:
+            caps = [0] * b
+            looked = [([], [])] * b
+            m = 0
+        total_row = self._pages_per_row(padded, n)
+        own_count = total_row - m
+        need = own_count * b
+        if self.prefix is not None:
+            self.prefix.evict_for(need)
+        flat = self.allocator.alloc(need)
+        if flat is None:
+            return False
+        st = _PrefillState(req)
+        st.shared = m
+        st.own_pages = [flat[i * own_count:(i + 1) * own_count]
+                        for i in range(b)]
+        st.nodes = [nodes[:m] for nodes, _ in looked]
+        st.pages = [list(pages[:m]) + st.own_pages[i]
+                    for i, (_, pages) in enumerate(looked)]
+        if self.prefix is not None:
+            for nodes in st.nodes:
+                self.prefix.acquire(nodes)
+            hit = m * b
+            miss = sum(max(cap - m, 0) for cap in caps)
+            self.prefix.hits += hit
+            self.prefix.misses += miss
+            tel = self._telemetry()
+            if tel is not None and hasattr(tel, "prefix_cache_hits"):
+                if hit:
+                    tel.prefix_cache_hits.inc(hit)
+                if miss:
+                    tel.prefix_cache_misses.inc(miss)
+        st.padded = padded
+        st.n = n
+        st.cursor = m * p
+        tokens_np = np.zeros((b, padded), np.int32)
+        mask_np = np.zeros((b, padded), bool)
+        for i, r in enumerate(rows):
+            tokens_np[i, :len(r)] = r
+            mask_np[i, :len(r)] = True
+        st.tokens_np = tokens_np
+        st.positions_np = np.maximum(
+            np.cumsum(mask_np.astype(np.int32), axis=-1) - 1, 0)
+        st.lengths = jnp.asarray(mask_np.sum(axis=-1).astype(np.int32))
+        pad_np = np.zeros((b, self.slot_len), np.float32)
+        pad_np[~np.concatenate(
+            [mask_np, np.ones((b, self.slot_len - padded), bool)],
+            axis=-1)] = _NEG_INF
+        st.pad_np = pad_np
+        table = np.zeros((b, self.max_pages_row), np.int32)
+        for i, pages in enumerate(st.pages):
+            table[i, :len(pages)] = pages
+        st.read_np = (table[:, :, None] * p
+                      + np.arange(p)[None, None, :]).reshape(b, -1)
+        from kubeflow_tpu.models.generate import _row_sampling_arrays
+
+        st.sampling = _row_sampling_arrays(
+            b, req.temperature, req.top_k, req.eos_token)
+        vocab = self.model.cfg.vocab_size
+        st.last_logits = jnp.zeros((b, vocab), jnp.float32)
+        req.t_admitted = time.perf_counter()
+        req.admitted.set()
+        self._prefilling = st
+        self._update_page_metrics()
+        return True
+
+    def _chunk_slots(self, st: _PrefillState, start: int, c: int,
+                     model_pool: bool = True) -> PagedSlots:
+        p = self.page_len
+        slots = np.arange(start, start + c)
+        write = np.stack([
+            np.asarray(pages, np.int32)[slots // p] * p + slots % p
+            for pages in st.pages])
+        return PagedSlots(write=jnp.asarray(write, jnp.int32),
+                          read=jnp.asarray(st.read_np, jnp.int32),
+                          pool_positions=self.pool_positions)
+
+    def _advance_prefill(self, st: _PrefillState):
+        """One prefill chunk on the device; on the last chunk, sample
+        the first token (the sequential rng recipe), run the draft
+        prefill, insert shareable pages, and peel rows into pending
+        slots."""
+        c = st.padded - st.cursor
+        if self.prefill_chunk > 0:
+            c = min(c, self.prefill_chunk)
+        sl = slice(st.cursor, st.cursor + c)
+        ps = self._chunk_slots(st, st.cursor, c)
+        self._cache, st.last_logits = _prefill_chunk(
+            self.model, self._cache, self.params,
+            jnp.asarray(st.tokens_np[:, sl]),
+            jnp.asarray(st.positions_np[:, sl]), ps,
+            jnp.int32(st.cursor), jnp.asarray(st.pad_np), st.lengths,
+            st.last_logits)
+        st.cursor += c
+        if st.cursor < st.padded:
+            return
+        self._finish_prefill(st)
+
+    def _finish_prefill(self, st: _PrefillState):
+        req = st.req
+        b = len(req.rows)
+        p = self.page_len
+        temps, top_ks, eos_ids, has_eos = st.sampling
+        first, row_rngs, done0 = _sample_first(
+            st.last_logits, jax.random.key(req.seed), temps, top_ks,
+            eos_ids, has_eos, sampled=req.temperature != 0.0)
+        if self._draft_cache is not None:
+            # The draft pool mirrors the target's pages in lockstep: one
+            # full-suffix pass fills the same flat slots of the draft's
+            # flat tensors, so future spec steps attend a complete
+            # draft-side history.
+            start = st.shared * p
+            sl = slice(start, st.padded)
+            ps = self._chunk_slots(st, start, st.padded - start)
+            self._draft_cache, _ = _prefill_chunk(
+                self.draft_model, self._draft_cache, self.draft_params,
+                jnp.asarray(st.tokens_np[:, sl]),
+                jnp.asarray(st.positions_np[:, sl]), ps,
+                jnp.int32(start), jnp.asarray(st.pad_np), st.lengths,
+                jnp.zeros_like(st.last_logits))
+        first_h, done_h, lengths_h = jax.device_get(
+            (first, done0, st.lengths))
+        req.t_first = time.perf_counter()
+        req.first_token.set()
+        if self.prefix is not None:
+            for i, r in enumerate(req.rows):
+                self.prefix.insert(r, st.pages[i], st.nodes[i],
+                                   len(r) // p)
+        self._prefilling = None
+        self._drop_queued(req)
+        tel = self._telemetry()
+        n = st.n
+        eos = req.eos_token
+        for i in range(b):
+            tok0 = int(first_h[i])
+            if n == 1 or bool(done_h[i]):
+                # Complete at admission: counted admitted AND evicted so
+                # the balance invariant holds at every instant; pages
+                # release immediately (prefix-inserted ones live on in
+                # the cache via its own refs).
+                self._admitted_total += 1
+                self._evicted_total += 1
+                self.allocator.release(st.own_pages[i])
+                if self.prefix is not None:
+                    self.prefix.release(st.nodes[i])
+                if tel is not None:
+                    tel.queue_depth.dec(1)
+                    tel.scheduler_admitted.inc()
+                    tel.scheduler_evicted.inc()
+                self._complete_row(req, i, [tok0] + [eos] * (n - 1))
+                continue
+            slot = _PagedSlot(
+                req, i, token=tok0, pos=int(lengths_h[i]),
+                write=st.padded, done=False, budget=n - 1)
+            slot.pages = st.pages[i]
+            slot.own_pages = st.own_pages[i]
+            slot.nodes = st.nodes[i]
+            slot._rng_src = (row_rngs, i)
+            slot._pad_row = st.pad_np[i]
+            self._pending_rows.append(slot)
+        self._update_page_metrics()
+
+    def _place(self, slot: _PagedSlot, idx: int):
+        """Lane placement without a cache copy: the row's K/V already
+        live in the pooled tensors — only the page-table row, rng key
+        and visibility bias land in the lane."""
+        self._lane_pages[idx] = slot.pages
+        row_rngs, i = slot._rng_src
+        self._rngs = self._rngs.at[idx].set(row_rngs[i])
+        self._pad_rows = self._pad_rows.at[idx].set(
+            jnp.asarray(slot._pad_row))
+        self._admitted_total += 1
+        tel = self._telemetry()
+        if tel is not None:
+            tel.queue_depth.dec(1)
+            tel.scheduler_admitted.inc()
+            tel.slots_active.set(
+                1 + sum(s is not None for s in self._slot_state))
+        del slot._rng_src, slot._pad_row
+        self._slot_state[idx] = slot
+        self._carry = None
+        self._update_page_metrics()
+
+    # -- decode -----------------------------------------------------------
+
+    def _spec_ready(self) -> bool:
+        """Speculative steps need a draft pool, all-greedy live rows
+        (greedy acceptance is exact only against argmax), and k+1 slots
+        of reserved headroom on every row so verify reads stay inside
+        owned pages."""
+        if self._draft_cache is None or self.spec_tokens < 1:
+            return False
+        k = self.spec_tokens
+        any_live = False
+        for s in self._slot_state:
+            if s is None:
+                continue
+            any_live = True
+            if s.temp != 0.0 or s.write + k + 1 > self.slot_len:
+                return False
+        return any_live
+
+    def _run_quantum(self):
+        if self._spec_ready():
+            self._run_spec_step()
+            return
+        state = self._slot_state
+        if self._carry is None:
+            temps = [s.temp if s else 0.0 for s in state]
+            self._carry = (
+                jnp.asarray([s.token if s else 0 for s in state],
+                            jnp.int32),
+                jnp.asarray([s.pos if s else 0 for s in state], jnp.int32),
+                jnp.asarray([s.write if s else 0 for s in state],
+                            jnp.int32),
+                jnp.asarray([s.done if s else True for s in state], bool),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray([s.top_k if s else 0 for s in state],
+                            jnp.int32),
+                jnp.asarray([s.eos if s else 0 for s in state], jnp.int32),
+                jnp.asarray([s.has_eos if s else False for s in state],
+                            bool),
+                any(t != 0.0 for t in temps),
+            )
+        (token, pos, write, done, temps_d, top_ks_d, eos_d, has_eos_d,
+         sampled) = self._carry
+        # The page table re-uploads every quantum (tiny int array): an
+        # eviction between quanta zeroes its lane row here, redirecting
+        # zombie writes to the null page BEFORE the freed pages can be
+        # handed to a new admission.
+        page_rows = jnp.asarray(self._page_rows_np())
+        (self._cache, token, pos, write, self._rngs, done, toks,
+         dones) = _paged_pool_steps(
+            self.model, self._cache, self.params,
+            token, pos, write, self._rngs, done,
+            self._pad_rows, page_rows, temps_d, top_ks_d, eos_d,
+            has_eos_d, quantum=self.quantum, sampled=sampled,
+            page_len=self.page_len, pool_positions=self.pool_positions,
+        )
+        self._carry = (token, pos, write, done, temps_d, top_ks_d, eos_d,
+                       has_eos_d, sampled)
+        toks_h, dones_h = jax.device_get((toks, dones))
+        self._steps_total += self.quantum
+        tel = self._telemetry()
+        active = sum(s is not None for s in state)
+        if tel is not None:
+            tel.batch_fill_ratio.observe(active / max(self.slots, 1))
+            tel.slots_active.set(active)
+        for i, slot in enumerate(state):
+            if slot is None:
+                continue
+            for t in range(self.quantum):
+                if len(slot.collected) >= slot.budget:
+                    break
+                slot.collected.append(int(toks_h[t, i]))
+                slot.done = bool(dones_h[t, i])
+            slot.token = int(toks_h[self.quantum - 1, i])
+            slot.pos += self.quantum
+            slot.write += self.quantum
+            if slot.done or len(slot.collected) >= slot.budget:
+                self._evict(i)
+        self._update_page_metrics()
+
+    def _run_spec_step(self):
+        """One speculative round: k+1 draft steps propose, one target
+        pass verifies, the host emits the accepted prefix + bonus token
+        per row.  Both pools' write pointers advance by accepted+1 in
+        lockstep; the rejected tail needs no rollback — those slots sit
+        above the new pointer, masked until the step that overwrites
+        them."""
+        state = self._slot_state
+        k = self.spec_tokens
+        token = jnp.asarray([s.token if s else 0 for s in state],
+                            jnp.int32)
+        pos = jnp.asarray([s.pos if s else 0 for s in state], jnp.int32)
+        write = jnp.asarray([s.write if s else 0 for s in state],
+                            jnp.int32)
+        page_rows = jnp.asarray(self._page_rows_np())
+        self._draft_cache, drafts = _draft_propose(
+            self.draft_model, self._draft_cache, self.draft_params,
+            token, pos, write, self._pad_rows, page_rows,
+            k=k, page_len=self.page_len,
+            pool_positions=self.pool_positions)
+        self._cache, greedy, accepted = _spec_verify(
+            self.model, self._cache, self.params, token, drafts, pos,
+            write, self._pad_rows, page_rows,
+            page_len=self.page_len, pool_positions=self.pool_positions)
+        greedy_h, acc_h = jax.device_get((greedy, accepted))
+        self._steps_total += 1
+        tel = self._telemetry()
+        active = sum(s is not None for s in state)
+        if tel is not None:
+            tel.batch_fill_ratio.observe(active / max(self.slots, 1))
+            tel.slots_active.set(active)
+        proposed = accepted_n = 0
+        for i, slot in enumerate(state):
+            if slot is None:
+                continue
+            a = int(acc_h[i])
+            proposed += k
+            accepted_n += a
+            for j in range(a + 1):
+                if len(slot.collected) >= slot.budget:
+                    break
+                t = int(greedy_h[i, j])
+                slot.collected.append(t)
+                if slot.has_eos and t == slot.eos:
+                    # Sequential semantics: EOS freezes the row; tokens
+                    # past it are EOS padding, which eviction fills.
+                    slot.done = True
+                    break
+            slot.token = int(greedy_h[i, a])
+            slot.pos += a + 1
+            slot.write += a + 1
+            if slot.done or len(slot.collected) >= slot.budget:
+                self._evict(i)
+        self._spec_proposed_total += proposed
+        self._spec_accepted_total += accepted_n
+        if tel is not None and hasattr(tel, "spec_proposed"):
+            if proposed:
+                tel.spec_proposed.inc(proposed)
+            if accepted_n:
+                tel.spec_accepted.inc(accepted_n)
+        # Host-side pointers moved: the next normal quantum must rebuild
+        # its device carry from the slot bookkeeping.
+        self._carry = None
+        self._update_page_metrics()
+
+    def _evict(self, idx: int):
+        slot = self._slot_state[idx]
+        super()._evict(idx)
+        # The lane's page-table row zeroes so the zombie lane writes to
+        # the null page; only then can the freed pages be reallocated.
+        self._lane_pages[idx] = []
+        self.allocator.release(slot.own_pages)
+        if self.prefix is not None:
+            self.prefix.release(slot.nodes)
+        self._update_page_metrics()
+
+    def _fail_outstanding(self, exc: BaseException):
+        st = self._prefilling
+        self._prefilling = None
+        super()._fail_outstanding(exc)
+        # The in-progress prefill's request was still queued, so the
+        # base drain failed it; page bookkeeping is moot on a dead
+        # scheduler but released anyway so post-mortem stats read sane.
+        if st is not None:
+            for own in st.own_pages:
+                self.allocator.release(own)
+            if self.prefix is not None:
+                for nodes in st.nodes:
+                    self.prefix.release(nodes)
+        self._lane_pages = [[] for _ in range(self.slots)]
+        self._update_page_metrics()

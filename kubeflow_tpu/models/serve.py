@@ -166,7 +166,8 @@ class GenerationService:
 
     def __init__(self, model, params, *, default_max_new_tokens: int = 32,
                  max_batch_rows: int = 64, mesh=None,
-                 use_scheduler: Optional[bool] = None):
+                 use_scheduler: Optional[bool] = None,
+                 draft_model=None, draft_params=None):
         self.model = model
         self.params = params
         self.default_max_new_tokens = default_max_new_tokens
@@ -175,6 +176,12 @@ class GenerationService:
         # scheduler places its slot pool's batch axis with batch_sharding
         # over the same mesh.
         self.mesh = mesh
+        # Speculative decoding (models/paged.py): a small same-vocab
+        # draft model proposes tokens the target verifies in one step.
+        # Only the paged scheduler consumes it; on every other path the
+        # pair is inert.
+        self.draft_model = draft_model
+        self.draft_params = draft_params
         # Continuous batching (models/scheduler.py): instrumented
         # services route through the cross-request scheduler unless
         # KFT_SERVE_SCHEDULER=0 (or use_scheduler=False) pins the
@@ -203,12 +210,34 @@ class GenerationService:
             return None
         with self._lock:
             if self._scheduler is None:
-                from kubeflow_tpu.models.scheduler import DecodeScheduler
+                from kubeflow_tpu.platform import config as _config
 
-                self._scheduler = DecodeScheduler(
-                    self.model, self.params, mesh=self.mesh,
-                    telemetry=lambda: self.telemetry,
-                )
+                # The paged engine (block-paged KV + prefix reuse +
+                # chunked prefill + optional speculative decoding) is
+                # the default; KFT_SERVE_PAGED=0 pins the PR-7
+                # fixed-slot pool.  The paged pool is not mesh-sharded
+                # yet, so SPMD serving always takes the fixed path.
+                if self.mesh is None and _config.env_bool(
+                        "KFT_SERVE_PAGED", True):
+                    from kubeflow_tpu.models.paged import (
+                        PagedDecodeScheduler,
+                    )
+
+                    self._scheduler = PagedDecodeScheduler(
+                        self.model, self.params,
+                        telemetry=lambda: self.telemetry,
+                        draft_model=self.draft_model,
+                        draft_params=self.draft_params,
+                    )
+                else:
+                    from kubeflow_tpu.models.scheduler import (
+                        DecodeScheduler,
+                    )
+
+                    self._scheduler = DecodeScheduler(
+                        self.model, self.params, mesh=self.mesh,
+                        telemetry=lambda: self.telemetry,
+                    )
             sched = self._scheduler
         return sched if sched.alive else None
 
@@ -606,9 +635,18 @@ def load_service(
     max_seq_len: Optional[int] = None,
     seed: int = 0, quantize: Optional[str] = None,
     mesh_spec: Optional[str] = None,
+    draft_model_name: Optional[str] = None,
+    draft_checkpoint_dir: Optional[str] = None,
 ) -> "GenerationService | Seq2SeqGenerationService":
     """Build the model; restore params from a train-loop checkpoint when
-    given, else random-init (useful for smoke/serving-path tests)."""
+    given, else random-init (useful for smoke/serving-path tests).
+
+    ``draft_model_name`` builds a second, smaller decoder for
+    speculative decoding under the paged scheduler — the registry
+    already carries small llamas to draft for big ones.  The draft must
+    share the target's vocab (its proposals index the target's token
+    space) and is validated here so a mismatch fails at startup, not on
+    the first speculative step."""
     from kubeflow_tpu.models import create_model
 
     model = create_model(model_name)
@@ -687,11 +725,45 @@ def load_service(
 
         params = shard_params(params, mesh, rules)
     if seq2seq:
+        if draft_model_name:
+            raise ValueError(
+                "--draft-model applies to decoder-only serving; seq2seq "
+                "models have no speculative-decoding path")
         return Seq2SeqGenerationService(model, params)
+    draft_model = draft_params = None
+    if draft_model_name:
+        draft_model = create_model(draft_model_name)
+        if hasattr(draft_model, "encode"):
+            raise ValueError(
+                f"draft model {draft_model_name} is seq2seq; speculative "
+                f"decoding needs a decoder-only draft")
+        if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_model.cfg.vocab_size} != target "
+                f"vocab {model.cfg.vocab_size}: the draft's proposals "
+                f"must index the target's token space")
+        if draft_checkpoint_dir:
+            from kubeflow_tpu.train.checkpoint import CheckpointManager
+
+            template = jax.eval_shape(
+                lambda: draft_model.init(
+                    jax.random.key(seed), jnp.ones((1, 8), jnp.int32))
+            )["params"]
+            with CheckpointManager(draft_checkpoint_dir) as mgr:
+                draft_params = mgr.restore_params(template=template)
+            if draft_params is None:
+                raise FileNotFoundError(
+                    f"no checkpoint found under {draft_checkpoint_dir}")
+        else:
+            draft_params = draft_model.init(
+                jax.random.key(seed), jnp.ones((1, 8), jnp.int32)
+            )["params"]
     # The mesh rides on the service so the continuous-batching scheduler
     # can batch-shard its slot pool over the same device mesh the params
     # are sharded across.
-    return GenerationService(model, params, mesh=mesh)
+    return GenerationService(model, params, mesh=mesh,
+                             draft_model=draft_model,
+                             draft_params=draft_params)
 
 
 def main(argv=None) -> int:
@@ -705,6 +777,13 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", default=None,
                     help="SPMD serving: shard params over a mesh, e.g. "
                          "'tp=4' (tensor parallel across 4 chips)")
+    ap.add_argument("--draft-model", default=None,
+                    help="small same-vocab decoder for speculative "
+                         "decoding under the paged scheduler "
+                         "(KFT_SERVE_SPEC_TOKENS proposals per step)")
+    ap.add_argument("--draft-checkpoint-dir", default=None,
+                    help="checkpoint for --draft-model (random-init "
+                         "when omitted — smoke/test use only)")
     args = ap.parse_args(argv)
 
     try:
@@ -712,6 +791,8 @@ def main(argv=None) -> int:
             args.model, checkpoint_dir=args.checkpoint_dir,
             max_seq_len=args.max_seq_len, quantize=args.quantize,
             mesh_spec=args.mesh,
+            draft_model_name=args.draft_model,
+            draft_checkpoint_dir=args.draft_checkpoint_dir,
         )
     except (ValueError, FileNotFoundError) as e:
         ap.error(str(e))  # clean CLI exit, not a traceback

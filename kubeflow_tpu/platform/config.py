@@ -31,6 +31,13 @@ class Knob(NamedTuple):
     parser: Callable[[str], Any]
     doc: str
     secret: bool
+    # Optional value check: returns an error message (str) for a bad
+    # value, None for a good one.  A validated knob REFUSES bad env input
+    # (raises ValueError) instead of silently falling back to the default
+    # — for knobs where the fallback is a different code path entirely
+    # (e.g. a bad KFT_SERVE_PAGE_LEN must not quietly benchmark the
+    # fixed-slot pool).
+    validate: Callable[[Any], Any] = None
 
 
 # name -> Knob, first registration wins (a knob read from two sites with
@@ -45,22 +52,37 @@ def parse_bool(v: str) -> bool:
 
 
 def knob(name: str, default: Any = None, parser: Callable[[str], Any] = str,
-         *, doc: str = "", secret: bool = None) -> Any:
+         *, doc: str = "", secret: bool = None,
+         validate: Callable[[Any], Any] = None) -> Any:
     """Resolve env knob ``name`` through the registry: parse the env value
     when set and parseable, else ``default``.  ``secret`` defaults to a
-    name sniff (TOKEN/SECRET/...) and controls /debug/knobs redaction."""
+    name sniff (TOKEN/SECRET/...) and controls /debug/knobs redaction.
+
+    ``validate`` (value -> error-message-or-None) makes the knob strict:
+    an unparseable or out-of-range env value raises ValueError instead of
+    silently resolving to the default."""
     if secret is None:
         secret = any(m in name.upper() for m in _SECRET_MARKERS)
     with _lock:
         if name not in KNOBS:
-            KNOBS[name] = Knob(name, default, parser, doc, secret)
+            KNOBS[name] = Knob(name, default, parser, doc, secret,
+                               validate)
     raw = os.environ.get(name)  # kft: disable=R005 the registry itself
     if raw is None:
         return default
     try:
-        return parser(raw)
+        value = parser(raw)
     except (TypeError, ValueError):
+        if validate is not None:
+            raise ValueError(
+                f"{name}={raw!r}: not a valid "
+                f"{getattr(parser, '__name__', 'value')}") from None
         return default
+    if validate is not None:
+        problem = validate(value)
+        if problem:
+            raise ValueError(f"{name}={raw!r}: {problem}")
+    return value
 
 
 def effective(*, redact: bool = True) -> Dict[str, dict]:
@@ -83,6 +105,13 @@ def effective(*, redact: bool = True) -> Dict[str, dict]:
                 # default — the typo is exactly what the reader is
                 # hunting.
                 value, source = k.default, "env-unparseable"
+            if source == "env" and k.validate is not None:
+                # Validated knobs raise at the read site; the debug page
+                # reports the rejection rather than pretending the bad
+                # value took effect.
+                problem = k.validate(value)
+                if problem:
+                    value, source = k.default, "env-invalid"
         if redact and k.secret and source == "env":
             value = "<redacted>"
         if not isinstance(value, (str, int, float, bool, type(None))):

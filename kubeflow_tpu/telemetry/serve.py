@@ -129,6 +129,51 @@ class ServeTelemetry:
             "excluding post-EOS padding)",
             registry=registry,
         )
+        # Paged-KV engine (models/paged.py).  Balance invariants, pinned
+        # by test_telemetry: free + active + shared == pages_total - 1
+        # (the null page is outside every state) at all times, active
+        # returns to 0 when the pool drains, and accepted <= proposed.
+        self.kv_pages = Gauge(
+            "serve_kv_pages",
+            "Physical KV pages by state under the paged pool: free (in "
+            "the allocator), active (held by live/pending rows only), "
+            "shared (resident in the prefix cache); the reserved null "
+            "page is counted in none of them",
+            ["state"], registry=registry,
+        )
+        self.kv_page_fragmentation = Gauge(
+            "serve_kv_page_fragmentation_ratio",
+            "Reserved-but-unwritten fraction of live rows' paged-KV "
+            "capacity (0 = every reserved page position holds a real "
+            "token; the fixed-slot pool's longest-bucket tax made "
+            "visible)",
+            registry=registry,
+        )
+        self.prefix_cache_hits = Counter(
+            "serve_prefix_cache_hits_total",
+            "Prompt pages served read-only from the prefix cache "
+            "instead of prefilling",
+            registry=registry,
+        )
+        self.prefix_cache_misses = Counter(
+            "serve_prefix_cache_misses_total",
+            "Lookup-eligible prompt pages that had to prefill (no "
+            "cached prefix page matched)",
+            registry=registry,
+        )
+        self.spec_proposed = Counter(
+            "serve_spec_decode_proposed_tokens_total",
+            "Draft-model tokens proposed across speculative-decoding "
+            "steps",
+            registry=registry,
+        )
+        self.spec_accepted = Counter(
+            "serve_spec_decode_accepted_tokens_total",
+            "Proposed draft tokens accepted by target-model "
+            "verification (accepted <= proposed; the bonus token per "
+            "step is not counted)",
+            registry=registry,
+        )
 
     # -- request lifecycle ----------------------------------------------------
 

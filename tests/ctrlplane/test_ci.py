@@ -38,7 +38,7 @@ def test_presubmit_lane_list_is_pinned():
         "notebook-controller", "resilience", "ha-shard", "bench-smoke",
         "tpujob", "inferenceservice", "lint", "journey", "slo",
         "profile", "admission-webhook", "web-apps", "compute", "native",
-        "notebook-images",
+        "notebook-images", "serve",
     ])
 
 

@@ -290,6 +290,39 @@ def test_debug_knobs_reports_unparseable_env_source():
         del os.environ["KFT_TEST_KNOB_BADINT"]
 
 
+def test_debug_knobs_reports_invalid_validated_env_source():
+    """Validated knobs (ISSUE 17, config.knob validate=): an env value
+    that parses but fails validation raises at the read site, and
+    /debug/knobs reports the rejection as env-invalid with the default
+    in effect — it must not pretend the bad value took hold."""
+    import os
+
+    import pytest
+
+    from kubeflow_tpu.platform import config
+
+    def in_range(v):
+        return None if 1 <= v <= 100 else "must be in [1, 100]"
+
+    config.knob("KFT_TEST_KNOB_RANGED", 10, int, validate=in_range)
+    os.environ["KFT_TEST_KNOB_RANGED"] = "4096"
+    try:
+        with pytest.raises(ValueError, match=r"must be in \[1, 100\]"):
+            config.knob("KFT_TEST_KNOB_RANGED", 10, int, validate=in_range)
+        entry = config.effective()["KFT_TEST_KNOB_RANGED"]
+        assert entry["value"] == 10
+        assert entry["source"] == "env-invalid"
+        # A validated knob is also strict about parse failures: it names
+        # the parser and raises instead of silently taking the default.
+        os.environ["KFT_TEST_KNOB_RANGED"] = "banana"
+        with pytest.raises(ValueError, match="not a valid int"):
+            config.knob("KFT_TEST_KNOB_RANGED", 10, int, validate=in_range)
+        assert config.effective()["KFT_TEST_KNOB_RANGED"][
+            "source"] == "env-unparseable"
+    finally:
+        del os.environ["KFT_TEST_KNOB_RANGED"]
+
+
 def test_debug_index_lists_live_surfaces():
     """/debug/ (ISSUE 15 satellite, hardened by ISSUE 16): the health
     port indexes every live debug surface with a one-line description,

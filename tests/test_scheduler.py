@@ -16,6 +16,7 @@ from werkzeug.test import Client
 
 from kubeflow_tpu.models.generate import generate
 from kubeflow_tpu.models.llama import CONFIGS, Llama
+from kubeflow_tpu.models.paged import PagedDecodeScheduler
 from kubeflow_tpu.models.scheduler import DecodeScheduler
 from kubeflow_tpu.models.serve import GenerationService, create_app
 
@@ -269,6 +270,338 @@ def test_sharded_serve_scheduler_token_equal(devices8):
     cache_leaf = next(x for x in jax.tree.leaves(sched._cache)
                       if getattr(x, "ndim", 0) >= 4)
     assert len(cache_leaf.sharding.device_set) > 1
+
+
+# -- paged KV engine (models/paged.py, ISSUE 17) --------------------------
+#
+# The token-equality matrix: paged == contiguous == sequential, across
+# greedy, seeded sampling, shared prefixes (copy-on-write divergence),
+# chunked prefill interleaved with decode, and speculative decoding at
+# its accept/reject boundaries.  The paged pool is an OPTIMIZATION —
+# every test here pins that it is never a behavior change.
+
+
+def _paged(model, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("slot_len", 64)
+    kw.setdefault("quantum", 4)
+    kw.setdefault("page_len", 16)
+    kw.setdefault("prefill_chunk", 16)
+    return PagedDecodeScheduler(model, params, **kw)
+
+
+def _pages_balanced(stats):
+    """The drained-pool balance invariant: nothing active, and every
+    non-null page is either free or resident in the prefix cache."""
+    assert stats["pages_active"] == 0, stats
+    assert (stats["pages_free"] + stats["pages_shared"]
+            == stats["pages_total"] - 1), stats
+
+
+def test_paged_greedy_matrix_token_equal(model_and_params):
+    """paged == contiguous == sequential for a mixed-length greedy
+    request whose rows span page boundaries (9 tokens over 16-token
+    pages, 12 new tokens => 2 pages per row)."""
+    model, params = model_and_params
+    rows = [[5, 6, 7, 8, 9], [1, 2, 3], [4, 4, 4, 4, 4, 4, 4, 4, 4]]
+    ref = sequential(model, params, rows, max_new_tokens=12)
+    fixed = DecodeScheduler(model, params, slots=4, slot_len=64, quantum=4)
+    assert fixed.submit(rows, max_new_tokens=12).result() == ref
+    paged = _paged(model, params)
+    assert paged.submit(rows, max_new_tokens=12).result() == ref
+    _pages_balanced(paged.stats())
+
+
+def test_paged_seeded_topk_token_equal(model_and_params):
+    model, params = model_and_params
+    rows = [[5, 6, 7, 8, 9], [1, 2, 3], [4, 4, 4, 4, 4, 4, 4, 4, 4]]
+    kw = dict(max_new_tokens=12, temperature=0.8, top_k=5, seed=7)
+    ref = sequential(model, params, rows, **kw)
+    fixed = DecodeScheduler(model, params, slots=4, slot_len=64, quantum=4)
+    assert fixed.submit(rows, **kw).result() == ref
+    paged = _paged(model, params)
+    assert paged.submit(rows, **kw).result() == ref
+
+
+def test_paged_shared_prefix_cow_divergence(model_and_params):
+    """Rows sharing a prompt prefix map to the SAME physical pages and
+    still diverge correctly after it (copy-on-write by construction:
+    decode writes land in row-owned pages, never shared ones).  Sharing
+    is cross-request: the first request populates the cache (misses
+    only), a follow-up with the same prefix hits it."""
+    model, params = model_and_params
+    sys_prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1] * 4  # 36 tokens = 2+ pages
+    chats = [sys_prompt + [10 + i] for i in range(3)]
+    paged = _paged(model, params)
+    ref = sequential(model, params, chats, max_new_tokens=8)
+    assert paged.submit(chats, max_new_tokens=8).result() == ref
+    st = paged.stats()
+    assert st["pages_shared"] > 0  # the prefix stayed resident
+    assert st["prefix_hits"] == 0  # cold cache: sharing is cross-request
+    assert st["prefix_misses"] > 0
+    # Follow-up request with the same system prompt: cache hit, output
+    # still exactly its own sequential continuation.
+    tail = [sys_prompt + [50]]
+    assert paged.submit(tail, max_new_tokens=8).result() == sequential(
+        model, params, tail, max_new_tokens=8)
+    st2 = paged.stats()
+    assert st2["prefix_hits"] > 0
+    _pages_balanced(st2)
+    # Drained pool: no lane holds pages, the shared set persists.
+    snap = paged.debug_pages()
+    assert snap["lanes"] == {} and snap["shared"]
+
+
+def test_paged_chunked_prefill_interleaves_with_eviction(model_and_params):
+    """A long prompt prefills in page-sized chunks BETWEEN decode quanta
+    while short requests EOS out and refill freed lanes mid-flight —
+    every output token-equal, pool drains balanced.  2 lanes + 6
+    threaded requests force both interleave and refill."""
+    model, params = model_and_params
+    paged = _paged(model, params, slots=2, quantum=2, page_len=8,
+                   prefill_chunk=8)
+    long_prompt = [(i * 7 + 3) % 250 + 1 for i in range(40)]  # 5 chunks
+    ref = sequential(model, params, [[5, 9, 2, 7]], max_new_tokens=10)
+    eos = ref[0][4]
+    reqs = [
+        ([long_prompt], dict(max_new_tokens=12)),
+        ([[5, 9, 2, 7]], dict(max_new_tokens=10, eos_token=eos)),
+        ([[1, 2, 3]], dict(max_new_tokens=12)),
+        ([[4, 4]], dict(max_new_tokens=6, temperature=0.5, top_k=4,
+                        seed=3)),
+        ([[9, 7, 5]], dict(max_new_tokens=4, eos_token=eos)),
+        ([long_prompt[:23]], dict(max_new_tokens=8)),
+    ]
+    outs = {}
+
+    def client(i, rows, kw):
+        outs[i] = paged.submit(rows, **kw).result()
+
+    threads = [threading.Thread(target=client, args=(i, r, kw))
+               for i, (r, kw) in enumerate(reqs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i, (rows, kw) in enumerate(reqs):
+        assert outs[i] == sequential(model, params, rows, **kw), i
+    stats = paged.stats()
+    assert stats["admitted_total"] == stats["evicted_total"] == 6
+    _pages_balanced(stats)
+
+
+def test_paged_spec_decode_zero_accept_boundary(model_and_params):
+    """Speculative floor: a draft that NEVER agrees with the target
+    (independent random init — deterministically disjoint argmaxes at
+    this scale) forces the 0-accepted boundary every step.  Each verify
+    still emits exactly one correct token: output token-equal, just no
+    speedup."""
+    model, params = model_and_params
+    draft_params = model.init(jax.random.key(1),
+                              jnp.ones((1, 8), jnp.int32))["params"]
+    rows = [[5, 6, 7, 8, 9], [1, 2, 3], [4, 4, 4, 4, 4, 4, 4, 4, 4]]
+    sp = _paged(model, params, draft_model=model,
+                draft_params=draft_params, spec_tokens=3)
+    assert sp.submit(rows, max_new_tokens=12).result() == sequential(
+        model, params, rows, max_new_tokens=12)
+    st = sp.stats()
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] == 0  # the boundary this test exists for
+
+
+def test_paged_spec_decode_all_accept_boundary(model_and_params):
+    """Speculative ceiling: draft == target accepts every proposal
+    (greedy determinism), so each step emits k+1 tokens — and the output
+    is still byte-identical to sequential."""
+    model, params = model_and_params
+    rows = [[5, 6, 7, 8, 9], [1, 2, 3], [4, 4, 4, 4, 4, 4, 4, 4, 4]]
+    sp = _paged(model, params, draft_model=model, draft_params=params,
+                spec_tokens=3)
+    assert sp.submit(rows, max_new_tokens=12).result() == sequential(
+        model, params, rows, max_new_tokens=12)
+    st = sp.stats()
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] == st["spec_proposed"]
+
+
+def test_paged_spec_decode_eos_inside_draft_window(model_and_params):
+    """EOS landing MID-WINDOW (an accepted draft token is the EOS) must
+    stop that row exactly there and right-pad — identical to the
+    sequential EOS semantics, with tokens past the EOS discarded even
+    though the verify step already scored them."""
+    model, params = model_and_params
+    rows = [[5, 9, 2, 7]]
+    ref = sequential(model, params, rows, max_new_tokens=10)
+    # Pick a token at positions 1..3 (inside the first k+1=4 window)
+    # whose value has not already appeared — its first occurrence is the
+    # stopping point on both engines.
+    p = next(i for i in range(1, 4) if ref[0][i] not in ref[0][:i])
+    eos = ref[0][p]
+    sp = _paged(model, params, draft_model=model, draft_params=params,
+                spec_tokens=3)
+    got = sp.submit(rows, max_new_tokens=10, eos_token=eos).result()
+    assert got == sequential(model, params, rows, max_new_tokens=10,
+                             eos_token=eos)
+    assert got[0][p + 1:] == [eos] * (9 - p)  # stopped at in-window EOS
+
+
+def test_paged_env_gate_falls_back_to_fixed_pool(model_and_params,
+                                                 monkeypatch):
+    """KFT_SERVE_PAGED=0 restores the fixed-slot engine unchanged; the
+    default service grows the paged one."""
+    model, params = model_and_params
+    on = GenerationService(model, params)
+    create_app(on, model_name="m")
+    assert isinstance(on._scheduler_or_none(), PagedDecodeScheduler)
+    monkeypatch.setenv("KFT_SERVE_PAGED", "0")
+    off = GenerationService(model, params)
+    create_app(off, model_name="m")
+    sched = off._scheduler_or_none()
+    assert isinstance(sched, DecodeScheduler)
+    assert not isinstance(sched, PagedDecodeScheduler)
+
+
+def test_paged_page_len_must_divide_slot_len(model_and_params):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="divisor"):
+        PagedDecodeScheduler(model, params, slots=2, slot_len=64,
+                             page_len=24)
+
+
+def test_paged_knob_validation_raises_and_reports(model_and_params,
+                                                  monkeypatch):
+    """Strict knobs (config.knob validate=): a bad KFT_SERVE_PAGE_LEN
+    raises at the read site instead of silently serving the default,
+    and /debug/knobs reports the rejection source."""
+    from kubeflow_tpu.platform import config
+
+    model, params = model_and_params
+    monkeypatch.setenv("KFT_SERVE_PAGE_LEN", "banana")
+    with pytest.raises(ValueError, match="not a valid int"):
+        PagedDecodeScheduler(model, params, slots=2, slot_len=64)
+    monkeypatch.setenv("KFT_SERVE_PAGE_LEN", "8192")
+    with pytest.raises(ValueError, match="must be in"):
+        PagedDecodeScheduler(model, params, slots=2, slot_len=64)
+    monkeypatch.setenv("KFT_SERVE_SPEC_TOKENS", "-1")
+    monkeypatch.delenv("KFT_SERVE_PAGE_LEN")
+    with pytest.raises(ValueError, match="must be in"):
+        PagedDecodeScheduler(model, params, slots=2, slot_len=64)
+    monkeypatch.setenv("KFT_SERVE_PAGE_LEN", "8192")
+    eff = config.effective()["KFT_SERVE_PAGE_LEN"]
+    assert eff["source"] == "env-invalid" and eff["value"] == 64
+    monkeypatch.setenv("KFT_SERVE_PAGE_LEN", "banana")
+    eff = config.effective()["KFT_SERVE_PAGE_LEN"]
+    assert eff["source"] == "env-unparseable" and eff["value"] == 64
+
+
+def test_paged_submit_over_page_capacity_raises(model_and_params):
+    """Worst-case page demand beyond the pool fails at submit (a clear
+    error) instead of stalling admission forever."""
+    model, params = model_and_params
+    paged = PagedDecodeScheduler(model, params, slots=2, slot_len=64,
+                                 quantum=2, page_len=16, num_pages=6)
+    with pytest.raises(ValueError, match="KV pages"):
+        paged.submit([[1, 2]] * 4, max_new_tokens=30)
+
+
+def test_paged_rejects_mesh(model_and_params):
+    model, params = model_and_params
+
+    class FakeMesh:
+        pass
+
+    with pytest.raises(ValueError, match="mesh"):
+        PagedDecodeScheduler(model, params, mesh=FakeMesh())
+
+
+@pytest.mark.slow
+def test_paged_soak_shared_prefix_invariants(model_and_params):
+    """Paged-pool soak (serve-soak postsubmit): concurrent HTTP clients
+    hammer chats sharing one system prompt.  Invariants: token equality
+    per prompt (no cross-request page mixing), zero page aliasing
+    outside the declared shared prefix at every live snapshot, prefix
+    hits accrue, and the drained pool balances."""
+    import json as _json
+    import urllib.request
+
+    model, params = model_and_params
+    service = GenerationService(model, params)
+    app = create_app(service, model_name="llama_debug")
+    # Explicit knobs: 8-token pages make the 18-token system prompt span
+    # 2+ cacheable pages inside the debug model's 64-token window.
+    sched = PagedDecodeScheduler(
+        model, params, slots=4, slot_len=64, quantum=4, page_len=8,
+        prefill_chunk=16, telemetry=lambda: service.telemetry)
+    service._scheduler = sched
+    server, base = app.test_server()
+    sys_prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1] * 2  # 18 tokens
+    prompts = [sys_prompt + [10 + i] for i in range(5)]
+    expect = {
+        i: sequential(model, params, [p], max_new_tokens=6)[0]
+        for i, p in enumerate(prompts)
+    }
+    errors = []
+    counts = [0] * 8
+    deadline = time.time() + 6.0
+
+    def hammer(cid):
+        i = cid
+        while time.time() < deadline:
+            i = (i + 3) % len(prompts)
+            try:
+                req = urllib.request.Request(
+                    base + "/v1/generate",
+                    data=_json.dumps({
+                        "tokens": [prompts[i]], "max_new_tokens": 6,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    out = _json.loads(resp.read())["tokens"]
+            except Exception as e:  # noqa: BLE001 — collect, fail below
+                errors.append((cid, repr(e)))
+                return
+            if out != [expect[i]]:
+                errors.append((cid, f"row mixing: prompt {i} -> {out}"))
+                return
+            counts[cid] += 1
+
+    def aliasing_violations():
+        snap = sched.debug_pages()
+        shared, lanes = snap["shared"], list(snap["lanes"].items())
+        bad = []
+        for ai in range(len(lanes)):
+            for bi in range(ai + 1, len(lanes)):
+                overlap = (set(lanes[ai][1]) & set(lanes[bi][1])) - shared
+                if overlap:
+                    bad.append((lanes[ai][0], lanes[bi][0], overlap))
+        return bad
+
+    threads = [threading.Thread(target=hammer, args=(c,))
+               for c in range(8)]
+    try:
+        for t in threads:
+            t.start()
+        while any(t.is_alive() for t in threads):
+            # Live aliasing check.  The snapshot races the loop thread
+            # (pages can be freed+reissued between reading two lanes),
+            # so only a violation that SURVIVES re-reads is real.
+            if aliasing_violations():
+                if aliasing_violations() and aliasing_violations():
+                    pytest.fail(f"page aliasing: {aliasing_violations()}")
+            time.sleep(0.05)
+        for t in threads:
+            t.join()
+    finally:
+        server.shutdown()
+    assert not errors, errors[:5]
+    assert all(c > 0 for c in counts), counts
+    stats = sched.stats()
+    assert stats["admitted_total"] == stats["evicted_total"]
+    assert stats["active_rows"] == 0 and stats["queued_rows"] == 0
+    assert stats["prefix_hits"] > 0  # the shared prompt really shared
+    _pages_balanced(stats)
+    assert sched.debug_pages()["lanes"] == {}
 
 
 @pytest.mark.slow

@@ -103,6 +103,35 @@ def test_serve_from_checkpoint(tmp_path):
     assert len(out[0]) == 3
 
 
+def test_serve_draft_model_plumbing_and_validation():
+    """--draft-model (ISSUE 17): load_service builds a same-vocab draft
+    pair that the paged scheduler consumes for speculative decoding, and
+    rejects incompatible drafts at startup, not on the first step."""
+    from kubeflow_tpu.models.paged import PagedDecodeScheduler
+    from kubeflow_tpu.models.serve import create_app, load_service
+
+    svc = load_service("llama_debug", max_seq_len=64,
+                       draft_model_name="llama_debug")
+    assert svc.draft_model is not None and svc.draft_params is not None
+    create_app(svc, model_name="llama_debug")  # attaches telemetry
+    sched = svc._scheduler_or_none()
+    try:
+        assert isinstance(sched, PagedDecodeScheduler)
+        assert sched.draft_model is svc.draft_model
+        assert sched.draft_params is svc.draft_params
+    finally:
+        if sched is not None:
+            sched.stop()
+
+    # A seq2seq draft can't propose into a decoder-only token stream.
+    with pytest.raises(ValueError, match="needs a decoder-only draft"):
+        load_service("llama_debug", max_seq_len=64,
+                     draft_model_name="t5_debug")
+    # And seq2seq targets never route through the paged scheduler.
+    with pytest.raises(ValueError, match="decoder-only serving"):
+        load_service("t5_debug", draft_model_name="llama_debug")
+
+
 def test_serve_seq2seq_model():
     """T5 serving: `tokens` rows are sources, response is the generated
     target — same HTTP contract, routed to the seq2seq service."""

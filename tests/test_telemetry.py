@@ -418,3 +418,91 @@ def test_tracer_key_naming_matches_plane():
     d = t.finish("ok")
     assert d["component"] == "model-serve" and d["request"] == "req-1"
     assert "controller" not in d
+
+
+# -- paged-KV serve metrics (ISSUE 17) ---------------------------------------
+
+
+def _metric_value(text, name, labels=""):
+    import re
+
+    pat = rf"^{re.escape(name)}{re.escape(labels)} ([0-9.e+-]+)$"
+    m = re.search(pat, text, re.M)
+    return float(m.group(1)) if m else None
+
+
+def test_serve_kv_page_balance_invariants():
+    """The paged pool's metric balance, pinned: at drain
+    free + active + shared == pages_total - 1 (the null page belongs to
+    no state), active returns to 0, the prefix counters accrue on a
+    shared-prompt workload, and fragmentation reads 0 with no live
+    rows."""
+    import dataclasses
+
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.models.paged import PagedDecodeScheduler
+    from kubeflow_tpu.models.serve import GenerationService, create_app
+
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=64)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))[
+        "params"]
+    service = GenerationService(model, params)
+    client = Client(create_app(service, model_name="m"))
+    sched = PagedDecodeScheduler(
+        model, params, slots=4, slot_len=64, quantum=4, page_len=8,
+        prefill_chunk=16, telemetry=lambda: service.telemetry)
+    service._scheduler = sched
+    sys_prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1] * 2  # 18 tokens = 2+ pages
+    service.generate([sys_prompt + [40], sys_prompt + [41]],
+                     max_new_tokens=5)
+    service.generate([sys_prompt + [42]], max_new_tokens=5)  # cache hit
+    text = client.get("/metrics").get_data(as_text=True)
+    free = _metric_value(text, "serve_kv_pages", '{state="free"}')
+    active = _metric_value(text, "serve_kv_pages", '{state="active"}')
+    shared = _metric_value(text, "serve_kv_pages", '{state="shared"}')
+    assert active == 0.0  # pool drained
+    assert shared > 0  # the system prompt stayed resident
+    assert free + active + shared == sched.num_pages - 1
+    assert _metric_value(
+        text, "serve_kv_page_fragmentation_ratio") == 0.0
+    hits = _metric_value(text, "serve_prefix_cache_hits_total")
+    misses = _metric_value(text, "serve_prefix_cache_misses_total")
+    assert hits > 0 and misses > 0  # second request hit, first missed
+    # Counters mirror the scheduler's own ledger exactly.
+    st = sched.stats()
+    assert (hits, misses) == (st["prefix_hits"], st["prefix_misses"])
+
+
+def test_serve_spec_decode_counters_balance():
+    """accepted <= proposed always; with draft == target (greedy
+    determinism) every proposal is accepted and both counters ride the
+    serve registry."""
+    import dataclasses
+
+    from werkzeug.test import Client
+
+    from kubeflow_tpu.models.llama import CONFIGS, Llama
+    from kubeflow_tpu.models.paged import PagedDecodeScheduler
+    from kubeflow_tpu.models.serve import GenerationService, create_app
+
+    cfg = dataclasses.replace(CONFIGS["llama_debug"], max_seq_len=64)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32))[
+        "params"]
+    service = GenerationService(model, params)
+    client = Client(create_app(service, model_name="m"))
+    service._scheduler = PagedDecodeScheduler(
+        model, params, slots=4, slot_len=64, quantum=4, page_len=8,
+        spec_tokens=3, draft_model=model, draft_params=params,
+        telemetry=lambda: service.telemetry)
+    service.generate([[5, 9, 2, 7]], max_new_tokens=10)
+    text = client.get("/metrics").get_data(as_text=True)
+    proposed = _metric_value(
+        text, "serve_spec_decode_proposed_tokens_total")
+    accepted = _metric_value(
+        text, "serve_spec_decode_accepted_tokens_total")
+    assert proposed > 0
+    assert accepted == proposed  # draft == target: the all-accept bound
