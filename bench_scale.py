@@ -192,6 +192,37 @@ INFERENCE_SCALE_BASELINE_S = 0.7
 FLEETSCRAPE_TARGETS = 200
 FLEETSCRAPE_SAMPLES_BASELINE = 45_000.0
 
+# Wire-codec decode bands (ISSUE 18): the native watch-line fast path
+# (native/wirecodec.cc scanner through k8s/codec.decode_event) against
+# the pure-Python json.loads leg, A/B over the same corpus of realistic
+# ~3 KB pod watch lines (full status/conditions/containerStatuses — the
+# object size a 100k-object fleet actually streams).  Each leg decodes
+# and then reads the three metadata identity fields (name / namespace /
+# resourceVersion), exactly the admit+dedup touch pattern, so the native
+# leg's laziness is measured at the honest boundary — identity reads
+# answer from the scanner's extracted fields without any Python JSON
+# parse.  Measured 2026-08-06 on the 2-CPU dev container: python ~35k
+# events/s, native ~170k events/s (4.9x).  Two gates: the usual 3x
+# throughput band on the native leg, AND the in-run speedup itself must
+# hold DECODE_SPEEDUP_MIN — a regression that slowed both legs equally
+# would slip a throughput-only band on a faster machine.
+DECODE_AB_EVENTS = 1500
+DECODE_SPEEDUP_MIN = 3.0
+DECODE_EPS_BASELINE = 150_000.0
+# Server-side shard filtering band (ISSUE 18): with ShardFilter
+# subscriptions pushed into watch/list, each of the 4 replicas' streams
+# should carry only ~1/4 of the informer-kind events plus rebalance
+# replay and fail-open deliveries (involved-source Events without a
+# derivable key, unfiltered startup streams).  The banded value is the
+# MEAN per-replica fraction of emitted informer-kind events actually
+# decoded (measured stable ~0.28 at smoke size); the per-replica MAX
+# rides along unbanded — at 24-name smoke waves the shard hash lottery
+# swings single replicas to ~0.42 on identical code.  Before server-side
+# filtering every replica decoded the full stream (fraction 1.0), so the
+# <1.0 assertion alone already proves the wall came down; 0.35 bounds
+# the slop.
+DECODE_FRACTION_MAX = 0.35
+
 # Always-on profiler overhead band (ISSUE 16): sampler-on vs sampler-off
 # fleet-converge waves, min-of-N per arm.  The budget is 5% — the design
 # point that justifies running the sampler ALWAYS (GWP lineage): at
@@ -722,6 +753,149 @@ def run_profile_overhead(n: int, *, rounds: int = 2,
     }
 
 
+def _watch_line(i: int) -> bytes:
+    """One realistic pod watch line (~3 KB): full spec with tolerations,
+    volumes, probes, and a Running status with conditions and
+    containerStatuses — the shape and size a real kubelet-fed apiserver
+    streams at fleet scale.  Deterministic in ``i`` so both A/B legs and
+    repeated runs decode the identical corpus."""
+    nb = f"nb-{i % 24}"
+    pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": f"{nb}-0", "namespace": f"user{i % 5}",
+            "uid": f"8f2c{i:08d}-aaaa-bbbb-cccc-000000000000",
+            "resourceVersion": str(100000 + i),
+            "creationTimestamp": "2026-08-06T01:02:03Z",
+            "labels": {"notebook-name": nb, "app": "notebook",
+                       "statefulset": nb,
+                       "controller-revision-hash": f"{nb}-7b9df"},
+            "annotations": {
+                "kubeflow.org/creator": f"user{i % 5}@example.com",
+                "kubernetes.io/config.seen":
+                    "2026-08-06T01:02:04.123456789Z",
+                "prometheus.io/scrape": "true",
+                "prometheus.io/port": "8888"},
+            "ownerReferences": [{
+                "apiVersion": "apps/v1", "kind": "StatefulSet",
+                "name": nb, "uid": f"11112222-3333-4444-5555-{i:012d}",
+                "controller": True, "blockOwnerDeletion": True}],
+        },
+        "spec": {
+            "nodeName": f"tpu-node-{i % 16}",
+            "serviceAccountName": "default-editor",
+            "schedulerName": "default-scheduler",
+            "tolerations": [
+                {"key": "google.com/tpu", "operator": "Exists",
+                 "effect": "NoSchedule"},
+                {"key": "node.kubernetes.io/not-ready",
+                 "operator": "Exists", "effect": "NoExecute",
+                 "tolerationSeconds": 300},
+                {"key": "node.kubernetes.io/unreachable",
+                 "operator": "Exists", "effect": "NoExecute",
+                 "tolerationSeconds": 300}],
+            "volumes": [
+                {"name": "workspace", "persistentVolumeClaim":
+                    {"claimName": f"workspace-{nb}"}},
+                {"name": "dshm", "emptyDir": {"medium": "Memory"}},
+                {"name": "kube-api-access", "projected": {"sources": [
+                    {"serviceAccountToken": {"expirationSeconds": 3607,
+                                             "path": "token"}}]}}],
+            "containers": [{
+                "name": "notebook",
+                "image": "jupyter/tensorflow-notebook:v1.8",
+                "command": ["jupyter"], "args": ["lab", "--ip=0.0.0.0"],
+                "ports": [{"containerPort": 8888,
+                           "name": "notebook-port", "protocol": "TCP"}],
+                "env": [
+                    {"name": "NB_PREFIX",
+                     "value": f"/notebook/user{i % 5}/{nb}"},
+                    {"name": "JUPYTER_ENABLE_LAB", "value": "yes"},
+                    {"name": "TPU_WORKER_ID", "value": str(i % 8)}],
+                "resources": {
+                    "limits": {"cpu": "4", "memory": "16Gi",
+                               "google.com/tpu": "8"},
+                    "requests": {"cpu": "2", "memory": "8Gi",
+                                 "google.com/tpu": "8"}},
+                "volumeMounts": [
+                    {"name": "workspace", "mountPath": "/home/jovyan"},
+                    {"name": "dshm", "mountPath": "/dev/shm"},
+                    {"name": "kube-api-access", "readOnly": True,
+                     "mountPath": "/var/run/secrets/"
+                                  "kubernetes.io/serviceaccount"}],
+                "livenessProbe": {
+                    "httpGet": {"path": "/api", "port": 8888},
+                    "initialDelaySeconds": 10, "periodSeconds": 5},
+                "imagePullPolicy": "IfNotPresent",
+                "terminationMessagePath": "/dev/termination-log"}],
+            "restartPolicy": "Always", "dnsPolicy": "ClusterFirst",
+            "terminationGracePeriodSeconds": 30,
+        },
+        "status": {
+            "phase": "Running",
+            "podIP": f"10.4.{i % 256}.{(i * 7) % 256}",
+            "hostIP": f"10.0.0.{i % 16}", "qosClass": "Burstable",
+            "startTime": "2026-08-06T01:02:05Z",
+            "conditions": [
+                {"type": t, "status": "True", "lastProbeTime": None,
+                 "lastTransitionTime": "2026-08-06T01:02:30Z"}
+                for t in ("Initialized", "Ready", "ContainersReady",
+                          "PodScheduled")],
+            "containerStatuses": [{
+                "name": "notebook", "ready": True, "restartCount": 0,
+                "started": True,
+                "image": "jupyter/tensorflow-notebook:v1.8",
+                "imageID": "docker-pullable://jupyter/"
+                           "tensorflow-notebook@sha256:" + "ab" * 32,
+                "containerID": "containerd://" + "cd" * 32,
+                "state": {"running":
+                          {"startedAt": "2026-08-06T01:02:20Z"}}}],
+        },
+    }
+    return json.dumps({"type": "MODIFIED", "object": pod},
+                      separators=(",", ":")).encode()
+
+
+def run_decode_ab(n_events: int = DECODE_AB_EVENTS) -> dict:
+    """The wire-codec A/B (ISSUE 18): decode the same corpus of
+    realistic pod watch lines through both codec engines, each event
+    followed by the three metadata identity reads the admit/dedup path
+    performs.  Best-of-3 per leg (throughput: max is the one-sided-noise
+    statistic, like the jobqueue band).  The python leg always runs —
+    it is the denominator of the speedup gate."""
+    from kubeflow_tpu.platform import native
+    from kubeflow_tpu.platform.k8s import codec
+
+    lines = [_watch_line(i) for i in range(n_events)]
+    avg_bytes = sum(len(ln) for ln in lines) / len(lines)
+
+    def leg(engine: str) -> float:
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for ln in lines:
+                _etype, obj = codec.decode_event(ln, engine=engine)
+                m = obj["metadata"]
+                m.get("name")
+                m.get("namespace")
+                m.get("resourceVersion")
+            best = max(best, len(lines) / (time.perf_counter() - t0))
+        return best
+
+    python_eps = leg("python")
+    native_available = native.available()
+    native_eps = leg("native") if native_available else 0.0
+    return {
+        "events": n_events,
+        "avg_line_bytes": round(avg_bytes, 0),
+        "python_eps": round(python_eps, 0),
+        "native_eps": round(native_eps, 0),
+        "speedup_x": round(native_eps / max(python_eps, 1e-9), 2),
+        "native_available": native_available,
+        "native_load_error": native.load_error(),
+    }
+
+
 def run_sharded(n: int, *, replicas: int = SHARDED_REPLICAS,
                 num_shards: int = SHARDED_SHARDS,
                 timeout: float = 900.0) -> dict:
@@ -745,8 +919,31 @@ def run_sharded(n: int, *, replicas: int = SHARDED_REPLICAS,
                          lease_seconds=SHARDED_LEASE_S,
                          renew_seconds=SHARDED_LEASE_S / 10.0)
     try:
+        # Decode-fraction protocol (ISSUE 18): measure the wave against a
+        # SETTLED shard map — during initial lease acquisition the
+        # subscriptions are still widening and streams replay history, so
+        # an unsettled start would charge rebalance churn to the steady
+        # state.  Denominator: events the fake broadcast for the kinds
+        # the replicas actually inform on (pre-filter — what an
+        # unfiltered replica would have had to decode).  Numerator: each
+        # replica's informers' events_seen delta (post-filter decodes).
+        fleet.wait_stable_shard_map()
+        informer_kinds = set()
+        for r in fleet.replicas:
+            informer_kinds.update(g.kind for g in r.controller.informers)
+        emitted0 = {k: fleet.kube.events_emitted.get(k, 0)
+                    for k in informer_kinds}
+        seen0 = {i: s["events_seen"]
+                 for i, s in fleet.cache_stats().items()}
         converge_s = fleet.wave(n, timeout=timeout)
         stats = fleet.cache_stats()
+        emitted_delta = sum(
+            fleet.kube.events_emitted.get(k, 0) - emitted0[k]
+            for k in informer_kinds)
+        decode_fracs = [
+            (stats[r.index]["events_seen"] - seen0[r.index])
+            / max(emitted_delta, 1)
+            for r in fleet.replicas]
         # Single-process baseline: a full-keyspace informer set caches
         # every live object of the watched kinds.
         watched = (NOTEBOOK, POD, STATEFULSET, SERVICE,
@@ -774,6 +971,12 @@ def run_sharded(n: int, *, replicas: int = SHARDED_REPLICAS,
         "replica_events_admitted": admitted,
         "replica_admit_frac_mean": round(
             sum(admitted) / max(sum(seen), 1), 4),
+        "events_emitted_delta": emitted_delta,
+        "replica_decode_fraction": [round(f, 4) for f in decode_fracs],
+        "decode_fraction_mean": round(
+            sum(decode_fracs) / max(len(decode_fracs), 1), 4),
+        "decode_fraction_max": round(max(decode_fracs), 4)
+        if decode_fracs else 0.0,
         "fenced_writes_checked": fenced_writes,
         "shard_map": shard_map,
     }
@@ -1134,6 +1337,29 @@ def _run_and_report_sharded(args) -> bool:
         "band": "pass" if load_ok else "REGRESSION",
         "band_floor": SHARDED_CACHE_FRAC_MAX,
     }), flush=True)
+    # Server-side shard filtering (ISSUE 18): the banded value is the
+    # MEAN per-replica decoded fraction of the informer-kind stream —
+    # the per-replica max rides along unbanded because at smoke-size
+    # waves the shard hash lottery swings single replicas well past the
+    # mean on identical code.  < 1.0 is the structural assertion (every
+    # replica decoded everything before server-side filtering);
+    # DECODE_FRACTION_MAX bounds the steady-state slop.
+    frac_ok = (sharded["decode_fraction_mean"] <= DECODE_FRACTION_MAX
+               and sharded["decode_fraction_mean"] < 1.0
+               and sharded["events_emitted_delta"] > 0)
+    print(json.dumps({
+        "metric": "ctrlplane_replica_decode_fraction",
+        "value": sharded["decode_fraction_mean"],
+        "unit": "mean per-replica fraction of emitted informer-kind "
+                "events decoded (server-side shard filtering; 1.0 = "
+                "every replica decodes the full stream)",
+        "replica_decode_fraction": sharded["replica_decode_fraction"],
+        "decode_fraction_max": sharded["decode_fraction_max"],
+        "events_emitted_delta": sharded["events_emitted_delta"],
+        "replicas": sharded["replicas"],
+        "band": "pass" if frac_ok else "REGRESSION",
+        "band_floor": DECODE_FRACTION_MAX,
+    }), flush=True)
     converge_ok = (sharded["converge_s"]
                    <= SHARDED_CONVERGE_BASELINE_S * BAND_FACTOR
                    if sharded["fleet"] >= 1000 else True)
@@ -1141,7 +1367,7 @@ def _run_and_report_sharded(args) -> bool:
     # fence; that must fail the PROCESS (the ha-chaos lane gates on exit
     # code), not just color a band string.
     fence_ok = sharded["fenced_writes_checked"] > 0
-    return load_ok and converge_ok and fence_ok
+    return load_ok and converge_ok and fence_ok and frac_ok
 
 
 def main(argv=None) -> int:
@@ -1194,6 +1420,29 @@ def main(argv=None) -> int:
     if args.sharded_only:
         ok = _run_and_report_sharded(args)
         return 0 if ok else 1
+
+    # Wire-codec decode A/B first: cheap, self-contained, and its corpus
+    # generation warms nothing the fleet phases depend on.
+    decode = run_decode_ab()
+    decode_ok = (decode["native_eps"] >= DECODE_EPS_BASELINE / BAND_FACTOR
+                 and decode["speedup_x"] >= DECODE_SPEEDUP_MIN)
+    print(json.dumps({
+        "metric": "ctrlplane_events_decoded_per_s",
+        "value": decode["native_eps"],
+        "unit": f"events/sec (native codec leg, {decode['events']} "
+                f"realistic ~{decode['avg_line_bytes']:.0f}B pod watch "
+                "lines, decode + 3 identity reads each, best of 3; "
+                f"gate: native >= {DECODE_SPEEDUP_MIN:g}x python)",
+        "python_eps": decode["python_eps"],
+        "speedup_x": decode["speedup_x"],
+        "avg_line_bytes": decode["avg_line_bytes"],
+        "native_available": decode["native_available"],
+        "native_load_error": decode["native_load_error"],
+        "vs_baseline": round(
+            decode["native_eps"] / DECODE_EPS_BASELINE, 4),
+        "band": "pass" if decode_ok else "REGRESSION",
+        "band_floor": round(1.0 / BAND_FACTOR, 3),
+    }), flush=True)
 
     small = run_fleet(args.small, churn_s=args.churn_seconds,
                       transport=args.transport,

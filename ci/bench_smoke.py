@@ -48,6 +48,8 @@ REQUIRED_METRICS = {
     "ctrlplane_sharded_converge_s",
     "ctrlplane_sharded_replica_load",
     "ctrlplane_fleet_churn",
+    "ctrlplane_events_decoded_per_s",
+    "ctrlplane_replica_decode_fraction",
     "tpujob_queue_decisions_per_s",
     "inferenceservice_scale_converge_s",
     "fleetscrape_samples_per_s",
@@ -63,6 +65,8 @@ BANDED_METRICS = {
     "ctrlplane_chaos_converge_s",
     "ctrlplane_sharded_converge_s",
     "ctrlplane_sharded_replica_load",
+    "ctrlplane_events_decoded_per_s",
+    "ctrlplane_replica_decode_fraction",
     "tpujob_queue_decisions_per_s",
     "inferenceservice_scale_converge_s",
     "fleetscrape_samples_per_s",
@@ -284,6 +288,38 @@ def main() -> int:
         if not isinstance(load.get(key), list) or not load[key]:
             print(f"sharded load line missing {key}", file=sys.stderr)
             return 1
+    # Wire fast path (ISSUE 18): the decode A/B line must carry both
+    # legs, and when the native library built, the native leg must beat
+    # the python leg OUTRIGHT — a scanner that loses to json.loads is a
+    # routing/implementation regression at any N (the 3x floor itself is
+    # left to the banded full run).  The decode-fraction line proves
+    # server-side shard filtering actually thinned the replica streams:
+    # a mean of 1.0 means every replica still decodes the full firehose.
+    decode = seen["ctrlplane_events_decoded_per_s"]
+    for key in ("value", "python_eps", "speedup_x", "avg_line_bytes"):
+        if not isinstance(decode.get(key), (int, float)):
+            print(f"decode A/B line missing key {key}: {decode}",
+                  file=sys.stderr)
+            return 1
+    if decode.get("native_available") and not decode["speedup_x"] > 1.0:
+        print(f"native decode lost to python json.loads: {decode}",
+              file=sys.stderr)
+        return 1
+    frac = seen["ctrlplane_replica_decode_fraction"]
+    if not (isinstance(frac.get("replica_decode_fraction"), list)
+            and frac["replica_decode_fraction"]):
+        print(f"decode-fraction line missing per-replica vector: {frac}",
+              file=sys.stderr)
+        return 1
+    if not (isinstance(frac.get("events_emitted_delta"), int)
+            and frac["events_emitted_delta"] > 0):
+        print(f"decode-fraction line: no events emitted: {frac}",
+              file=sys.stderr)
+        return 1
+    if not frac.get("value", 1.0) < 1.0:
+        print(f"replicas still decode the full stream (filtering "
+              f"unhooked?): {frac}", file=sys.stderr)
+        return 1
     # TPUJob queue band (ISSUE 11): the decision loop must actually have
     # decided — a zero count means the drain silently stopped exercising
     # the ledger.

@@ -523,6 +523,35 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # native-wire presubmit lane (ISSUE 18): build the native library,
+    # then the wire fast-path matrix — the 3-way decode/merge/encode
+    # semantics matrix (python/native/mixed engines), the native parity
+    # suite, and the sharding suite with server-side shard filtering on
+    # (its default) — plus a KF_NATIVE=0 leg proving the pure-Python
+    # fallback passes the same codec matrix: the fallback is a pinned
+    # contract, not a hope, because a box where the toolchain is absent
+    # runs it for every event.
+    name="native-wire",
+    include_dirs=[
+        "native/*", "kubeflow_tpu/platform/native.py",
+        "kubeflow_tpu/platform/k8s/*", "kubeflow_tpu/platform/runtime/*",
+        "releasing/*",
+    ],
+    steps=[
+        Step("build", ["make", "-C", "native"]),
+        Step("matrix", _pytest(
+            "tests/ctrlplane/test_wirecodec.py",
+            "tests/ctrlplane/test_native.py",
+        ) + ["-m", "not slow"], depends="build"),
+        Step("filtered-sharding", _pytest("tests/ctrlplane/test_sharding.py")
+             + ["-m", "not slow", "-k", "not 1k_wave"], depends="build"),
+        Step("python-fallback", ["env", "KF_NATIVE=0"] + _pytest(
+            "tests/ctrlplane/test_wirecodec.py") + ["-m", "not slow"],
+            depends="build"),
+    ],
+))
+
+_register(ComponentWorkflow(
     name="notebook-images",
     include_dirs=["images/*", "examples/*", "releasing/*"],
     steps=[

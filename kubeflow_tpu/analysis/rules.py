@@ -1,4 +1,4 @@
-"""The repo-native rule set (R001..R009).
+"""The repo-native rule set (R001..R010).
 
 Each rule encodes a contract a past PR bled for — the rationale, an
 example finding, and the sanctioned fix live in docs/analysis.md.  Rules
@@ -586,3 +586,48 @@ class StampedChildCreates(Rule):
                        "traceparent annotation and severs the child's "
                        "journey; use apply.create(self.client, obj) or "
                        "apply.create_or_update")
+
+
+@register
+class CodecSeamDecode(Rule):
+    """R010: watch/list hot-path JSON decode routes through the
+    ``k8s.codec`` seam (``decode_event`` / ``materialize``) — a raw
+    ``json.loads`` in runtime/ or k8s/ re-opens the Python byte wall the
+    native wire fast path removed (ISSUE 18): the event pays a full
+    document parse again, invisibly to the codec engine counters and the
+    ``ctrlplane_events_decoded_per_s`` band, and skips the LazyResource
+    deferral that keeps non-admitted replicas from decoding bodies at
+    all.  The seam modules themselves (codec.py, and client.py for raw
+    error/Status bodies at the transport edge) are the sanctioned homes
+    for the real parses."""
+
+    id = "R010"
+    summary = ("watch/list hot-path JSON decode goes through k8s.codec "
+               "(decode_event/materialize), never raw json.loads")
+    scope = (RUNTIME, "kubeflow_tpu/platform/k8s/*.py")
+    exclude = (
+        "kubeflow_tpu/platform/k8s/codec.py",   # the seam itself
+        "kubeflow_tpu/platform/k8s/client.py",  # transport-edge bodies
+    )
+
+    def check(self, tree, text, path):
+        for node in ast.walk(tree):
+            # `from json import loads` aliases the parser out from under
+            # the receiver check — flag the import itself (R005 pattern).
+            if (isinstance(node, ast.ImportFrom) and node.module == "json"
+                    and any(a.name in ("loads", "load")
+                            for a in node.names)):
+                yield (node.lineno,
+                       "importing loads/load from json hides hot-path "
+                       "decodes from the codec seam; route through "
+                       "codec.decode_event / codec.materialize")
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("loads", "load")
+                  and isinstance(node.func.value, ast.Name)
+                  and node.func.value.id == "json"):
+                yield (node.lineno,
+                       "raw json." + node.func.attr + "() on the "
+                       "watch/list hot path bypasses the codec seam; use "
+                       "codec.decode_event / codec.materialize (native "
+                       "fast path, engine counters, lazy bodies)")

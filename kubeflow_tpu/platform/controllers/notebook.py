@@ -1084,4 +1084,13 @@ def make_controller(client, **kwargs):
         # bounded watch windows): re-list the primaries periodically.
         resync_period=300.0,
         shards=shards,
+        # Server-side shard subscriptions for the watches-sourced kinds:
+        # pods carry their notebook's name in the statefulset-template
+        # label, which is exactly how pods_to_notebook_requests maps
+        # them; events shard on their involvedObject's name candidates
+        # (name, ordinal-stripped, slice-stripped — a superset of what
+        # events_to_notebook_requests resolves, so the wire filter only
+        # ever removes events admit would also drop).
+        shard_sources={POD: f"label={nbapi.LABEL_NOTEBOOK_NAME}",
+                       EVENT: "involved"},
     )

@@ -10,7 +10,6 @@ the envtest-style suites.
 """
 from __future__ import annotations
 
-import json
 import logging
 import os
 import random
@@ -19,12 +18,11 @@ import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Protocol, Tuple
 
 from kubeflow_tpu.platform import config
-from kubeflow_tpu.platform.k8s import errors
+from kubeflow_tpu.platform.k8s import codec, errors
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
     Resource,
     gvk_of,
-    json_default,
     meta,
     name_of,
     namespace_of,
@@ -64,6 +62,7 @@ class KubeClient(Protocol):
         *,
         label_selector: Optional[Dict[str, str]] = None,
         field_selector: Optional[Dict[str, str]] = None,
+        shard_filter: Optional[str] = None,
     ) -> List[Resource]: ...
 
     def create(self, obj: Resource, *, dry_run: bool = False) -> Resource: ...
@@ -108,6 +107,7 @@ class KubeClient(Protocol):
         *,
         resource_version: Optional[str] = None,
         label_selector: Optional[Dict[str, str]] = None,
+        shard_filter: Optional[str] = None,
         stop: Optional[threading.Event] = None,
     ) -> Iterator[WatchEvent]: ...
 
@@ -463,11 +463,12 @@ class RestKubeClient:
             }[ptype]
         data = None
         if body is not None:
-            # Serialize here (not via requests' json=) so frozen cache
-            # views (types.FrozenResource) cross the wire directly — a
-            # read-modify-write round trip never deep-copies just to
-            # serialize.
-            data = json.dumps(body, default=json_default)
+            # Serialize through the codec seam (not via requests' json=)
+            # so frozen cache views (types.FrozenResource) cross the wire
+            # directly — a read-modify-write round trip never deep-copies
+            # just to serialize — and a never-materialized lazy watch
+            # object passes its raw bytes back untouched.
+            data = codec.encode(body)
             headers.setdefault("Content-Type", "application/json")
         attempt = 0
         while True:
@@ -568,8 +569,15 @@ class RestKubeClient:
         return self._request("GET", gvk.path(namespace, name),
                              verb="get", kind=gvk.kind).json()
 
+    # The codec/filter surface the informer feature-detects: this client
+    # forwards shard subscriptions as the shardFilter query param (an
+    # HttpKube/FakeKube extension; a stock apiserver would ignore it, so
+    # informers only subscribe when the server honors filtering — see
+    # runtime/sharding.py ShardFilter).
+    supports_shard_filter = True
+
     def list(self, gvk, namespace=None, *, label_selector=None,
-             field_selector=None) -> List[Resource]:
+             field_selector=None, shard_filter=None) -> List[Resource]:
         """``field_selector`` is a dict of dotted field path → exact value
         (e.g. ``{"involvedObject.name": "nb"}``), serialized to the API
         server's fieldSelector syntax — only fields the server indexes for
@@ -581,15 +589,20 @@ class RestKubeClient:
         fsel = _selector_string(field_selector)
         if fsel:
             params["fieldSelector"] = fsel
+        if shard_filter:
+            params["shardFilter"] = shard_filter
         data = self._request("GET", gvk.path(namespace), params=params,
                              verb="list", kind=gvk.kind).json()
         return data.get("items", [])
 
-    def list_with_rv(self, gvk, namespace=None):
+    def list_with_rv(self, gvk, namespace=None, *, shard_filter=None):
         """List plus the collection resourceVersion — the correct point to
         resume a watch from (object RVs miss deletions; informers need the
-        snapshot RV)."""
-        data = self._request("GET", gvk.path(namespace),
+        snapshot RV).  A shard-filtered list still returns the GLOBAL
+        collection RV: the ranged relist is a cache snapshot, not a
+        narrower watch history."""
+        params = {"shardFilter": shard_filter} if shard_filter else None
+        data = self._request("GET", gvk.path(namespace), params=params,
                              verb="list", kind=gvk.kind).json()
         rv = ((data.get("metadata") or {}).get("resourceVersion"))
         return data.get("items", []), rv
@@ -662,7 +675,8 @@ class RestKubeClient:
     WATCH_TIMEOUT_SECONDS = 300
 
     def watch(self, gvk, namespace=None, *, resource_version=None,
-              label_selector=None, stop: Optional[threading.Event] = None):
+              label_selector=None, shard_filter=None,
+              stop: Optional[threading.Event] = None):
         params: Dict[str, Any] = {
             "watch": "true",
             # int(): a real apiserver rejects fractional timeoutSeconds;
@@ -675,6 +689,8 @@ class RestKubeClient:
         sel = _selector_string(label_selector)
         if sel:
             params["labelSelector"] = sel
+        if shard_filter:
+            params["shardFilter"] = shard_filter
         import requests
 
         # Establishment is idempotent (no event has streamed yet), so it
@@ -701,8 +717,11 @@ class RestKubeClient:
                     return
                 if not line:
                     continue
-                evt = json.loads(line)
-                yield evt.get("type", ""), evt.get("object", {})
+                # THE hot line at fleet scale: one decode per event per
+                # informer.  codec.decode_event scans the envelope
+                # natively and defers the body (LazyResource) so events
+                # the caller's admit drops are never fully parsed.
+                yield codec.decode_event(line)
         except requests.RequestException as e:
             # Mid-stream transport death (read timeout, reset): typed, so
             # watch loops keep their RV (k8s.errors taxonomy) instead of

@@ -93,8 +93,18 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
     def app(environ, start_response):
         path = environ.get("PATH_INFO", "")
         if path == "/healthz":
+            from kubeflow_tpu.platform import native
+
             ok = manager.healthy()
-            body = {"healthy": ok}
+            # Which wire/patch engine this replica runs on (ISSUE 18):
+            # a fleet silently stuck on the Python fallback decodes every
+            # watch event ~4x slower, and the first symptom is usually a
+            # lag alert — the engine string (plus the cached build/load
+            # failure when there is one) makes it a one-probe diagnosis.
+            body = {"healthy": ok, "engine": native.backend_info()}
+            err = native.load_error()
+            if err is not None:
+                body["engine_error"] = err
             if client is not None and hasattr(client, "health"):
                 body["rest_client"] = client.health()
             start_response("200 OK" if ok else "503 Service Unavailable",

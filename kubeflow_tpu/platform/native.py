@@ -9,18 +9,24 @@ C++ + Python):
   admission webhook to diff pods (reference admission-webhook/main.go:683-695).
 * ``kfq_*`` — delaying rate-limited workqueue used by the controller runtime
   (reference vendored client-go util/workqueue).
+* ``kfw_*`` — watch-event envelope scanner for the wire codec fast path
+  (k8s/codec.py): locates type/object/metadata byte ranges so the informer
+  defers full-body decode until an event is actually admitted.
 
 Loading is best-effort: if the shared library is absent we attempt one
-``make -C native`` (g++ is in the image); on any failure the pure-Python
-implementations are used.  ``KF_NATIVE=0`` disables the native path.
+``make -C native`` (g++ is in the image) — and only one: build failure is
+cached for the life of the process and every caller sticks to the
+pure-Python implementations (``load_error()`` says why, /healthz carries
+the engine string).  ``KF_NATIVE=0`` disables the native path.
 """
 from __future__ import annotations
 
 import ctypes
 import os
+import struct
 import subprocess
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _LIB_PATH = os.path.join(_REPO_ROOT, "kubeflow_tpu", "_native", "libkfnative.so")
@@ -28,6 +34,12 @@ _LIB_PATH = os.path.join(_REPO_ROOT, "kubeflow_tpu", "_native", "libkfnative.so"
 _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 _load_lock = threading.Lock()
+_load_error: Optional[str] = None
+
+# Everything the one shared library serves; the per-component breakdown
+# exists because /metrics wants native_engine_active{component="..."} even
+# though today the components load (or fail) as one unit.
+ENGINE_COMPONENTS = ("jsonpatch", "workqueue", "packer", "wirecodec")
 
 
 def _try_build() -> bool:
@@ -46,33 +58,68 @@ def _try_build() -> bool:
         return False
 
 
+def _knob_native() -> str:
+    from kubeflow_tpu.platform import config
+
+    try:
+        return config.knob(
+            "KF_NATIVE", "1",
+            doc="'0' disables the native C++ engine, '1' enables it",
+            validate=lambda v: None if v in ("0", "1")
+            else "must be '0' or '1'")
+    except ValueError:
+        # Strict knob: the bad env value is surfaced at /debug/knobs
+        # (source=env-invalid); the engine itself keeps the default.
+        return "1"
+
+
+def _set_engine_gauge(active: bool) -> None:
+    try:
+        from kubeflow_tpu.platform.runtime import metrics
+
+        for component in ENGINE_COMPONENTS:
+            metrics.native_engine_active.labels(
+                component=component).set(1.0 if active else 0.0)
+    except Exception:  # kft: disable=R006 metrics best-effort at load time
+        pass
+
+
+def _finish_load(lib: Optional[ctypes.CDLL], error: Optional[str]
+                 ) -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    _lib = lib
+    _load_error = error
+    _set_engine_gauge(lib is not None)
+    return _lib
+
+
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib, _load_attempted
+    global _load_attempted
     if _load_attempted:
         return _lib
     with _load_lock:
         if _load_attempted:
             return _lib
         _load_attempted = True
-        from kubeflow_tpu.platform import config
-
-        if config.knob("KF_NATIVE", "1",
-                       doc="'0' disables the native C++ engine") == "0":
-            return None
+        if _knob_native() == "0":
+            return _finish_load(None, "disabled by KF_NATIVE=0")
         if not os.path.exists(_LIB_PATH) and not _try_build():
-            return None
+            # The single build attempt this process gets: from here on
+            # every component answers from the Python fallback without
+            # re-invoking make.
+            return _finish_load(None, "build failed or unavailable")
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError:
-            return None
-        if not hasattr(lib, "kfq_is_processing"):  # newest required symbol
+        except OSError as e:
+            return _finish_load(None, f"dlopen failed: {e}")
+        if not hasattr(lib, "kfw_scan_event"):  # newest required symbol
             # Stale prebuilt library from before a symbol was added.
             # Rebuild for FUTURE processes (make re-links, sources are
             # newer) but report unavailable now — dlopen caches the mapped
             # object by path, so re-CDLL'ing in this process would return
             # the stale mapping anyway.  Python fallbacks engage.
             _try_build()
-            return None
+            return _finish_load(None, "stale library (missing kfw_scan_event)")
         # kfp: JSON patch engine
         lib.kfp_create_patch.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.kfp_create_patch.restype = ctypes.c_void_p
@@ -112,8 +159,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.kfpk_pack.argtypes = [_i64p, ctypes.c_int64, ctypes.c_int64,
                                   _i64p, _i64p]
         lib.kfpk_pack.restype = ctypes.c_int64
-        _lib = lib
-        return _lib
+        # kfw: wire codec
+        lib.kfw_scan_event.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                       _i64p]
+        lib.kfw_scan_event.restype = ctypes.c_int
+        lib.kfw_last_error.argtypes = []
+        lib.kfw_last_error.restype = ctypes.c_char_p
+        return _finish_load(lib, None)
 
 
 def available() -> bool:
@@ -140,6 +192,22 @@ def preload() -> bool:
 
 def backend_info() -> str:
     return f"native:{_LIB_PATH}" if available() else "python"
+
+
+def load_error() -> Optional[str]:
+    """Why the native engine is NOT active (None while active or before
+    the first load attempt).  Surfaced next to the engine string in
+    /healthz so a fleet stuck on the Python fallback is diagnosable."""
+    return _load_error
+
+
+def engine_components() -> Dict[str, bool]:
+    """Per-component engine state, the native_engine_active gauge's
+    source of truth (the components ship in one .so, so they activate or
+    fail together — the breakdown keeps the metric stable if that ever
+    changes)."""
+    active = available()
+    return {c: active for c in ENGINE_COMPONENTS}
 
 
 # -- JSON patch ---------------------------------------------------------------
@@ -204,6 +272,17 @@ def merge_patch_apply(doc: Any, patch: Any) -> Any:
     return json.loads(out)
 
 
+def merge_patch_create_json(before_json: str, after_json: str) -> str:
+    """String-boundary variant of merge_patch_create for callers that
+    already hold serialized documents (the wire codec): no Python-side
+    json round trip on the inputs."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    return _call_str(lib.kfp_merge_create, before_json.encode(),
+                     after_json.encode())
+
+
 def merge_patch_create(before: Any, after: Any) -> Any:
     """Diff two documents into the merge patch turning before into after."""
     import json
@@ -214,6 +293,85 @@ def merge_patch_create(before: Any, after: Any) -> Any:
     out = _call_str(lib.kfp_merge_create, json.dumps(before).encode(),
                     json.dumps(after).encode())
     return json.loads(out)
+
+
+def canonical_json(doc_json: str) -> str:
+    """Parse + re-serialize a JSON document through the native engine's
+    Python-compatible compact serializer (byte-equal to
+    ``json.dumps(obj, separators=(",", ":"))``)."""
+    lib = _load()
+    if lib is None:
+        raise NativeError("native library unavailable")
+    return _call_str(lib.kfp_canonical, doc_json.encode())
+
+
+# -- wire codec ---------------------------------------------------------------
+
+
+# One unpack of the whole 12-slot out array beats twelve ctypes
+# __getitem__ calls — the wrapper overhead is most of what separates the
+# native decode path from the 3x band (bench_scale's decode A/B).
+_KFW_UNPACK = struct.Struct("=12q").unpack_from
+
+WireScan = Tuple[str, bytes, Optional[bytes],
+                 Optional[str], Optional[str], Optional[str]]
+
+
+def wire_scanner() -> Optional[Callable[[bytes], WireScan]]:
+    """Bind the native envelope scanner into a fast per-caller closure.
+
+    Returns None when the library is unavailable.  The closure takes one
+    watch line and returns ``(etype, object_bytes, metadata_bytes_or_None,
+    name, namespace, resourceVersion)``; the trailing three are the
+    metadata identity fields when the scanner could extract them
+    (escape-free strings), else None — None means "parse the metadata
+    slice to find out", never "absent".  Raises NativeError when the line
+    does not scan.
+
+    The closure owns its out-buffer, so it is NOT thread-safe: hold one
+    closure per thread (the codec keeps them in a threading.local).
+    Binding everything per-closure keeps the per-event cost to one
+    ctypes call plus one struct unpack.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    scan = lib.kfw_scan_event
+    last_error = lib.kfw_last_error
+    out = (ctypes.c_int64 * 12)()
+    unpack = _KFW_UNPACK
+
+    def _scan(line: bytes) -> WireScan:
+        if scan(line, len(line), out) != 0:
+            raise NativeError(last_error().decode())
+        (ts, te, os_, oe, ms, me,
+         ns_s, ns_e, sp_s, sp_e, rv_s, rv_e) = unpack(out)
+        return (
+            line[ts:te].decode(),
+            line[os_:oe],
+            line[ms:me] if ms >= 0 else None,
+            line[ns_s:ns_e].decode() if ns_s >= 0 else None,
+            line[sp_s:sp_e].decode() if sp_s >= 0 else None,
+            line[rv_s:rv_e].decode() if rv_s >= 0 else None,
+        )
+
+    return _scan
+
+
+def wire_scan_event(line: bytes):
+    """Scan one watch line's envelope natively.
+
+    Returns ``(etype, object_bytes, metadata_bytes_or_None)`` — the slices
+    of ``line`` holding the event type, the full object value, and the
+    object's top-level metadata value.  Raises NativeError when the
+    library is unavailable or the line does not scan (the codec falls
+    back to json.loads on the whole line).  Convenience form of
+    :func:`wire_scanner` for tests and one-off callers."""
+    scanner = wire_scanner()
+    if scanner is None:
+        raise NativeError("native library unavailable")
+    etype, obj, meta, _, _, _ = scanner(line)
+    return etype, obj, meta
 
 
 # -- workqueue ----------------------------------------------------------------

@@ -105,7 +105,21 @@ def _diff(current: Any, desired: Any) -> Any:
 def merge_patch_for(current: Any, desired: Any) -> Optional[dict]:
     """Minimal JSON merge patch turning ``current`` into ``desired`` —
     ``None`` when nothing differs.  Top level must be mappings (merge
-    patches are objects)."""
+    patches are objects).
+
+    The diff walk runs in the native engine when it is loaded
+    (k8s/codec.py -> kfp_merge_create; frozen cache views serialize via
+    ``json_default`` without a thaw copy); the pure-Python ``_diff``
+    below is the fallback and the semantic reference — the 3-way matrix
+    in tests/ctrlplane/test_wirecodec.py pins both engines equal."""
+    from kubeflow_tpu.platform.k8s import codec
+
+    if codec.engine_native():
+        try:
+            return codec.merge_patch_native(current, desired)
+        except (codec.NativeError, TypeError, ValueError):
+            pass  # non-JSON-shaped input or engine hiccup: Python walk
+    codec.count_merge_python()
     patch = _diff(current or {}, desired or {})
     if patch is _UNCHANGED:
         return None

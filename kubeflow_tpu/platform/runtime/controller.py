@@ -235,6 +235,24 @@ def make_workqueue(*, base_delay: float = 0.05, max_delay: float = 30.0,
 EventMapper = Callable[[Resource], List[Request]]
 
 
+def _server_filter_enabled() -> bool:
+    """KF_SHARD_SERVER_FILTER: push each informer's shard subscription to
+    the apiserver (the ``shardFilter`` watch/list param) so a replica's
+    stream only carries its own ranges.  Off (``0``) keeps the pre-PR
+    behavior — full stream, client-side admit filtering only — as the
+    escape hatch if a server mis-filters.  A typo'd value surfaces at
+    /debug/knobs (env-invalid) and the default applies."""
+    try:
+        return config.knob(
+            "KF_SHARD_SERVER_FILTER", "1",
+            doc="server-side shard filtering of watch/list streams: "
+                "1 on (default), 0 off (client-side admit only)",
+            validate=lambda v: None if v in ("0", "1")
+            else "must be '0' or '1'") != "0"
+    except ValueError:
+        return True
+
+
 class Controller:
     def __init__(
         self,
@@ -255,6 +273,7 @@ class Controller:
         max_retries: Optional[int] = None,
         stuck_deadline: Optional[float] = None,
         shards=None,
+        shard_sources: Optional[Dict[GVK, Optional[str]]] = None,
     ):
         self.name = name
         self.reconciler = reconciler
@@ -335,6 +354,14 @@ class Controller:
         # this filter's — the filter is the fast path, the fence is the
         # proof.
         self.shards = shards
+        # GVK -> ShardFilter source string (runtime/sharding.ShardFilter)
+        # overriding the defaults _wire_sharding derives (primary ->
+        # "self", owns -> "owner=<primary kind>"); plain ``watches``
+        # kinds stream unfiltered unless named here (their mappers are
+        # arbitrary Python the server cannot mirror).  Map a kind to
+        # None to force it unfiltered.
+        self.shard_sources: Dict[GVK, Optional[str]] = dict(
+            shard_sources or {})
 
     def busy_workers(self) -> int:
         """Reconciles in flight right now — the worker-utilization gauge
@@ -854,6 +881,18 @@ class Controller:
         mappers_by_gvk: Dict[GVK, List[EventMapper]] = {}
         for gvk, mapper in pairs:
             mappers_by_gvk.setdefault(gvk, []).append(mapper)
+        # Server-side subscriptions (fast path on top of admit): which
+        # ShardFilter source mirrors each kind's key derivation.  The
+        # primary's reconcile key is the object itself; owned kinds map
+        # through their controlling ownerRef (exactly _owner_mapper);
+        # custom ``watches`` mappers are arbitrary Python the server
+        # cannot mirror, so those stream unfiltered unless the caller
+        # names a source in ``shard_sources``.
+        server_filter = _server_filter_enabled()
+        sources: Dict[GVK, Optional[str]] = {self.primary: "self"}
+        for g in self.owns:
+            sources[g] = f"owner={self.primary.kind}"
+        sources.update(self.shard_sources)
         for gvk, mappers in mappers_by_gvk.items():
             informer = self.informers.get(gvk)
             if informer is None:
@@ -873,6 +912,32 @@ class Controller:
                 # identically, and silently replacing another
                 # controller's predicate would be worse than keeping it.
                 informer.admit = admit
+                source = sources.get(gvk)
+                if server_filter and source is not None:
+                    # Attached ONLY together with admit (same controller,
+                    # same key derivation): a subscription narrowing a
+                    # stream some OTHER sharer's admit filters would
+                    # break the server-delivers-a-superset-of-admit
+                    # contract.  Subscribes owned + draining — a
+                    # draining shard's deltas must keep flowing until
+                    # the lease actually releases.
+                    def subscription(_source=source):
+                        from kubeflow_tpu.platform.runtime.sharding import \
+                            ShardFilter
+
+                        shards = frozenset(
+                            self.shards.owned() | self.shards.draining())
+                        if not shards:
+                            # Nothing leased yet (startup, full drain):
+                            # stream unfiltered and let admit drop —
+                            # an empty subscription would blind the
+                            # informer to acquisitions racing its
+                            # first establishment.
+                            return None
+                        return ShardFilter(self.shards.num_shards,
+                                           shards, _source).spec()
+
+                    informer.shard_subscription = subscription
             else:
                 log.debug("%s: informer %s already shard-filtered by its "
                           "owner; keeping that filter", self.name, gvk.kind)

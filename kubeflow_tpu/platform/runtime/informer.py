@@ -17,6 +17,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from kubeflow_tpu.platform.runtime import metrics, trace
+from kubeflow_tpu.platform.k8s import codec
 from kubeflow_tpu.platform.k8s.types import (
     GVK,
     Resource,
@@ -141,6 +142,19 @@ class Informer:
         # per-replica load band.  The filter may change what it answers
         # over time (shard rebalance): call refilter() after a change.
         self.admit = admit
+        # Server-side companion to admit: a callable returning the
+        # ShardFilter spec string this replica subscribes to (or None =
+        # unfiltered).  When the client advertises
+        # ``supports_shard_filter``, the spec rides the LIST and WATCH
+        # requests so the server only sends events whose keys this
+        # replica could admit — the stream itself shrinks to 1/replicas
+        # instead of every replica decoding the full fleet's bytes.
+        # admit stays wired as the correctness layer: the server filter
+        # is fail-open (a key it cannot derive is delivered), so it may
+        # deliver a superset of what admit accepts, never a subset.
+        # Attached by the controller alongside admit; refilter() breaks
+        # the live watch stream so a changed subscription takes effect.
+        self.shard_subscription: Optional[Callable[[], Optional[str]]] = None
         self.events_seen = 0       # relist items + watch deltas observed
         self.events_admitted = 0   # ... that passed admit into the store
         self._store: Dict[Tuple[str, str], Resource] = {}
@@ -168,6 +182,13 @@ class Informer:
         self._last_refilter_token = None
         self._synced = threading.Event()
         self._stop = threading.Event()
+        # Per-establishment stream breaker: set by refilter() to tear
+        # down the CURRENT watch without stopping the informer, so the
+        # next establishment carries the new shard subscription (resumed
+        # from the last seen RV — the server replays the gap under the
+        # NEW filter).  _run replaces it before each watch; stop() sets
+        # both events.
+        self._stream_stop = threading.Event()
         self._handlers: List[Handler] = []
         self._thread: Optional[threading.Thread] = None
         self._indexers: Dict[str, IndexFunc] = dict(indexers or {})
@@ -221,6 +242,7 @@ class Informer:
 
     def stop(self) -> None:
         self._stop.set()
+        self._stream_stop.set()
         metrics.deregister_informer(self)
 
     def wait_for_sync(self, timeout: float = 30.0) -> bool:
@@ -326,6 +348,23 @@ class Informer:
                 for v in vals:
                     idx.setdefault(v, {})[key] = obj
 
+    def _current_filter(self) -> Optional[str]:
+        """The shard-filter spec to send with LIST/WATCH right now, or
+        None for unfiltered.  Fail-open on every edge — no subscription
+        wired, a client that can't filter, or a subscription callable
+        that raises — because an unfiltered stream is only slower,
+        while a wrongly-filtered one starves reconcilers."""
+        if self.shard_subscription is None:
+            return None
+        if not getattr(self.client, "supports_shard_filter", False):
+            return None
+        try:
+            return self.shard_subscription()
+        except Exception:
+            log.exception("informer %s: shard subscription failed; "
+                          "streaming unfiltered", self.gvk.kind)
+            return None
+
     def _relist(self) -> Optional[str]:
         """Rebuild the store from a full LIST; returns the collection
         resourceVersion to resume the watch from (None when the client
@@ -335,10 +374,18 @@ class Informer:
 
     def _relist_locked(self) -> Optional[str]:
         t0 = time.monotonic()
+        # Ranged relist: the shard subscription rides the LIST too, so a
+        # rebalance re-seeds only the owned ranges instead of paging the
+        # full keyspace through Python.  Only forwarded when a spec is
+        # in effect — plain clients keep their unfiltered signature.
+        flt = self._current_filter()
+        kw = {} if flt is None else {"shard_filter": flt}
         if hasattr(self.client, "list_with_rv"):
-            items, rv = self.client.list_with_rv(self.gvk, self.namespace)
+            items, rv = self.client.list_with_rv(self.gvk, self.namespace,
+                                                 **kw)
         else:
-            items, rv = self.client.list(self.gvk, self.namespace), None
+            items, rv = self.client.list(self.gvk, self.namespace,
+                                         **kw), None
         self.events_seen += len(items)
         if self.admit is not None:
             items = [o for o in items if self._admitted(o)]
@@ -416,6 +463,14 @@ class Informer:
         if not self._refilter_gate.acquire(blocking=False):
             return 0  # a concurrent refilter is already doing this work
         try:
+            if self.shard_subscription is not None:
+                # Break the live watch: it was established under the OLD
+                # subscription and the server is still filtering by it.
+                # _run re-establishes from the last seen RV with the new
+                # spec; the replay since that RV runs under the NEW
+                # filter, so events for newly-acquired ranges emitted
+                # during the swap are not lost.
+                self._stream_stop.set()
             return self._refilter_gated(relist=relist)
         finally:
             self._refilter_gate.release()
@@ -463,6 +518,12 @@ class Informer:
             # release-time refilter or the next relist.
             return
         self.events_admitted += 1
+        # Admission is the decode boundary: a LazyResource (codec fast
+        # path) served admit from its eagerly-decoded metadata alone;
+        # only now — about to enter the store and reach handlers — does
+        # the full body get parsed.  The cache and everything downstream
+        # keep holding plain dicts (types.freeze dispatches on dict).
+        obj = codec.materialize(obj)
         with self._lock:
             handlers = list(self._handlers)
             key = self._key(obj)
@@ -512,9 +573,19 @@ class Informer:
                     self._synced.set()
                     failures = 0
                     deadline = _time.monotonic() + self.resync_period
+                # Fresh breaker per establishment: refilter() sets the
+                # CURRENT one to tear down a stream whose server-side
+                # shard filter went stale; the loop then re-establishes
+                # from the last seen RV under the new subscription.
+                stream_stop = threading.Event()
+                self._stream_stop = stream_stop
+                if self._stop.is_set():
+                    break  # stop() raced the swap; don't open a stream
+                flt = self._current_filter()
+                kw = {} if flt is None else {"shard_filter": flt}
                 for etype, obj in self.client.watch(
                     self.gvk, self.namespace, resource_version=rv,
-                    stop=self._stop,
+                    stop=stream_stop, **kw,
                 ):
                     if etype == "ERROR":
                         # Typically 410 Gone: the resume RV was compacted.

@@ -543,6 +543,13 @@ rest_client_retries_total = Counter(
     "+ 429s; see k8s/client.py retry policy)",
     ["verb"], registry=registry,
 )
+native_engine_active = Gauge(
+    "native_engine_active",
+    "Whether the native C++ engine serves this component (1) or the "
+    "pure-Python fallback does (0); set once per process at the first "
+    "load attempt (platform/native.py)",
+    ["component"], registry=registry,
+)
 rest_client_circuit_state = Gauge(
     "rest_client_circuit_state",
     "Client circuit breaker state (0=closed, 1=half-open, 2=open)",

@@ -105,6 +105,128 @@ def shard_of(namespace: str, name: str, num_shards: int) -> int:
     return stable_key_hash(namespace, name) % num_shards
 
 
+class ShardFilter:
+    """A server-side shard subscription: which keys a watch/list stream
+    should carry, evaluated at the APISERVER (HttpKube/FakeKube) so a
+    replica's stream never contains bytes its ``admit`` would drop.
+
+    Wire form (the ``shardFilter`` query param):
+
+        v1:<num_shards>:<shard,shard,...>:<source>
+
+    ``source`` names how the server derives the SHARD KEY from an object
+    — it must mirror the key derivation of the informer's admit mapper:
+
+    * ``self``          — the object's own ``namespace/name`` (primary
+      kinds, whose reconcile key is the object itself);
+    * ``label=<key>``   — ``namespace/<label value>`` (secondary kinds
+      mapped to their parent by a label, e.g. a Notebook's pods via
+      ``notebook-name``);
+    * ``owner=<Kind>``  — ``namespace/<controller ownerRef name>`` where
+      the controlling ownerReference has that kind (children created by
+      ``apply.create_or_update``);
+    * ``involved``      — core/v1 Event streams: candidate keys derived
+      from ``involvedObject.name`` — the name itself, the name with a
+      trailing ``-<ordinal>`` stripped (a StatefulSet pod is always
+      ``<sts>-<ordinal>``), and each with a trailing ``-s<i>`` slice
+      suffix stripped (the platform's multislice STS naming).  The
+      event is delivered when ANY candidate's shard is subscribed, so
+      this is a strict superset of every admit mapper that resolves an
+      event to its object or that object's owner by name.
+
+    FAIL-OPEN is the safety contract: an object whose source yields no
+    key (label missing, no controlling ref of the kind) is DELIVERED and
+    the client-side ``admit`` stays the correctness layer — server
+    filtering may only ever remove events admit would also drop, so a
+    source that does not apply to some object can cost bytes, never
+    keys.  Everything else (unknown source, malformed spec) parses to
+    None at the server, i.e. an unfiltered stream.
+    """
+
+    __slots__ = ("num_shards", "shards", "source")
+
+    def __init__(self, num_shards: int, shards: FrozenSet[int],
+                 source: str = "self"):
+        self.num_shards = num_shards
+        self.shards = frozenset(shards)
+        self.source = source
+
+    def spec(self) -> str:
+        return "v1:{}:{}:{}".format(
+            self.num_shards, ",".join(str(s) for s in sorted(self.shards)),
+            self.source)
+
+    @classmethod
+    def parse(cls, spec: Optional[str]) -> Optional["ShardFilter"]:
+        """Parse a wire spec; None (unfiltered) for anything malformed —
+        a server that cannot understand a subscription must deliver
+        everything rather than silently drop keys."""
+        if not spec:
+            return None
+        parts = spec.split(":", 3)
+        if len(parts) != 4 or parts[0] != "v1":
+            return None
+        try:
+            num_shards = int(parts[1])
+            shards = frozenset(int(s) for s in parts[2].split(",") if s)
+        except ValueError:
+            return None
+        source = parts[3]
+        if num_shards <= 0:
+            return None
+        if source not in ("self", "involved") and not source.startswith(
+                ("label=", "owner=")):
+            return None
+        return cls(num_shards, shards, source)
+
+    def _key_name(self, md: dict) -> Optional[str]:
+        if self.source == "self":
+            return md.get("name")
+        if self.source.startswith("label="):
+            return (md.get("labels") or {}).get(self.source[6:])
+        if self.source.startswith("owner="):
+            kind = self.source[6:]
+            for ref in md.get("ownerReferences") or ():
+                if ref.get("controller") and ref.get("kind") == kind:
+                    return ref.get("name")
+            return None
+        return None
+
+    @staticmethod
+    def _involved_candidates(obj) -> list:
+        """Key-name candidates for an ``involved`` source: the involved
+        object's name plus its ordinal- and slice-suffix-stripped forms
+        (every name an event→owner admit mapper could resolve to)."""
+        name = (obj.get("involvedObject") or {}).get("name")
+        if not name:
+            return []
+        cands = [name]
+        prefix, _, tail = name.rpartition("-")
+        if prefix and tail.isdigit():
+            cands.append(prefix)
+        for c in list(cands):
+            prefix, _, tail = c.rpartition("-")
+            if prefix and tail.startswith("s") and tail[1:].isdigit():
+                cands.append(prefix)
+        return cands
+
+    def admits(self, obj) -> bool:
+        """Whether the stream should carry this object.  Fail-open: no
+        derivable key -> deliver."""
+        md = obj.get("metadata") or {}
+        ns = md.get("namespace") or ""
+        if self.source == "involved":
+            cands = self._involved_candidates(obj)
+            if not cands:
+                return True
+            return any(shard_of(ns, name, self.num_shards) in self.shards
+                       for name in cands)
+        name = self._key_name(md)
+        if not name:
+            return True
+        return shard_of(ns, name, self.num_shards) in self.shards
+
+
 class FencingError(errors.Conflict):
     """A write was refused because this replica no longer (provably) owns
     the key's shard lease.  Subclasses Conflict deliberately: the

@@ -241,16 +241,24 @@ class ChaosKube:
         return self.inner.get(gvk, name, namespace)
 
     def list(self, gvk, namespace=None, *, label_selector=None,
-             field_selector=None) -> List[Resource]:
+             field_selector=None, shard_filter=None) -> List[Resource]:
         self._record("list", gvk.kind)
         self._inject("list", gvk.kind)
-        return self.inner.list(gvk, namespace, label_selector=label_selector,
-                               field_selector=field_selector)
+        kwargs = {"label_selector": label_selector,
+                  "field_selector": field_selector}
+        if shard_filter is not None:
+            # Only forwarded when set, so plain test doubles that predate
+            # the codec/filter surface keep working as inner clients.
+            kwargs["shard_filter"] = shard_filter
+        return self.inner.list(gvk, namespace, **kwargs)
 
-    def list_with_rv(self, gvk, namespace=None):
+    def list_with_rv(self, gvk, namespace=None, *, shard_filter=None):
         self._record("list", gvk.kind)
         self._inject("list", gvk.kind)
         if hasattr(self.inner, "list_with_rv"):
+            if shard_filter is not None:
+                return self.inner.list_with_rv(gvk, namespace,
+                                               shard_filter=shard_filter)
             return self.inner.list_with_rv(gvk, namespace)
         return self.inner.list(gvk, namespace), None
 
@@ -307,20 +315,24 @@ class ChaosKube:
         return self.inner.pod_logs(name, namespace, container=container)
 
     def watch(self, gvk, namespace=None, *, resource_version=None,
-              label_selector=None, stop: Optional[threading.Event] = None
+              label_selector=None, shard_filter=None,
+              stop: Optional[threading.Event] = None
               ) -> Iterator[Tuple[str, Resource]]:
         self._record("watch", gvk.kind)
         with self._lock:
             self.watch_establishments.append({
                 "kind": gvk.kind, "namespace": namespace,
                 "resource_version": resource_version,
+                "shard_filter": shard_filter,
             })
         # Establishment faults (429/503/timeout/410 ...) fire BEFORE the
         # inner watch registers, exactly like a rejected HTTP upgrade.
         self._inject("watch", gvk.kind)
-        inner_iter = self.inner.watch(
-            gvk, namespace, resource_version=resource_version,
-            label_selector=label_selector, stop=stop)
+        kwargs = {"resource_version": resource_version,
+                  "label_selector": label_selector, "stop": stop}
+        if shard_filter is not None:
+            kwargs["shard_filter"] = shard_filter
+        inner_iter = self.inner.watch(gvk, namespace, **kwargs)
 
         def stream() -> Iterator[Tuple[str, Resource]]:
             for evt in inner_iter:

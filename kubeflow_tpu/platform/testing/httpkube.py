@@ -23,6 +23,7 @@ from urllib.parse import parse_qs
 
 from kubeflow_tpu.platform.k8s import errors
 from kubeflow_tpu.platform.k8s.types import GVK, WELL_KNOWN
+from kubeflow_tpu.platform.runtime.sharding import ShardFilter
 
 # RestKubeClient PATCH Content-Type → FakeKube patch_type.
 _PATCH_TYPES = {
@@ -157,10 +158,16 @@ class HttpKube:
             items, rv = self.kube.list_with_rv(gvk, namespace)
             label = _parse_selector(params.get("labelSelector"))
             field = _parse_selector(params.get("fieldSelector"))
+            filt = ShardFilter.parse(params.get("shardFilter"))
             if label:
                 items = [o for o in items if match_labels(o, label)]
             if field:
                 items = [o for o in items if _match_fields(o, field)]
+            if filt is not None:
+                # Server-side shard range: filtering happens before
+                # serialization, so the ranged relist after a shard move
+                # only ships the subscribed range's bytes.
+                items = [o for o in items if filt.admits(o)]
             return self._json(start_response, {
                 "kind": gvk.kind + "List",
                 "apiVersion": gvk.api_version,
@@ -231,12 +238,14 @@ class HttpKube:
         timer.start()
         label = _parse_selector(params.get("labelSelector"))
         rv = params.get("resourceVersion")
+        shard_filter = params.get("shardFilter")
 
         def stream() -> Iterator[bytes]:
             try:
                 for etype, obj in self.kube.watch(
                     gvk, namespace, resource_version=rv,
-                    label_selector=label, stop=stop,
+                    label_selector=label, shard_filter=shard_filter,
+                    stop=stop,
                 ):
                     yield json.dumps(
                         {"type": etype, "object": obj}

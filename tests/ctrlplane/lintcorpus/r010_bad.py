@@ -1,0 +1,16 @@
+"""R010 bad twin: hot-path decodes that bypass the codec seam."""
+import json
+from json import loads
+
+
+def on_event(line):
+    evt = json.loads(line)
+    return evt["type"], evt["object"]
+
+
+def read_body(fh):
+    return json.load(fh)
+
+
+def aliased(line):
+    return loads(line)

@@ -1,0 +1,11 @@
+"""R010 good twin: decode through the codec seam."""
+from kubeflow_tpu.platform.k8s import codec
+
+
+def on_event(line):
+    etype, obj = codec.decode_event(line)
+    return etype, obj
+
+
+def admit(obj):
+    return codec.materialize(obj)

@@ -35,6 +35,7 @@ CORPUS = {
              "kubeflow_tpu/platform/runtime/metrics.py"),
     "R008": ("r008", "kubeflow_tpu/platform/controllers/corpus.py", None),
     "R009": ("r009", "kubeflow_tpu/platform/controllers/corpus.py", None),
+    "R010": ("r010", "kubeflow_tpu/platform/runtime/corpus.py", None),
 }
 
 
@@ -43,9 +44,9 @@ def _corpus(stem: str, kind: str) -> str:
         return fh.read()
 
 
-def test_registry_has_the_nine_rules():
+def test_registry_has_the_ten_rules():
     ids = sorted(r.id for r in engine.all_rules())
-    assert ids == [f"R00{i}" for i in range(1, 10)]
+    assert ids == [f"R00{i}" for i in range(1, 10)] + ["R010"]
     assert set(CORPUS) == set(ids)
 
 
@@ -183,6 +184,7 @@ def test_cli_list_rules_and_exit_codes(tmp_repo, tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO)
     assert out.returncode == 0
     assert all(f"R00{i}" in out.stdout for i in range(1, 10))
+    assert "R010" in out.stdout
 
     dirty = subprocess.run(
         [sys.executable, "-m", "kubeflow_tpu.analysis",

@@ -38,7 +38,7 @@ def test_presubmit_lane_list_is_pinned():
         "notebook-controller", "resilience", "ha-shard", "bench-smoke",
         "tpujob", "inferenceservice", "lint", "journey", "slo",
         "profile", "admission-webhook", "web-apps", "compute", "native",
-        "notebook-images", "serve",
+        "native-wire", "notebook-images", "serve",
     ])
 
 
@@ -107,6 +107,28 @@ def test_profile_lane_registered_and_shaped():
         assert piece in unit
     assert "test_observability.py" in " ".join(wf.steps[1].command)
     assert wf.steps[1].depends == "unit"
+
+
+def test_native_wire_lane_registered_and_shaped():
+    """The native-wire lane (ISSUE 18): the library build gates the 3-way
+    codec matrix, the filtered sharding suite, and the KF_NATIVE=0
+    pure-Python fallback leg — triggered by native, codec, and runtime
+    changes."""
+    assert "native-wire" in select(["native/wirecodec.cc"])
+    assert "native-wire" in select(["kubeflow_tpu/platform/k8s/codec.py"])
+    assert "native-wire" in select(
+        ["kubeflow_tpu/platform/runtime/informer.py"])
+    wf = WORKFLOWS["native-wire"]
+    assert [s.name for s in wf.steps] == [
+        "build", "matrix", "filtered-sharding", "python-fallback"]
+    assert wf.steps[0].command == ["make", "-C", "native"]
+    matrix = " ".join(wf.steps[1].command)
+    assert "test_wirecodec.py" in matrix and "test_native.py" in matrix
+    assert "test_sharding.py" in " ".join(wf.steps[2].command)
+    fallback = wf.steps[3].command
+    assert fallback[:2] == ["env", "KF_NATIVE=0"]
+    assert "test_wirecodec.py" in " ".join(fallback)
+    assert all(s.depends == "build" for s in wf.steps[1:])
 
 
 def test_conformance_is_postsubmit_only():

@@ -1,0 +1,671 @@
+"""Wire fast path tests (ISSUE 18): native scanner vs Python semantics.
+
+The codec seam (k8s/codec.py) carries every watch/list byte, so its two
+engines must be indistinguishable except in speed.  This suite pins:
+
+* the raw C scanner (native/wirecodec.cc): envelope slicing, identity
+  field extraction (only escape-free strings), duplicate-key last-wins,
+  scalar-metadata demotion, malformed-line rejection — all against
+  ``json.loads`` as the semantic reference, including a seeded fuzz;
+* the 3-way decode/encode matrix (python / native / mixed) over a corpus
+  of realistic and adversarial watch lines;
+* LazyResource/LazyMeta laziness, proven by ``codec.stats()`` counters
+  rather than guessed: identity reads parse nothing, admit materializes
+  exactly once;
+* merge-patch parity (native kfp_merge_create vs apply.py's ``_diff``)
+  and canonical-serialization byte equality, both fuzzed;
+* ShardFilter: spec round-trip, fail-open admits for every source, the
+  ``involved`` candidate derivation;
+* server-side filtering end to end: FakeKube watch/list and the real
+  RestKubeClient -> HttpKube wire path only deliver subscribed shards;
+* the KF_WIRE_CODEC / KF_SHARD_SERVER_FILTER knob contracts.
+
+When the library is unbuilt the native halves skip and the Python
+fallback legs still run — which is itself the contract the KF_NATIVE=0
+CI leg enforces.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+
+import pytest
+
+from kubeflow_tpu.platform import native
+from kubeflow_tpu.platform.k8s import codec
+from kubeflow_tpu.platform.k8s.types import NOTEBOOK, freeze
+from kubeflow_tpu.platform.runtime import apply
+from kubeflow_tpu.platform.runtime.sharding import ShardFilter, shard_of
+from kubeflow_tpu.platform.testing import FakeKube
+
+NATIVE = native.available()
+needs_native = pytest.mark.skipif(not NATIVE, reason="libkfnative not built")
+
+
+def _nb(name, ns="user1", labels=None):
+    md = {"name": name, "namespace": ns}
+    if labels:
+        md["labels"] = labels
+    return {
+        "apiVersion": "kubeflow.org/v1beta1", "kind": "Notebook",
+        "metadata": md,
+        "spec": {"template": {"spec": {"containers": [
+            {"name": name, "image": "img"}]}}},
+    }
+
+
+def _line(etype="MODIFIED", obj=None) -> bytes:
+    if obj is None:
+        obj = {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "nb-0", "namespace": "user1",
+                         "resourceVersion": "42",
+                         "labels": {"notebook-name": "nb"}},
+            "spec": {"containers": [{"name": "nb", "image": "img"}]},
+            "status": {"phase": "Running"},
+        }
+    return json.dumps({"type": etype, "object": obj},
+                      separators=(",", ":")).encode()
+
+
+# -- the raw scanner ----------------------------------------------------------
+
+
+@needs_native
+def test_scan_event_slices_match_json_loads():
+    line = _line()
+    etype, obj_bytes, meta_bytes = native.wire_scan_event(line)
+    evt = json.loads(line)
+    assert etype == evt["type"]
+    assert json.loads(obj_bytes) == evt["object"]
+    assert json.loads(meta_bytes) == evt["object"]["metadata"]
+
+
+@needs_native
+def test_scanner_extracts_identity_fields():
+    scan = native.wire_scanner()
+    etype, obj, meta, name, ns, rv = scan(_line())
+    assert (etype, name, ns, rv) == ("MODIFIED", "nb-0", "user1", "42")
+
+
+@needs_native
+def test_escaped_name_is_not_extracted_but_still_readable():
+    # Field extraction is an optimization for escape-free strings; an
+    # escaped value must come back None (-> slow path), never mangled.
+    obj = {"metadata": {"name": 'a"b', "namespace": "user1"}}
+    scan = native.wire_scanner()
+    _, _, meta, name, ns, _ = scan(_line(obj=obj))
+    assert name is None          # escaped: not extracted
+    assert ns == "user1"         # escape-free sibling still extracted
+    assert json.loads(meta)["name"] == 'a"b'
+
+
+@needs_native
+def test_duplicate_keys_last_wins_like_json_loads():
+    # json.loads keeps the LAST occurrence at every level; the scanner
+    # must agree or the fast path would answer differently than the
+    # fallback for the same bytes.
+    line = (b'{"type":"ADDED","object":{"metadata":{"name":"first"},'
+            b'"x":1},"object":{"metadata":{"name":"old","name":"new",'
+            b'"namespace":"ns2"}}}')
+    evt = json.loads(line)
+    scan = native.wire_scanner()
+    etype, obj, meta, name, ns, rv = scan(line)
+    assert json.loads(obj) == evt["object"]
+    assert json.loads(meta) == evt["object"]["metadata"]
+    assert name == evt["object"]["metadata"]["name"] == "new"
+    assert ns == "ns2"
+
+
+@needs_native
+def test_scalar_metadata_is_demoted_to_slow_path():
+    # A non-object metadata (never produced by a real apiserver) must not
+    # be sliced: the Python side materializes the body and sees exactly
+    # what json.loads would.
+    etype, obj, meta = native.wire_scan_event(
+        b'{"type":"ADDED","object":{"metadata":5,"spec":{}}}')
+    assert meta is None
+    assert json.loads(obj) == {"metadata": 5, "spec": {}}
+
+
+@needs_native
+def test_error_event_status_object():
+    status = {"kind": "Status", "apiVersion": "v1", "status": "Failure",
+              "reason": "Expired", "code": 410,
+              "message": "too old resource version: 5"}
+    etype, obj, meta = native.wire_scan_event(_line("ERROR", status))
+    assert etype == "ERROR"
+    assert meta is None          # a Status has no metadata
+    assert json.loads(obj) == status
+
+
+@needs_native
+@pytest.mark.parametrize("bad", [
+    b"",
+    b"not json",
+    b'{"object":{}}',                       # missing type
+    b'{"type":"ADDED"}',                    # missing object
+    b'{"type":5,"object":{}}',              # non-string type
+    b'{"type":"ADDED","object":{}}trail',   # trailing data
+    b'{"type":"ADDED","object":{"a":"unterminated}',
+])
+def test_scanner_rejects_malformed(bad):
+    with pytest.raises(native.NativeError):
+        native.wire_scan_event(bad)
+
+
+@needs_native
+def test_scanner_fuzz_against_json_loads():
+    # Random envelopes: every slice must json.loads to exactly what a
+    # full-document parse sees, and every extracted field must equal it.
+    rng = random.Random(18)
+
+    def rand_value(depth=0):
+        r = rng.random()
+        if depth > 2 or r < 0.35:
+            return rng.choice([
+                1, -7, 3.5, "plain", "with spaces", 'q"uote', "back\\slash",
+                "unié", "nl\nline", True, False, None, [], {},
+                [1, "two", {"three": 3}],
+            ])
+        return {f"k{i}": rand_value(depth + 1)
+                for i in range(rng.randint(0, 4))}
+
+    def rand_meta():
+        md = {}
+        if rng.random() < 0.9:
+            md["name"] = rng.choice(
+                ["nb-0", "nb", 'we"ird', "unié", "a-b-c"])
+        if rng.random() < 0.7:
+            md["namespace"] = rng.choice(["user1", "user2"])
+        if rng.random() < 0.7:
+            md["resourceVersion"] = str(rng.randint(1, 10**6))
+        if rng.random() < 0.5:
+            md["labels"] = {"app": "notebook"}
+        return md
+
+    scan = native.wire_scanner()
+    for i in range(300):
+        obj = {"metadata": rand_meta(), "spec": rand_value(),
+               "status": rand_value()}
+        for sep in ((",", ":"), (", ", ": ")):
+            line = json.dumps(
+                {"type": rng.choice(["ADDED", "MODIFIED", "DELETED"]),
+                 "object": obj}, separators=sep).encode()
+            evt = json.loads(line)
+            etype, ob, mb, name, ns, rv = scan(line)
+            assert etype == evt["type"]
+            assert json.loads(ob) == evt["object"], line
+            assert json.loads(mb) == evt["object"]["metadata"]
+            md = evt["object"]["metadata"]
+            for got, key in ((name, "name"), (ns, "namespace"),
+                             (rv, "resourceVersion")):
+                if got is not None:
+                    assert got == md[key], (line, key)
+
+
+# -- the 3-way decode/encode matrix ------------------------------------------
+
+
+CORPUS = [
+    _line(),
+    _line("ADDED", _nb("nb", labels={"notebook-name": "nb"})),
+    _line("DELETED", {"metadata": {"name": "gone"}}),
+    _line("ERROR", {"kind": "Status", "apiVersion": "v1", "code": 410,
+                    "status": "Failure", "reason": "Expired",
+                    "message": "too old"}),
+    _line(obj={"metadata": {"name": 'esc"aped', "namespace": "user1"},
+               "spec": {"uni": "héllo", "n": 2**40}}),
+    _line(obj={"metadata": {"name": "cluster-scoped"}}),    # no namespace
+    json.dumps({"type": "MODIFIED",
+                "object": {"metadata": {"name": "spaced"}}},
+               separators=(", ", ": ")).encode(),           # padded JSON
+]
+
+
+@needs_native
+@pytest.mark.parametrize("line", CORPUS, ids=range(len(CORPUS)))
+def test_three_way_decode_matrix(line):
+    t_py, o_py = codec.decode_event(line, engine="python")
+    t_nat, o_nat = codec.decode_event(line, engine="native")
+    assert t_nat == t_py
+    # Mapping equality before materialization, dict equality after.
+    assert o_nat == o_py
+    assert codec.materialize(o_nat) == o_py
+    # Mixed legs: a natively decoded object must serialize identically
+    # through both encode engines.
+    _, o_mixed = codec.decode_event(line, engine="native")
+    assert json.loads(codec.encode(o_mixed, engine="native")) == o_py
+    _, o_mixed2 = codec.decode_event(line, engine="native")
+    assert json.loads(codec.encode(o_mixed2, engine="python")) == o_py
+
+
+@needs_native
+def test_identity_reads_parse_nothing():
+    before = codec.stats()
+    _, obj = codec.decode_event(_line(), engine="native")
+    m = obj["metadata"]
+    assert (m.get("name"), m.get("namespace"), m.get("resourceVersion")) \
+        == ("nb-0", "user1", "42")
+    assert "name" in m and bool(m)
+    assert not m.parsed                   # no metadata JSON parse
+    assert not obj.materialized           # no body parse
+    after = codec.stats()
+    assert after["materialize"] == before["materialize"]
+    assert after["decode_native"] == before["decode_native"] + 1
+    assert after["decode_python"] == before["decode_python"]
+
+
+@needs_native
+def test_non_identity_meta_read_parses_slice_not_body():
+    _, obj = codec.decode_event(_line(), engine="native")
+    m = obj["metadata"]
+    assert m["labels"] == {"notebook-name": "nb"}
+    assert m.parsed                       # metadata slice decoded...
+    assert not obj.materialized           # ...body still deferred
+
+
+@needs_native
+def test_admit_materializes_exactly_once():
+    before = codec.stats()["materialize"]
+    _, obj = codec.decode_event(_line(), engine="native")
+    doc = codec.materialize(obj)
+    assert type(doc) is dict
+    assert doc["status"]["phase"] == "Running"
+    assert codec.materialize(obj) is doc  # cached, not re-parsed
+    assert codec.stats()["materialize"] == before + 1
+
+
+@needs_native
+def test_lazy_meta_is_read_only_and_deep_gettable():
+    from kubeflow_tpu.platform.k8s.types import deep_get
+
+    _, obj = codec.decode_event(_line(), engine="native")
+    assert deep_get(obj, "metadata", "labels", "notebook-name") == "nb"
+    with pytest.raises(TypeError):
+        obj["metadata"]["name"] = "other"     # no __setitem__
+
+
+@needs_native
+def test_scan_failure_falls_back_to_python():
+    # A line the scanner rejects but json.loads accepts (no "type" key)
+    # must cost a fallback, not a failure.
+    line = b'{"object": {"metadata": {"name": "x"}}}'
+    before = codec.stats()["decode_python"]
+    etype, obj = codec.decode_event(line, engine="native")
+    assert etype == "" and obj == {"metadata": {"name": "x"}}
+    assert codec.stats()["decode_python"] == before + 1
+
+
+def test_forced_native_without_library_uses_python(monkeypatch):
+    # engine="native" on a box with no library: the decoder factory
+    # returns None and the Python path answers.
+    monkeypatch.setattr(codec, "_tls", threading.local())
+    monkeypatch.setattr(native, "wire_scanner", lambda: None)
+    etype, obj = codec.decode_event(_line(), engine="native")
+    assert etype == "MODIFIED" and type(obj) is dict
+
+
+def test_python_engine_decodes_plain_dicts():
+    before = codec.stats()["decode_python"]
+    etype, obj = codec.decode_event(_line(), engine="python")
+    assert etype == "MODIFIED" and type(obj) is dict
+    assert codec.stats()["decode_python"] == before + 1
+
+
+@needs_native
+def test_encode_raw_passthrough_until_materialized():
+    line = _line()
+    raw = json.loads(line)["object"]
+    _, obj = codec.decode_event(line, engine="native")
+    before = codec.stats()
+    out = codec.encode(obj)
+    # Byte-identical passthrough: the wire bytes were never re-serialized.
+    assert json.dumps(raw, separators=(",", ":")) == out
+    assert codec.stats()["encode_raw"] == before["encode_raw"] + 1
+    codec.materialize(obj)
+    out2 = codec.encode(obj)              # materialized: python path
+    assert json.loads(out2) == raw
+    assert codec.stats()["encode_raw"] == before["encode_raw"] + 1
+
+
+def test_encode_frozen_view_without_thaw():
+    doc = _nb("frozen")
+    assert json.loads(codec.encode(freeze(doc))) == doc
+
+
+# -- merge patch + canonical serialization parity -----------------------------
+
+
+def _py_merge(current, desired):
+    patch = apply._diff(current or {}, desired or {})
+    return None if patch is apply._UNCHANGED else patch
+
+
+MERGE_CASES = [
+    ({}, {}),
+    ({"a": 1}, {"a": 1}),
+    ({"a": 1}, {"a": 2}),
+    ({"a": 1, "b": 2}, {"a": 1}),
+    ({"a": {"b": 1, "c": 2}}, {"a": {"b": 1}}),
+    ({"a": [1, 2]}, {"a": [3]}),
+    ({"m": {"x": 1}}, {"m": "scalar"}),
+    ({"s": 'hé"llo'}, {"s": "wörld"}),
+    ({"spec": {"containers": [{"name": "a"}]}},
+     {"spec": {"containers": [{"name": "a"}, {"name": "b"}]},
+      "status": {"phase": "Running"}}),
+]
+
+
+@needs_native
+@pytest.mark.parametrize("cur,des", MERGE_CASES)
+def test_merge_patch_native_matches_python_diff(cur, des):
+    assert codec.merge_patch_native(cur, des) == _py_merge(cur, des)
+
+
+@needs_native
+def test_merge_patch_parity_fuzz():
+    # No nulls/bools: RFC 7386 cannot store a null and the diff follows
+    # Python == (True == 1) — both outside the k8s-object domain.
+    rng = random.Random(1806)
+
+    def rand_doc(depth=0):
+        r = rng.random()
+        if depth > 2 or r < 0.3:
+            return rng.choice([1, "s", 3.5, [1, 2], "t", 7, "x"])
+        return {f"k{i}": rand_doc(depth + 1)
+                for i in range(rng.randint(0, 4))}
+
+    for _ in range(120):
+        cur = {"root": rand_doc(), "x": rand_doc()}
+        des = {"root": rand_doc(), "y": rand_doc()}
+        assert codec.merge_patch_native(cur, des) == _py_merge(cur, des), \
+            (cur, des)
+
+
+@needs_native
+def test_merge_patch_for_routes_native_and_counts():
+    if not codec.engine_native():
+        pytest.skip("codec engine forced to python")
+    before = codec.stats()["merge_native"]
+    patch = apply.merge_patch_for({"a": 1, "b": 2}, {"a": 2})
+    assert patch == {"a": 2, "b": None}
+    assert apply.merge_patch_for({"a": 1}, {"a": 1}) is None
+    assert codec.stats()["merge_native"] == before + 2
+
+
+@needs_native
+def test_canonical_json_byte_equal_fuzz():
+    rng = random.Random(99)
+
+    def rand_doc(depth=0):
+        r = rng.random()
+        if depth > 2 or r < 0.35:
+            return rng.choice([
+                0, 1, -42, 2**40, 3.5, 0.25, True, False, None,
+                "plain", 'q"uote', "back\\slash", "nl\nline",
+                "unié中", "", [1, 2, "three"], {},
+            ])
+        return {f"k{i}": rand_doc(depth + 1)
+                for i in range(rng.randint(0, 4))}
+
+    for _ in range(100):
+        doc = {"root": rand_doc(), "arr": [rand_doc() for _ in range(3)]}
+        # Canonical form is UTF-8 passthrough, not \uXXXX escapes.
+        want = json.dumps(doc, separators=(",", ":"), ensure_ascii=False)
+        assert native.canonical_json(json.dumps(doc)) == want
+
+
+# -- ShardFilter --------------------------------------------------------------
+
+
+def test_shard_filter_spec_round_trip():
+    for src in ("self", "label=notebook-name", "owner=StatefulSet",
+                "involved"):
+        f = ShardFilter(8, frozenset({1, 5}), src)
+        g = ShardFilter.parse(f.spec())
+        assert (g.num_shards, g.shards, g.source) == (8, {1, 5}, src)
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "v2:8:1:self", "v1:8:1", "v1:zero:1:self", "v1:8:x,y:self",
+    "v1:0:1:self", "v1:-4:1:self", "v1:8:1:bogus", "self:8:1:v1",
+])
+def test_shard_filter_malformed_specs_parse_to_unfiltered(bad):
+    assert ShardFilter.parse(bad) is None
+
+
+def test_shard_filter_admits_self_source():
+    f = ShardFilter(8, frozenset({shard_of("user1", "mine", 8)}), "self")
+    assert f.admits(_nb("mine"))
+    other = next(n for n in (f"nb-{i}" for i in range(64))
+                 if shard_of("user1", n, 8) not in f.shards)
+    assert not f.admits(_nb(other))
+    assert f.admits({"metadata": {"namespace": "user1"}})  # no name: open
+
+
+def test_shard_filter_admits_label_source():
+    f = ShardFilter(8, frozenset({shard_of("user1", "nb", 8)}),
+                    "label=notebook-name")
+    assert f.admits(_nb("nb-0", labels={"notebook-name": "nb"}))
+    assert f.admits(_nb("nb-0"))          # label missing: fail-open
+    other = next(n for n in (f"x{i}" for i in range(64))
+                 if shard_of("user1", n, 8) not in f.shards)
+    assert not f.admits(_nb("pod", labels={"notebook-name": other}))
+
+
+def test_shard_filter_admits_owner_source():
+    f = ShardFilter(8, frozenset({shard_of("user1", "nb", 8)}),
+                    "owner=StatefulSet")
+    pod = _nb("nb-0")
+    pod["metadata"]["ownerReferences"] = [
+        {"kind": "StatefulSet", "name": "nb", "controller": True}]
+    assert f.admits(pod)
+    pod["metadata"]["ownerReferences"] = [
+        {"kind": "ReplicaSet", "name": "nb", "controller": True}]
+    assert f.admits(pod)                  # no controlling STS: fail-open
+
+
+def test_shard_filter_involved_candidates():
+    # nb-s2-0 -> itself, ordinal-stripped, slice-suffix-stripped: any
+    # resolvable owner name keeps the event on the stream.
+    cands = ShardFilter._involved_candidates(
+        {"involvedObject": {"name": "nb-s2-0"}})
+    assert cands == ["nb-s2-0", "nb-s2", "nb"]
+    assert ShardFilter._involved_candidates({}) == []
+
+
+def test_shard_filter_involved_admits_any_candidate_shard():
+    f = ShardFilter(8, frozenset({shard_of("user1", "nb", 8)}), "involved")
+    evt = {"metadata": {"namespace": "user1", "name": "evt-1"},
+           "involvedObject": {"name": "nb-0"}}
+    assert f.admits(evt)                  # stripped "nb" is subscribed
+    assert f.admits({"metadata": {"namespace": "user1"}})  # no involved
+
+
+# -- server-side filtering end to end ----------------------------------------
+
+
+def _admissible(names, shards, num_shards=8, ns="user1"):
+    return {n for n in names if shard_of(ns, n, num_shards) in shards}
+
+
+def test_fakekube_list_and_watch_are_shard_filtered():
+    kube = FakeKube()
+    kube.add_namespace("user1")
+    names = [f"nb-{i}" for i in range(24)]
+    for n in names:
+        kube.create(_nb(n))
+    shards = frozenset({0, 3, 5})
+    spec = ShardFilter(8, shards, "self").spec()
+    want = _admissible(names, shards)
+    assert want and want != set(names)    # the filter really splits
+    got = {n["metadata"]["name"]
+           for n in kube.list(NOTEBOOK, "user1", shard_filter=spec)}
+    assert got == want
+    # Watch backlog + live events, both filtered server-side.
+    stop = threading.Event()
+    w = kube.watch(NOTEBOOK, "user1", shard_filter=spec, stop=stop)
+    seen = {obj["metadata"]["name"] for _, obj in
+            (next(w) for _ in range(len(want)))}
+    assert seen == want
+    # events_emitted counts PRE-filter: the decode-fraction denominator.
+    emitted = kube.events_emitted["Notebook"]
+    assert emitted >= len(names)
+    stop.set()
+
+
+def test_fakekube_relist_rv_stays_global_under_filter():
+    kube = FakeKube()
+    kube.add_namespace("user1")
+    for i in range(8):
+        kube.create(_nb(f"nb-{i}"))
+    spec = ShardFilter(8, frozenset({1}), "self").spec()
+    _, rv_filtered = kube.list_with_rv(NOTEBOOK, "user1",
+                                       shard_filter=spec)
+    _, rv_full = kube.list_with_rv(NOTEBOOK, "user1")
+    assert rv_filtered == rv_full         # resume point misses no shard
+
+
+def test_httpkube_wire_carries_shard_filter():
+    from kubeflow_tpu.platform.k8s.client import RestKubeClient
+    from kubeflow_tpu.platform.testing.httpkube import HttpKubeServer
+
+    kube = FakeKube()
+    kube.add_namespace("user1")
+    names = [f"nb-{i}" for i in range(16)]
+    for n in names:
+        kube.create(_nb(n))
+    shards = frozenset({2, 6})
+    spec = ShardFilter(8, shards, "self").spec()
+    want = _admissible(names, shards)
+    assert want and want != set(names)
+    server = HttpKubeServer(kube).start()
+    try:
+        client = RestKubeClient(server.base_url, qps=0)
+        assert client.supports_shard_filter
+        got = {n["metadata"]["name"]
+               for n in client.list(NOTEBOOK, "user1", shard_filter=spec)}
+        assert got == want
+        items, rv = client.list_with_rv(NOTEBOOK, "user1",
+                                        shard_filter=spec)
+        assert {n["metadata"]["name"] for n in items} == want
+        stop = threading.Event()
+        seen = set()
+        for etype, obj in client.watch(NOTEBOOK, "user1",
+                                       shard_filter=spec, stop=stop):
+            assert etype == "ADDED"
+            seen.add(obj["metadata"]["name"])
+            if len(seen) == len(want):
+                stop.set()
+                break
+        assert seen == want
+    finally:
+        server.stop()
+
+
+def test_informer_subscription_thins_stream_and_refilters():
+    from kubeflow_tpu.platform.runtime.informer import Informer
+
+    kube = FakeKube()
+    kube.add_namespace("user1")
+    names = [f"nb-{i}" for i in range(24)]
+    for n in names:
+        kube.create(_nb(n))
+    shards = {"cur": frozenset({0, 1, 2, 3})}
+
+    def subscription():
+        return ShardFilter(8, shards["cur"], "self").spec()
+
+    def admit(obj):
+        return shard_of(obj["metadata"].get("namespace") or "",
+                        obj["metadata"]["name"], 8) in shards["cur"]
+
+    inf = Informer(kube, NOTEBOOK, admit=admit)
+    inf.shard_subscription = subscription
+    inf.start()
+    try:
+        assert inf.wait_for_sync(5.0)
+        want = _admissible(names, shards["cur"])
+        assert {k[1] for k in inf.keys()} == want
+        # The SERVER thinned the stream: this informer saw only its own
+        # ranges, not the full keyspace (fail-open allows a superset of
+        # admit, but self-source derives every key here).
+        assert inf.events_seen == len(want) < len(names)
+        # Shard move: new subscription + refilter -> ranged relist under
+        # the NEW filter; dropped ranges leave, acquired ranges land.
+        shards["cur"] = frozenset({4, 5, 6, 7})
+        inf.refilter()
+        want2 = _admissible(names, shards["cur"])
+        assert {k[1] for k in inf.keys()} == want2
+        assert not (want2 & want)
+    finally:
+        inf.stop()
+
+
+def test_informer_ignores_subscription_without_server_support():
+    from kubeflow_tpu.platform.runtime.informer import Informer
+
+    class NoFilterKube(FakeKube):
+        supports_shard_filter = False
+
+        def list(self, gvk, namespace=None, **kw):
+            assert kw.pop("shard_filter", None) is None
+            return super().list(gvk, namespace, **kw)
+
+    kube = NoFilterKube()
+    kube.add_namespace("user1")
+    for i in range(6):
+        kube.create(_nb(f"nb-{i}"))
+    inf = Informer(kube, NOTEBOOK)
+    inf.shard_subscription = lambda: ShardFilter(8, frozenset({0}),
+                                                 "self").spec()
+    inf.start()
+    try:
+        assert inf.wait_for_sync(5.0)
+        # Unfiltered: a server that cannot filter must deliver everything.
+        assert len(inf.keys()) == 6
+    finally:
+        inf.stop()
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+def test_wire_codec_knob_validates_and_defaults(monkeypatch):
+    monkeypatch.setenv("KF_WIRE_CODEC", "bogus")
+    assert codec._knob_codec() == "auto"      # env-invalid -> default
+    monkeypatch.setenv("KF_WIRE_CODEC", "python")
+    codec.reset_engine_cache()
+    try:
+        assert codec._knob_codec() == "python"
+        assert codec.engine_native() is False
+    finally:
+        monkeypatch.delenv("KF_WIRE_CODEC")
+        codec.reset_engine_cache()
+
+
+def test_server_filter_knob_validates_and_defaults(monkeypatch):
+    from kubeflow_tpu.platform.runtime.controller import (
+        _server_filter_enabled,
+    )
+
+    assert _server_filter_enabled() is True
+    monkeypatch.setenv("KF_SHARD_SERVER_FILTER", "0")
+    assert _server_filter_enabled() is False
+    monkeypatch.setenv("KF_SHARD_SERVER_FILTER", "bogus")
+    assert _server_filter_enabled() is True   # env-invalid -> default
+
+
+def test_knobs_are_registered_for_debug_dump():
+    from kubeflow_tpu.platform import config
+    from kubeflow_tpu.platform.runtime.controller import (
+        _server_filter_enabled,
+    )
+
+    codec._knob_codec()
+    _server_filter_enabled()
+    dump = config.effective()
+    assert "KF_WIRE_CODEC" in dump
+    assert "KF_SHARD_SERVER_FILTER" in dump
