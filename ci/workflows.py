@@ -367,6 +367,41 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
+    # activator presubmit lane (ISSUE 19): the serving front door's unit
+    # matrix — hold/replay lifecycle, wake-stamp cadence vs the
+    # autoscaler's staleness race, per-tenant buckets + the SLO-knee
+    # surcharge, WRR fair-share drain, structured shed outcomes — plus
+    # the replica-side QoS gates (warm-503, deadline-504, priority
+    # admission) and the noisy-neighbor conformance smoke: two tenants
+    # through a live activator, the hammering one shed with wire 429s
+    # while the quiet one's TTFT holds.
+    name="activator",
+    include_dirs=[
+        "kubeflow_tpu/platform/activator.py",
+        "kubeflow_tpu/platform/main.py",
+        "kubeflow_tpu/platform/controllers/*",
+        "kubeflow_tpu/models/client.py", "kubeflow_tpu/models/serve.py",
+        "kubeflow_tpu/models/scheduler.py", "kubeflow_tpu/models/paged.py",
+        "conformance/*", "releasing/*",
+    ],
+    steps=[
+        Step("unit", _pytest(
+            "tests/ctrlplane/test_activator.py",
+            "tests/ctrlplane/test_autoscale.py",
+        )),
+        Step("qos-gates", _pytest("tests/test_serve.py",
+                                  "tests/test_scheduler.py")
+             + ["-m", "not slow", "-k",
+                "priority or deadline or warm_probe or qos"],
+             depends="unit"),
+        Step("noisy-neighbor-smoke", [
+            sys.executable, "conformance/run.py",
+            "--only", "inferenceservice-noisy-neighbor",
+        ], depends="unit"),
+    ],
+))
+
+_register(ComponentWorkflow(
     # lint presubmit lane (ISSUE 13): kftlint over the whole tree — exit
     # nonzero on any unsuppressed, un-baselined finding (the shipped
     # baseline is EMPTY: every repo-native invariant in docs/analysis.md

@@ -417,6 +417,11 @@ _LABEL_ALLOWLIST = {
     # long-lived thread names; default Thread-N names all fold into the
     # single "unattributed" value.
     "role",
+    # ISSUE 19 (the serving front door; docs/serving.md "The front
+    # door"): "tenant" is bounded by the profile set — the activator's
+    # X-KFT-Tenant values are profile namespaces (plus "default"), the
+    # same bounded vocabulary the quota ledger keys on.
+    "tenant",
 }
 
 

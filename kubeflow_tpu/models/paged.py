@@ -59,6 +59,7 @@ import numpy as np
 
 from kubeflow_tpu.models.layers import PagedSlots
 from kubeflow_tpu.models.scheduler import (
+    DEFAULT_PRIORITY,
     DecodeScheduler,
     PendingRequest,
     _NEG_INF,
@@ -585,7 +586,8 @@ class PagedDecodeScheduler(DecodeScheduler):
         return min(math.ceil(need / self.page_len), self.max_pages_row)
 
     def submit(self, rows, *, max_new_tokens, temperature=0.0, top_k=None,
-               eos_token=None, seed=0, tokens=None, prompt_mask=None):
+               eos_token=None, seed=0, tokens=None, prompt_mask=None,
+               priority=DEFAULT_PRIORITY, deadline=None):
         longest = max(len(r) for r in rows)
         if longest + max_new_tokens <= self.slot_len:
             # Worst-case page demand (no prefix reuse) must fit the pool,
@@ -602,7 +604,7 @@ class PagedDecodeScheduler(DecodeScheduler):
         return super().submit(
             rows, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, eos_token=eos_token, seed=seed, tokens=tokens,
-            prompt_mask=prompt_mask)
+            prompt_mask=prompt_mask, priority=priority, deadline=deadline)
 
     def stats(self) -> dict:
         out = super().stats()
@@ -713,10 +715,9 @@ class PagedDecodeScheduler(DecodeScheduler):
                 continue            # finished: loop to place its rows
             if self._pending_rows:
                 return              # rows wait on lanes, keep decoding
-            with self._cond:
-                if not self._queue:
-                    return
-                req = self._queue[0]
+            req = self._next_queued(pop=False)
+            if req is None:
+                return
             try:
                 started = self._begin_prefill(req)
             except BaseException as exc:  # noqa: BLE001 — per-request

@@ -67,6 +67,21 @@ from kubeflow_tpu.platform import config
 
 _NEG_INF = -1e30
 
+# Request priority classes, lowest value admitted first.  The names are
+# the wire vocabulary (X-KFT-Priority header, activator fair-share) and
+# the ints are the admission order — FIFO within a class, so a flood of
+# batch work can never starve interactive requests of ADMISSION (decode
+# slots already held are never preempted).
+PRIORITY_CLASSES = {"interactive": 0, "standard": 1, "batch": 2}
+DEFAULT_PRIORITY = PRIORITY_CLASSES["standard"]
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline budget (X-KFT-Deadline-Seconds) ran out
+    while it was still queued — the scheduler evicts it at selection
+    time instead of spending prefill/decode on a client that has already
+    given up.  models/serve.py maps this to a structured 504."""
+
 
 @functools.partial(
     jax.jit,
@@ -177,13 +192,16 @@ class PendingRequest:
     scheduler-side error."""
 
     def __init__(self, rows, *, max_new_tokens, temperature, top_k,
-                 eos_token, seed):
+                 eos_token, seed, priority=DEFAULT_PRIORITY,
+                 deadline=None):
         self.rows = rows
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.top_k = top_k
         self.eos_token = eos_token
         self.seed = seed
+        self.priority = priority    # admission class, lower admits first
+        self.deadline = deadline    # absolute time.monotonic() cutoff
         self.tokens = None          # optional pre-padded [b, L] prompt
         self.prompt_mask = None     # optional [b, L] validity mask
         self.outputs: List[Optional[list]] = [None] * len(rows)
@@ -314,7 +332,9 @@ class DecodeScheduler:
     def submit(self, rows: List[List[int]], *, max_new_tokens: int,
                temperature: float = 0.0, top_k: Optional[int] = None,
                eos_token: Optional[int] = None, seed: int = 0,
-               tokens=None, prompt_mask=None) -> PendingRequest:
+               tokens=None, prompt_mask=None,
+               priority: int = DEFAULT_PRIORITY,
+               deadline: Optional[float] = None) -> PendingRequest:
         """Queue one request (a list of prompt token rows).  Raises
         ValueError synchronously when prompt+budget cannot fit a slot —
         the same contract as the sequential path's cache-length check.
@@ -333,7 +353,8 @@ class DecodeScheduler:
             )
         req = PendingRequest(
             rows, max_new_tokens=max_new_tokens, temperature=temperature,
-            top_k=top_k, eos_token=eos_token, seed=seed)
+            top_k=top_k, eos_token=eos_token, seed=seed,
+            priority=priority, deadline=deadline)
         req.tokens = tokens
         req.prompt_mask = prompt_mask
         tel = self._telemetry()
@@ -441,9 +462,39 @@ class DecodeScheduler:
     def _free_slots(self) -> List[int]:
         return [i for i, s in enumerate(self._slot_state) if s is None]
 
+    def _next_queued(self, *, pop: bool) -> Optional[PendingRequest]:
+        """Admission-order selection under the queue lock: first fail
+        queued requests whose deadline already expired (a dead request
+        must never reach prefill — its client stopped waiting), then
+        pick the best (lowest) priority class, FIFO within a class.
+        ``pop`` removes the pick; the paged scheduler peeks instead —
+        chunked prefill keeps the request queued until
+        ``_begin_prefill`` owns it."""
+        now = time.monotonic()
+        with self._cond:
+            expired = [r for r in self._queue
+                       if r.deadline is not None and now >= r.deadline]
+            if expired:
+                self._queue = [r for r in self._queue
+                               if r not in expired]
+            req = None
+            if self._queue:
+                i = min(range(len(self._queue)),
+                        key=lambda j: self._queue[j].priority)
+                req = self._queue.pop(i) if pop else self._queue[i]
+        tel = self._telemetry()
+        for dead in expired:
+            dead._fail(DeadlineExceeded(
+                "request deadline expired while queued "
+                f"({now - dead.deadline:.3f}s past cutoff)"))
+            if tel is not None:
+                tel.queue_depth.dec(len(dead.rows))
+        return req
+
     def _admit(self):
         """Fill free slots: first from prefilled pending rows, then by
-        prefilling queued requests (FIFO, no bypass).
+        prefilling queued requests (priority classes, FIFO within a
+        class — see ``_next_queued``).
 
         Crash safety: rows live in ``_pending_rows`` (or still in the
         queue) at every point a device call can raise — peeked, placed,
@@ -457,10 +508,9 @@ class DecodeScheduler:
                 self._pending_rows.pop(0)
             if not free or self._pending_rows:
                 return
-            with self._cond:
-                if not self._queue:
-                    return
-                req = self._queue.pop(0)
+            req = self._next_queued(pop=True)
+            if req is None:
+                return
             try:
                 self._pending_rows.extend(self._prefill(req))
             except BaseException as exc:  # noqa: BLE001 — per-request

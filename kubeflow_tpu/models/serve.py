@@ -106,6 +106,18 @@ def _generated_token_count(rows, eos_token):
     return total
 
 
+def _check_deadline(deadline):
+    """Lock-path deadline gate: the request's X-KFT-Deadline-Seconds
+    budget ran out while it waited for the service lock — fail it
+    before spending device time on a client that already gave up (the
+    scheduler path has the same gate at admission selection)."""
+    if deadline is not None and time.monotonic() >= deadline:
+        from kubeflow_tpu.models.scheduler import DeadlineExceeded
+
+        raise DeadlineExceeded(
+            "request deadline expired while queued for the service lock")
+
+
 def _telemetry_request(service, rows, eos_token, validate, run):
     """ONE request lifecycle for both services — admit (validate, before
     the lock so bad requests 400 without queueing) → queue (lock wait,
@@ -242,7 +254,7 @@ class GenerationService:
         return sched if sched.alive else None
 
     def _generate_scheduled(self, sched, rows, validate, *, temperature,
-                            top_k, eos_token, seed):
+                            top_k, eos_token, seed, priority, deadline):
         """Continuous-batched request lifecycle: submit to the scheduler
         and wait, mapping the scheduler's admission/first-token/finish
         events onto the SAME span sequence the lock path traces
@@ -263,7 +275,8 @@ class GenerationService:
             pending = sched.submit(
                 rows, max_new_tokens=n, temperature=temperature,
                 top_k=top_k, eos_token=eos_token, seed=seed,
-                tokens=prompt, prompt_mask=mask)
+                tokens=prompt, prompt_mask=mask,
+                priority=priority, deadline=deadline)
             with tel.span("queue"):
                 pending.wait_admitted()
             with tel.span("prefill", rows=len(rows)):
@@ -283,12 +296,23 @@ class GenerationService:
 
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 eos_token=_UNSET, seed: int = 0):
+                 eos_token=_UNSET, seed: int = 0,
+                 priority: Optional[int] = None,
+                 deadline: Optional[float] = None):
+        """``priority`` is a PRIORITY_CLASSES value (admission order
+        under the scheduler; the lock path serializes regardless);
+        ``deadline`` is an absolute ``time.monotonic()`` cutoff — a
+        request still queued past it raises DeadlineExceeded instead of
+        generating for a client that stopped waiting."""
         from kubeflow_tpu.models.generate import (
             generate,
             generate_decode,
             generate_prefill,
         )
+        from kubeflow_tpu.models.scheduler import DEFAULT_PRIORITY
+
+        if priority is None:
+            priority = DEFAULT_PRIORITY
 
         if eos_token is _UNSET:
             eos_token = self.default_eos_token
@@ -310,9 +334,11 @@ class GenerationService:
         if sched is not None:
             return self._generate_scheduled(
                 sched, rows, validate, temperature=temperature,
-                top_k=top_k, eos_token=eos_token, seed=seed)
+                top_k=top_k, eos_token=eos_token, seed=seed,
+                priority=priority, deadline=deadline)
 
         def run(tel, t_arrival, prompt, mask, n):
+            _check_deadline(deadline)
             kw = dict(max_new_tokens=n, temperature=temperature,
                       top_k=top_k, eos_token=eos_token)
             if tel is None:
@@ -385,7 +411,13 @@ class Seq2SeqGenerationService:
 
     def generate(self, rows, *, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 eos_token=_UNSET, seed: int = 0):
+                 eos_token=_UNSET, seed: int = 0,
+                 priority: Optional[int] = None,
+                 deadline: Optional[float] = None):
+        # ``priority`` is accepted for wire uniformity but inert — the
+        # lock path serializes in arrival order; ``deadline`` still
+        # evicts a request that expired waiting on the lock.
+        del priority
         from kubeflow_tpu.models.generate import generate_seq2seq
 
         if eos_token is _UNSET:
@@ -403,6 +435,7 @@ class Seq2SeqGenerationService:
             )
 
         def run(tel, t_arrival, source, mask, n):
+            _check_deadline(deadline)
             # Encoder-decoder generation stays one jit (the encoder pass
             # is not a prompt-cache prefill); the TTFT/per-token split
             # applies to the decoder-only service.
@@ -433,9 +466,14 @@ def create_app(service: GenerationService, *, model_name: str = "model",
         generate_latest,
     )
 
+    from kubeflow_tpu.models.scheduler import (
+        DeadlineExceeded,
+        PRIORITY_CLASSES,
+    )
     from kubeflow_tpu.platform.web.framework import (
         App,
         HttpError,
+        failure,
         json_response,
         success,
     )
@@ -456,6 +494,15 @@ def create_app(service: GenerationService, *, model_name: str = "model",
     )
     tokens_total = Counter(
         "generate_tokens_total", "Tokens generated", registry=registry,
+    )
+    # Requests refused without generating, by reason: "warming" (the
+    # /readyz warm generate is still in flight — structured 503 +
+    # Retry-After instead of queueing behind the compile), "deadline"
+    # (X-KFT-Deadline-Seconds expired before or while queued — 504).
+    rejected_total = Counter(
+        "generate_rejected_total",
+        "Generation requests refused without running, by reason",
+        ["reason"], registry=registry,
     )
     if revision is None:
         from kubeflow_tpu.platform import config as _cfg
@@ -485,13 +532,15 @@ def create_app(service: GenerationService, *, model_name: str = "model",
     # rolling update gates its traffic flip on this (readinessProbe +
     # the controller's own pre-flip probe), so a replica that would
     # crash or compile-stall on its first request never takes traffic.
-    warm = {"done": False, "seconds": None, "error": None}
+    warm = {"done": False, "seconds": None, "error": None,
+            "inflight": False}
     warm_lock = threading.Lock()
 
     @app.route("/readyz")
     def readyz(request):
         with warm_lock:
             if not warm["done"]:
+                warm["inflight"] = True
                 t0 = time.perf_counter()
                 try:
                     service.generate([[1]], max_new_tokens=1)
@@ -503,6 +552,8 @@ def create_app(service: GenerationService, *, model_name: str = "model",
                     # probe (a transient fault must not wedge readiness).
                     warm["error"] = None
                     warm["done"] = True
+                finally:
+                    warm["inflight"] = False
                 warm["seconds"] = round(time.perf_counter() - t0, 3)
         if warm["error"] is not None:
             raise HttpError(503, f"warm generate failed: {warm['error']}")
@@ -591,15 +642,57 @@ def create_app(service: GenerationService, *, model_name: str = "model",
         # request's current context (web/framework.App.__call__), so the
         # serve trace links into the caller's journey via
         # ServeTelemetry.begin_request reading causal.current() —
-        # nothing to re-parse here.
+        # nothing to re-parse here.  The deadline and priority ride the
+        # same passthrough as headers the activator forwards verbatim.
         body = request.get_json(force=True, silent=True) or {}
         t0 = time.perf_counter()
         try:  # noqa: SIM105 — latency must cover every outcome
-            return _generate(body)
+            if warm["inflight"] and not warm["done"]:
+                # Not yet warm: the /readyz warm generate is compiling
+                # the decode path right now.  A structured 503 with a
+                # Retry-After beats queueing this request behind a
+                # multi-second compile — the activator (or any client)
+                # replays it once readiness flips.
+                rejected_total.labels(reason="warming").inc()
+                return failure(
+                    "replica not warm: /readyz warm generate in flight",
+                    503, headers={"Retry-After": "2"})
+            try:
+                priority, deadline = _qos_headers(request)
+            except ValueError as e:
+                requests_total.labels(outcome="invalid").inc()
+                raise HttpError(400, str(e)) from None
+            if deadline is not None and time.monotonic() >= deadline:
+                rejected_total.labels(reason="deadline").inc()
+                requests_total.labels(outcome="deadline").inc()
+                return failure("request deadline already expired", 504)
+            return _generate(body, priority, deadline)
         finally:
             request_seconds.observe(time.perf_counter() - t0)
 
-    def _generate(body):
+    def _qos_headers(request):
+        """(priority, absolute-monotonic deadline) from the QoS headers;
+        raises ValueError (→400) on a malformed value."""
+        priority = None
+        name = request.headers.get("X-KFT-Priority")
+        if name:
+            if name not in PRIORITY_CLASSES:
+                raise ValueError(
+                    f"unknown priority class {name!r}; expected one of "
+                    f"{sorted(PRIORITY_CLASSES)}")
+            priority = PRIORITY_CLASSES[name]
+        deadline = None
+        raw = request.headers.get("X-KFT-Deadline-Seconds")
+        if raw:
+            try:
+                secs = float(raw)
+            except ValueError:
+                raise ValueError(
+                    f"malformed X-KFT-Deadline-Seconds {raw!r}") from None
+            deadline = time.monotonic() + secs
+        return priority, deadline
+
+    def _generate(body, priority, deadline):
         try:
             # int()/float() coercions raise TypeError on null/list inputs —
             # every malformed field must land as a 400, not a 500.
@@ -614,8 +707,15 @@ def create_app(service: GenerationService, *, model_name: str = "model",
                 temperature=float(body.get("temperature", 0.0)),
                 top_k=body.get("top_k"),
                 seed=int(body.get("seed", 0)),
+                priority=priority, deadline=deadline,
                 **kwargs,
             )
+        except DeadlineExceeded as e:
+            # The budget expired while queued (scheduler or lock): a
+            # structured 504 — the caller must NOT replay a dead request.
+            rejected_total.labels(reason="deadline").inc()
+            requests_total.labels(outcome="deadline").inc()
+            return failure(str(e), 504)
         except (ValueError, TypeError) as e:
             requests_total.labels(outcome="invalid").inc()
             raise HttpError(400, str(e)) from None

@@ -152,7 +152,7 @@ class InferenceServiceReconciler(Reconciler):
                  informers: Optional[dict] = None,
                  queue: Optional[jq.JobQueue] = None,
                  scraper=None, sync_period: Optional[float] = None,
-                 tsdb=None, now=time.time):
+                 tsdb=None, now=time.time, book=None):
         self.client = client
         self.informers: dict = informers or {}
         self.recorder = EventRecorder(client, "inferenceservice-controller")
@@ -191,6 +191,18 @@ class InferenceServiceReconciler(Reconciler):
         from kubeflow_tpu.telemetry import fleetscrape
         from kubeflow_tpu.telemetry.tsdb import TSDB
 
+        # Endpoint discovery for the serving front door
+        # (platform/activator.py): each reconcile PUBLISHES the ready
+        # serving-revision endpoints (and the TTFT SLO target) into the
+        # book the activator reads — push, not probe, so the data path
+        # never lists pods and never races the informer.  Same
+        # private/shared split as ``tsdb``: bare construction gets a
+        # PRIVATE book (test instances never couple through process
+        # state); ``make_controller`` passes the process-shared
+        # ``activator.default_book()`` the front door reads.
+        from kubeflow_tpu.platform import activator as _activator
+
+        self.book = book if book is not None else _activator.EndpointBook()
         self.tsdb = tsdb if tsdb is not None else TSDB()
         self.fleet = fleetscrape.FleetScraper(
             self.tsdb, scraper=scraper,
@@ -221,9 +233,11 @@ class InferenceServiceReconciler(Reconciler):
             svc = self.client.get(INFERENCESERVICE, req.name, req.namespace)
         except errors.NotFound:
             # ownerReference GC tears the Deployments/Service down with
-            # the CR; drop the ledger charge and the scrape memory now.
+            # the CR; drop the ledger charge, the scrape memory, and the
+            # front door's endpoint record now.
             self.queue.forget_service(req.namespace, req.name)
             self.tsdb.drop(matcher={"service": f"{req.namespace}/{req.name}"})
+            self.book.forget(f"{req.namespace}/{req.name}")
             return None
 
         try:
@@ -391,6 +405,18 @@ class InferenceServiceReconciler(Reconciler):
             phase = api.PHASE_READY
         else:
             phase = api.PHASE_PENDING
+        # Publish endpoint discovery for the activator: the READY
+        # serving-revision replicas (post-flip, so a rollout's traffic
+        # switch and the front door's view move together).  An empty
+        # endpoint list is a real publication — it tells the front door
+        # "cold: hold and wake", where a missing record means "no such
+        # service: 404".
+        self.book.publish(
+            f"{ns}/{name}",
+            endpoints=[self._endpoint_of(p, api.port_of(svc))
+                       for p in serving_pods if pod_ready(p)],
+            ttft_target_s=targets_from_spec(svc).ttft_p99_s,
+            phase=phase)
         status = {
             "phase": phase,
             "replicas": desired,
@@ -712,7 +738,10 @@ def make_controller(client, **kwargs):
     # tsdb= overrides for hermetic harnesses.
     from kubeflow_tpu.telemetry import fleetscrape
 
+    from kubeflow_tpu.platform import activator as _activator
+
     kwargs.setdefault("tsdb", fleetscrape.default_tsdb())
+    kwargs.setdefault("book", _activator.default_book())
     reconciler = InferenceServiceReconciler(client, informers=informers,
                                             queue=queue, **kwargs)
 

@@ -88,6 +88,9 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
         "/debug/incidents": "incident flight-recorder bundles captured "
                             "on alert firing (manifest list; fetch one "
                             "at /debug/incidents/<id>)",
+        "/debug/activator": "serving front door: endpoint book + live "
+                            "per-tenant hold queues (docs/serving.md "
+                            "\"The front door\")",
     }
 
     def app(environ, start_response):
@@ -168,6 +171,20 @@ def _serve_health(manager, port: int, *, host: str = "0.0.0.0",
             from kubeflow_tpu.telemetry import goodput
 
             snap = goodput.debug_snapshot()
+            if snap is not None:
+                start_response("200 OK",
+                               [("Content-Type", "application/json")])
+                return [json.dumps(snap).encode()]
+        if path == "/debug/activator":
+            # The serving front door (platform/activator.py): the
+            # controller-published endpoint book plus every live hold
+            # queue keyed by service and tenant — the first page to read
+            # when "where is my request parked" is the question
+            # (docs/serving.md "The front door").  404 until
+            # run_controllers registers its activator.
+            from kubeflow_tpu.platform import activator as activator_mod
+
+            snap = activator_mod.debug_snapshot()
             if snap is not None:
                 start_response("200 OK",
                                [("Content-Type", "application/json")])
@@ -378,6 +395,27 @@ def run_controllers(args) -> int:
             notebook_informer=nb_ctrl.informers.get(NOTEBOOK)))
     mgr.start()
     _serve_health(mgr, args.health_port, client=client, shards=shards)
+    # The serving front door (docs/serving.md "The front door"): the
+    # activator data path shares this process with the InferenceService
+    # reconciler, so endpoint discovery is the in-memory EndpointBook the
+    # reconciler publishes into (no pod lists, no informer races).  Wake
+    # stamps go through the RAW client — like Lease/Event traffic, a
+    # wake-at annotation is a signal, not a reconcile write to fence.
+    from kubeflow_tpu.platform import activator as activator_mod
+
+    act_server = None
+    act_port = activator_mod.activator_port()
+    if act_port:
+        from werkzeug.serving import make_server as _make_server
+
+        act = activator_mod.Activator(client)
+        activator_mod.register_debug(act)
+        act_server = _make_server(
+            "0.0.0.0", act_port, activator_mod.create_activator_app(act),
+            threaded=True)
+        threading.Thread(target=act_server.serve_forever,
+                         daemon=True).start()
+        logging.info("activator front door on :%d", act_port)
     # The fleet metrics pipeline (docs/observability.md "The metrics
     # pipeline"): scrape -> in-process TSDB -> burn-rate SLO rules +
     # goodput accounting, on one knobbed cadence.  Targets: the
@@ -438,6 +476,9 @@ def run_controllers(args) -> int:
         else "off",
     )
     _wait_for_term()
+    if act_server is not None:
+        act_server.shutdown()
+        activator_mod.register_debug(None)
     pipeline.stop()
     slo_mod.register_debug_alerts(None)
     goodput_mod.register_debug_goodput(None)

@@ -269,6 +269,51 @@ inferenceservice_scrape_errors_total = Counter(
     ["reason"], registry=registry,
 )
 
+# -- serving front door (platform/activator.py; docs/serving.md "The front
+#    door").  The main-loop metrics pipeline self-scrapes this registry, so
+#    these land in the TSDB and are queryable at /debug/ without extra
+#    wiring. --------------------------------------------------------------
+
+serve_requests_held = Gauge(
+    "serve_requests_held",
+    "requests currently parked in the activator's per-service hold "
+    "queues, waiting for a scaled-to-zero service to wake",
+    registry=registry,
+)
+serve_requests_shed_total = Counter(
+    "serve_requests_shed_total",
+    "activator requests refused, by tenant and reason: 'tenant-bucket' "
+    "(429, token bucket empty), 'slo-shed' (429, admission surcharge "
+    "past the TTFT SLO knee drained the bucket), 'hold-overflow' (503, "
+    "per-service hold queue full), 'wake-timeout' (503, wake deadline "
+    "expired mid-hold), 'deadline' (504, the request's own "
+    "X-KFT-Deadline-Seconds expired while held)",
+    ["tenant", "reason"], registry=registry,
+)
+serve_tenant_ttft_seconds = Histogram(
+    "serve_tenant_ttft_seconds",
+    "activator-observed seconds to a replica's first response byte, per "
+    "tenant — the fairness series: a noisy neighbor moves its own "
+    "histogram while the quiet tenants' hold",
+    ["tenant"],
+    buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0),
+    registry=registry,
+)
+activator_proxy_requests_total = Counter(
+    "activator_proxy_requests_total",
+    "requests through the activator data path, by outcome: 'ok' "
+    "(forwarded, 2xx/4xx passthrough), 'replayed' (held across a cold "
+    "start, then served), 'shed' (refused with a structured 429/503/"
+    "504), 'error' (replay budget exhausted or backend unreachable)",
+    ["outcome"], registry=registry,
+)
+activator_wake_stamps_total = Counter(
+    "activator_wake_stamps_total",
+    "wake-at annotation stamps written by the activator (first stamp "
+    "and periodic re-stamps while requests stay held)",
+    registry=registry,
+)
+
 
 tpujob_restarts_total = Counter(
     "tpujob_restarts_total",

@@ -1,0 +1,460 @@
+"""The serving front door (ISSUE 19, platform/activator.py): zero-drop
+cold-start holds (wake-stamp + replay), per-tenant token-bucket
+admission with the burn-driven SLO surcharge, weighted fair-share hold
+drain, structured shed outcomes on the wire, and the controller-push
+EndpointBook.  Hermetic — fake kube client, fake forward transport."""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import types
+
+import pytest
+from werkzeug.test import Client
+
+from kubeflow_tpu.platform import activator as act_mod
+from kubeflow_tpu.platform.activator import (
+    Activator,
+    EndpointBook,
+    TokenBucket,
+    _ServiceFront,
+    create_activator_app,
+)
+from kubeflow_tpu.platform.apis import inferenceservice as api
+from kubeflow_tpu.platform.runtime import metrics
+
+
+class FakeClient:
+    """Captures the wake-annotation patches the activator writes."""
+
+    def __init__(self):
+        self.patches = []
+
+    def patch(self, gvk, name, patch, namespace=None, *,
+              patch_type="merge"):
+        self.patches.append((gvk, name, namespace, patch, patch_type))
+
+
+def ok_forward(calls):
+    def forward(url, method, body, headers, timeout):
+        calls.append({"url": url, "method": method, "body": body,
+                      "headers": dict(headers)})
+        return 200, {"Content-Type": "application/json"}, json.dumps(
+            {"success": True, "tokens": [[1, 2]]}).encode()
+    return forward
+
+
+def make_front(book=None, forward=None, client=None, **kw):
+    book = book if book is not None else EndpointBook()
+    client = client if client is not None else FakeClient()
+    act = Activator(client, book=book, forward=forward or ok_forward([]),
+                    **kw)
+    return Client(create_activator_app(act)), book, client, act
+
+
+def post(client, path="/serve/ns/svc/v1/generate", *, headers=None,
+         body=None):
+    return client.post(
+        path, data=json.dumps(body or {"tokens": [[1]]}),
+        headers={"Content-Type": "application/json", **(headers or {})})
+
+
+def shed_count(tenant, reason):
+    return metrics.registry.get_sample_value(
+        "serve_requests_shed_total",
+        {"tenant": tenant, "reason": reason}) or 0.0
+
+
+# -- QoS primitives -----------------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_hint():
+    clock = [0.0]
+    b = TokenBucket(rate=2.0, burst=4.0, now=lambda: clock[0])
+    for _ in range(4):
+        assert b.take()[0]
+    granted, wait = b.take()
+    assert not granted and wait == pytest.approx(0.5)
+    clock[0] += 0.5  # one token refilled
+    assert b.take()[0]
+    # The bucket caps at burst: a long idle stretch is not a mega-burst.
+    clock[0] += 1e6
+    grants = sum(1 for _ in range(10) if b.take()[0])
+    assert grants == 4
+
+
+def test_wrr_drain_is_weighted_fair():
+    """Smooth WRR with a=2, b=1: every window of three drains serves a
+    twice and b once, and within a tenant the order stays FIFO."""
+    front = _ServiceFront({"a": 2.0, "b": 1.0})
+    tags = {}  # _Waiter has __slots__, so tag by identity
+    with front.lock:
+        for tenant, n in (("a", 6), ("b", 3)):
+            for i in range(n):
+                w = act_mod._Waiter(tenant)
+                tags[id(w)] = f"{tenant}{i}"
+                front.enqueue(w)
+    order = []
+    for _ in range(9):
+        with front.lock:
+            w = front.next_waiter()
+            front.advance(w)
+        order.append(tags[id(w)])
+    for lo in range(0, 9, 3):
+        window = order[lo:lo + 3]
+        assert sum(1 for t in window if t.startswith("a")) == 2, order
+    assert [t for t in order if t.startswith("a")] == [
+        f"a{i}" for i in range(6)]
+    assert [t for t in order if t.startswith("b")] == [
+        f"b{i}" for i in range(3)]
+    with front.lock:
+        assert front.next_waiter() is None
+        assert not front._wrr_current  # state clears when drained
+
+
+def test_tenant_weights_knob_parses(monkeypatch):
+    monkeypatch.setenv("KFT_ACTIVATOR_TENANT_WEIGHTS", "alice=2, bob=1")
+    assert act_mod.tenant_weights() == {"alice": 2.0, "bob": 1.0}
+
+
+# -- endpoint book ------------------------------------------------------------
+
+
+def test_endpoint_book_publish_forget_subscribe():
+    book = EndpointBook()
+    seen = []
+    book.subscribe(seen.append)
+    book.publish("ns/a", endpoints=["http://x:1", None],
+                 ttft_target_s=0.5, phase="Ready")
+    rec = book.get("ns/a")
+    assert rec.endpoints == ("http://x:1",)  # falsy entries dropped
+    assert rec.ttft_target_s == 0.5 and rec.phase == "Ready"
+    book.forget("ns/a")
+    assert book.get("ns/a") is None
+    assert seen == ["ns/a", "ns/a"]
+    assert book.snapshot() == {}
+
+
+# -- admission: tenant buckets + the SLO surcharge ----------------------------
+
+
+def test_tenant_bucket_429_isolates_tenants(monkeypatch):
+    monkeypatch.setenv("KFT_ACTIVATOR_TENANT_BURST", "2")
+    monkeypatch.setenv("KFT_ACTIVATOR_TENANT_RATE", "0.001")
+    client, book, _, _ = make_front()
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    heavy = {"X-KFT-Tenant": "heavy"}
+    assert post(client, headers=heavy).status_code == 200
+    assert post(client, headers=heavy).status_code == 200
+    before = shed_count("heavy", "tenant-bucket")
+    resp = post(client, headers=heavy)
+    assert resp.status_code == 429
+    assert float(resp.headers["Retry-After"]) >= 1
+    assert "admission rate" in resp.get_json()["log"]
+    assert shed_count("heavy", "tenant-bucket") == before + 1
+    # The quiet tenant's bucket is untouched.
+    assert post(client, headers={"X-KFT-Tenant": "quiet"}).status_code \
+        == 200
+
+
+def test_slo_knee_surcharge_sheds_heavy_tenant(monkeypatch):
+    """Past the knee (stored-series TTFT p99 over the target multiple)
+    every request costs KFT_ACTIVATOR_SHED_COST tokens: with burst 3 and
+    cost 4 the very first request runs the bucket dry → 429 slo-shed.
+    Below the knee the same request costs 1 and flows."""
+    from kubeflow_tpu.telemetry import fleetscrape
+
+    monkeypatch.setenv("KFT_ACTIVATOR_TENANT_BURST", "3")
+    monkeypatch.setenv("KFT_ACTIVATOR_SHED_COST", "4")
+    ttft = {"p99": 10.0}
+    monkeypatch.setattr(
+        fleetscrape, "serve_sample",
+        lambda tsdb, key: types.SimpleNamespace(ttft_p99_s=ttft["p99"]))
+    client, book, _, act = make_front(tsdb=object())
+    book.publish("ns/svc", endpoints=["http://b:1"], ttft_target_s=0.5)
+    # 10.0 > 0.5 * 4 (the default multiple): over the knee.
+    before = shed_count("hammer", "slo-shed")
+    resp = post(client, headers={"X-KFT-Tenant": "hammer"})
+    assert resp.status_code == 429 and resp.headers.get("Retry-After")
+    assert shed_count("hammer", "slo-shed") == before + 1
+    # Below the knee the surcharge is off (fresh cache + fresh tenant).
+    ttft["p99"] = 0.1
+    act._knee_cache.clear()
+    assert post(client, headers={"X-KFT-Tenant": "calm"}).status_code \
+        == 200
+
+
+def test_malformed_deadline_400_and_unknown_service_404():
+    client, book, _, _ = make_front()
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    assert post(client, headers={
+        "X-KFT-Deadline-Seconds": "soon"}).status_code == 400
+    resp = post(client, path="/serve/ns/nope/v1/generate")
+    assert resp.status_code == 404
+    assert "no such service" in resp.get_json()["log"]
+
+
+# -- the warm proxy path ------------------------------------------------------
+
+
+def test_proxy_forwards_qos_headers_and_remaining_deadline():
+    calls = []
+    client, book, _, _ = make_front(forward=ok_forward(calls))
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    resp = post(client, headers={
+        "X-KFT-Tenant": "alice", "X-KFT-Priority": "interactive",
+        "X-KFT-Deadline-Seconds": "30",
+        "traceparent": "00-" + "a" * 32 + "-" + "b" * 16 + "-01"})
+    assert resp.status_code == 200
+    assert resp.get_json()["tokens"] == [[1, 2]]
+    [call] = calls
+    assert call["url"] == "http://b:1/v1/generate"
+    h = call["headers"]
+    assert h["X-KFT-Tenant"] == "alice"
+    assert h["X-KFT-Priority"] == "interactive"
+    assert h["traceparent"].startswith("00-" + "a" * 32)
+    # Forwarded as the REMAINING budget, not the original header.
+    assert 0.0 < float(h["X-KFT-Deadline-Seconds"]) <= 30.0
+
+
+def test_proxy_retries_backend_503_then_succeeds(monkeypatch):
+    monkeypatch.setenv("KFT_ACTIVATOR_REPLAY_BASE_SECONDS", "0.001")
+    monkeypatch.setenv("KFT_ACTIVATOR_REPLAY_CAP_SECONDS", "0.002")
+    statuses = [503, 503, 200]
+    calls = []
+
+    def flaky(url, method, body, headers, timeout):
+        calls.append(url)
+        status = statuses[min(len(calls) - 1, len(statuses) - 1)]
+        return status, {"Content-Type": "application/json"}, json.dumps(
+            {"success": status == 200}).encode()
+
+    client, book, _, _ = make_front(forward=flaky)
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    assert post(client).status_code == 200
+    assert len(calls) == 3
+
+
+def test_proxy_exhausted_replays_return_503_with_retry_after(monkeypatch):
+    monkeypatch.setenv("KFT_ACTIVATOR_REPLAY_RETRIES", "2")
+    monkeypatch.setenv("KFT_ACTIVATOR_REPLAY_BASE_SECONDS", "0.001")
+    monkeypatch.setenv("KFT_ACTIVATOR_REPLAY_CAP_SECONDS", "0.002")
+
+    def down(url, method, body, headers, timeout):
+        raise OSError("connection refused")
+
+    client, book, _, _ = make_front(forward=down)
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    resp = post(client)
+    assert resp.status_code == 503
+    assert resp.headers.get("Retry-After")
+    assert "replay budget exhausted" in resp.get_json()["log"]
+
+
+def test_proxy_passes_backend_failures_through_verbatim():
+    """A backend 504 (its own deadline gate) or 400 is the caller's
+    answer — the activator must not retry or rewrap it."""
+    calls = []
+
+    def gone(url, method, body, headers, timeout):
+        calls.append(url)
+        return 504, {"Content-Type": "application/json"}, json.dumps(
+            {"success": False, "log": "request deadline expired"}).encode()
+
+    client, book, _, _ = make_front(forward=gone)
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    resp = post(client)
+    assert resp.status_code == 504
+    assert len(calls) == 1  # never replayed
+
+
+# -- the cold hold path -------------------------------------------------------
+
+
+def test_cold_hold_stamps_wake_and_replays(monkeypatch):
+    """The tentpole lifecycle: a request for a zero-replica service is
+    held (never refused), the wake annotation is merge-patched with the
+    current time, and once the controller publishes ready endpoints the
+    request replays — one 200, zero drops."""
+    monkeypatch.setenv("KFT_ACTIVATOR_RESTAMP_SECONDS", "0.05")
+    calls = []
+    client, book, kube, act = make_front(forward=ok_forward(calls),
+                                         now=lambda: 1234.5)
+    book.publish("ns/svc", endpoints=[], phase="Idle")  # cold, known
+    out = {}
+
+    def go():
+        out["resp"] = post(client, headers={"X-KFT-Tenant": "alice"})
+
+    t = threading.Thread(target=go)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not kube.patches and time.monotonic() < deadline:
+        time.sleep(0.01)
+    gvk, name, ns, patch, patch_type = kube.patches[0]
+    assert (name, ns, patch_type) == ("svc", "ns", "merge")
+    assert patch["metadata"]["annotations"][api.ANNOTATION_WAKE] \
+        == "1234.500"
+    with act._fronts_lock:
+        front = act._fronts["ns/svc"]
+    with front.lock:
+        assert front.held_count() == 1  # parked, not dropped
+    # The controller converged: ready endpoints published → replay.
+    book.publish("ns/svc", endpoints=["http://b:1"], phase="Ready")
+    t.join(10)
+    assert out["resp"].status_code == 200
+    assert calls and calls[0]["url"] == "http://b:1/v1/generate"
+    with front.lock:
+        assert front.held_count() == 0
+
+
+def test_hold_restamps_while_held(monkeypatch):
+    """The staleness-race defeat: while requests stay held the stamp is
+    refreshed on cadence, so a controller that read a stale stamp sees a
+    fresh one next pass (tests/ctrlplane/test_autoscale.py pins the
+    decide_scale side)."""
+    monkeypatch.setenv("KFT_ACTIVATOR_RESTAMP_SECONDS", "0.05")
+    clock = {"t": 100.0}
+    client, book, kube, _ = make_front(now=lambda: clock["t"])
+    book.publish("ns/svc", endpoints=[])
+    out = {}
+
+    def go():
+        out["resp"] = post(client)
+
+    t = threading.Thread(target=go)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while len(kube.patches) < 3 and time.monotonic() < deadline:
+        clock["t"] += 1.0
+        time.sleep(0.02)
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    t.join(10)
+    assert out["resp"].status_code == 200
+    stamps = [float(p[3]["metadata"]["annotations"][api.ANNOTATION_WAKE])
+              for p in kube.patches]
+    assert len(stamps) >= 3
+    assert stamps == sorted(stamps) and stamps[-1] > stamps[0]
+
+
+def test_hold_overflow_sheds_503(monkeypatch):
+    monkeypatch.setenv("KFT_ACTIVATOR_HOLD_QUEUE", "1")
+    monkeypatch.setenv("KFT_ACTIVATOR_RESTAMP_SECONDS", "0.05")
+    client, book, _, act = make_front()
+    book.publish("ns/svc", endpoints=[])
+    out = {}
+    t = threading.Thread(target=lambda: out.setdefault("r", post(client)))
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        with act._fronts_lock:
+            front = act._fronts.get("ns/svc")
+        if front is not None:
+            with front.lock:
+                if front.held_count() == 1:
+                    break
+        time.sleep(0.01)
+    before = shed_count("late", "hold-overflow")
+    resp = post(client, headers={"X-KFT-Tenant": "late"})
+    assert resp.status_code == 503
+    assert resp.headers.get("Retry-After")
+    assert "hold queue full" in resp.get_json()["log"]
+    assert shed_count("late", "hold-overflow") == before + 1
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    t.join(10)
+    assert out["r"].status_code == 200  # the held one still lands
+
+
+def test_wake_timeout_sheds_503(monkeypatch):
+    monkeypatch.setenv("KFT_ACTIVATOR_WAKE_DEADLINE_SECONDS", "0.2")
+    monkeypatch.setenv("KFT_ACTIVATOR_RESTAMP_SECONDS", "0.05")
+    client, book, _, _ = make_front()
+    book.publish("ns/svc", endpoints=[])
+    before = shed_count("default", "wake-timeout")
+    resp = post(client)
+    assert resp.status_code == 503
+    assert resp.headers.get("Retry-After")
+    assert "wake deadline" in resp.get_json()["log"]
+    assert shed_count("default", "wake-timeout") == before + 1
+
+
+def test_held_request_deadline_504_and_never_replayed(monkeypatch):
+    """X-KFT-Deadline-Seconds expires while held → 504, evicted from the
+    hold queue, and NEVER forwarded once the service wakes — a dead
+    request must not consume a decode slot."""
+    monkeypatch.setenv("KFT_ACTIVATOR_RESTAMP_SECONDS", "0.05")
+    calls = []
+    client, book, _, act = make_front(forward=ok_forward(calls))
+    book.publish("ns/svc", endpoints=[])
+    before = shed_count("giveup", "deadline")
+    resp = post(client, headers={"X-KFT-Tenant": "giveup",
+                                 "X-KFT-Deadline-Seconds": "0.15"})
+    assert resp.status_code == 504
+    assert "deadline expired" in resp.get_json()["log"]
+    assert shed_count("giveup", "deadline") == before + 1
+    with act._fronts_lock:
+        front = act._fronts["ns/svc"]
+    with front.lock:
+        assert front.held_count() == 0  # evicted, not leaked
+    book.publish("ns/svc", endpoints=["http://b:1"])
+    time.sleep(0.05)
+    assert calls == []  # the corpse never replayed
+
+
+def test_debug_snapshot_lists_services_and_holds():
+    client, book, _, act = make_front()
+    book.publish("ns/svc", endpoints=["http://b:1"], ttft_target_s=0.5,
+                 phase="Ready")
+    snap = client.get("/debug/activator").get_json()
+    assert snap["services"]["ns/svc"]["endpoints"] == ["http://b:1"]
+    assert snap["services"]["ns/svc"]["phase"] == "Ready"
+    assert snap["held"] == {}
+    assert client.get("/healthz").status_code == 200
+    # The single-slot registry feeds the controller health port's
+    # /debug/activator (main._serve_health).
+    act_mod.register_debug(act)
+    try:
+        assert act_mod.debug_snapshot() == act.debug_snapshot()
+    finally:
+        act_mod.register_debug(None)
+    assert act_mod.debug_snapshot() is None
+
+
+# -- controller publish (the discovery seam) ----------------------------------
+
+
+def test_reconciler_publishes_endpoints_and_forgets_on_delete():
+    from kubeflow_tpu.platform.k8s.types import INFERENCESERVICE
+    from kubeflow_tpu.platform.runtime import Request
+    from kubeflow_tpu.platform.testing import FakeKube
+
+    from .test_inferenceservice_controller import (
+        add_replica_pod,
+        make_reconciler,
+        make_service,
+    )
+
+    kube = FakeKube()
+    kube.add_namespace("serve")
+    book = EndpointBook()
+    kube.create(make_service(replicas={"min": 2, "max": 4}))
+    r = make_reconciler(kube)
+    r.book = book
+    r.reconcile(Request("serve", "llm"))
+    rec = book.get("serve/llm")
+    assert rec is not None and rec.endpoints == ()  # nothing ready yet
+    assert rec.phase == "Pending"
+
+    add_replica_pod(kube, "serve", "llm", 1, 0,
+                    endpoint="http://replica-0:9000")
+    add_replica_pod(kube, "serve", "llm", 1, 1, ready=False,
+                    endpoint="http://replica-1:9000")
+    r.reconcile(Request("serve", "llm"))
+    rec = book.get("serve/llm")
+    assert rec.endpoints == ("http://replica-0:9000",)  # ready pods only
+    assert rec.ttft_target_s is None  # no TTFT target in this spec
+
+    kube.delete(INFERENCESERVICE, "llm", "serve")
+    r.reconcile(Request("serve", "llm"))
+    assert book.get("serve/llm") is None

@@ -420,3 +420,52 @@ def test_tsdb_path_yields_identical_decision_sequences():
                 state, width = d.state, d.replicas
             seqs.append(decisions)
         assert seqs[0] == seqs[1], (name, seqs)
+
+
+def test_wake_restamp_defeats_stale_stamp_and_fires_once():
+    """The activator staleness race (ISSUE 19): the service idled to
+    zero at t=100 while an OLD wake stamp (t=90, from the previous
+    episode) is still on the object.  A controller replica reading that
+    stale stamp must hold zero — and the activator's re-stamp cadence
+    (platform/activator.py ``_stamp_wake``) is what converges it: the
+    fresh stamp postdates the scale-down, the wake fires EXACTLY once,
+    and further re-stamps mid-warm neither re-wake nor let the warming
+    pool flap back to zero."""
+    targets = _targets(min_replicas=0, idle_seconds=60.0)
+    state = ScaleState(last_scale_down_at=100.0, idle_since_zero=True,
+                       last_traffic_at=40.0)
+
+    # Pass 1: the stale stamp (90 < last_scale_down_at=100) holds zero.
+    d1 = decide_scale(0, _sample(replicas_scraped=0), targets, state,
+                      now=105.0, wake_requested_at=90.0)
+    assert d1.replicas == 0 and d1.reason == ""
+    assert d1.state.idle_since_zero
+
+    # Pass 2: the activator re-stamped at t=106 while requests stay
+    # held — the fresh stamp postdates the scale-down: wake.
+    d2 = decide_scale(0, _sample(replicas_scraped=0), targets, d1.state,
+                      now=107.0, wake_requested_at=106.0)
+    assert d2.replicas == 1 and d2.reason == "Wake"
+    assert not d2.state.idle_since_zero
+
+    # Pass 3: the annotation is still on the object (the controller
+    # clears it asynchronously) and the activator may re-stamp again
+    # mid-warm — with the pool already awake (current=1, nothing
+    # scraped yet) the stamp is inert: width holds, no second "Wake".
+    d3 = decide_scale(1, _sample(replicas_scraped=0), targets, d2.state,
+                      now=110.0, wake_requested_at=109.0)
+    assert d3.replicas == 1 and d3.reason == ""
+
+    # Pass 4: warm-up outlasts the idle window (scrape still silent at
+    # t=300, idle_seconds=60) — the warming pool must NOT flap back to
+    # zero; silence is not idleness.
+    d4 = decide_scale(1, _sample(replicas_scraped=0), targets, d3.state,
+                      now=300.0, wake_requested_at=109.0)
+    assert d4.replicas == 1 and d4.reason == ""
+
+    # Pass 5: first scrape contact restarts the idle window — the
+    # replayed traffic keeps the service up well past the old window.
+    d5 = decide_scale(1, _sample(replicas_scraped=1, requests_total=3.0),
+                      targets, d4.state, now=301.0)
+    assert d5.replicas == 1
+    assert d5.state.last_traffic_at == 301.0

@@ -38,8 +38,31 @@ def test_presubmit_lane_list_is_pinned():
         "notebook-controller", "resilience", "ha-shard", "bench-smoke",
         "tpujob", "inferenceservice", "lint", "journey", "slo",
         "profile", "admission-webhook", "web-apps", "compute", "native",
-        "native-wire", "notebook-images", "serve",
+        "native-wire", "notebook-images", "serve", "activator",
     ])
+
+
+def test_activator_lane_registered_and_shaped():
+    """The activator lane (ISSUE 19): front-door unit matrix (hold/
+    replay, QoS admission, the wake staleness race) gates the
+    replica-side QoS gates and the noisy-neighbor conformance smoke —
+    triggered by activator, serve-plane, and controller changes."""
+    assert "activator" in select(["kubeflow_tpu/platform/activator.py"])
+    assert "activator" in select(["kubeflow_tpu/models/serve.py"])
+    assert "activator" in select(
+        ["kubeflow_tpu/platform/controllers/inferenceservice.py"])
+    wf = WORKFLOWS["activator"]
+    assert [s.name for s in wf.steps] == [
+        "unit", "qos-gates", "noisy-neighbor-smoke"]
+    unit = " ".join(wf.steps[0].command)
+    assert "test_activator.py" in unit and "test_autoscale.py" in unit
+    gates = " ".join(wf.steps[1].command)
+    assert "test_serve.py" in gates and "test_scheduler.py" in gates
+    assert wf.steps[1].depends == "unit"
+    smoke = wf.steps[2].command
+    assert smoke[-2:] == ["--only", "inferenceservice-noisy-neighbor"]
+    assert smoke[1].endswith("conformance/run.py")
+    assert wf.steps[2].depends == "unit"
 
 
 def test_lint_lane_registered_and_shaped():

@@ -344,7 +344,7 @@ def test_debug_index_lists_live_surfaces():
             "/debug/knobs", "/debug/queue", "/debug/shards",
             "/debug/traces", "/debug/journey/<trace_id>",
             "/debug/alerts", "/debug/goodput", "/debug/profile",
-            "/debug/incidents"}
+            "/debug/incidents", "/debug/activator"}
         assert all(isinstance(v, str) and v for v in index.values())
         # The bare path serves it too.
         assert json.loads(_get(base + "/debug"))["debug"] == index

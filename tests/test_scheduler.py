@@ -662,3 +662,104 @@ def test_serve_soak_concurrent_invariants(model_and_params):
     stats = service._scheduler.stats()
     assert stats["admitted_total"] == stats["evicted_total"]
     assert stats["active_rows"] == 0 and stats["queued_rows"] == 0
+
+
+# -- QoS admission: priority classes + deadlines (ISSUE 19) -------------------
+#
+# The activator forwards X-KFT-Priority / X-KFT-Deadline-Seconds; the
+# serving layer threads them into submit().  The contract under test:
+# lower class admits first (FIFO within a class), and a request whose
+# deadline expired while still queued fails with DeadlineExceeded at
+# selection — it must never reach prefill for a client that gave up.
+
+
+def _pending(rows=((1, 2),), **kw):
+    from kubeflow_tpu.models.scheduler import PendingRequest
+
+    kw.setdefault("max_new_tokens", 2)
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("top_k", None)
+    kw.setdefault("eos_token", None)
+    kw.setdefault("seed", 0)
+    return PendingRequest([list(r) for r in rows], **kw)
+
+
+def test_priority_admission_selection_order(model_and_params):
+    """_next_queued as a pure unit (nothing submitted, loop parked):
+    lowest priority class pops first, FIFO within a class."""
+    from kubeflow_tpu.models.scheduler import PRIORITY_CLASSES
+
+    model, params = model_and_params
+    sched = DecodeScheduler(model, params, slots=2, slot_len=64, quantum=2)
+    reqs = []
+    for tag, cls in [("b1", "batch"), ("s1", "standard"),
+                     ("i1", "interactive"), ("s2", "standard"),
+                     ("b2", "batch")]:
+        r = _pending(priority=PRIORITY_CLASSES[cls])
+        r.tag = tag
+        reqs.append(r)
+    with sched._cond:
+        sched._queue.extend(reqs)
+    order = [sched._next_queued(pop=True).tag for _ in range(len(reqs))]
+    assert order == ["i1", "s1", "s2", "b1", "b2"]
+    assert sched._next_queued(pop=True) is None
+
+
+def test_expired_queued_request_evicted_at_selection(model_and_params):
+    from kubeflow_tpu.models.scheduler import DeadlineExceeded
+
+    model, params = model_and_params
+    sched = DecodeScheduler(model, params, slots=2, slot_len=64, quantum=2)
+    dead = _pending(deadline=time.monotonic() - 0.01)
+    live = _pending()
+    with sched._cond:
+        sched._queue.extend([dead, live])
+    # Peek (the paged scheduler's mode) evicts expired requests too.
+    assert sched._next_queued(pop=False) is live
+    assert dead.done.is_set()
+    with pytest.raises(DeadlineExceeded, match="expired while queued"):
+        dead.result()
+    assert sched._next_queued(pop=True) is live
+
+
+def test_submit_deadline_and_priority_ride_through(model_and_params):
+    from kubeflow_tpu.models.scheduler import (
+        PRIORITY_CLASSES,
+        DeadlineExceeded,
+    )
+
+    model, params = model_and_params
+    sched = DecodeScheduler(model, params, slots=2, slot_len=64, quantum=2)
+    # Already-expired deadline: fails fast with the typed error, and the
+    # loop survives it (the next request is served normally).
+    fut = sched.submit([[5, 9]], max_new_tokens=3,
+                       deadline=time.monotonic() - 0.001)
+    with pytest.raises(DeadlineExceeded):
+        fut.result()
+    assert sched.alive
+    rows = [[5, 9, 2, 7]]
+    got = sched.submit(rows, max_new_tokens=4,
+                       priority=PRIORITY_CLASSES["batch"],
+                       deadline=time.monotonic() + 60.0).result()
+    assert got == sequential(model, params, rows, max_new_tokens=4)
+
+
+def test_paged_submit_deadline_and_priority(model_and_params):
+    from kubeflow_tpu.models.scheduler import (
+        PRIORITY_CLASSES,
+        DeadlineExceeded,
+    )
+
+    model, params = model_and_params
+    sched = _paged(model, params)
+    fut = sched.submit([[5, 9]], max_new_tokens=3,
+                       deadline=time.monotonic() - 0.001)
+    with pytest.raises(DeadlineExceeded):
+        fut.result()
+    assert sched.alive
+    rows = [[5, 9, 2, 7]]
+    got = sched.submit(rows, max_new_tokens=4,
+                       priority=PRIORITY_CLASSES["interactive"],
+                       deadline=time.monotonic() + 60.0).result()
+    assert got == sequential(model, params, rows, max_new_tokens=4)
+    _pages_balanced(sched.stats())

@@ -506,3 +506,85 @@ def test_tokens_total_excludes_post_eos_padding():
     # Row 0 counts through its first EOS (3 tokens); row 1 never hit EOS
     # (all 4 count): 7 total, not the 8 raw slots.
     assert "generate_tokens_total 7.0" in text
+
+
+# -- the front-door contract on the replica side (ISSUE 19) -------------------
+
+
+def test_generate_503_while_warm_probe_inflight(service, monkeypatch):
+    """A not-yet-warm replica answers /v1/generate with a structured
+    503 + Retry-After WHILE the /readyz warm generate is in flight —
+    instead of silently queueing the request behind a multi-second
+    compile.  Once readiness flips, the same request serves 200."""
+    import threading
+
+    from kubeflow_tpu.models.serve import create_app as mk_app
+
+    started, gate = threading.Event(), threading.Event()
+    real = service.generate
+
+    def slow_generate(*a, **kw):
+        started.set()
+        assert gate.wait(30)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(service, "generate", slow_generate)
+    c = Client(mk_app(service, model_name="llama_debug"))
+    probe = threading.Thread(target=lambda: c.get("/readyz"))
+    probe.start()
+    try:
+        assert started.wait(30)  # the warm generate is now in flight
+        resp = c.post("/v1/generate",
+                      json={"tokens": [[5, 9]], "max_new_tokens": 2})
+        assert resp.status_code == 503
+        assert resp.headers["Retry-After"] == "2"
+        body = resp.get_json()
+        assert body["success"] is False and "not warm" in body["log"]
+    finally:
+        gate.set()
+        probe.join(30)
+    # Warm now (and cached): the replay lands.
+    resp = c.post("/v1/generate",
+                  json={"tokens": [[5, 9]], "max_new_tokens": 2})
+    assert resp.status_code == 200
+
+
+def test_generate_deadline_expired_is_504_never_run(client, service):
+    """X-KFT-Deadline-Seconds already spent on arrival: a structured 504
+    without touching the device — the activator never replays a 504."""
+    resp = client.post(
+        "/v1/generate",
+        json={"tokens": [[5, 9]], "max_new_tokens": 2},
+        headers={"X-KFT-Deadline-Seconds": "-0.5"})
+    assert resp.status_code == 504
+    assert "expired" in resp.get_json()["log"]
+    # A generous budget serves normally.
+    resp = client.post(
+        "/v1/generate",
+        json={"tokens": [[5, 9]], "max_new_tokens": 2},
+        headers={"X-KFT-Deadline-Seconds": "60"})
+    assert resp.status_code == 200
+
+
+def test_generate_qos_header_validation(client):
+    ok = client.post("/v1/generate",
+                     json={"tokens": [[5, 9]], "max_new_tokens": 2},
+                     headers={"X-KFT-Priority": "interactive"})
+    assert ok.status_code == 200
+    bad_prio = client.post("/v1/generate",
+                           json={"tokens": [[5, 9]], "max_new_tokens": 2},
+                           headers={"X-KFT-Priority": "urgent"})
+    assert bad_prio.status_code == 400
+    assert "priority class" in bad_prio.get_json()["log"]
+    bad_deadline = client.post(
+        "/v1/generate", json={"tokens": [[5, 9]], "max_new_tokens": 2},
+        headers={"X-KFT-Deadline-Seconds": "soon"})
+    assert bad_deadline.status_code == 400
+
+
+def test_generate_rejections_counted_by_reason(client):
+    client.post("/v1/generate",
+                json={"tokens": [[5, 9]], "max_new_tokens": 2},
+                headers={"X-KFT-Deadline-Seconds": "-1"})
+    text = client.get("/metrics").get_data(as_text=True)
+    assert 'generate_rejected_total{reason="deadline"}' in text
