@@ -94,7 +94,12 @@ def _parse_json_lines(stdout: str, what: str):
 def check_compute_bench() -> int:
     """bench.py smoke (CPU, llama8k + serve): the telemetry wiring keys
     and the continuous-batching A/B line."""
-    env = dict(os.environ, KFT_BENCH_SMOKE="1", JAX_PLATFORMS="cpu")
+    # 8 forced host devices so the serve_paged sharded arm (tp=2,fsdp=4
+    # page-pool split) actually runs instead of reporting mesh_skipped.
+    env = dict(os.environ, KFT_BENCH_SMOKE="1", JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip())
     proc = subprocess.run(
         [sys.executable, "bench.py", "--sections",
          "llama8k,serve,serve_paged"],
@@ -208,6 +213,33 @@ def check_compute_bench() -> int:
         return 1
     if not paged["speedup_vs_fixed"] > 1.0:
         print(f"paged arm lost to the fixed-slot arm: {paged}",
+              file=sys.stderr)
+        return 1
+    # The ISSUE 20 line: the GSPMD-sharded arm must have actually run
+    # (nonzero throughput, pool split >1 way — the 8-device forcing
+    # above makes that unconditional), and the pipelined-vs-synchronous
+    # dispatch A/B must parse and sit inside its band (1.15x overlap
+    # floor with >=2 host cores, 0.85x no-regression tripwire on a
+    # single-core box — bench.py picks and reports the floor).
+    sharded = seen.get("serve_paged_sharded")
+    if sharded is None:
+        print(f"bench smoke missing the serve_paged_sharded line: "
+              f"{sorted(seen)}", file=sys.stderr)
+        return 1
+    for key in ("value", "mesh_pool_shards",
+                "dispatch_pipelined_tokens_per_sec",
+                "dispatch_sync_tokens_per_sec", "dispatch_speedup",
+                "dispatch_overlap_ratio", "band_floor", "host_cores"):
+        if not isinstance(sharded.get(key), (int, float)):
+            print(f"serve_paged_sharded line missing key {key}: "
+                  f"{sharded}", file=sys.stderr)
+            return 1
+    if not (sharded["value"] > 0 and sharded["mesh_pool_shards"] > 1):
+        print(f"sharded serve arm did not run sharded: {sharded}",
+              file=sys.stderr)
+        return 1
+    if sharded.get("band") != "pass":
+        print(f"dispatch pipelining outside its band: {sharded}",
               file=sys.stderr)
         return 1
     print(f"bench-smoke compute OK: {len(seen)} metrics "

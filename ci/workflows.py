@@ -235,22 +235,30 @@ _register(ComponentWorkflow(
 ))
 
 _register(ComponentWorkflow(
-    # Serve presubmit lane (ISSUE 17): the paged-KV/spec-decode fast
-    # matrix on every serve-path change — paged == contiguous ==
-    # sequential token equality (greedy + seeded sampling), shared-prefix
-    # copy-on-write divergence, chunked-prefill interleave with
-    # mid-flight eviction, the speculative accept/reject boundaries, the
-    # KFT_SERVE_PAGED=0 fallback pin, and the strict knob validation —
-    # plus the serve-registry page/prefix/spec counter balance pins.
+    # Serve presubmit lane (ISSUE 17, extended by ISSUE 20): the
+    # paged-KV/spec-decode fast matrix on every serve-path change —
+    # paged == contiguous == sequential token equality (greedy + seeded
+    # sampling), shared-prefix copy-on-write divergence, chunked-prefill
+    # interleave with mid-flight eviction, the speculative accept/reject
+    # boundaries, the KFT_SERVE_PAGED=0 fallback pin, and the strict
+    # knob validation — plus the serve-registry page/prefix/spec counter
+    # balance pins.  The paged-sharded step runs the ISSUE 20 matrix on
+    # 8 forced host devices: GSPMD-sharded pool == unsharded ==
+    # fixed-slot token streams, pipelined == synchronous dispatch, and
+    # the structured-fallback surfaces (the XLA_FLAGS prefix is explicit
+    # so the manifest is runnable outside tests/conftest.py's forcing).
     name="serve",
     include_dirs=[
         "kubeflow_tpu/models/*", "kubeflow_tpu/telemetry/*",
         "kubeflow_tpu/ops/*", "kubeflow_tpu/platform/config.py",
-        "releasing/*",
+        "kubeflow_tpu/parallel/*", "releasing/*",
     ],
     steps=[
         Step("engine-matrix", _pytest("tests/test_scheduler.py")
              + ["-m", "not slow"]),
+        Step("paged-sharded",
+             ["env", "XLA_FLAGS=--xla_force_host_platform_device_count=8"]
+             + _pytest("tests/test_paged.py")),
         Step("serve-metrics", _pytest("tests/test_telemetry.py")
              + ["-k", "serve_kv or serve_spec"], depends="engine-matrix"),
     ],

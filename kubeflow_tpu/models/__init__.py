@@ -8,6 +8,16 @@ BASELINE.json: ResNet50 (images/sec/chip headline), ViT-B/16, BERT-base, and
 a Llama-style decoder for the multi-host pjit config.
 """
 
+import jax
+
+# Partition-invariant threefry (rationale in models/generate.py).  Set
+# HERE — before any create_model()/init() can run — not only at the
+# generate/sharding imports: the flag changes jax.random's bit stream,
+# so flipping it lazily mid-process (first generate() call) would make
+# two same-seed param inits in one process disagree depending on which
+# ran before the first lazy import.
+jax.config.update("jax_threefry_partitionable", True)
+
 from kubeflow_tpu.models import registry
 from kubeflow_tpu.models.registry import create_model, list_models, register_model
 

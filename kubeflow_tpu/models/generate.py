@@ -25,6 +25,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+# Partition-invariant threefry: with the legacy lowering, jax.random ops
+# traced with GSPMD-sharded operands generate DIFFERENT bits than the
+# same ops unsharded, so seeded sampling under a serving mesh would
+# diverge from the single-device stream.  The partitionable lowering
+# derives every element's bits from (key, index) alone — sharded and
+# unsharded sampling are bit-equal, which the sharded-vs-unsharded
+# token-equality tests pin.  Set at import by every generation engine
+# (parallel/sharding.py sets it for the training side).
+jax.config.update("jax_threefry_partitionable", True)
+
 
 def sample_logits(logits: jax.Array, rng: jax.Array, *,
                   temperature: float = 1.0,
@@ -71,6 +81,17 @@ def sample_logits_rows(logits: jax.Array, rngs: jax.Array, *,
     masked = jnp.where(scaled < kth, -1e30, scaled)
     pick = jax.vmap(jax.random.categorical)(rngs, masked).astype(jnp.int32)
     return jnp.where(temps == 0.0, greedy, pick)
+
+
+def split_row_rngs(row_rngs: jax.Array):
+    """Advance a [b] per-row key array one step: ``(next_rngs, subs)``
+    where ``subs`` feeds this step's ``sample_logits_rows`` draw.  The
+    ONE rng recipe every sampling site shares — prefill first-token,
+    the sequential decode scan, and the paged engine's chunked-prefill
+    sampler (models/paged.py) — so the streams stay byte-identical
+    across engines by construction, not by parallel reimplementation."""
+    split2 = jax.vmap(jax.random.split)(row_rngs)
+    return split2[:, 0], split2[:, 1]
 
 
 def _row_sampling_arrays(b: int, temperature, top_k, eos_token):
@@ -143,8 +164,7 @@ def _prefill_parts(model, params, prompt, prompt_mask, cache_len, *,
     last_logits = jnp.take_along_axis(logits, idx, axis=1)[:, 0]  # [b, vocab]
 
     row_rngs = jax.random.split(rng, b)                   # [b] keys
-    split2 = jax.vmap(jax.random.split)(row_rngs)         # [b, 2]
-    row_rngs, subs = split2[:, 0], split2[:, 1]
+    row_rngs, subs = split_row_rngs(row_rngs)
     first = sample_logits_rows(last_logits, subs, temps=temps,
                                top_ks=top_ks, sampled=sampled)
     done0 = has_eos & (first == eos_ids)
@@ -176,8 +196,7 @@ def decode_step(model, params, cache, token, pos, rngs, done, bias, *,
         cache_slots=cache_slots,
         mutable=["cache"],
     )
-    split2 = jax.vmap(jax.random.split)(rngs)
-    rngs, subs = split2[:, 0], split2[:, 1]
+    rngs, subs = split_row_rngs(rngs)
     nxt = sample_logits_rows(logits[:, -1], subs, temps=temps,
                              top_ks=top_ks, sampled=sampled)
     nxt = jnp.where(done & has_eos, eos_ids, nxt)

@@ -28,11 +28,18 @@ class PagedSlots:
                             caller's mask_bias hides)
 
     ``pool_positions`` is static metadata (the pooled tensors' leading
-    dim), so one compiled graph serves one pool geometry."""
+    dim), so one compiled graph serves one pool geometry.
+
+    ``pool_sharding`` (optional ``jax.sharding.NamedSharding`` over the
+    rank-3 pool leaf, static like ``pool_positions``) pins the scatter
+    output back onto the pool's at-rest layout under GSPMD: without the
+    constraint the partitioner may materialize the post-scatter pool
+    replicated, silently un-sharding the cache between decode steps."""
 
     write: jax.Array
     read: jax.Array
     pool_positions: int = flax.struct.field(pytree_node=False, default=0)
+    pool_sharding: Any = flax.struct.field(pytree_node=False, default=None)
 
 
 class Embed(nn.Module):
@@ -284,6 +291,11 @@ class Attention(nn.Module):
             )
             k_pool = paged_k.value.at[slots.write].set(k)
             v_pool = paged_v.value.at[slots.write].set(v)
+            if slots.pool_sharding is not None:
+                k_pool = jax.lax.with_sharding_constraint(
+                    k_pool, slots.pool_sharding)
+                v_pool = jax.lax.with_sharding_constraint(
+                    v_pool, slots.pool_sharding)
             paged_k.value = k_pool
             paged_v.value = v_pool
             # Gather preserves logical order, so a row's [L] view is

@@ -42,9 +42,21 @@ contribute exact zeros (the -1e30 bias underflows exp to 0.0), and the
 first-token sampling replays ``generate._prefill_parts``' rng recipe op
 for op.  Pinned by tests/test_scheduler.py's paged matrix.
 
-``KFT_SERVE_PAGED=0`` (or a mesh) falls back to the fixed-slot
-DecodeScheduler unchanged; this module is single-host (the paged pool is
-not mesh-sharded yet — see docs/serving.md "Paged KV and prefix reuse").
+GSPMD: pass ``mesh`` and the flat pool shards over the POOL-POSITION
+axis across the data axes (parallel/sharding.page_pool_spec), with
+``num_pages`` rounded up so shard boundaries align with page boundaries
+— the host-side page tables, free list and prefix trie are untouched
+(they only ever produce flat int indices, and gathers/scatters through
+them partition like any other indexed op).  Per-lane arrays stay
+replicated: lanes are the (tiny) batch axis of the compiled step, and
+splitting them would couple lane count to mesh shape.  Speculative
+decoding is the one unsupported combination (the draft pool's lockstep
+mirroring is not mesh-aware yet) — a draft model plus a mesh raises at
+construction, and serve.py records the fallback reason.
+
+``KFT_SERVE_PAGED=0`` falls back to the fixed-slot DecodeScheduler
+unchanged (``serve_paged_fallback_total`` counts why — see
+docs/serving.md "Sharded paged serving").
 """
 from __future__ import annotations
 
@@ -62,6 +74,7 @@ from kubeflow_tpu.models.scheduler import (
     DEFAULT_PRIORITY,
     DecodeScheduler,
     PendingRequest,
+    _Inflight,
     _NEG_INF,
     _Slot,
 )
@@ -146,12 +159,11 @@ def _sample_first(last_logits, rng, temps, top_ks, eos_ids, has_eos, *,
     op the tail of ``generate._prefill_parts`` (split(rng, b) → per-row
     split → sample_logits_rows), so the paged first token is
     byte-identical to the sequential path's."""
-    from kubeflow_tpu.models.generate import sample_logits_rows
+    from kubeflow_tpu.models.generate import (
+        sample_logits_rows, split_row_rngs)
 
     b = last_logits.shape[0]
-    row_rngs = jax.random.split(rng, b)
-    split2 = jax.vmap(jax.random.split)(row_rngs)
-    row_rngs, subs = split2[:, 0], split2[:, 1]
+    row_rngs, subs = split_row_rngs(jax.random.split(rng, b))
     first = sample_logits_rows(last_logits, subs, temps=temps,
                                top_ks=top_ks, sampled=sampled)
     done0 = has_eos & (first == eos_ids)
@@ -161,12 +173,13 @@ def _sample_first(last_logits, rng, temps, top_ks, eos_ids, has_eos, *,
 @functools.partial(
     jax.jit,
     static_argnames=("model", "quantum", "sampled", "page_len",
-                     "pool_positions"),
+                     "pool_positions", "pool_ns"),
     donate_argnums=(1,),
 )
 def _paged_pool_steps(model, cache, params, token, pos, write, rngs, done,
                       pad_rows, page_rows, temps, top_ks, eos_ids, has_eos,
-                      *, quantum, sampled, page_len, pool_positions):
+                      *, quantum, sampled, page_len, pool_positions,
+                      pool_ns=None):
     """``quantum`` decode steps over the paged pool — the exact
     ``scheduler._pool_steps`` body with the per-row write index resolved
     through the page table into flat pool positions.  Vacated lanes keep
@@ -191,7 +204,8 @@ def _paged_pool_steps(model, cache, params, token, pos, write, rngs, done,
         bias = (jnp.where(allowed, 0.0, _NEG_INF)[:, None, None, :]
                 + pad_rows[:, None, None, :])
         ps = PagedSlots(write=flat_w[:, None], read=read,
-                        pool_positions=pool_positions)
+                        pool_positions=pool_positions,
+                        pool_sharding=pool_ns)
         cache, nxt, pos, rngs, done = decode_step(
             model, params, cache, token, pos, rngs, done, bias,
             cache_len=L, temps=temps, top_ks=top_ks, eos_ids=eos_ids,
@@ -494,18 +508,38 @@ class PagedDecodeScheduler(DecodeScheduler):
     """
 
     def __init__(self, model, params, *, slots=None, slot_len=None,
-                 quantum=None, mesh=None, telemetry=None,
+                 quantum=None, mesh=None, pipeline=None, telemetry=None,
                  page_len=None, num_pages=None, prefill_chunk=None,
                  spec_tokens=None, draft_model=None, draft_params=None,
                  prefix_cache=None):
-        if mesh is not None:
-            # The flat pool has no batch axis to shard; SPMD serving
-            # stays on the fixed-slot scheduler (serve.py routes there).
+        if mesh is not None and draft_model is not None:
+            # The draft pool mirrors the target's pages in lockstep from
+            # host-built chunk slots; that mirroring is not mesh-aware
+            # yet, and a silently-replicated draft pool would defeat the
+            # sharding.  serve.py catches this and records the fallback
+            # reason (spec-decode-mesh).
             raise ValueError(
-                "PagedDecodeScheduler does not support a mesh; use "
-                "DecodeScheduler for SPMD serving")
+                "speculative decoding under a mesh is not supported: "
+                "drop --draft-model or the mesh (serve.py falls back to "
+                "the fixed-slot scheduler for this combination)")
         super().__init__(model, params, slots=slots, slot_len=slot_len,
-                         quantum=quantum, mesh=None, telemetry=telemetry)
+                         quantum=quantum, mesh=mesh, pipeline=pipeline,
+                         telemetry=telemetry)
+        # GSPMD pool layout (module docstring): the flat pool shards
+        # over its pool-position axis across the data axes; a tp-only
+        # mesh has no data axis, so the pool stays replicated (shards=1)
+        # while the params still run tensor-parallel.
+        self._page_ns = None
+        self.pool_shards = 1
+        if mesh is not None:
+            from kubeflow_tpu.parallel.sharding import (
+                page_pool_shards,
+                page_pool_sharding,
+            )
+
+            self.pool_shards = page_pool_shards(mesh)
+            if self.pool_shards > 1:
+                self._page_ns = page_pool_sharding(mesh)
         self.page_len = page_len or config.knob(
             "KFT_SERVE_PAGE_LEN", 64, int,
             doc="Paged-KV page size in tokens (models/paged.py); the "
@@ -530,6 +564,13 @@ class PagedDecodeScheduler(DecodeScheduler):
                 f"KFT_SERVE_PAGES {self.num_pages} cannot hold one "
                 f"full-length row ({self.max_pages_row} pages) plus the "
                 f"null page")
+        if self.num_pages % self.pool_shards:
+            # Round UP to a shard multiple: every shard then holds whole
+            # pages (the page-axis sharding rule — a page never
+            # straddles devices) and the pool axis divides evenly at
+            # device_put.  Extra pages only add capacity.
+            self.num_pages += (self.pool_shards
+                               - self.num_pages % self.pool_shards)
         self.pool_positions = self.num_pages * self.page_len
         self.prefill_chunk = prefill_chunk if prefill_chunk is not None \
             else config.knob(
@@ -619,6 +660,7 @@ class PagedDecodeScheduler(DecodeScheduler):
             "prefix_misses": self.prefix.misses if self.prefix else 0,
             "spec_proposed": self._spec_proposed_total,
             "spec_accepted": self._spec_accepted_total,
+            "pool_shards": self.pool_shards,
         })
         return out
 
@@ -640,14 +682,35 @@ class PagedDecodeScheduler(DecodeScheduler):
         self._cache = _init_paged_pool(
             self.model, self.params, lanes=self.slots,
             slot_len=self.slot_len, pool_positions=self.pool_positions)
+        if self._page_ns is not None:
+            # Place every pool leaf sharded over its pool-position axis
+            # (ndim - 3: leaves are [pool, kv_h, d], or
+            # [layers, pool, kv_h, d] under scan_layers).  The in-step
+            # scatter output is pinned back to this layout by
+            # layers.PagedSlots.pool_sharding, so the pool never
+            # silently materializes replicated between quanta.
+            from jax.sharding import NamedSharding
+
+            from kubeflow_tpu.parallel.sharding import page_pool_spec
+
+            def place(x):
+                return jax.device_put(x, NamedSharding(
+                    self.mesh, page_pool_spec(self.mesh, x.ndim)))
+
+            self._cache = jax.tree.map(
+                lambda x: place(x) if getattr(x, "ndim", 0) >= 3 else x,
+                self._cache)
+        self._rngs = jax.random.split(jax.random.key(0), self.slots)
         if self.draft_model is not None and self.spec_tokens >= 1:
             self._draft_cache = _init_paged_pool(
                 self.draft_model, self.draft_params, lanes=self.slots,
                 slot_len=self.slot_len,
                 pool_positions=self.pool_positions)
-        self._rngs = jax.random.split(jax.random.key(0), self.slots)
         self._pad_rows = jnp.full(
             (self.slots, self.slot_len), _NEG_INF, jnp.float32)
+        tel = self._telemetry()
+        if tel is not None and hasattr(tel, "page_pool_shards"):
+            tel.page_pool_shards.set(self.pool_shards)
         self._update_page_metrics()
 
     def _page_rows_np(self) -> np.ndarray:
@@ -842,7 +905,8 @@ class PagedDecodeScheduler(DecodeScheduler):
             for pages in st.pages])
         return PagedSlots(write=jnp.asarray(write, jnp.int32),
                           read=jnp.asarray(st.read_np, jnp.int32),
-                          pool_positions=self.pool_positions)
+                          pool_positions=self.pool_positions,
+                          pool_sharding=self._page_ns)
 
     def _advance_prefill(self, st: _PrefillState):
         """One prefill chunk on the device; on the last chunk, sample
@@ -969,10 +1033,21 @@ class PagedDecodeScheduler(DecodeScheduler):
                 return False
         return any_live
 
-    def _run_quantum(self):
+    def _pre_dispatch_sync(self) -> bool:
+        """Paged sync points, on top of the base carry-rebuild rule:
+        speculative decisioning (``_spec_ready``) and the spec step
+        itself read host write pointers, so with a draft model attached
+        the pending harvest always lands first (pipelining then overlaps
+        only admission work — the draft path trades overlap for exact
+        lockstep pointers)."""
+        if self._carry is None or self._draft_cache is not None:
+            self._harvest()
         if self._spec_ready():
             self._run_spec_step()
-            return
+            return True
+        return not any(s is not None for s in self._slot_state)
+
+    def _dispatch_quantum(self):
         state = self._slot_state
         if self._carry is None:
             temps = [s.temp if s else 0.0 for s in state]
@@ -993,10 +1068,14 @@ class PagedDecodeScheduler(DecodeScheduler):
             )
         (token, pos, write, done, temps_d, top_ks_d, eos_d, has_eos_d,
          sampled) = self._carry
-        # The page table re-uploads every quantum (tiny int array): an
-        # eviction between quanta zeroes its lane row here, redirecting
-        # zombie writes to the null page BEFORE the freed pages can be
-        # handed to a new admission.
+        # The page table re-uploads every dispatch (tiny int array): an
+        # eviction zeroes its lane row here, redirecting zombie writes
+        # to the null page.  Under pipelining one in-flight quantum may
+        # still carry the PREVIOUS table — safe: the zombie then writes
+        # its own already-released pages, and any new occupant of those
+        # pages prefills strictly after it on the device stream (the
+        # donated-cache dependency chain), overwriting every position
+        # its mask will ever expose.
         page_rows = jnp.asarray(self._page_rows_np())
         (self._cache, token, pos, write, self._rngs, done, toks,
          dones) = _paged_pool_steps(
@@ -1005,29 +1084,16 @@ class PagedDecodeScheduler(DecodeScheduler):
             self._pad_rows, page_rows, temps_d, top_ks_d, eos_d,
             has_eos_d, quantum=self.quantum, sampled=sampled,
             page_len=self.page_len, pool_positions=self.pool_positions,
+            pool_ns=self._page_ns,
         )
         self._carry = (token, pos, write, done, temps_d, top_ks_d, eos_d,
                        has_eos_d, sampled)
-        toks_h, dones_h = jax.device_get((toks, dones))
-        self._steps_total += self.quantum
-        tel = self._telemetry()
-        active = sum(s is not None for s in state)
-        if tel is not None:
-            tel.batch_fill_ratio.observe(active / max(self.slots, 1))
-            tel.slots_active.set(active)
-        for i, slot in enumerate(state):
-            if slot is None:
-                continue
-            for t in range(self.quantum):
-                if len(slot.collected) >= slot.budget:
-                    break
-                slot.collected.append(int(toks_h[t, i]))
-                slot.done = bool(dones_h[t, i])
-            slot.token = int(toks_h[self.quantum - 1, i])
-            slot.pos += self.quantum
-            slot.write += self.quantum
-            if slot.done or len(slot.collected) >= slot.budget:
-                self._evict(i)
+        if self._t_cycle_mark is None:
+            self._t_cycle_mark = time.perf_counter()
+        return _Inflight(toks, dones, list(state), self.quantum)
+
+    def _harvest_handle(self, h):
+        super()._harvest_handle(h)
         self._update_page_metrics()
 
     def _run_spec_step(self):

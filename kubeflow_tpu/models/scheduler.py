@@ -52,6 +52,19 @@ GSPMD: pass ``mesh`` to run the same loop over a sharded model — params
 come pre-sharded (parallel/sharding.shard_params via serve.load_service
 --mesh), the slot pool's batch axis is placed with ``batch_sharding``,
 and XLA inserts the collectives inside the one compiled step.
+
+Pipelined dispatch (``KFT_SERVE_PIPELINE``, default on): the loop
+dispatches quantum N+1 from the device-resident carry BEFORE blocking on
+quantum N's host-visible tokens, so Python bookkeeping (token
+collection, eviction, admission prep) overlaps device execution instead
+of serializing with it.  At most ONE quantum is un-harvested; the
+harvest credits tokens against a dispatch-time slot snapshot (a lane
+re-occupied mid-flight can never inherit its predecessor's zombie
+tokens), and any sync point that reads host pointers — carry rebuild
+after an admission, speculative steps — harvests first.  Token streams
+are byte-identical to the synchronous loop: the carry chains purely on
+device, and an eviction merely lands one harvest later (pinned by
+tests/test_paged.py's determinism A/B).
 """
 from __future__ import annotations
 
@@ -260,6 +273,25 @@ class _Slot:
         self.has_eos = req.eos_token is not None
 
 
+class _Inflight:
+    """One dispatched-but-unharvested quantum: the device output handles
+    (futures under async dispatch — touching them does NOT block) plus a
+    snapshot of the lanes that were live at dispatch.  The harvest
+    collects tokens against the SNAPSHOT, and only for lanes whose
+    occupant is still the same slot object — a lane evicted (and
+    possibly re-filled) between dispatch and harvest contributes zombie
+    tokens that must be discarded, exactly as the synchronous loop never
+    would have stepped it."""
+
+    __slots__ = ("toks", "dones", "snapshot", "quantum")
+
+    def __init__(self, toks, dones, snapshot, quantum):
+        self.toks = toks
+        self.dones = dones
+        self.snapshot = snapshot
+        self.quantum = quantum
+
+
 class DecodeScheduler:
     """The continuous-batching engine: one background thread owns the
     device (prefills at admission, one compiled pool step for decode);
@@ -282,6 +314,7 @@ class DecodeScheduler:
                  slot_len: Optional[int] = None,
                  quantum: Optional[int] = None,
                  mesh=None,
+                 pipeline: Optional[bool] = None,
                  telemetry: Optional[Callable[[], object]] = None):
         self.model = model
         self.params = params
@@ -296,6 +329,11 @@ class DecodeScheduler:
         self.quantum = quantum or config.env_int(
             "KFT_SERVE_DECODE_QUANTUM", 8)
         self.mesh = mesh
+        # Pipelined dispatch (module docstring): overlap host bookkeeping
+        # with device execution.  KFT_SERVE_PIPELINE=0 pins the
+        # synchronous loop (the bench A/B arm and a rollback lever).
+        self.pipeline = pipeline if pipeline is not None else \
+            config.env_bool("KFT_SERVE_PIPELINE", True)
         # Zero-arg callable so a service can re-attach telemetry (every
         # create_app builds a fresh registry) without a stale reference
         # pinning dead instruments.
@@ -317,6 +355,13 @@ class DecodeScheduler:
         self._rngs = None
         self._pad_rows = None
         self._carry = None
+        # The one un-harvested quantum (pipelined dispatch); plus the
+        # overlap accounting the serve_dispatch_overlap_ratio gauge and
+        # the bench A/B read.
+        self._inflight: Optional[_Inflight] = None
+        self._blocked_s = 0.0
+        self._cycle_s = 0.0
+        self._t_cycle_mark: Optional[float] = None
         self._batch_ns = None
         if mesh is not None:
             from kubeflow_tpu.parallel.sharding import batch_sharding
@@ -407,6 +452,12 @@ class DecodeScheduler:
             "steps_total": self._steps_total,
             "slots": self.slots,
             "slot_len": self.slot_len,
+            "pipeline": self.pipeline,
+            "dispatch_blocked_s": round(self._blocked_s, 6),
+            "dispatch_cycle_s": round(self._cycle_s, 6),
+            "dispatch_overlap_ratio": round(
+                1.0 - self._blocked_s / self._cycle_s, 6)
+            if self._cycle_s > 0 else 0.0,
         }
 
     # -- loop thread ------------------------------------------------------
@@ -418,6 +469,7 @@ class DecodeScheduler:
                 with self._cond:
                     while (not self._stop_flag and not self._queue
                            and not self._pending_rows
+                           and self._inflight is None
                            and all(s is None for s in self._slot_state)):
                         self._cond.wait()
                     if self._stop_flag:
@@ -425,6 +477,12 @@ class DecodeScheduler:
                 self._admit()
                 if any(s is not None for s in self._slot_state):
                     self._run_quantum()
+                else:
+                    # Every lane drained at the last harvest while one
+                    # more quantum was already in flight: drain it (all
+                    # its lanes are zombies by construction) before
+                    # sleeping, so its device buffers free.
+                    self._harvest()
         except BaseException as exc:  # noqa: BLE001 — fail every waiter
             self._dead = exc
             self._fail_outstanding(exc)
@@ -613,8 +671,60 @@ class DecodeScheduler:
         self._carry = None
 
     def _run_quantum(self):
-        """One compiled multi-step dispatch over the pool, then host-side
-        collection and eviction.
+        """One decode quantum, pipelined: dispatch quantum N+1 from the
+        device-resident carry FIRST, then harvest quantum N's tokens —
+        the host-side collection/eviction work overlaps N+1's device
+        execution instead of serializing with it.  At most one quantum
+        is ever un-harvested.  ``pipeline=False`` harvests its own
+        dispatch immediately (the synchronous loop, token-identical by
+        the snapshot discipline — see ``_Inflight``)."""
+        if self._pre_dispatch_sync():
+            return
+        prev = self._inflight
+        if prev is not None and self._inflight_ready(prev):
+            # Opportunistic harvest: quantum N's tokens are ALREADY
+            # host-visible, so harvesting first costs no wait and gets
+            # its evictions (and any admission they unblock) into
+            # quantum N+1 instead of burning a zombie quantum on rows
+            # that finished.  On a genuinely async device the tokens
+            # are still in flight here and the dispatch keeps its head
+            # start — this fast path only fires when the pipeline has
+            # nothing left to hide.
+            self._inflight = None
+            self._harvest_handle(prev)
+            prev = None
+            self._admit()
+            if self._pre_dispatch_sync():
+                return
+        self._inflight = self._dispatch_quantum()
+        if prev is not None:
+            self._harvest_handle(prev)
+        if not self.pipeline:
+            self._harvest()
+
+    @staticmethod
+    def _inflight_ready(h: _Inflight) -> bool:
+        """Whether a dispatched quantum's results are already on host —
+        a committed-transfer check, never a wait."""
+        try:
+            return h.toks.is_ready() and h.dones.is_ready()
+        except AttributeError:  # pragma: no cover — older jax.Array
+            return False
+
+    def _pre_dispatch_sync(self) -> bool:
+        """Pipeline sync point: a cleared carry means an admission
+        changed the pool, and its rebuild reads host pointers
+        (token/pos/write) that only the pending harvest can update — so
+        harvest BEFORE rebuilding.  Returns True when the harvest's
+        evictions leave nothing to dispatch."""
+        if self._carry is None:
+            self._harvest()
+        return not any(s is not None for s in self._slot_state)
+
+    def _dispatch_quantum(self) -> _Inflight:
+        """Launch one compiled multi-step dispatch over the pool and
+        return the un-harvested handle (async dispatch: this does not
+        block on the results).
 
         The device-side carry (token/pos/write/done + the per-row
         sampling arrays) round-trips between quanta WITHOUT touching the
@@ -622,7 +732,7 @@ class DecodeScheduler:
         admission changed the pool (``_place`` clears it).  Evictions
         deliberately do NOT invalidate it — a vacated slot keeps
         stepping as a zombie whose writes stay clamped inside its own
-        (masked) region and whose tokens the host discards; the next
+        (masked) region and whose tokens the harvest discards; the next
         occupant overwrites everything that matters at placement."""
         state = self._slot_state
         if self._carry is None:
@@ -655,24 +765,51 @@ class DecodeScheduler:
         )
         self._carry = (token, pos, write, done, temps_d, top_ks_d, eos_d,
                        has_eos_d, sampled)
-        toks_h, dones_h = jax.device_get((toks, dones))
-        self._steps_total += self.quantum
+        if self._t_cycle_mark is None:
+            self._t_cycle_mark = time.perf_counter()
+        return _Inflight(toks, dones, list(state), self.quantum)
+
+    def _harvest(self):
+        if self._inflight is not None:
+            handle, self._inflight = self._inflight, None
+            self._harvest_handle(handle)
+
+    def _harvest_handle(self, h: _Inflight):
+        """Block on one dispatched quantum's tokens, then run the host
+        bookkeeping: token collection, EOS/budget eviction, overlap
+        accounting.  Collection goes by the dispatch-time snapshot and
+        skips any lane whose occupant changed since (see ``_Inflight``)."""
+        t0 = time.perf_counter()
+        toks_h, dones_h = jax.device_get((h.toks, h.dones))
+        t1 = time.perf_counter()
+        # Overlap ratio: the fraction of each dispatch→harvest cycle the
+        # host was NOT blocked in device_get.  The synchronous loop runs
+        # the whole quantum inside that wait; pipelining moves the wait
+        # behind the bookkeeping of the previous quantum.
+        self._blocked_s += t1 - t0
+        self._cycle_s += t1 - self._t_cycle_mark
+        self._t_cycle_mark = t1
+        self._steps_total += h.quantum
         tel = self._telemetry()
-        active = sum(s is not None for s in state)
+        active = sum(s is not None for s in h.snapshot)
         if tel is not None:
             tel.batch_fill_ratio.observe(active / max(self.slots, 1))
-            tel.slots_active.set(active)
-        for i, slot in enumerate(state):
-            if slot is None:
+            tel.slots_active.set(
+                sum(s is not None for s in self._slot_state))
+            if self._cycle_s > 0 and hasattr(tel, "dispatch_overlap"):
+                tel.dispatch_overlap.set(
+                    1.0 - self._blocked_s / self._cycle_s)
+        for i, slot in enumerate(h.snapshot):
+            if slot is None or self._slot_state[i] is not slot:
                 continue
-            for t in range(self.quantum):
+            for t in range(h.quantum):
                 if len(slot.collected) >= slot.budget:
                     break
                 slot.collected.append(int(toks_h[t, i]))
                 slot.done = bool(dones_h[t, i])
-            slot.token = int(toks_h[self.quantum - 1, i])
-            slot.pos += self.quantum
-            slot.write += self.quantum
+            slot.token = int(toks_h[h.quantum - 1, i])
+            slot.pos += h.quantum
+            slot.write += h.quantum
             if slot.done or len(slot.collected) >= slot.budget:
                 self._evict(i)
 
@@ -699,6 +836,10 @@ class DecodeScheduler:
             req.done.set()
 
     def _fail_outstanding(self, exc: BaseException):
+        # Drop the un-harvested quantum, if any: its snapshot slots are
+        # failed below, and a dead/stopped scheduler must not block on
+        # device results nobody will read.
+        self._inflight = None
         with self._cond:
             queued = list(self._queue)
             self._queue.clear()

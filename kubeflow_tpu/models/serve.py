@@ -202,6 +202,13 @@ class GenerationService:
         # plain GenerationService(model, params).generate() call.
         self._use_scheduler = use_scheduler
         self._scheduler = None
+        # Structured paged-engine fallback record ({reason, detail}, or
+        # None while the paged engine serves) — surfaced by /debug/serve
+        # and counted by serve_paged_fallback_total{reason}.  A mesh run
+        # no longer falls back silently: the paged pool shards over the
+        # page axis (models/paged.py), so only genuinely unsupported
+        # combinations land here.
+        self.scheduler_fallback = None
         # generate() donates nothing but jit compilation is per-shape; a
         # lock keeps concurrent requests from racing device memory on tiny
         # single-chip deployments.
@@ -226,17 +233,30 @@ class GenerationService:
 
                 # The paged engine (block-paged KV + prefix reuse +
                 # chunked prefill + optional speculative decoding) is
-                # the default; KFT_SERVE_PAGED=0 pins the PR-7
-                # fixed-slot pool.  The paged pool is not mesh-sharded
-                # yet, so SPMD serving always takes the fixed path.
-                if self.mesh is None and _config.env_bool(
-                        "KFT_SERVE_PAGED", True):
+                # the default, mesh or not — under a mesh the pool
+                # shards over the page axis (models/paged.py).  The
+                # remaining fallbacks are explicit and RECORDED
+                # (serve_paged_fallback_total + /debug/serve): a silent
+                # drop to the fixed pool cost PR 17's wins exactly on
+                # the sharded deployments that serve the most traffic.
+                reason = detail = None
+                if not _config.env_bool("KFT_SERVE_PAGED", True):
+                    reason = "env-disabled"
+                    detail = ("KFT_SERVE_PAGED=0 pins the fixed-slot "
+                              "pool")
+                elif self.mesh is not None \
+                        and self.draft_model is not None:
+                    reason = "spec-decode-mesh"
+                    detail = ("speculative decoding is not mesh-aware; "
+                              "the fixed-slot pool serves this mesh "
+                              "and the draft model is inert")
+                if reason is None:
                     from kubeflow_tpu.models.paged import (
                         PagedDecodeScheduler,
                     )
 
                     self._scheduler = PagedDecodeScheduler(
-                        self.model, self.params,
+                        self.model, self.params, mesh=self.mesh,
                         telemetry=lambda: self.telemetry,
                         draft_model=self.draft_model,
                         draft_params=self.draft_params,
@@ -246,6 +266,12 @@ class GenerationService:
                         DecodeScheduler,
                     )
 
+                    self.scheduler_fallback = {
+                        "reason": reason, "detail": detail}
+                    if self.telemetry is not None and hasattr(
+                            self.telemetry, "paged_fallback"):
+                        self.telemetry.paged_fallback.labels(
+                            reason=reason).inc()
                     self._scheduler = DecodeScheduler(
                         self.model, self.params, mesh=self.mesh,
                         telemetry=lambda: self.telemetry,
@@ -617,6 +643,31 @@ def create_app(service: GenerationService, *, model_name: str = "model",
         if body is None:
             raise HttpError(404, "no such profile window")
         return Response(body, mimetype="text/plain")
+
+    @app.route("/debug/serve")
+    def debug_serve(request):
+        # The serving-engine debug surface (/debug/knobs sibling): which
+        # scheduler actually serves, the STRUCTURED paged-fallback
+        # reason when the fixed pool took over (counted by
+        # serve_paged_fallback_total), live scheduler stats (pool
+        # shards, dispatch-overlap ratio, page states), and the knob
+        # registry snapshot.  Same gate as the other debug routes.
+        if not debug_traces_enabled:
+            raise HttpError(404, "debug traces disabled")
+        sched = getattr(service, "_scheduler", None)
+        engine = None
+        if sched is not None:
+            engine = type(sched).__name__
+        return success({
+            "engine": engine,
+            "mesh": (dict(service.mesh.shape)
+                     if getattr(service, "mesh", None) is not None
+                     else None),
+            "paged_fallback": getattr(service, "scheduler_fallback",
+                                      None),
+            "scheduler": sched.stats() if sched is not None else None,
+            "knobs": _config.effective(),
+        })
 
     @app.route("/metrics")
     def metrics(request):

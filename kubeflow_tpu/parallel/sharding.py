@@ -30,6 +30,12 @@ from typing import Any, Sequence, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# Partition-invariant threefry — random streams must not depend on how
+# GSPMD shards the operands (see models/generate.py for the serving-side
+# rationale; here it keeps init/dropout streams stable across mesh
+# shapes).  Idempotent with generate.py's identical update.
+jax.config.update("jax_threefry_partitionable", True)
+
 Rules = Sequence[Tuple[str, P]]
 
 
@@ -215,6 +221,36 @@ def batch_sharding(mesh: Mesh, *, seq_axis: bool = False) -> NamedSharding:
     if seq_axis:
         return NamedSharding(mesh, P(data_axes(mesh), "sp"))
     return NamedSharding(mesh, P(data_axes(mesh)))
+
+
+def page_pool_shards(mesh: Mesh) -> int:
+    """How many shards the paged-KV pool axis splits into on ``mesh`` —
+    the product of the data-axis sizes (the tp/sp axes never split the
+    pool: K/V heads already shard over tp inside each position)."""
+    import math as _math
+
+    return _math.prod(mesh.shape[a] for a in data_axes(mesh)) or 1
+
+
+def page_pool_spec(mesh: Mesh, ndim: int) -> P:
+    """Partition spec for one paged-KV cache leaf: shard the flat
+    pool-position axis over the data axes, replicate everything else.
+
+    Pool leaves are [pool_positions, kv_h, d] (ndim 3) or, under
+    scan_layers, [layers, pool_positions, kv_h, d] (ndim 4) — the pool
+    axis is always ``ndim - 3``.  models/paged.py rounds ``num_pages``
+    up to a multiple of ``page_pool_shards`` so shard boundaries always
+    align with page boundaries: a page never straddles two devices, and
+    every page-table indirection resolves within one shard's rows."""
+    spec = [None] * ndim
+    spec[ndim - 3] = data_axes(mesh)
+    return P(*spec)
+
+
+def page_pool_sharding(mesh: Mesh, ndim: int = 3) -> NamedSharding:
+    """``NamedSharding`` form of :func:`page_pool_spec` (rank-3 default:
+    the in-module view layers.Attention._update_cache constrains)."""
+    return NamedSharding(mesh, page_pool_spec(mesh, ndim))
 
 
 def infer_state_shardings(state: Any, mesh: Mesh, rules: Rules) -> Any:

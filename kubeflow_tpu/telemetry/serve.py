@@ -174,6 +174,32 @@ class ServeTelemetry:
             "step is not counted)",
             registry=registry,
         )
+        # Sharded paged serving + pipelined dispatch (ISSUE 20).
+        self.page_pool_shards = Gauge(
+            "serve_page_pool_shards",
+            "Shards the paged-KV pool axis splits into over the serving "
+            "mesh's data axes (1 = unsharded/replicated pool; set when "
+            "the pool is built)",
+            registry=registry,
+        )
+        self.dispatch_overlap = Gauge(
+            "serve_dispatch_overlap_ratio",
+            "Fraction of each decode dispatch->harvest cycle the "
+            "scheduler host thread was NOT blocked on device results "
+            "(cumulative since start; the synchronous loop spends the "
+            "whole quantum blocked, pipelined dispatch hides the wait "
+            "behind bookkeeping)",
+            registry=registry,
+        )
+        self.paged_fallback = Counter(
+            "serve_paged_fallback_total",
+            "Times the service routed to the fixed-slot scheduler "
+            "instead of the paged engine, by structured reason "
+            "(env-disabled = KFT_SERVE_PAGED=0, spec-decode-mesh = "
+            "draft model under a mesh); /debug/serve carries the "
+            "human-readable detail",
+            ["reason"], registry=registry,
+        )
 
     # -- request lifecycle ----------------------------------------------------
 

@@ -246,9 +246,12 @@ def test_seq2seq_stays_on_lock_path(monkeypatch):
 
 
 def test_sharded_serve_scheduler_token_equal(devices8):
-    """ISSUE 8 acceptance: the scheduler drives a GSPMD-sharded model on
-    8 forced host devices — params via shard_params, slot-pool batch
-    axis via batch_sharding — token-equal to the unsharded path."""
+    """ISSUE 8 acceptance, extended by ISSUE 20: the service drives a
+    GSPMD-sharded model on 8 forced host devices — params via
+    shard_params, and (now that the mesh routes to the paged engine) the
+    page pool split over the data axes — token-equal to the unsharded
+    path."""
+    from kubeflow_tpu.models.paged import PagedDecodeScheduler
     from kubeflow_tpu.models.serve import load_service
 
     plain = load_service("llama_debug", max_seq_len=64)
@@ -261,14 +264,19 @@ def test_sharded_serve_scheduler_token_equal(devices8):
     a = plain.generate(rows, max_new_tokens=6)
     b = spmd.generate(rows, max_new_tokens=6)
     assert a == b
-    # Both requests really ran through schedulers, and the sharded one's
-    # slot pool is distributed: params AND the pool cache span devices.
+    # Both requests really ran through schedulers; the sharded service
+    # routes to the paged engine (no fallback recorded) and its pool —
+    # rank-3 [pool_positions, kv_heads, head_dim] leaves — is split on
+    # the pool axis across the fsdp=4 data devices.
     sched = spmd._scheduler
-    assert sched is not None and sched.stats()["evicted_total"] >= 2
+    assert isinstance(sched, PagedDecodeScheduler)
+    assert spmd.scheduler_fallback is None
+    assert sched.stats()["evicted_total"] >= 2
+    assert sched.stats()["pool_shards"] == 4
     leaf = jax.tree.leaves(spmd.params)[0]
     assert len(leaf.sharding.device_set) > 1
     cache_leaf = next(x for x in jax.tree.leaves(sched._cache)
-                      if getattr(x, "ndim", 0) >= 4)
+                      if getattr(x, "ndim", 0) >= 3)
     assert len(cache_leaf.sharding.device_set) > 1
 
 
@@ -504,14 +512,26 @@ def test_paged_submit_over_page_capacity_raises(model_and_params):
         paged.submit([[1, 2]] * 4, max_new_tokens=30)
 
 
-def test_paged_rejects_mesh(model_and_params):
+def test_paged_rejects_spec_decode_under_mesh(model_and_params):
+    """ISSUE 20 lifts the blanket mesh rejection — a mesh is now a
+    first-class paged configuration — but speculative decoding under a
+    mesh stays unsupported and must fail at construction, not on the
+    first spec step."""
+    from kubeflow_tpu.models.serve import load_service
+    from kubeflow_tpu.train.run import parse_mesh
+
     model, params = model_and_params
-
-    class FakeMesh:
-        pass
-
-    with pytest.raises(ValueError, match="mesh"):
-        PagedDecodeScheduler(model, params, mesh=FakeMesh())
+    mesh = parse_mesh("tp=%d" % len(jax.devices()), len(jax.devices()))
+    draft = load_service("llama_debug", max_seq_len=64)
+    with pytest.raises(ValueError, match="[Ss]peculative"):
+        PagedDecodeScheduler(model, params, mesh=mesh,
+                             draft_model=draft.model,
+                             draft_params=draft.params)
+    # Mesh alone constructs fine (tp-only → a single replicated shard).
+    sched = PagedDecodeScheduler(model, params, mesh=mesh, slots=2,
+                                 slot_len=64, page_len=16)
+    assert sched.pool_shards == 1
+    sched.stop()
 
 
 @pytest.mark.slow
